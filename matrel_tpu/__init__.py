@@ -1,0 +1,27 @@
+"""matrel_tpu — a TPU-native rebuild of purduedb/MatRel.
+
+Distributed relational linear algebra on JAX/XLA: block-partitioned matrices
+as mesh-sharded jax.Arrays, a Catalyst-style algebraic optimizer with
+matrix-chain DP reordering, cost-based physical matmul strategies lowering to
+ICI collectives, and relational operators (σ/γ/⋈) over matrices.
+
+See SURVEY.md for the reference layer map this package mirrors.
+"""
+
+from matrel_tpu.config import MatrelConfig, default_config, set_default_config
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.core.mesh import make_mesh
+from matrel_tpu.executor import CompiledPlan, compile_expr, execute
+from matrel_tpu.ir.expr import MatExpr, as_expr, leaf
+from matrel_tpu.session import MatrelSession, get_or_create_session, reset_session
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MatrelConfig", "default_config", "set_default_config",
+    "BlockMatrix", "make_mesh",
+    "CompiledPlan", "compile_expr", "execute",
+    "MatExpr", "as_expr", "leaf",
+    "MatrelSession", "get_or_create_session", "reset_session",
+    "__version__",
+]

@@ -1,0 +1,103 @@
+"""Typed configuration for matrel_tpu.
+
+The reference (purduedb/MatRel) configures itself through SparkConf key-value
+pairs (``spark.matfast.*`` keys — block size, broadcast threshold; see
+SURVEY.md §5 "Config / flag system"). The TPU-native equivalent is a small
+frozen dataclass threaded through the session, overridable from environment
+variables (``MATREL_*``) or a plain dict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Mapping, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class MatrelConfig:
+    """Global knobs for planning and execution.
+
+    Attributes:
+      block_size: logical tile edge used for block-level reasoning (sparsity
+        masks, cost model granularity). The reference defaults to 1000x1000
+        MLlib blocks; on TPU we default to 512, a multiple of the 128-lane
+        MXU tiling.
+      mesh_shape: (rows, cols) of the 2D device mesh. ``None`` → derive a
+        near-square mesh from ``jax.device_count()``.
+      mesh_axis_names: names of the two mesh axes.
+      broadcast_threshold_bytes: operands smaller than this are planned as
+        Broadcast-MM (replicated sharding) — the analogue of MatRel's
+        broadcast-variable threshold.
+      strategy_override: force one of {"bmm", "cpmm", "rmm", "auto"} for
+        every matmul, bypassing the cost model. "auto" = cost-based.
+      sparsity_threshold: density below which a matrix is considered sparse
+        by the planner/cost model.
+      default_dtype: dtype for constructors that don't specify one.
+      matmul_precision: jax.lax precision for dot_general ("default",
+        "high", "highest"). bfloat16 inputs + "highest" ≈ f32 accumulate.
+      use_pallas: enable hand-written Pallas kernels where available.
+      chain_opt: enable the matrix-chain DP reorder.
+      rewrite_rules: enable the algebraic rewrite pass.
+      donate_intermediates: donate chain intermediates to XLA where legal.
+    """
+
+    block_size: int = 512
+    mesh_shape: Optional[Tuple[int, int]] = None
+    mesh_axis_names: Tuple[str, str] = ("x", "y")
+    broadcast_threshold_bytes: int = 64 * 1024 * 1024
+    strategy_override: str = "auto"
+    sparsity_threshold: float = 0.05
+    default_dtype: str = "float32"
+    matmul_precision: str = "highest"
+    use_pallas: bool = True
+    chain_opt: bool = True
+    rewrite_rules: bool = True
+    donate_intermediates: bool = True
+
+    def replace(self, **kw: Any) -> "MatrelConfig":
+        return dataclasses.replace(self, **kw)
+
+    @staticmethod
+    def from_env(base: Optional["MatrelConfig"] = None) -> "MatrelConfig":
+        """Build a config from MATREL_* environment variables."""
+        cfg = base or MatrelConfig()
+        overrides: dict = {}
+        for f in dataclasses.fields(MatrelConfig):
+            env_key = "MATREL_" + f.name.upper()
+            if env_key not in os.environ:
+                continue
+            raw = os.environ[env_key]
+            if f.type in ("int", int):
+                overrides[f.name] = int(raw)
+            elif f.type in ("float", float):
+                overrides[f.name] = float(raw)
+            elif f.type in ("bool", bool):
+                overrides[f.name] = raw.lower() in ("1", "true", "yes", "on")
+            elif f.name == "mesh_shape":
+                parts = [int(p) for p in raw.replace("x", ",").split(",") if p]
+                overrides[f.name] = tuple(parts)
+            else:
+                overrides[f.name] = raw
+        return cfg.replace(**overrides) if overrides else cfg
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any], base: Optional["MatrelConfig"] = None) -> "MatrelConfig":
+        cfg = base or MatrelConfig()
+        valid = {f.name for f in dataclasses.fields(MatrelConfig)}
+        unknown = set(d) - valid
+        if unknown:
+            raise KeyError(f"unknown MatrelConfig keys: {sorted(unknown)}")
+        return cfg.replace(**dict(d))
+
+
+_default_config = MatrelConfig.from_env()
+
+
+def default_config() -> MatrelConfig:
+    return _default_config
+
+
+def set_default_config(cfg: MatrelConfig) -> None:
+    global _default_config
+    _default_config = cfg

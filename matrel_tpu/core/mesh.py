@@ -1,0 +1,77 @@
+"""Device-mesh construction — the TPU analogue of MatRel's Spark cluster.
+
+In the reference, ``MatfastSession`` rides a SparkSession whose executors form
+the "device grid" and whose partitioners (RowPartitioner / ColumnPartitioner /
+BlockCyclicPartitioner, SURVEY.md §2 "Partitioners") map block indices onto
+executors. On TPU the grid is explicit: a 2D ``jax.sharding.Mesh`` over ICI,
+and the partitioner-equivalents are ``NamedSharding`` PartitionSpecs
+(see shardings.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _near_square_factors(n: int) -> Tuple[int, int]:
+    """Factor n into (a, b) with a*b == n and a <= b, a as large as possible."""
+    a = int(math.isqrt(n))
+    while a > 1 and n % a != 0:
+        a -= 1
+    return a, n // a
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, int]] = None,
+    axis_names: Tuple[str, str] = ("x", "y"),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a 2D device mesh.
+
+    ``shape=None`` derives a near-square 2D grid from the available devices —
+    the analogue of MatRel defaulting its block-cyclic grid to the executor
+    count. A single device yields a 1x1 mesh, so all code paths are
+    mesh-uniform even on one chip.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if shape is None:
+        shape = _near_square_factors(n)
+    r, c = shape
+    if r * c != n:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    grid = np.asarray(devs, dtype=object).reshape(r, c)
+    return Mesh(grid, axis_names)
+
+
+def mesh_grid_shape(mesh: Mesh) -> Tuple[int, int]:
+    names = mesh.axis_names
+    return mesh.shape[names[0]], mesh.shape[names[1]]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharding_2d(mesh: Mesh) -> NamedSharding:
+    """Both matrix dims sharded: the 2D block-cyclic analogue."""
+    x, y = mesh.axis_names
+    return NamedSharding(mesh, P(x, y))
+
+
+def sharding_row(mesh: Mesh) -> NamedSharding:
+    """Row-sharded over the whole mesh (both axes on dim 0) — the
+    RowPartitioner analogue."""
+    x, y = mesh.axis_names
+    return NamedSharding(mesh, P((x, y), None))
+
+
+def sharding_col(mesh: Mesh) -> NamedSharding:
+    """Column-sharded over the whole mesh — the ColumnPartitioner analogue."""
+    x, y = mesh.axis_names
+    return NamedSharding(mesh, P(None, (x, y)))

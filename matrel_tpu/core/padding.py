@@ -1,0 +1,47 @@
+"""Padding + canonical sharding rules shared by BlockMatrix and the executor.
+
+Logical dims are padded up to a multiple of the total device count so every
+sharding used anywhere in the system (P(x,y), P((x,y),None), P(None,(x,y)),
+and the shard_map in_specs of the matmul strategies) divides evenly.
+Size-1 dims (vectors from rowSum/colSum, scalars from sum/trace) are NOT
+padded — they stay 1 and are replicated on that axis, which keeps matvec
+shapes natural and avoids degenerate shards.
+
+Invariant maintained by the executor: every padded array is exactly zero
+outside its logical region, so matmul/add/elementwise-multiply compose
+without masks; ops that break the invariant re-mask (see executor.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from matrel_tpu.core import mesh as mesh_lib
+
+
+def pad_dim(d: int, total_devices: int) -> int:
+    if d <= 1:
+        return max(d, 1)
+    return int(math.ceil(d / total_devices) * total_devices)
+
+
+def padded_shape(shape: Tuple[int, int], mesh: Mesh) -> Tuple[int, int]:
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    total = gx * gy
+    return pad_dim(shape[0], total), pad_dim(shape[1], total)
+
+
+def canonical_spec(pshape: Tuple[int, int], mesh: Mesh) -> P:
+    """2D sharding where divisible, replicated where not (size-1 dims)."""
+    x, y = mesh.axis_names
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    row = x if pshape[0] % gx == 0 and pshape[0] >= gx and gx > 1 else None
+    col = y if pshape[1] % gy == 0 and pshape[1] >= gy and gy > 1 else None
+    return P(row, col)
+
+
+def canonical_sharding(pshape: Tuple[int, int], mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, canonical_spec(pshape, mesh))

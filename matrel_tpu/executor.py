@@ -1,0 +1,316 @@
+"""Executor — lowers an optimized MatExpr into ONE jitted XLA program.
+
+Reference pipeline (SURVEY.md §3.2): optimized Catalyst plan → physical exec
+nodes → RDD DAG → shuffle-bounded Spark stages → per-task BLAS. TPU rebuild:
+optimized MatExpr → a single traced function over the leaf arrays, with each
+matmul dispatched to its planned strategy (shard_map collective recipe) and
+everything else to jnp ops; XLA fuses the elementwise traffic into the
+matmuls and schedules the collectives on ICI. The whole post-optimizer
+pipeline is one compiled program — no per-stage host round-trips.
+
+Zero-padding invariant: every lowered intermediate is exactly 0 outside its
+logical region (padding.py). Ops that would break it (scalar-add, pow≤0,
+division, broadcasted add/sub, select fills, join merges) re-mask. Aggregates
+mask padding where zeros would change the answer (max/min/avg/count).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from matrel_tpu.config import MatrelConfig, default_config
+from matrel_tpu.core import mesh as mesh_lib, padding
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.ir import rules
+from matrel_tpu.ir.expr import MatExpr, leaves as expr_leaves
+from matrel_tpu.parallel import planner, strategies
+
+Array = jax.Array
+
+
+def _row_mask(n: int, pn: int) -> Array:
+    return (jnp.arange(pn) < n)[:, None]
+
+
+def _col_mask(m: int, pm: int) -> Array:
+    return (jnp.arange(pm) < m)[None, :]
+
+
+def _mask_to_logical(x: Array, shape: Tuple[int, int]) -> Array:
+    """Zero out everything outside the logical region."""
+    pn, pm = x.shape
+    n, m = shape
+    if (pn, pm) == (n, m):
+        return x
+    return jnp.where(_row_mask(n, pn) & _col_mask(m, pm), x, jnp.zeros((), x.dtype))
+
+
+class Lowerer:
+    """Recursively lowers MatExpr nodes to jnp ops over padded arrays."""
+
+    def __init__(self, mesh: Mesh, config: MatrelConfig):
+        self.mesh = mesh
+        self.config = config
+
+    def lower(self, root: MatExpr, leaf_order: List[MatExpr]) -> Callable:
+        leaf_pos = {l.uid: i for i, l in enumerate(leaf_order)}
+
+        def fn(*leaf_arrays: Array) -> Array:
+            memo: Dict[int, Array] = {}
+
+            def ev(node: MatExpr) -> Array:
+                if node.uid in memo:
+                    return memo[node.uid]
+                out = self._eval(node, ev, leaf_arrays, leaf_pos)
+                memo[node.uid] = out
+                return out
+
+            out = ev(root)
+            pshape = padding.padded_shape(root.shape, self.mesh)
+            if tuple(out.shape) != pshape:
+                out = jnp.pad(out, ((0, pshape[0] - out.shape[0]),
+                                    (0, pshape[1] - out.shape[1])))
+            return jax.lax.with_sharding_constraint(
+                out, padding.canonical_sharding(pshape, self.mesh))
+
+        return fn
+
+    # -- per-node lowering --------------------------------------------------
+
+    def _eval(self, node: MatExpr, ev, leaf_arrays, leaf_pos) -> Array:
+        k = node.kind
+        if k == "leaf":
+            return leaf_arrays[leaf_pos[node.uid]]
+        if k == "transpose":
+            return ev(node.children[0]).T
+        if k == "matmul":
+            return self._matmul(node, ev)
+        if k == "elemwise":
+            return self._elemwise(node, ev)
+        if k == "scalar":
+            return self._scalar(node, ev)
+        if k == "agg":
+            return self._agg(node, ev)
+        if k == "vec":
+            return self._vec(node, ev)
+        if k == "rank1":
+            a, u, v = (ev(c) for c in node.children)
+            return a + u @ v.T
+        if k == "select_value":
+            x = ev(node.children[0])
+            pred, fill = node.attrs["predicate"], node.attrs["fill"]
+            out = jnp.where(pred(x), x, jnp.asarray(fill, x.dtype))
+            if fill != 0.0:
+                out = _mask_to_logical(out, node.shape)
+            return out
+        if k == "select_index":
+            return self._select_index(node, ev)
+        if k == "join_index":
+            a, b = ev(node.children[0]), ev(node.children[1])
+            out = node.attrs["merge"](a, b)
+            return _mask_to_logical(out, node.shape)
+        if k == "join_value":
+            return self._join_value(node, ev)
+        raise NotImplementedError(f"lowering for node kind {k!r}")
+
+    def _matmul(self, node: MatExpr, ev) -> Array:
+        a, b = ev(node.children[0]), ev(node.children[1])
+        strategy = node.attrs.get("strategy", "xla")
+        return strategies.run_matmul(strategy, a, b, self.mesh, self.config)
+
+    def _elemwise(self, node: MatExpr, ev) -> Array:
+        l, r = node.children
+        a, b = ev(l), ev(r)
+        broadcast = l.shape != r.shape
+        if broadcast:
+            # slice logical size-1 dims so padded shapes broadcast correctly
+            a = self._slice_for_broadcast(a, l.shape, node.shape)
+            b = self._slice_for_broadcast(b, r.shape, node.shape)
+        op = node.attrs["op"]
+        if op == "add":
+            out = a + b
+        elif op == "sub":
+            out = a - b
+        elif op == "mul":
+            out = a * b
+        elif op == "div":
+            safe_b = jnp.where(b == 0, jnp.ones((), b.dtype), b)
+            out = jnp.where(b == 0, jnp.zeros((), jnp.result_type(a, b)),
+                            a / safe_b)
+        elif op == "min":
+            out = jnp.minimum(a, b)
+        elif op == "max":
+            out = jnp.maximum(a, b)
+        else:
+            raise NotImplementedError(op)
+        if broadcast and op != "mul":
+            out = _mask_to_logical(out, node.shape)
+        return out
+
+    @staticmethod
+    def _slice_for_broadcast(x: Array, lshape, out_shape) -> Array:
+        if lshape[0] == 1 and out_shape[0] != 1 and x.shape[0] != 1:
+            x = x[:1, :]
+        if lshape[1] == 1 and out_shape[1] != 1 and x.shape[1] != 1:
+            x = x[:, :1]
+        return x
+
+    def _scalar(self, node: MatExpr, ev) -> Array:
+        x = ev(node.children[0])
+        op, v = node.attrs["op"], node.attrs["value"]
+        if op == "mul":
+            return x * jnp.asarray(v, x.dtype)
+        if op == "add":
+            out = x + jnp.asarray(v, x.dtype)
+            return _mask_to_logical(out, node.shape) if v != 0.0 else out
+        if op == "pow":
+            out = jnp.power(x, jnp.asarray(v, x.dtype))
+            return _mask_to_logical(out, node.shape) if v <= 0 else out
+        raise NotImplementedError(op)
+
+    def _agg(self, node: MatExpr, ev) -> Array:
+        (child,) = node.children
+        x = ev(child)
+        kind, axis = node.attrs["agg"], node.attrs["axis"]
+        n, m = child.shape
+        pn, pm = x.shape
+        if axis == "diag":
+            d = jnp.diagonal(x)[:n]
+            if kind == "sum":
+                return jnp.sum(d).reshape(1, 1)
+            if kind == "count":
+                return jnp.sum(d != 0).reshape(1, 1).astype(x.dtype)
+            if kind == "avg":
+                c = jnp.sum(d != 0)
+                return jnp.where(c > 0, jnp.sum(d) / c, 0.0).reshape(1, 1).astype(x.dtype)
+            if kind == "max":
+                return jnp.max(d).reshape(1, 1)
+            if kind == "min":
+                return jnp.min(d).reshape(1, 1)
+        ax = {"row": 1, "col": 0, "all": None}[axis]
+
+        def finish(res: Array) -> Array:
+            if axis == "row":
+                return res.reshape(pn, 1) if res.ndim == 1 else res
+            if axis == "col":
+                return res.reshape(1, pm) if res.ndim == 1 else res
+            return res.reshape(1, 1)
+
+        if kind == "sum":
+            out = finish(jnp.sum(x, axis=ax))
+        elif kind == "count":
+            out = finish(jnp.sum((x != 0), axis=ax).astype(x.dtype))
+        elif kind == "avg":
+            s = jnp.sum(x, axis=ax)
+            c = jnp.sum((x != 0), axis=ax)
+            out = finish(jnp.where(c > 0, s / c, 0).astype(x.dtype))
+        elif kind in ("max", "min"):
+            fill = -jnp.inf if kind == "max" else jnp.inf
+            valid = _row_mask(n, pn) & _col_mask(m, pm)
+            masked = jnp.where(valid, x, jnp.asarray(fill, x.dtype))
+            red = jnp.max if kind == "max" else jnp.min
+            out = finish(red(masked, axis=ax))
+            out = jnp.where(jnp.isfinite(out), out, jnp.zeros((), x.dtype))
+        else:
+            raise NotImplementedError(kind)
+        # zero out aggregate rows/cols that lie in the padded region
+        return _mask_to_logical(out, node.shape)
+
+    def _vec(self, node: MatExpr, ev) -> Array:
+        (child,) = node.children
+        x = ev(child)
+        n, m = child.shape
+        v = x[:n, :m].T.reshape(n * m, 1)  # column-major vec
+        pshape = padding.padded_shape(node.shape, self.mesh)
+        if v.shape[0] != pshape[0]:
+            v = jnp.pad(v, ((0, pshape[0] - v.shape[0]), (0, 0)))
+        return v
+
+    def _select_index(self, node: MatExpr, ev) -> Array:
+        x = ev(node.children[0])
+        rows, cols = node.attrs["rows"], node.attrs["cols"]
+        pn, pm = x.shape
+        keep = jnp.ones((), dtype=bool)
+        if rows is not None:
+            keep = keep & rows(jnp.arange(pn))[:, None]
+        if cols is not None:
+            keep = keep & cols(jnp.arange(pm))[None, :]
+        return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+    def _join_value(self, node: MatExpr, ev) -> Array:
+        """Value-join: all pairs (a_entry, b_entry) with predicate; output is
+        the (|A|, |B|) pair matrix (entries merge(va, vb) where predicate
+        holds, else 0). Blockwise outer construction; sizes are the caller's
+        responsibility (SURVEY.md §7.6 static-shape semantics)."""
+        l, r = node.children
+        a, b = ev(l), ev(r)
+        va = a[: l.shape[0], : l.shape[1]].T.reshape(-1)  # column-major entries
+        vb = b[: r.shape[0], : r.shape[1]].T.reshape(-1)
+        merge, pred = node.attrs["merge"], node.attrs["predicate"]
+        A = va[:, None]
+        B = vb[None, :]
+        out = merge(A, B)
+        if pred is not None:
+            out = jnp.where(pred(A, B), out, jnp.zeros((), out.dtype))
+        pshape = padding.padded_shape(node.shape, self.mesh)
+        if tuple(out.shape) != pshape:
+            out = jnp.pad(out, ((0, pshape[0] - out.shape[0]),
+                                (0, pshape[1] - out.shape[1])))
+        return out
+
+
+@dataclasses.dataclass
+class CompiledPlan:
+    """A jitted plan plus its leaf binding order — re-runnable with fresh
+    leaf data (the analogue of re-executing an RDD lineage on new blocks)."""
+
+    jitted: Callable
+    leaf_order: List[MatExpr]
+    optimized: MatExpr
+    mesh: Mesh
+    config: MatrelConfig
+
+    def run(self, bindings: Optional[Dict[int, BlockMatrix]] = None) -> BlockMatrix:
+        arrays = []
+        for l in self.leaf_order:
+            m = (bindings or {}).get(l.uid, l.attrs["matrix"])
+            arrays.append(m.data)
+        out = self.jitted(*arrays)
+        return BlockMatrix.from_array(
+            out, self.optimized.shape, self.mesh,
+            padding.canonical_spec(tuple(out.shape), self.mesh),
+            nnz=self.optimized.nnz,
+        )
+
+    def hlo(self) -> str:
+        """Optimized HLO text — for plan-shape assertions on collectives."""
+        arrays = [l.attrs["matrix"].data for l in self.leaf_order]
+        return self.jitted.lower(*arrays).compile().as_text()
+
+
+def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
+                 config: Optional[MatrelConfig] = None) -> CompiledPlan:
+    """optimize → plan → lower → jit. The full Catalyst pipeline analogue."""
+    cfg = config or default_config()
+    lvs = expr_leaves(expr)
+    if mesh is None:
+        mesh = lvs[0].attrs["matrix"].mesh if lvs else mesh_lib.make_mesh(
+            cfg.mesh_shape, cfg.mesh_axis_names)
+    opt = rules.optimize(expr, cfg)
+    opt = planner.annotate_strategies(opt, mesh, cfg)
+    leaf_order = expr_leaves(opt)
+    fn = Lowerer(mesh, cfg).lower(opt, leaf_order)
+    jitted = jax.jit(fn)
+    return CompiledPlan(jitted=jitted, leaf_order=leaf_order, optimized=opt,
+                        mesh=mesh, config=cfg)
+
+
+def execute(expr: MatExpr, mesh: Optional[Mesh] = None,
+            config: Optional[MatrelConfig] = None) -> BlockMatrix:
+    return compile_expr(expr, mesh, config).run()

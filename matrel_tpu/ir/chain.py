@@ -1,0 +1,94 @@
+"""Matrix-chain multiplication reordering — MatRel's flagship optimization
+(SURVEY.md §2 "Optimizer: matrix-chain DP", §3.3).
+
+"The join-order optimizer of linear algebra": collect maximal chains of
+matmul nodes A·B·C·…, run the classic O(n³) interval DP with a
+dimension- AND sparsity-aware cost model, and re-parenthesise the tree to
+the minimum-cost order. Pure Python, runs before tracing; unit-testable
+without devices (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from matrel_tpu.ir import stats
+from matrel_tpu.ir.expr import MatExpr, matmul
+
+
+def collect_chain(e: MatExpr) -> List[MatExpr]:
+    """Flatten a maximal matmul tree into its ordered operand list."""
+    if e.kind != "matmul":
+        return [e]
+    return collect_chain(e.children[0]) + collect_chain(e.children[1])
+
+
+def optimal_order(operands: List[MatExpr]) -> Tuple[MatExpr, float]:
+    """Interval DP over the operand list; returns (rebuilt expr, est. cost).
+
+    cost[i][j] = min over split s of cost[i][s] + cost[s+1][j]
+                 + multiplyCost(dims, densities)
+    Densities of intermediates are re-estimated per split via the same
+    propagation the stats module uses, so sparse chains order correctly.
+    """
+    n = len(operands)
+    if n == 1:
+        return operands[0], 0.0
+    # best[i][j] = (cost, expr) for operands[i..j] inclusive
+    best: List[List[Optional[Tuple[float, MatExpr]]]] = [
+        [None] * n for _ in range(n)
+    ]
+    for i in range(n):
+        best[i][i] = (0.0, operands[i])
+    for span in range(2, n + 1):
+        for i in range(0, n - span + 1):
+            j = i + span - 1
+            cand: Optional[Tuple[float, MatExpr]] = None
+            for s in range(i, j):
+                cl, el = best[i][s]
+                cr, er = best[s + 1][j]
+                step = stats.matmul_cost(
+                    el.shape[0], el.shape[1], er.shape[1],
+                    el.density, er.density,
+                )
+                total = cl + cr + step
+                if cand is None or total < cand[0]:
+                    cand = (total, matmul(el, er))
+            best[i][j] = cand
+    cost, e = best[0][n - 1]
+    return e, cost
+
+
+def reorder_chains(e: MatExpr) -> MatExpr:
+    """Recursively find maximal matmul chains and DP-reorder each."""
+    if e.kind == "matmul":
+        ops = collect_chain(e)
+        # optimize below each chain operand first, then the chain itself
+        ops = [reorder_chains(o) if o.kind != "leaf" else o for o in ops]
+        if len(ops) > 2:
+            new, _ = optimal_order(ops)
+            return new
+        if len(ops) == 2:
+            return matmul(ops[0], ops[1])
+        return ops[0]
+    if not e.children:
+        return e
+    new_children = tuple(
+        reorder_chains(c) for c in e.children
+    )
+    if all(nc is oc for nc, oc in zip(new_children, e.children)):
+        return e
+    return e.with_children(new_children)
+
+
+def chain_cost(e: MatExpr) -> float:
+    """Total estimated matmul FLOP cost of a (sub)tree, for plan assertions."""
+    total = 0.0
+    if e.kind == "matmul":
+        l, r = e.children
+        total += stats.matmul_cost(
+            l.shape[0], l.shape[1], r.shape[1], l.density, r.density
+        )
+    for c in e.children:
+        total += chain_cost(c)
+    return total

@@ -1,0 +1,210 @@
+"""Algebraic rewrite rules — the MatfastOptimizer rule batch
+(SURVEY.md §2 "Optimizer: rewrite rules", §3.2).
+
+Rules, mirroring the reference's Catalyst batch:
+  R1 double-transpose elimination:      (Aᵀ)ᵀ → A
+  R2 transpose push-down:               (A·B)ᵀ → Bᵀ·Aᵀ ;
+     (A+B)ᵀ → Aᵀ+Bᵀ ; (sA)ᵀ → s(Aᵀ) ; vec/agg interplay
+  R3 aggregation push-down into multiply:
+     rowSum(A·B) → A·rowSum(B) ; colSum(A·B) → colSum(A)·B
+     sum(A·B)    → colSum(A)·rowSum(B)
+     trace(A·B)  → sum(A ⊙ Bᵀ)
+     rowSum(Aᵀ)  → colSum(A)ᵀ ; colSum(Aᵀ) → rowSum(A)ᵀ
+     sum(sA)     → s·sum(A) ; sum(A+B) → sum(A)+sum(B)
+  R4 scalar folding: s1·(s2·A) → (s1·s2)·A ; s1+(s2+A) → (s1+s2)+A ;
+     1·A → A ; 0+A → A
+  R5 selection push-down: index-σ commutes through elementwise ops and
+     transposes (σ_rows through transpose becomes σ_cols).
+  R6 matrix-chain DP reorder (chain.py), run after the structure-exposing
+     rules above.
+
+Each rule is a bottom-up tree transform; the batch runs to fixpoint with a
+bound, Catalyst-style.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from matrel_tpu.config import MatrelConfig, default_config
+from matrel_tpu.ir import chain as chain_lib
+from matrel_tpu.ir.expr import (
+    MatExpr, agg, elemwise, matmul, scalar_op, select_index, transpose, vec,
+)
+
+Rule = Callable[[MatExpr], Optional[MatExpr]]
+
+
+def _rewrite_bottom_up(e: MatExpr, rule: Rule) -> MatExpr:
+    new_children = tuple(_rewrite_bottom_up(c, rule) for c in e.children)
+    if any(nc is not oc for nc, oc in zip(new_children, e.children)):
+        e = e.with_children(new_children)
+    out = rule(e)
+    return out if out is not None else e
+
+
+# -- R1/R2: transpose rules -------------------------------------------------
+
+
+def transpose_rules(e: MatExpr) -> Optional[MatExpr]:
+    if e.kind != "transpose":
+        return None
+    (c,) = e.children
+    if c.kind == "transpose":  # (Aᵀ)ᵀ → A
+        return c.children[0]
+    if c.kind == "matmul":  # (A·B)ᵀ → Bᵀ·Aᵀ
+        a, b = c.children
+        return matmul(transpose(b), transpose(a))
+    if c.kind == "elemwise":  # (A∘B)ᵀ → Aᵀ∘Bᵀ  (shapes must match exactly)
+        a, b = c.children
+        if a.shape == b.shape:
+            return elemwise(c.attrs["op"], transpose(a), transpose(b))
+        return None
+    if c.kind == "scalar":  # (s∘A)ᵀ → s∘(Aᵀ)
+        return scalar_op(c.attrs["op"], transpose(c.children[0]), c.attrs["value"])
+    if c.kind == "agg":
+        # rowSumᵀ/colSumᵀ still just a vector; transposing agg output is
+        # cheap — leave in place.
+        return None
+    return None
+
+
+# -- R3: aggregation push-down ---------------------------------------------
+
+
+def agg_pushdown(e: MatExpr) -> Optional[MatExpr]:
+    if e.kind != "agg":
+        return None
+    kind, axis = e.attrs["agg"], e.attrs["axis"]
+    (c,) = e.children
+    if kind != "sum":
+        return None  # max/min/count/avg don't distribute over matmul
+    if c.kind == "matmul":
+        a, b = c.children
+        if axis == "row":   # rowSum(A·B) = A · rowSum(B)
+            return matmul(a, agg(b, "sum", "row"))
+        if axis == "col":   # colSum(A·B) = colSum(A) · B
+            return matmul(agg(a, "sum", "col"), b)
+        if axis == "all":   # sum(A·B) = colSum(A) · rowSum(B)
+            return matmul(agg(a, "sum", "col"), agg(b, "sum", "row"))
+        if axis == "diag":  # trace(A·B) = sum(A ⊙ Bᵀ)
+            if a.shape == (b.shape[1], b.shape[0]):
+                return agg(elemwise("mul", a, transpose(b)), "sum", "all")
+        return None
+    if c.kind == "transpose":
+        inner = c.children[0]
+        if axis == "row":   # rowSum(Aᵀ) = colSum(A)ᵀ
+            return transpose(agg(inner, "sum", "col"))
+        if axis == "col":
+            return transpose(agg(inner, "sum", "row"))
+        if axis in ("all", "diag"):  # invariant under transpose
+            return agg(inner, "sum", axis)
+        return None
+    if c.kind == "scalar" and c.attrs["op"] == "mul":
+        # sum(s·A) = s·sum(A) — shrink before scaling
+        return scalar_op("mul", agg(c.children[0], "sum", axis), c.attrs["value"])
+    if c.kind == "elemwise" and c.attrs["op"] in ("add", "sub") \
+            and c.children[0].shape == c.children[1].shape:
+        a, b = c.children
+        return elemwise(c.attrs["op"], agg(a, "sum", axis), agg(b, "sum", axis))
+    return None
+
+
+# -- R4: scalar folding -----------------------------------------------------
+
+
+def scalar_folding(e: MatExpr) -> Optional[MatExpr]:
+    if e.kind != "scalar":
+        return None
+    op, v = e.attrs["op"], e.attrs["value"]
+    (c,) = e.children
+    if op == "mul" and v == 1.0:
+        return c
+    if op == "add" and v == 0.0:
+        return c
+    if op == "pow" and v == 1.0:
+        return c
+    if c.kind == "scalar" and c.attrs["op"] == op and op in ("mul", "add"):
+        merged = v * c.attrs["value"] if op == "mul" else v + c.attrs["value"]
+        return scalar_op(op, c.children[0], merged)
+    return None
+
+
+# -- R5: selection push-down ------------------------------------------------
+
+
+def selection_pushdown(e: MatExpr) -> Optional[MatExpr]:
+    if e.kind != "select_index":
+        return None
+    rows, cols = e.attrs["rows"], e.attrs["cols"]
+    (c,) = e.children
+    if c.kind == "transpose":
+        # σ_rows(Aᵀ) = (σ_cols(A))ᵀ
+        return transpose(select_index(c.children[0], rows=cols, cols=rows))
+    if c.kind == "elemwise" and c.children[0].shape == c.children[1].shape:
+        a, b = c.children
+        return elemwise(
+            c.attrs["op"],
+            select_index(a, rows=rows, cols=cols),
+            select_index(b, rows=rows, cols=cols),
+        )
+    if c.kind == "scalar" and c.attrs["op"] == "mul":
+        return scalar_op("mul",
+                         select_index(c.children[0], rows=rows, cols=cols),
+                         c.attrs["value"])
+    if c.kind == "matmul":
+        # σ over rows touches only A's rows; over cols only B's cols:
+        # σ_r,c(A·B) = σ_r(A) · σ_c(B)
+        a, b = c.children
+        if rows is not None or cols is not None:
+            na = select_index(a, rows=rows, cols=None) if rows is not None else a
+            nb = select_index(b, rows=None, cols=cols) if cols is not None else b
+            if na is not a or nb is not b:
+                return matmul(na, nb)
+    return None
+
+
+_RULES: List[Rule] = [
+    transpose_rules,
+    agg_pushdown,
+    scalar_folding,
+    selection_pushdown,
+]
+
+_MAX_ITERS = 10
+
+
+def apply_rewrites(e: MatExpr) -> MatExpr:
+    """Run the rule batch to fixpoint (bounded, Catalyst-style)."""
+    for _ in range(_MAX_ITERS):
+        before = e
+        for rule in _RULES:
+            e = _rewrite_bottom_up(e, rule)
+        if _same_structure(e, before):
+            break
+    return e
+
+
+def _same_structure(a: MatExpr, b: MatExpr) -> bool:
+    if a is b:
+        return True
+    if a.kind != b.kind or a.shape != b.shape or len(a.children) != len(b.children):
+        return False
+    keys = ("op", "value", "agg", "axis")
+    if any(a.attrs.get(k) != b.attrs.get(k) for k in keys):
+        return False
+    if a.kind == "leaf":
+        return a.attrs["matrix"] is b.attrs["matrix"]
+    return all(_same_structure(x, y) for x, y in zip(a.children, b.children))
+
+
+def optimize(e: MatExpr, config: Optional[MatrelConfig] = None) -> MatExpr:
+    """Full logical optimization: rewrites, then chain-DP reorder."""
+    cfg = config or default_config()
+    if cfg.rewrite_rules:
+        e = apply_rewrites(e)
+    if cfg.chain_opt:
+        e = chain_lib.reorder_chains(e)
+        if cfg.rewrite_rules:
+            e = apply_rewrites(e)  # reorder can expose new folds
+    return e

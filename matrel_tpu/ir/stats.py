@@ -1,0 +1,70 @@
+"""Dimension + sparsity statistics propagation (SURVEY.md §2
+"Statistics / sparsity estimation").
+
+The reference propagates (nRows, nCols, nnz) bottom-up through the Catalyst
+plan and feeds the estimates to the matrix-chain DP and physical strategy
+choice. Same role here: pure-Python estimates over the MatExpr tree, no
+devices involved.
+
+Estimation model (standard independence assumptions, as in MatFast/MatRel):
+  density(A·B)   ≈ 1 - (1 - dA*dB)^k   (k = contraction dim)
+  density(A+B)   ≈ min(1, dA + dB)
+  density(A⊙B)  ≈ dA * dB
+  transpose/scalar-mul preserve density; scalar-add densifies.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+
+def density_of(nnz: Optional[int], shape: Tuple[int, int]) -> float:
+    if nnz is None:
+        return 1.0
+    n = shape[0] * shape[1]
+    return min(1.0, nnz / n) if n else 0.0
+
+
+def nnz_from_density(d: float, shape: Tuple[int, int]) -> int:
+    return int(round(min(1.0, max(0.0, d)) * shape[0] * shape[1]))
+
+
+def matmul_density(da: float, db: float, k: int) -> float:
+    """Probability an output entry is nonzero given k independent trials."""
+    p = da * db
+    if p <= 0.0:
+        return 0.0
+    if p >= 1.0:
+        return 1.0
+    # 1-(1-p)^k, computed stably.
+    return -math.expm1(k * math.log1p(-p))
+
+
+def add_density(da: float, db: float) -> float:
+    return min(1.0, da + db)
+
+
+def elemmul_density(da: float, db: float) -> float:
+    return da * db
+
+
+def matmul_cost(
+    n: int, k: int, m: int, da: float = 1.0, db: float = 1.0
+) -> float:
+    """Estimated FLOP cost of an (n×k)·(k×m) multiply.
+
+    Sparsity-aware as in the reference's chain DP: work scales with the
+    expected number of nonzero multiply-accumulate pairs.
+    """
+    return 2.0 * n * k * m * da * db
+
+
+def matmul_out_nnz(
+    n: int, k: int, m: int, nnz_a: Optional[int], nnz_b: Optional[int]
+) -> Optional[int]:
+    if nnz_a is None and nnz_b is None:
+        return None
+    da = density_of(nnz_a, (n, k))
+    db = density_of(nnz_b, (k, m))
+    return nnz_from_density(matmul_density(da, db, k), (n, m))

@@ -1,0 +1,172 @@
+"""Cost-based physical planning — the MatfastPlanner analogue
+(SURVEY.md §2 "Physical planner", §3.2 "strategy choice per multiply").
+
+The reference chooses BMM vs CPMM vs RMM per multiply from dimensions,
+sparsity, and partitioning. Here the choice is made per matmul node before
+tracing, from the same statistics, using a communication-cost model over the
+mesh (comm bytes moved across ICI per strategy — the shuffle-bytes analogue).
+The chosen strategy is recorded on the node (``attrs["strategy"]``) so plan
+tests can assert it, mirroring the reference's Catalyst plan assertions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from jax.sharding import Mesh
+
+from matrel_tpu.config import MatrelConfig, default_config
+from matrel_tpu.core import mesh as mesh_lib
+from matrel_tpu.ir.expr import MatExpr
+
+
+def _bytes(shape: Tuple[int, int], density: float, itemsize: int = 4) -> float:
+    return shape[0] * shape[1] * itemsize * max(density, 0.0)
+
+
+def comm_cost(strategy: str, n: int, k: int, m: int,
+              da: float, db: float, gx: int, gy: int,
+              itemsize: int = 4,
+              a_layout: str = "2d", b_layout: str = "2d") -> float:
+    """Estimated per-device ICI bytes moved by each strategy.
+
+    ``a_layout``/``b_layout`` describe how the operand already lives on the
+    mesh ("2d", "row", "col", "rep"): co-partitioned inputs make their
+    reshard terms free — the analogue of the reference's partitioner-aware
+    planning that skips shuffles for co-partitioned RDDs (SURVEY.md §2
+    "Partitioners", "co-partitioning"). Costs count resharding all-gathers
+    plus execution-time collectives; the closed forms recast the reference's
+    shuffle-size formulas for a gx × gy mesh.
+    """
+    a_bytes = _bytes((n, k), da, itemsize)
+    b_bytes = _bytes((k, m), db, itemsize)
+    c_bytes = _bytes((n, m), 1.0, itemsize)
+    p = gx * gy
+    if strategy == "bmm_right":
+        # replicate B everywhere (all-gather to every device) + reshard A to
+        # row-sharding over all devices (free when already row-sharded).
+        bcast = 0.0 if b_layout == "rep" else b_bytes * (p - 1) / p
+        reshard_a = 0.0 if a_layout == "row" else (a_bytes / p) * (1 - 1 / gy)
+        return bcast + reshard_a
+    if strategy == "bmm_left":
+        bcast = 0.0 if a_layout == "rep" else a_bytes * (p - 1) / p
+        reshard_b = 0.0 if b_layout == "col" else (b_bytes / p) * (1 - 1 / gx)
+        return bcast + reshard_b
+    if strategy == "cpmm":
+        # reshard B to P(y, None): each device gathers b_bytes/gy of B rows
+        # replicated along x (factor (gx-1)/gx of that), then reduce-scatter
+        # of partial C over y.
+        reshard_b = (b_bytes / gy) * (gx - 1) / gx
+        rs_c = (c_bytes / gx) * (gy - 1) / gy
+        return reshard_b + rs_c
+    if strategy == "rmm":
+        # all-gather A along y (each device ends with n/gx × k) and B along x
+        ag_a = (a_bytes / gx) * (gy - 1) / gy
+        ag_b = (b_bytes / gy) * (gx - 1) / gx
+        return ag_a + ag_b
+    if strategy == "summa":
+        # Cannon: g steps, each moves one A tile + one B tile per device
+        g = max(gx, gy)
+        return (a_bytes / p + b_bytes / p) * (g - 1)
+    if strategy == "xla":
+        # unknown until SPMD partitioner runs; model as RMM (its usual pick)
+        ag_a = (a_bytes / gx) * (gy - 1) / gy
+        ag_b = (b_bytes / gy) * (gx - 1) / gx
+        return ag_a + ag_b
+    raise ValueError(f"unknown strategy {strategy}")
+
+
+def _layout_of(node: MatExpr, mesh: Mesh) -> str:
+    """How a matmul operand already lives on the mesh. Leaves carry their
+    real PartitionSpec; intermediates are canonical 2D."""
+    if node.kind == "leaf":
+        spec = node.attrs["matrix"].spec
+        x, y = mesh.axis_names
+        row_axes = spec[0] if len(spec) > 0 else None
+        col_axes = spec[1] if len(spec) > 1 else None
+        if row_axes is None and col_axes is None:
+            return "rep"
+        if col_axes is None and row_axes in ((x, y), (y, x)):
+            return "row"
+        if row_axes is None and col_axes in ((x, y), (y, x)):
+            return "col"
+    return "2d"
+
+
+def admissible(strategy: str, pn: int, pk: int, pm: int,
+               gx: int, gy: int) -> bool:
+    """Can this strategy's shard_map specs divide the padded dims evenly?
+
+    Size-1 (vector/scalar) dims stay unpadded (padding.py), so matvec-shaped
+    multiplies are only eligible for strategies that keep those dims
+    replicated — everything else falls through to the XLA SPMD path.
+    """
+    p = gx * gy
+    if strategy == "bmm_right":
+        return pn % p == 0
+    if strategy == "bmm_left":
+        return pm % p == 0
+    if strategy == "cpmm":
+        return pn % gx == 0 and pk % gy == 0 and pm % gy == 0
+    if strategy == "rmm":
+        return pn % gx == 0 and pm % gy == 0
+    if strategy == "summa":
+        return (gx == gy and pn % gx == 0 and pm % gy == 0
+                and pk % gx == 0 and pk % gy == 0)
+    return True  # xla
+
+
+def choose_strategy(node: MatExpr, mesh: Mesh,
+                    config: Optional[MatrelConfig] = None) -> str:
+    """Pick the cheapest admissible strategy for one matmul node."""
+    cfg = config or default_config()
+    if cfg.strategy_override != "auto":
+        return cfg.strategy_override
+    a, b = node.children
+    n, k = a.shape
+    _, m = b.shape
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    if gx * gy == 1:
+        return "xla"  # single device: plain local dot
+    from matrel_tpu.core import padding
+    pn, pk = padding.padded_shape((n, k), mesh)
+    _, pm = padding.padded_shape((k, m), mesh)
+    da, db = a.density, b.density
+    la, lb = _layout_of(a, mesh), _layout_of(b, mesh)
+    cands = {}
+    a_bytes = _bytes((n, k), da)
+    b_bytes = _bytes((k, m), db)
+    # BMM is only admissible when the broadcast side fits the threshold —
+    # the reference's broadcast-variable size gate.
+    if b_bytes <= cfg.broadcast_threshold_bytes:
+        cands["bmm_right"] = comm_cost("bmm_right", n, k, m, da, db, gx, gy,
+                                       a_layout=la, b_layout=lb)
+    if a_bytes <= cfg.broadcast_threshold_bytes:
+        cands["bmm_left"] = comm_cost("bmm_left", n, k, m, da, db, gx, gy,
+                                      a_layout=la, b_layout=lb)
+    cands["cpmm"] = comm_cost("cpmm", n, k, m, da, db, gx, gy,
+                              a_layout=la, b_layout=lb)
+    cands["rmm"] = comm_cost("rmm", n, k, m, da, db, gx, gy,
+                             a_layout=la, b_layout=lb)
+    # SUMMA needs a square grid and pays latency per step; prefer it when
+    # replication would not fit HBM (big square operands).
+    if gx == gy and gx > 1:
+        cands["summa"] = comm_cost("summa", n, k, m, da, db, gx, gy,
+                                   a_layout=la, b_layout=lb)
+    cands = {s: c for s, c in cands.items()
+             if admissible(s, pn, pk, pm, gx, gy)}
+    if not cands:
+        return "xla"
+    return min(cands, key=cands.get)
+
+
+def annotate_strategies(e: MatExpr, mesh: Mesh,
+                        config: Optional[MatrelConfig] = None) -> MatExpr:
+    """Bottom-up pass stamping attrs['strategy'] on every matmul node."""
+    new_children = tuple(annotate_strategies(c, mesh, config)
+                         for c in e.children)
+    if any(nc is not oc for nc, oc in zip(new_children, e.children)):
+        e = e.with_children(new_children)
+    if e.kind == "matmul" and "strategy" not in e.attrs:
+        e = e.with_attrs(strategy=choose_strategy(e, mesh, config))
+    return e

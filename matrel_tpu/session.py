@@ -1,0 +1,138 @@
+"""MatrelSession — the entry point, analogue of the reference's
+``MatfastSession`` (SURVEY.md §2 "Session & catalog", §3.1).
+
+The reference subclasses SparkSession and installs its own analyzer /
+optimizer / planner into the session state; executors register with the
+cluster manager. Here the session owns the device mesh (the "cluster"), the
+config (the SparkConf analogue), a tiny named-matrix catalog, and the
+optimize→plan→jit pipeline, plus a compiled-plan cache keyed by expression
+structure so repeated actions don't re-trace (the Spark query-cache
+analogue).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+from matrel_tpu import executor as executor_lib
+from matrel_tpu.config import MatrelConfig, default_config
+from matrel_tpu.core import mesh as mesh_lib
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.ir.expr import MatExpr, as_expr
+
+log = logging.getLogger("matrel_tpu")
+
+_active: Optional["MatrelSession"] = None
+
+
+class MatrelSession:
+    """Owns mesh + config + catalog; compiles and runs matrix queries."""
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 config: Optional[MatrelConfig] = None):
+        self.config = config or default_config()
+        self.mesh = mesh or mesh_lib.make_mesh(
+            self.config.mesh_shape, self.config.mesh_axis_names)
+        self.catalog: Dict[str, BlockMatrix] = {}
+        self._plan_cache: Dict[str, executor_lib.CompiledPlan] = {}
+
+    # -- builder (MatfastSession.builder().getOrCreate() analogue) ---------
+
+    class Builder:
+        def __init__(self):
+            self._cfg = default_config()
+            self._mesh = None
+
+        def config(self, **kw) -> "MatrelSession.Builder":
+            self._cfg = self._cfg.replace(**kw)
+            return self
+
+        def mesh(self, mesh: Mesh) -> "MatrelSession.Builder":
+            self._mesh = mesh
+            return self
+
+        def get_or_create(self) -> "MatrelSession":
+            global _active
+            if _active is None:
+                _active = MatrelSession(self._mesh, self._cfg)
+            return _active
+
+    @staticmethod
+    def builder() -> "MatrelSession.Builder":
+        return MatrelSession.Builder()
+
+    # -- catalog (matrix tables, SQL-facing names) -------------------------
+
+    def register(self, name: str, matrix: BlockMatrix) -> None:
+        self.catalog[name] = matrix
+
+    def table(self, name: str) -> BlockMatrix:
+        return self.catalog[name]
+
+    # -- constructors bound to this session's mesh/config ------------------
+
+    def from_numpy(self, arr: np.ndarray, **kw) -> BlockMatrix:
+        return BlockMatrix.from_numpy(arr, mesh=self.mesh, config=self.config, **kw)
+
+    def random(self, shape: Tuple[int, int], **kw) -> BlockMatrix:
+        return BlockMatrix.random(shape, mesh=self.mesh, config=self.config, **kw)
+
+    def zeros(self, shape: Tuple[int, int], **kw) -> BlockMatrix:
+        return BlockMatrix.zeros(shape, mesh=self.mesh, config=self.config, **kw)
+
+    def eye(self, n: int, **kw) -> BlockMatrix:
+        return BlockMatrix.eye(n, mesh=self.mesh, config=self.config, **kw)
+
+    # -- actions ------------------------------------------------------------
+
+    def compile(self, expr: MatExpr) -> executor_lib.CompiledPlan:
+        key = _plan_key(as_expr(expr))
+        plan = self._plan_cache.get(key)
+        if plan is None:
+            plan = executor_lib.compile_expr(as_expr(expr), self.mesh, self.config)
+            self._plan_cache[key] = plan
+        return plan
+
+    def compute(self, expr: MatExpr) -> BlockMatrix:
+        return self.compile(expr).run()
+
+    def explain(self, expr: MatExpr) -> str:
+        return as_expr(expr).explain(self.config)
+
+    def sql(self, query: str) -> MatExpr:
+        """SQL-ish entry point over registered matrix tables (the reference's
+        SQL surface, SURVEY.md §2 'SQL entry point'). See sql.py."""
+        from matrel_tpu.sql import parse_sql
+        return parse_sql(query, self)
+
+
+def _plan_key(e: MatExpr) -> str:
+    parts = []
+
+    def walk(n: MatExpr):
+        if n.kind == "leaf":
+            m = n.attrs["matrix"]
+            parts.append(f"leaf:{id(m)}:{m.shape}:{m.spec}")
+            return
+        attrs = {k: v for k, v in sorted(n.attrs.items())
+                 if isinstance(v, (int, float, str, bool))}
+        parts.append(f"{n.kind}:{n.shape}:{attrs}(")
+        for c in n.children:
+            walk(c)
+        parts.append(")")
+
+    walk(e)
+    return "|".join(parts)
+
+
+def get_or_create_session() -> MatrelSession:
+    return MatrelSession.builder().get_or_create()
+
+
+def reset_session() -> None:
+    global _active
+    _active = None

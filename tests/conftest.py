@@ -1,0 +1,62 @@
+"""Test harness: simulate an 8-device mesh on CPU.
+
+The reference tests on Spark's ``local[*]`` — an in-process cluster that
+exercises the real shuffle/partitioner code paths in one JVM (SURVEY.md §4).
+The JAX analogue: 8 virtual CPU devices via
+``--xla_force_host_platform_device_count``, so every sharding, shard_map and
+collective in the framework runs for real, just without ICI.
+
+Must run before jax is imported anywhere — hence module level, in conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon sitecustomize registers the TPU PJRT plugin at interpreter start,
+# which pins the platform before this conftest runs; the config API still
+# overrides it (env vars alone do not).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from matrel_tpu.core import mesh as mesh_lib
+    return mesh_lib.make_mesh((2, 4))
+
+
+@pytest.fixture(scope="session")
+def mesh4x2():
+    from matrel_tpu.core import mesh as mesh_lib
+    return mesh_lib.make_mesh((4, 2))
+
+
+@pytest.fixture(scope="session")
+def mesh_square():
+    """2x2 square mesh (SUMMA/Cannon needs gx == gy)."""
+    import jax
+    from matrel_tpu.core import mesh as mesh_lib
+    return mesh_lib.make_mesh((2, 2), devices=jax.devices()[:4])
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_session():
+    from matrel_tpu import session
+    session.reset_session()
+    yield
+    session.reset_session()

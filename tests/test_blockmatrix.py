@@ -1,0 +1,78 @@
+"""BlockMatrix representation tests — the BasicMatrixOpsSuite analogue
+(SURVEY.md §4): numerics vs numpy oracles on a simulated 8-device mesh."""
+
+import numpy as np
+import pytest
+
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.core import padding
+
+
+def test_roundtrip_exact_shape(mesh8, rng):
+    a = rng.standard_normal((16, 32)).astype(np.float32)
+    bm = BlockMatrix.from_numpy(a, mesh=mesh8)
+    np.testing.assert_allclose(bm.to_numpy(), a, rtol=1e-6)
+
+
+def test_roundtrip_ragged_shape_pads(mesh8, rng):
+    a = rng.standard_normal((13, 7)).astype(np.float32)
+    bm = BlockMatrix.from_numpy(a, mesh=mesh8)
+    assert bm.shape == (13, 7)
+    assert bm.padded_shape[0] % 8 == 0 and bm.padded_shape[1] % 8 == 0
+    np.testing.assert_allclose(bm.to_numpy(), a, rtol=1e-6)
+    # padding region must be zero
+    full = np.asarray(bm.data)
+    assert np.all(full[13:, :] == 0) and np.all(full[:, 7:] == 0)
+
+
+def test_vector_dims_not_padded(mesh8, rng):
+    v = rng.standard_normal((10, 1)).astype(np.float32)
+    bm = BlockMatrix.from_numpy(v, mesh=mesh8)
+    assert bm.padded_shape[1] == 1  # size-1 dims stay unpadded/replicated
+    np.testing.assert_allclose(bm.to_numpy(), v, rtol=1e-6)
+
+
+def test_eye_and_zeros(mesh8):
+    e = BlockMatrix.eye(9, mesh=mesh8)
+    np.testing.assert_allclose(e.to_numpy(), np.eye(9, dtype=np.float32))
+    z = BlockMatrix.zeros((5, 5), mesh=mesh8)
+    assert z.nnz == 0
+    np.testing.assert_allclose(z.to_numpy(), np.zeros((5, 5)))
+
+
+def test_random_masks_padding(mesh8):
+    bm = BlockMatrix.random((10, 10), mesh=mesh8, seed=1)
+    full = np.asarray(bm.data)
+    assert np.all(full[10:, :] == 0) and np.all(full[:, 10:] == 0)
+    assert np.all(bm.to_numpy() >= 0) and np.all(bm.to_numpy() < 1)
+
+
+def test_from_block_fn(mesh8):
+    bm = BlockMatrix.from_block_fn((6, 6), lambda r, c: (r * 6 + c), mesh=mesh8)
+    expect = np.arange(36, dtype=np.float32).reshape(6, 6)
+    np.testing.assert_allclose(bm.to_numpy(), expect)
+
+
+def test_sharding_is_distributed(mesh8, rng):
+    a = rng.standard_normal((64, 64)).astype(np.float32)
+    bm = BlockMatrix.from_numpy(a, mesh=mesh8)
+    # data actually lives across all 8 devices
+    assert len({s.device for s in bm.data.addressable_shards}) == 8
+
+
+def test_with_spec_reshards(mesh8, rng):
+    from jax.sharding import PartitionSpec as P
+    a = rng.standard_normal((32, 32)).astype(np.float32)
+    bm = BlockMatrix.from_numpy(a, mesh=mesh8)
+    row = bm.with_spec(P(("x", "y"), None))
+    np.testing.assert_allclose(row.to_numpy(), a, rtol=1e-6)
+    assert row.spec != bm.spec
+
+
+def test_padding_rules(mesh8):
+    assert padding.pad_dim(1, 8) == 1
+    assert padding.pad_dim(7, 8) == 8
+    assert padding.pad_dim(8, 8) == 8
+    assert padding.pad_dim(9, 8) == 16
+    spec = padding.canonical_spec((16, 1), mesh8)
+    assert spec[1] is None

@@ -1,0 +1,150 @@
+"""End-to-end execution tests — the MatrixOperatorSuite analogue
+(SURVEY.md §4): DSL queries on the simulated 8-device mesh, numerics vs
+numpy oracles, including ragged (padded) shapes."""
+
+import numpy as np
+import pytest
+
+from matrel_tpu import execute
+from matrel_tpu.core.blockmatrix import BlockMatrix
+
+
+def bm(arr, mesh, **kw):
+    return BlockMatrix.from_numpy(np.asarray(arr, dtype=np.float32), mesh=mesh, **kw)
+
+
+@pytest.fixture()
+def mats(mesh8, rng):
+    a = rng.standard_normal((24, 16)).astype(np.float32)
+    b = rng.standard_normal((16, 24)).astype(np.float32)
+    return a, b, bm(a, mesh8), bm(b, mesh8)
+
+
+class TestDenseOps:
+    def test_matmul(self, mats):
+        a, b, A, B = mats
+        out = A.multiply(B).compute().to_numpy()
+        np.testing.assert_allclose(out, a @ b, rtol=1e-4, atol=1e-5)
+
+    def test_matmul_ragged(self, mesh8, rng):
+        a = rng.standard_normal((13, 9)).astype(np.float32)
+        b = rng.standard_normal((9, 11)).astype(np.float32)
+        out = bm(a, mesh8).multiply(bm(b, mesh8)).compute().to_numpy()
+        assert out.shape == (13, 11)
+        np.testing.assert_allclose(out, a @ b, rtol=1e-4, atol=1e-5)
+
+    def test_transpose(self, mats):
+        a, _, A, _ = mats
+        np.testing.assert_allclose(A.t().compute().to_numpy(), a.T, rtol=1e-6)
+
+    def test_add_sub_elemwise(self, mesh8, rng):
+        a = rng.standard_normal((10, 10)).astype(np.float32)
+        b = rng.standard_normal((10, 10)).astype(np.float32)
+        A, B = bm(a, mesh8), bm(b, mesh8)
+        np.testing.assert_allclose(A.add(B).compute().to_numpy(), a + b, rtol=1e-5)
+        np.testing.assert_allclose(A.subtract(B).compute().to_numpy(), a - b, rtol=1e-5)
+        np.testing.assert_allclose(
+            A.elem_multiply(B).compute().to_numpy(), a * b, rtol=1e-5)
+
+    def test_divide_safe(self, mesh8):
+        a = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+        b = np.array([[2.0, 0.0], [1.0, 4.0]], dtype=np.float32)
+        out = bm(a, mesh8).divide(bm(b, mesh8)).compute().to_numpy()
+        # division by zero yields 0 (sparse-relational semantics: missing)
+        np.testing.assert_allclose(out, [[0.5, 0.0], [3.0, 1.0]], rtol=1e-6)
+
+    def test_scalar_ops_mask_padding(self, mesh8, rng):
+        a = rng.standard_normal((5, 5)).astype(np.float32)  # heavily padded
+        A = bm(a, mesh8)
+        out = A.add_scalar(3.0).compute()
+        np.testing.assert_allclose(out.to_numpy(), a + 3.0, rtol=1e-5)
+        # padding must remain zero after scalar add (invariant)
+        full = np.asarray(out.data)
+        assert np.all(full[5:, :] == 0)
+
+    def test_power(self, mesh8):
+        a = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+        out = bm(a, mesh8).power(2.0).compute().to_numpy()
+        np.testing.assert_allclose(out, a ** 2, rtol=1e-5)
+
+    def test_chained_expression(self, mats):
+        a, b, A, B = mats
+        # (A·B)ᵀ + (A·B)ᵀ computed via DSL; exercises rewrite + CSE by memo
+        e = A.multiply(B).t().add(A.multiply(B).t())
+        np.testing.assert_allclose(
+            e.compute().to_numpy(), 2 * (a @ b).T, rtol=1e-4, atol=1e-5)
+
+
+class TestAggregates:
+    def test_row_col_sums(self, mesh8, rng):
+        a = rng.standard_normal((9, 7)).astype(np.float32)
+        A = bm(a, mesh8)
+        np.testing.assert_allclose(
+            A.row_sum().compute().to_numpy(), a.sum(1, keepdims=True),
+            rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            A.col_sum().compute().to_numpy(), a.sum(0, keepdims=True),
+            rtol=1e-4, atol=1e-5)
+
+    def test_sum_trace(self, mesh8, rng):
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        A = bm(a, mesh8)
+        assert A.sum().compute().to_numpy()[0, 0] == pytest.approx(a.sum(), rel=1e-4)
+        assert A.trace().compute().to_numpy()[0, 0] == pytest.approx(
+            np.trace(a), rel=1e-4)
+
+    def test_max_min_with_negative_entries(self, mesh8):
+        # all-negative matrix, ragged: padding zeros must NOT win the max
+        a = -np.abs(np.random.default_rng(0).standard_normal((5, 3))).astype(np.float32) - 1
+        A = bm(a, mesh8)
+        out = A.expr().row_max().compute().to_numpy()
+        np.testing.assert_allclose(out, a.max(1, keepdims=True), rtol=1e-5)
+        out = A.expr().col_min().compute().to_numpy()
+        np.testing.assert_allclose(out, a.min(0, keepdims=True), rtol=1e-5)
+
+    def test_count_avg(self, mesh8):
+        a = np.array([[1.0, 0.0, 2.0], [0.0, 0.0, 3.0]], dtype=np.float32)
+        A = bm(a, mesh8)
+        np.testing.assert_allclose(
+            A.expr().row_count().compute().to_numpy(), [[2.0], [1.0]])
+        np.testing.assert_allclose(
+            A.expr().row_avg().compute().to_numpy(), [[1.5], [3.0]])
+
+    def test_rowsum_pushdown_numerics(self, mesh8, rng):
+        # optimized plan (A·rowSum(B)) must equal unoptimized rowSum(A·B)
+        a = rng.standard_normal((12, 20)).astype(np.float32)
+        b = rng.standard_normal((20, 12)).astype(np.float32)
+        A, B = bm(a, mesh8), bm(b, mesh8)
+        out = A.multiply(B).row_sum().compute().to_numpy()
+        np.testing.assert_allclose(out, (a @ b).sum(1, keepdims=True),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestVecRank1:
+    def test_vec_column_major(self, mesh8):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out = bm(a, mesh8).vec().compute().to_numpy()
+        np.testing.assert_allclose(out, a.T.reshape(-1, 1))
+
+    def test_rank_one_update(self, mesh8, rng):
+        a = rng.standard_normal((6, 4)).astype(np.float32)
+        u = rng.standard_normal((6, 1)).astype(np.float32)
+        v = rng.standard_normal((4, 1)).astype(np.float32)
+        out = bm(a, mesh8).rank_one_update(bm(u, mesh8), bm(v, mesh8))
+        np.testing.assert_allclose(out.compute().to_numpy(), a + u @ v.T,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestNormalEquations:
+    def test_linreg_normal_equations(self, mesh8, rng):
+        # the reference's flagship workload: (XᵀX)⁻¹Xᵀy pieces via the IR
+        x = rng.standard_normal((64, 8)).astype(np.float32)
+        y = rng.standard_normal((64, 1)).astype(np.float32)
+        X, Y = bm(x, mesh8), bm(y, mesh8)
+        xtx = X.t().multiply(X).compute().to_numpy()
+        xty = X.t().multiply(Y).compute().to_numpy()
+        np.testing.assert_allclose(xtx, x.T @ x, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(xty, x.T @ y, rtol=1e-4, atol=1e-4)
+        theta = np.linalg.solve(xtx, xty)
+        oracle = np.linalg.lstsq(x, y, rcond=None)[0]
+        np.testing.assert_allclose(theta, oracle, rtol=1e-2, atol=1e-3)

@@ -1,0 +1,156 @@
+"""Optimizer tests — plan-level assertions, the Catalyst comparePlans idiom
+(SURVEY.md §4 "Optimizer tests"). Pure Python, no devices needed: rewrite
+rules, chain DP decisions, statistics propagation."""
+
+import numpy as np
+import pytest
+
+from matrel_tpu.config import MatrelConfig
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.ir import chain, stats
+from matrel_tpu.ir.expr import leaf, matmul, transpose
+from matrel_tpu.ir.rules import apply_rewrites, optimize
+
+
+def L(n, m, mesh, nnz=None, rng=None):
+    a = np.zeros((n, m), dtype=np.float32)
+    bm = BlockMatrix.from_numpy(a, mesh=mesh, nnz=nnz)
+    return leaf(bm)
+
+
+class TestRewrites:
+    def test_double_transpose(self, mesh8):
+        a = L(4, 6, mesh8)
+        e = apply_rewrites(transpose(transpose(a)))
+        assert e is a
+
+    def test_transpose_of_matmul(self, mesh8):
+        a, b = L(4, 5, mesh8), L(5, 6, mesh8)
+        e = apply_rewrites(transpose(matmul(a, b)))
+        # (A·B)ᵀ → Bᵀ·Aᵀ
+        assert e.kind == "matmul"
+        assert e.children[0].kind == "transpose"
+        assert e.children[0].children[0] is b
+        assert e.children[1].children[0] is a
+        assert e.shape == (6, 4)
+
+    def test_rowsum_pushdown(self, mesh8):
+        a, b = L(4, 5, mesh8), L(5, 6, mesh8)
+        e = apply_rewrites(matmul(a, b).row_sum())
+        # rowSum(A·B) → A · rowSum(B)
+        assert e.kind == "matmul"
+        assert e.children[0] is a
+        assert e.children[1].kind == "agg"
+        assert e.children[1].attrs["axis"] == "row"
+        assert e.shape == (4, 1)
+
+    def test_colsum_pushdown(self, mesh8):
+        a, b = L(4, 5, mesh8), L(5, 6, mesh8)
+        e = apply_rewrites(matmul(a, b).col_sum())
+        assert e.kind == "matmul"
+        assert e.children[0].kind == "agg"
+        assert e.children[1] is b
+
+    def test_sum_of_matmul(self, mesh8):
+        a, b = L(4, 5, mesh8), L(5, 6, mesh8)
+        e = apply_rewrites(matmul(a, b).sum())
+        # sum(A·B) → colSum(A)·rowSum(B): a (1,5)x(5,1) matmul
+        assert e.kind == "matmul"
+        assert e.shape == (1, 1)
+        assert e.children[0].kind == "agg" and e.children[1].kind == "agg"
+
+    def test_trace_of_matmul(self, mesh8):
+        a, b = L(4, 5, mesh8), L(5, 4, mesh8)
+        e = apply_rewrites(matmul(a, b).trace())
+        # trace(A·B) → sum(A ⊙ Bᵀ): no matmul remains
+        assert e.kind == "agg" and e.attrs["axis"] == "all"
+        assert e.children[0].kind == "elemwise"
+
+    def test_rowsum_of_transpose(self, mesh8):
+        a = L(4, 6, mesh8)
+        e = apply_rewrites(transpose(a).row_sum())
+        assert e.kind == "transpose"
+        assert e.children[0].attrs["axis"] == "col"
+
+    def test_scalar_folding(self, mesh8):
+        a = L(4, 4, mesh8)
+        e = apply_rewrites(leaf_expr := (a.multiply_scalar(2.0).multiply_scalar(3.0)))
+        assert e.kind == "scalar" and e.attrs["value"] == 6.0
+        e2 = apply_rewrites(a.multiply_scalar(1.0))
+        assert e2 is a
+
+    def test_selection_pushdown_through_matmul(self, mesh8):
+        a, b = L(4, 5, mesh8), L(5, 6, mesh8)
+        sel = matmul(a, b).select_index(rows=lambda i: i < 2)
+        e = apply_rewrites(sel)
+        # σ_rows(A·B) → σ_rows(A)·B
+        assert e.kind == "matmul"
+        assert e.children[0].kind == "select_index"
+        assert e.children[1] is b
+
+
+class TestChainDP:
+    def test_skewed_chain_reorders(self, mesh8):
+        # A(10x1000)·B(1000x10)·C(10x1000): left-assoc is vastly cheaper
+        a, b, c = L(10, 1000, mesh8), L(1000, 10, mesh8), L(10, 1000, mesh8)
+        built = matmul(a, matmul(b, c))  # deliberately bad parenthesisation
+        opt = chain.reorder_chains(built)
+        # optimal: (A·B)·C
+        assert opt.children[0].kind == "matmul"
+        assert opt.children[0].children[0] is a
+        assert opt.children[1] is c
+        assert chain.chain_cost(opt) < chain.chain_cost(built)
+
+    def test_chain_cost_matches_classic_dp(self, mesh8):
+        # classic CLRS instance: dims 30x35, 35x15, 15x5, 5x10, 10x20, 20x25
+        dims = [(30, 35), (35, 15), (15, 5), (5, 10), (10, 20), (20, 25)]
+        ops = [L(n, m, mesh8) for n, m in dims]
+        e = ops[0]
+        for o in ops[1:]:
+            e = matmul(e, o)
+        opt, cost = chain.optimal_order(chain.collect_chain(e))
+        # CLRS optimal scalar-mult count is 15125; our cost is 2x (FLOPs)
+        assert cost == pytest.approx(2 * 15125)
+
+    def test_sparsity_aware_ordering(self, mesh8):
+        n = 100
+        dense = L(n, n, mesh8)
+        sp1 = L(n, n, mesh8, nnz=int(n * n * 0.01))
+        sp2 = L(n, n, mesh8, nnz=int(n * n * 0.01))
+        # (dense·sp1)·sp2 vs dense·(sp1·sp2): multiplying the two sparse
+        # ones first is far cheaper; equal dims means only sparsity decides
+        built = matmul(matmul(dense, sp1), sp2)
+        opt = chain.reorder_chains(built)
+        assert opt.children[0] is dense
+        assert opt.children[1].kind == "matmul"
+
+    def test_normal_equations_plan(self, mesh8):
+        # linreg: Xᵀ·X and Xᵀ·y with X 10000x100 — full optimize() pass
+        x = L(10000, 100, mesh8)
+        y = L(10000, 1, mesh8)
+        e = optimize(matmul(transpose(x), matmul(x, matmul(transpose(x), y))))
+        # chain DP must avoid materialising X·Xᵀ (10000x10000)
+        def max_intermediate(node):
+            sizes = [node.shape[0] * node.shape[1]] if node.kind == "matmul" else []
+            for ch in node.children:
+                sizes.extend(max_intermediate(ch))
+            return sizes
+        assert max(max_intermediate(e)) <= 10000 * 1
+
+
+class TestStats:
+    def test_matmul_density(self):
+        assert stats.matmul_density(1.0, 1.0, 100) == 1.0
+        assert stats.matmul_density(0.0, 0.5, 100) == 0.0
+        d = stats.matmul_density(0.01, 0.01, 1000)
+        assert 0.05 < d < 0.15  # 1-(1-1e-4)^1000 ≈ 0.095
+
+    def test_propagation_through_expr(self, mesh8):
+        a = L(100, 100, mesh8, nnz=100)   # 1% dense
+        b = L(100, 100, mesh8, nnz=100)
+        mm = matmul(a, b)
+        assert mm.nnz is not None and mm.nnz < 100 * 100 * 0.05
+        add = a.add(b)
+        assert add.nnz == pytest.approx(200, rel=0.01)
+        em = a.elem_multiply(b)
+        assert em.density == pytest.approx(0.0001, rel=0.01)

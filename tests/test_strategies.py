@@ -1,0 +1,143 @@
+"""Physical-strategy tests: each of BMM/CPMM/RMM/SUMMA must (a) match the
+numpy oracle on a real multi-device mesh and (b) lower to the collectives
+its reference analogue implies — the HLO-inspection analogue of the
+reference's Catalyst plan assertions (SURVEY.md §4 "plan shape")."""
+
+import jax
+import numpy as np
+import pytest
+
+from matrel_tpu.config import MatrelConfig
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.ir.expr import leaf, matmul
+from matrel_tpu.parallel import planner, strategies
+from matrel_tpu import executor
+
+
+def _run(strategy, a, b, mesh):
+    A = BlockMatrix.from_numpy(a, mesh=mesh)
+    B = BlockMatrix.from_numpy(b, mesh=mesh)
+    f = jax.jit(lambda x, y: strategies.run_matmul(strategy, x, y, mesh, None))
+    out = np.asarray(f(A.data, B.data))
+    return out[: a.shape[0], : b.shape[1]]
+
+
+ALL = ["bmm_left", "bmm_right", "cpmm", "rmm", "xla"]
+
+
+@pytest.mark.parametrize("strategy", ALL)
+def test_strategy_numerics_2x4(strategy, mesh8, rng):
+    a = rng.standard_normal((16, 24)).astype(np.float32)
+    b = rng.standard_normal((24, 32)).astype(np.float32)
+    np.testing.assert_allclose(_run(strategy, a, b, mesh8), a @ b,
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("strategy", ALL + ["summa"])
+def test_strategy_numerics_square_mesh(strategy, mesh_square, rng):
+    a = rng.standard_normal((12, 20)).astype(np.float32)
+    b = rng.standard_normal((20, 8)).astype(np.float32)
+    np.testing.assert_allclose(_run(strategy, a, b, mesh_square), a @ b,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_summa_on_rect_mesh_falls_back(mesh8, rng):
+    a = rng.standard_normal((16, 16)).astype(np.float32)
+    b = rng.standard_normal((16, 16)).astype(np.float32)
+    np.testing.assert_allclose(_run("summa", a, b, mesh8), a @ b,
+                               rtol=1e-4, atol=1e-4)
+
+
+class TestHloCollectives:
+    """CPMM must reduce-scatter; RMM must all-gather with no reduce-scatter;
+    SUMMA must ride a ppermute ring (collective-permute)."""
+
+    def _hlo(self, strategy, mesh, shape=(16, 16)):
+        a = BlockMatrix.random(shape, mesh=mesh, seed=0)
+        b = BlockMatrix.random(shape, mesh=mesh, seed=1)
+        f = jax.jit(lambda x, y: strategies.run_matmul(strategy, x, y, mesh, None))
+        return f.lower(a.data, b.data).compile().as_text()
+
+    def test_cpmm_reduce_scatter(self, mesh8):
+        hlo = self._hlo("cpmm", mesh8)
+        assert "reduce-scatter" in hlo
+
+    def test_rmm_all_gather_only(self, mesh8):
+        hlo = self._hlo("rmm", mesh8)
+        assert "all-gather" in hlo
+        assert "reduce-scatter" not in hlo
+
+    def test_summa_collective_permute(self, mesh_square):
+        hlo = self._hlo("summa", mesh_square)
+        assert "collective-permute" in hlo
+
+    def test_bmm_no_execution_collectives_after_reshard(self, mesh8):
+        # BMM: the only comm is the input broadcast (all-gather of B);
+        # no reduce-scatter / collective-permute anywhere.
+        hlo = self._hlo("bmm_right", mesh8)
+        assert "reduce-scatter" not in hlo
+        assert "collective-permute" not in hlo
+
+
+class TestPlannerChoice:
+    def _mk(self, n, k, m, mesh, nnz_a=None, nnz_b=None):
+        """Planner only reads shapes/stats, so fabricate metadata-true,
+        data-tiny leaves: a small zero matrix with an overridden shape."""
+        import dataclasses
+        a_small = BlockMatrix.from_numpy(
+            np.zeros((8, 8), dtype=np.float32), mesh=mesh)
+        b_small = BlockMatrix.from_numpy(
+            np.zeros((8, 8), dtype=np.float32), mesh=mesh)
+        a = dataclasses.replace(a_small, shape=(n, k), nnz=nnz_a)
+        b = dataclasses.replace(b_small, shape=(k, m), nnz=nnz_b)
+        return matmul(leaf(a), leaf(b))
+
+    def test_small_rhs_broadcasts(self, mesh8):
+        # Classic BMM case: big side already row-partitioned (co-partitioned
+        # input — zero shuffle of it), tiny RHS broadcast. The reference's
+        # canonical broadcast-join situation.
+        import dataclasses
+        from jax.sharding import PartitionSpec as P
+        a_small = BlockMatrix.from_numpy(
+            np.zeros((8, 8), dtype=np.float32), mesh=mesh8,
+            spec=P(("x", "y"), None))
+        b_small = BlockMatrix.from_numpy(
+            np.zeros((8, 8), dtype=np.float32), mesh=mesh8)
+        a = dataclasses.replace(a_small, shape=(100_000, 512))
+        b = dataclasses.replace(b_small, shape=(512, 64))
+        node = matmul(leaf(a), leaf(b))
+        assert planner.choose_strategy(node, mesh8) == "bmm_right"
+
+    def test_2d_input_large_output_prefers_cpmm_over_bmm(self, mesh8):
+        # With A in canonical 2D layout, broadcasting would pay to reshard
+        # the big side row-wise; CPMM leaves A in place and reduce-scatters
+        # the (smaller) output — the cost model must see that.
+        node = self._mk(100_000, 512, 64, mesh8)
+        assert planner.choose_strategy(node, mesh8) == "cpmm"
+
+    def test_large_contraction_uses_cpmm(self, mesh8):
+        cfg = MatrelConfig(broadcast_threshold_bytes=1024)
+        node = self._mk(4096, 65536, 4096, mesh8)
+        assert planner.choose_strategy(node, mesh8, cfg) == "cpmm"
+
+    def test_square_large_not_bmm(self, mesh8):
+        cfg = MatrelConfig(broadcast_threshold_bytes=1024)
+        s = planner.choose_strategy(self._mk(8192, 8192, 8192, mesh8), mesh8, cfg)
+        assert s in ("rmm", "cpmm", "summa")
+
+    def test_single_device_is_xla(self):
+        import jax as j
+        from matrel_tpu.core import mesh as mesh_lib
+        m1 = mesh_lib.make_mesh((1, 1), devices=j.devices()[:1])
+        node = self._mk(1024, 1024, 1024, m1)
+        assert planner.choose_strategy(node, m1) == "xla"
+
+    def test_override(self, mesh8):
+        cfg = MatrelConfig(strategy_override="rmm")
+        node = self._mk(512, 512, 512, mesh8)
+        assert planner.choose_strategy(node, mesh8, cfg) == "rmm"
+
+    def test_annotation_recorded_in_plan(self, mesh8):
+        node = self._mk(100_000, 512, 64, mesh8)
+        plan = executor.compile_expr(node, mesh8)
+        assert "strategy" in plan.optimized.attrs
