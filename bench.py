@@ -1,0 +1,114 @@
+"""Benchmark driver — prints ONE JSON line with the headline metric.
+
+Metric (BASELINE.json:2): dense block-MatMul TFLOPS/chip, measured on the
+4k×4k BlockMatrix multiply config (BASELINE.md row 1) through the full
+framework stack (BlockMatrix → IR → planner → jitted strategy).
+
+vs_baseline: ratio against the self-measured CPU reference (numpy BLAS on
+this host, standing in for the reference's local[*] Spark config —
+BASELINE.md "the build must fill in the CPU reference itself"). The CPU
+number is measured once and cached in cpu_baseline.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N = 4096
+DTYPE = "bfloat16"
+REPEATS = 40
+CPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "cpu_baseline.json")
+
+
+def flops(n: int) -> float:
+    return 2.0 * n * n * n
+
+
+def measure_cpu_baseline() -> float:
+    """numpy (BLAS) matmul TFLOPS on this host — the local[*] stand-in."""
+    a = np.random.default_rng(0).standard_normal((N, N)).astype(np.float32)
+    b = np.random.default_rng(1).standard_normal((N, N)).astype(np.float32)
+    a @ b  # warm up BLAS threads
+    t0 = time.perf_counter()
+    a @ b
+    dt = time.perf_counter() - t0
+    return flops(N) / dt / 1e12
+
+
+def cpu_baseline() -> float:
+    if os.path.exists(CPU_CACHE):
+        with open(CPU_CACHE) as f:
+            return json.load(f)["tflops"]
+    v = measure_cpu_baseline()
+    with open(CPU_CACHE, "w") as f:
+        json.dump({"tflops": v, "n": N, "dtype": "float32"}, f)
+    return v
+
+
+def measure_tpu() -> float:
+    """Marginal per-multiply time through the framework's compiled plan.
+
+    The axon relay acks dispatches before execution completes
+    (block_until_ready is unreliable), so: chain each multiply on the
+    previous result (real data dependency), force completion with a scalar
+    fetch, and take the MARGINAL time between two repeat counts to cancel
+    the fixed relay round-trip latency (~60ms on this host).
+    """
+    import jax
+    import jax.numpy as jnp
+    from matrel_tpu.config import MatrelConfig, set_default_config
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.executor import compile_expr
+
+    set_default_config(MatrelConfig())
+    mesh = mesh_lib.make_mesh()
+    A = BlockMatrix.random((N, N), mesh=mesh, seed=0, dtype=DTYPE)
+    B = BlockMatrix.random((N, N), mesh=mesh, seed=1, dtype=DTYPE)
+    plan = compile_expr(A.expr().multiply(B.expr()), mesh)
+    a_leaf = plan.leaf_order[0]
+    fetch = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+
+    def chained(reps: int) -> float:
+        # keep_input_dtype keeps the chain bf16×bf16 with f32 accumulation
+        cur = plan.run()  # C = A·B
+        for _ in range(reps - 1):
+            cur = plan.run(bindings={a_leaf.uid: cur})  # C ← C·B
+        np.asarray(fetch(cur.data))
+        return 0.0
+
+    chained(2)  # warm both programs
+    lo, hi = 5, 5 + REPEATS
+    dts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        chained(lo)
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        chained(hi)
+        t_hi = time.perf_counter() - t0
+        dts.append(max((t_hi - t_lo) / (hi - lo), 1e-9))
+    dt = sorted(dts)[len(dts) // 2]
+    n_chips = max(1, len(mesh.devices.ravel()))
+    return flops(N) / dt / 1e12 / n_chips
+
+
+def main() -> None:
+    base = cpu_baseline()
+    tpu = measure_tpu()
+    print(json.dumps({
+        "metric": "dense_blockmatmul_tflops_per_chip",
+        "value": round(tpu, 3),
+        "unit": "TFLOPS",
+        "vs_baseline": round(tpu / base, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

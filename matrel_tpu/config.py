@@ -36,6 +36,10 @@ class MatrelConfig:
       default_dtype: dtype for constructors that don't specify one.
       matmul_precision: jax.lax precision for dot_general ("default",
         "high", "highest"). bfloat16 inputs + "highest" ≈ f32 accumulate.
+      keep_input_dtype: cast matmul results back to the common input dtype
+        (f32 accumulation on the MXU, bf16 storage in HBM — halves the
+        write bandwidth of bf16 pipelines; XLA fuses the cast into the
+        matmul epilogue).
       use_pallas: enable hand-written Pallas kernels where available.
       chain_opt: enable the matrix-chain DP reorder.
       rewrite_rules: enable the algebraic rewrite pass.
@@ -50,6 +54,7 @@ class MatrelConfig:
     sparsity_threshold: float = 0.05
     default_dtype: str = "float32"
     matmul_precision: str = "highest"
+    keep_input_dtype: bool = True
     use_pallas: bool = True
     chain_opt: bool = True
     rewrite_rules: bool = True

@@ -122,7 +122,11 @@ class Lowerer:
     def _matmul(self, node: MatExpr, ev) -> Array:
         a, b = ev(node.children[0]), ev(node.children[1])
         strategy = node.attrs.get("strategy", "xla")
-        return strategies.run_matmul(strategy, a, b, self.mesh, self.config)
+        out = strategies.run_matmul(strategy, a, b, self.mesh, self.config)
+        if (self.config.keep_input_dtype and a.dtype == b.dtype
+                and out.dtype != a.dtype):
+            out = out.astype(a.dtype)  # f32 accumulate, input-dtype storage
+        return out
 
     def _elemwise(self, node: MatExpr, ev) -> Array:
         l, r = node.children

@@ -1,0 +1,94 @@
+"""Normal-equations linear regression — the reference's flagship workload
+(SURVEY.md §3.2, BASELINE.md row 3: tall-skinny (XᵀX)⁻¹Xᵀy, 10M×1k).
+
+Reference execution: the DSL query X.t().multiply(X) runs as shuffle-bounded
+Spark stages; the k×k Gram matrix is collected and inverted on the driver.
+TPU rebuild: Gram + RHS build through the IR (so the chain optimizer sees
+the whole expression), lower to ONE jitted program where the tall-skinny
+product reduce-scatters over the mesh, and the tiny k×k solve runs
+replicated on-device via Cholesky — no host round trip at all.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from matrel_tpu.config import MatrelConfig, default_config
+from matrel_tpu.core import padding
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.executor import compile_expr
+from matrel_tpu.ir.expr import matmul, transpose
+
+
+def normal_equations_expr(X: BlockMatrix, y: BlockMatrix):
+    """The logical plan (XᵀX, Xᵀy) as IR expressions."""
+    xe, ye = X.expr(), y.expr()
+    return matmul(transpose(xe), xe), matmul(transpose(xe), ye)
+
+
+def fit(X: BlockMatrix, y: BlockMatrix,
+        l2: float = 0.0,
+        config: Optional[MatrelConfig] = None) -> jax.Array:
+    """Solve argmin ‖Xθ - y‖² (+ l2‖θ‖²) by normal equations.
+
+    Returns θ as a replicated (k, 1) array. The Gram build and the solve are
+    fused into one XLA program per call via plan compilation + a jitted
+    solve; X may be any mesh sharding (typically row-sharded: the data-
+    parallel layout for tall-skinny X).
+    """
+    cfg = config or default_config()
+    gram_e, rhs_e = normal_equations_expr(X, y)
+    gram_plan = compile_expr(gram_e, X.mesh, cfg)
+    rhs_plan = compile_expr(rhs_e, X.mesh, cfg)
+    gram = gram_plan.run()
+    rhs = rhs_plan.run()
+    k = X.shape[1]
+
+    @jax.jit
+    def solve(g, r):
+        gl = g[:k, :k] + l2 * jnp.eye(k, dtype=g.dtype)
+        # Gram matrices are SPD (up to conditioning): Cholesky solve
+        c, low = jax.scipy.linalg.cho_factor(gl)
+        return jax.scipy.linalg.cho_solve((c, low), r[:k, :])
+
+    return solve(gram.data, rhs.data)
+
+
+def fit_fused(X: BlockMatrix, y: BlockMatrix, l2: float = 0.0,
+              config: Optional[MatrelConfig] = None) -> jax.Array:
+    """Single-program variant: Gram, RHS and solve in ONE jit — the shape
+    used by the benchmarks (zero host sync between stages)."""
+    cfg = config or default_config()
+    k = X.shape[1]
+    mesh = X.mesh
+    row_spec = P((mesh.axis_names[0], mesh.axis_names[1]), None)
+
+    @jax.jit
+    def step(xd, yd):
+        xs = jax.lax.with_sharding_constraint(xd, NamedSharding(mesh, row_spec))
+        prec = jax.lax.Precision.HIGHEST
+        gram = jax.lax.with_sharding_constraint(
+            jnp.einsum("nk,nj->kj", xs, xs, precision=prec,
+                       preferred_element_type=jnp.float32),
+            NamedSharding(mesh, P()))
+        rhs = jax.lax.with_sharding_constraint(
+            jnp.einsum("nk,nj->kj", xs, yd, precision=prec,
+                       preferred_element_type=jnp.float32),
+            NamedSharding(mesh, P()))
+        gl = gram[:k, :k] + l2 * jnp.eye(k, dtype=gram.dtype)
+        c, low = jax.scipy.linalg.cho_factor(gl)
+        return jax.scipy.linalg.cho_solve((c, low), rhs[:k, :])
+
+    return step(X.data, y.data)
+
+
+def predict(X: BlockMatrix, theta: jax.Array) -> jax.Array:
+    @jax.jit
+    def f(xd, t):
+        return xd @ jnp.pad(t, ((0, xd.shape[1] - t.shape[0]), (0, 0)))
+
+    return f(X.data, theta)[: X.shape[0]]

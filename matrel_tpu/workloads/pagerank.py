@@ -1,0 +1,72 @@
+"""PageRank power iteration — reference workload (SURVEY.md §3.5,
+BASELINE.md row 5: 1M-node adjacency, 30 matvec rounds).
+
+Reference execution: a driver-side loop; every round is one optimized plan
+execution and one Spark shuffle — the shuffle dominates. TPU rebuild: the
+WHOLE loop is one jitted ``lax.fori_loop``; the matvec's psum rides ICI and
+there is no host round trip between rounds (SURVEY.md §3.5 🔥 note).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from matrel_tpu.config import MatrelConfig, default_config
+from matrel_tpu.core.blockmatrix import BlockMatrix
+
+
+def pagerank(A: BlockMatrix, rounds: int = 30, alpha: float = 0.85,
+             config: Optional[MatrelConfig] = None) -> jax.Array:
+    """r ← α·Âᵀ·r + (1-α)/N, iterated ``rounds`` times inside one program.
+
+    A is the (row-stochastic-normalisable) adjacency matrix: A[i, j] = 1 for
+    an edge i→j. Dangling nodes (zero out-degree) redistribute uniformly.
+    Returns the rank vector as a replicated (N, 1) array.
+    """
+    n = A.shape[0]
+    if A.shape[0] != A.shape[1]:
+        raise ValueError(f"adjacency must be square, got {A.shape}")
+    mesh = A.mesh
+    pn = A.padded_shape[0]
+    out_sharding = NamedSharding(mesh, P())
+
+    @jax.jit
+    def run(ad):
+        valid_row = (jnp.arange(pn) < n)[:, None]
+        deg = jnp.sum(ad, axis=1, keepdims=True)               # out-degree
+        inv_deg = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1e-30), 0.0)
+        dangling = (valid_row & (deg == 0)).astype(ad.dtype)
+        r0 = jnp.where(valid_row, 1.0 / n, 0.0).astype(ad.dtype)
+        teleport = (1.0 - alpha) / n
+
+        def body(_, r):
+            # contribution along edges: Âᵀ·r with Â = D⁻¹A (row-normalised)
+            contrib = jnp.einsum("ij,ik->jk", ad, inv_deg * r,
+                                 precision=jax.lax.Precision.HIGHEST)
+            # dangling mass redistributes uniformly over real nodes
+            dmass = jnp.sum(dangling * r)
+            r_new = alpha * (contrib + dmass / n) + teleport
+            return jnp.where(valid_row, r_new, 0.0)
+
+        r = jax.lax.fori_loop(0, rounds, body, r0)
+        return jax.lax.with_sharding_constraint(r, out_sharding)
+
+    return run(A.data)[:n]
+
+
+def pagerank_numpy_oracle(a, rounds=30, alpha=0.85):
+    """Naive host oracle for tests."""
+    import numpy as np
+    n = a.shape[0]
+    deg = a.sum(1, keepdims=True)
+    inv = np.where(deg > 0, 1.0 / np.maximum(deg, 1e-30), 0.0)
+    r = np.full((n, 1), 1.0 / n, dtype=np.float64)
+    for _ in range(rounds):
+        contrib = (a * inv).T @ r
+        dmass = r[(deg == 0).ravel()].sum()
+        r = alpha * (contrib + dmass / n) + (1 - alpha) / n
+    return r

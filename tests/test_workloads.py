@@ -1,0 +1,83 @@
+"""Workload acceptance tests (SURVEY.md §7.5, BASELINE.md rows 2/3/5):
+linreg, chain reorder, PageRank — numerics vs host oracles on the 8-device
+mesh."""
+
+import numpy as np
+import pytest
+
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.workloads import chain_bench, linreg, pagerank
+
+
+class TestLinreg:
+    def _data(self, rng, n=256, k=8):
+        x = rng.standard_normal((n, k)).astype(np.float32)
+        theta_true = rng.standard_normal((k, 1)).astype(np.float32)
+        y = x @ theta_true + 0.01 * rng.standard_normal((n, 1)).astype(np.float32)
+        return x, y, theta_true
+
+    def test_fit_matches_lstsq(self, mesh8, rng):
+        x, y, _ = self._data(rng)
+        X = BlockMatrix.from_numpy(x, mesh=mesh8)
+        Y = BlockMatrix.from_numpy(y, mesh=mesh8)
+        theta = np.asarray(linreg.fit(X, Y))
+        oracle = np.linalg.lstsq(x, y, rcond=None)[0]
+        np.testing.assert_allclose(theta, oracle, rtol=1e-2, atol=1e-3)
+
+    def test_fit_fused_matches(self, mesh8, rng):
+        x, y, _ = self._data(rng)
+        from jax.sharding import PartitionSpec as P
+        X = BlockMatrix.from_numpy(x, mesh=mesh8, spec=P(("x", "y"), None))
+        Y = BlockMatrix.from_numpy(y, mesh=mesh8, spec=P(("x", "y"), None))
+        theta = np.asarray(linreg.fit_fused(X, Y))
+        oracle = np.linalg.lstsq(x, y, rcond=None)[0]
+        np.testing.assert_allclose(theta, oracle, rtol=1e-2, atol=1e-3)
+
+    def test_ridge_shrinks(self, mesh8, rng):
+        x, y, _ = self._data(rng)
+        X = BlockMatrix.from_numpy(x, mesh=mesh8)
+        Y = BlockMatrix.from_numpy(y, mesh=mesh8)
+        t0 = np.asarray(linreg.fit(X, Y, l2=0.0))
+        t1 = np.asarray(linreg.fit(X, Y, l2=100.0))
+        assert np.linalg.norm(t1) < np.linalg.norm(t0)
+
+
+class TestChain:
+    def test_skewed_chain_picks_cheap_order(self, mesh8):
+        mats = chain_bench.skewed_abc(mesh8, n=256, mid=8)
+        plan, paren, cost = chain_bench.compile_chain(mats)
+        assert paren == "((A·B)·C)" or paren == "(A·(B·C))"
+        # for n >> mid, (A·B)·C costs n*mid*n + n*n*mid vs A·(B·C): both
+        # orders share no term; optimal is A·(B·C): mid·n·mid twice
+        assert paren == "(A·(B·C))"
+
+    def test_chain_numerics(self, mesh8, rng):
+        a = rng.standard_normal((24, 4)).astype(np.float32)
+        b = rng.standard_normal((4, 24)).astype(np.float32)
+        c = rng.standard_normal((24, 4)).astype(np.float32)
+        mats = [BlockMatrix.from_numpy(m, mesh=mesh8) for m in (a, b, c)]
+        plan, _, _ = chain_bench.compile_chain(mats)
+        out = plan.run()
+        np.testing.assert_allclose(out.to_numpy(), a @ b @ c,
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestPageRank:
+    def test_matches_oracle(self, mesh8, rng):
+        n = 50
+        a = (rng.random((n, n)) < 0.1).astype(np.float32)
+        np.fill_diagonal(a, 0)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        r = np.asarray(pagerank.pagerank(A, rounds=30))
+        oracle = pagerank.pagerank_numpy_oracle(a, rounds=30)
+        np.testing.assert_allclose(r, oracle, rtol=1e-3, atol=1e-6)
+        assert r.sum() == pytest.approx(1.0, rel=1e-3)
+
+    def test_dangling_nodes_conserve_mass(self, mesh8):
+        # node 2 has no out-edges
+        a = np.array([[0, 1, 1], [1, 0, 0], [0, 0, 0]], dtype=np.float32)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        r = np.asarray(pagerank.pagerank(A, rounds=50))
+        assert r.sum() == pytest.approx(1.0, rel=1e-4)
+        oracle = pagerank.pagerank_numpy_oracle(a, rounds=50)
+        np.testing.assert_allclose(r, oracle, rtol=1e-3, atol=1e-6)
