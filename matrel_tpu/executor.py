@@ -117,7 +117,36 @@ class Lowerer:
             return _mask_to_logical(out, node.shape)
         if k == "join_value":
             return self._join_value(node, ev)
+        if k == "select_block":
+            x = ev(node.children[0])
+            bs = node.attrs["block_size"]
+            pred = node.attrs["predicate"]
+            pn, pm = x.shape
+            bi = (jnp.arange(pn) // bs)[:, None]
+            bj = (jnp.arange(pm) // bs)[None, :]
+            return jnp.where(pred(bi, bj), x, jnp.zeros((), x.dtype))
+        if k in ("join_rows", "join_cols"):
+            return self._join_axis(node, ev)
         raise NotImplementedError(f"lowering for node kind {k!r}")
+
+    def _join_axis(self, node: MatExpr, ev) -> Array:
+        """Row/col-index joins: statically-shaped pairwise merge along the
+        non-join axis (the replication-scheme joins of the reference)."""
+        l, r = node.children
+        a = ev(l)[: l.shape[0], : l.shape[1]]
+        b = ev(r)[: r.shape[0], : r.shape[1]]
+        merge = node.attrs["merge"]
+        if node.kind == "join_rows":
+            out = merge(a[:, :, None], b[:, None, :])       # (n, ma, mb)
+            out = out.reshape(l.shape[0], l.shape[1] * r.shape[1])
+        else:
+            out = merge(a[:, None, :], b[None, :, :])       # (na, nb, m)
+            out = out.reshape(l.shape[0] * r.shape[0], l.shape[1])
+        pshape = padding.padded_shape(node.shape, self.mesh)
+        if tuple(out.shape) != pshape:
+            out = jnp.pad(out, ((0, pshape[0] - out.shape[0]),
+                                (0, pshape[1] - out.shape[1])))
+        return out
 
     def _matmul(self, node: MatExpr, ev) -> Array:
         a, b = ev(node.children[0]), ev(node.children[1])

@@ -1,0 +1,110 @@
+"""Relational operators over matrices — MatRel's contribution on top of
+MatFast (SURVEY.md §2 "Physical: relational execs", §3.4; paper P1).
+
+A matrix is viewed as the relation (i, j, v). MatRel provides:
+  σ (selection)   on entry values, row/col indices, or blocks
+  γ (aggregation) sum/count/avg/max/min over row/col/all/diag
+  ⋈ (join)        of two matrices on index equality or value predicates,
+                  entries combined by a merge function
+
+Static-shape semantics (the XLA design decision flagged in SURVEY.md §7.6):
+selections return same-shaped matrices with non-matching entries at 0 (the
+relation's "missing"), plus nnz counts — never dynamically-shaped results.
+The executor keeps 0 exactly representable (zero-padding invariant), so
+σ/γ compose exactly with the linear-algebra ops.
+
+This module is the user-facing surface; the nodes live in ir/expr.py and
+lower in executor.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.ir import expr as E
+
+MatLike = Union[BlockMatrix, E.MatExpr]
+
+
+# -- σ selection ------------------------------------------------------------
+
+
+def select_entries(m: MatLike, predicate: Callable, fill: float = 0.0) -> E.MatExpr:
+    """σ_pred on entry values: entries failing ``predicate(v)`` become
+    ``fill`` (default 0 = missing)."""
+    return E.as_expr(m).select_value(predicate, fill=fill)
+
+
+def select_rows(m: MatLike, predicate: Callable) -> E.MatExpr:
+    """σ on row index: keep rows i where ``predicate(i)`` (vectorised)."""
+    return E.as_expr(m).select_index(rows=predicate)
+
+
+def select_cols(m: MatLike, predicate: Callable) -> E.MatExpr:
+    return E.as_expr(m).select_index(cols=predicate)
+
+
+def select_blocks(m: MatLike, predicate: Callable,
+                  block_size: Optional[int] = None) -> E.MatExpr:
+    """σ on block index: keep entries whose (row_block, col_block) =
+    (i // bs, j // bs) satisfies ``predicate(bi, bj)`` — the reference's
+    block-granular selection, expressed through index predicates."""
+    e = E.as_expr(m)
+    bs = block_size or getattr(m, "block_size", 512)
+    import jax.numpy as jnp
+
+    def rows(i):
+        return jnp.ones_like(i, dtype=bool)
+
+    # encode 2D block predicate as a value-level mask via join of row/col
+    # block ids; realised as a select_index with both callables closed over
+    # the block size.
+    return E.MatExpr("select_block", (e,), e.shape, e.nnz,
+                     {"predicate": predicate, "block_size": bs})
+
+
+# -- γ aggregation ----------------------------------------------------------
+
+
+def aggregate(m: MatLike, kind: str, axis: str) -> E.MatExpr:
+    """γ_kind over axis ∈ {row, col, all, diag}; kind ∈ {sum, count, avg,
+    max, min}. count counts nonzero entries (the relation's tuples)."""
+    return E.agg(E.as_expr(m), kind, axis)
+
+
+# -- ⋈ joins ---------------------------------------------------------------
+
+
+def join_on_index(a: MatLike, b: MatLike, merge: Callable) -> E.MatExpr:
+    """⋈ on (i, j) equality — the co-partitioned cogroup join:
+    C[i,j] = merge(A[i,j], B[i,j])."""
+    return E.as_expr(a).join_on_index(E.as_expr(b), merge)
+
+
+def join_on_rows(a: MatLike, b: MatLike, merge: Callable) -> E.MatExpr:
+    """⋈ on row index only: C[i, (j_a, j_b)] pairs — statically shaped as
+    the (n, m_a*m_b) matrix C[i, j_a*m_b + j_b] = merge(A[i,j_a], B[i,j_b]).
+    The replication-scheme row join of the reference."""
+    ae, be = E.as_expr(a), E.as_expr(b)
+    if ae.shape[0] != be.shape[0]:
+        raise ValueError(f"row join needs equal row counts: {ae.shape} vs {be.shape}")
+    shape = (ae.shape[0], ae.shape[1] * be.shape[1])
+    return E.MatExpr("join_rows", (ae, be), shape, None, {"merge": merge})
+
+
+def join_on_cols(a: MatLike, b: MatLike, merge: Callable) -> E.MatExpr:
+    """⋈ on column index: C[(i_a, i_b), j] = merge(A[i_a,j], B[i_b,j]),
+    statically shaped (n_a*n_b, m)."""
+    ae, be = E.as_expr(a), E.as_expr(b)
+    if ae.shape[1] != be.shape[1]:
+        raise ValueError(f"col join needs equal col counts: {ae.shape} vs {be.shape}")
+    shape = (ae.shape[0] * be.shape[0], ae.shape[1])
+    return E.MatExpr("join_cols", (ae, be), shape, None, {"merge": merge})
+
+
+def join_on_values(a: MatLike, b: MatLike, merge: Callable,
+                   predicate: Optional[Callable] = None) -> E.MatExpr:
+    """⋈ on value predicate over all entry pairs; see ir.expr.join_on_value
+    for the static pair-matrix semantics."""
+    return E.as_expr(a).join_on_value(E.as_expr(b), merge, predicate)

@@ -1,0 +1,132 @@
+"""Relational operator tests — σ/γ/⋈ semantics vs numpy oracles
+(SURVEY.md §3.4; the MatRel-paper relational exec suite analogue)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.relational import ops as R
+
+
+def bm(arr, mesh, **kw):
+    return BlockMatrix.from_numpy(np.asarray(arr, dtype=np.float32), mesh=mesh, **kw)
+
+
+class TestSelection:
+    def test_select_entries_value_predicate(self, mesh8, rng):
+        a = rng.standard_normal((9, 9)).astype(np.float32)
+        A = bm(a, mesh8)
+        out = R.select_entries(A, lambda v: v > 0).compute().to_numpy()
+        np.testing.assert_allclose(out, np.where(a > 0, a, 0), rtol=1e-6)
+
+    def test_select_entries_custom_fill(self, mesh8):
+        a = np.array([[1.0, -2.0], [-3.0, 4.0]], dtype=np.float32)
+        out = R.select_entries(bm(a, mesh8), lambda v: v > 0, fill=-1.0)
+        np.testing.assert_allclose(out.compute().to_numpy(),
+                                   [[1.0, -1.0], [-1.0, 4.0]])
+
+    def test_select_rows(self, mesh8, rng):
+        a = rng.standard_normal((10, 6)).astype(np.float32)
+        out = R.select_rows(bm(a, mesh8), lambda i: i % 2 == 0)
+        expect = a.copy()
+        expect[1::2, :] = 0
+        np.testing.assert_allclose(out.compute().to_numpy(), expect, rtol=1e-6)
+
+    def test_select_cols(self, mesh8, rng):
+        a = rng.standard_normal((6, 10)).astype(np.float32)
+        out = R.select_cols(bm(a, mesh8), lambda j: j < 3)
+        expect = a.copy()
+        expect[:, 3:] = 0
+        np.testing.assert_allclose(out.compute().to_numpy(), expect, rtol=1e-6)
+
+    def test_select_blocks(self, mesh8):
+        a = np.ones((8, 8), dtype=np.float32)
+        # 4x4 blocks: keep only the diagonal blocks
+        out = R.select_blocks(bm(a, mesh8), lambda bi, bj: bi == bj,
+                              block_size=4)
+        got = out.compute().to_numpy()
+        assert got[:4, :4].sum() == 16 and got[4:, 4:].sum() == 16
+        assert got[:4, 4:].sum() == 0 and got[4:, :4].sum() == 0
+
+    def test_selection_composes_with_matmul(self, mesh8, rng):
+        # σ then multiply: masked semantics must flow through the algebra
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        b = rng.standard_normal((8, 8)).astype(np.float32)
+        A, B = bm(a, mesh8), bm(b, mesh8)
+        e = R.select_entries(A, lambda v: v > 0).multiply(B.expr())
+        np.testing.assert_allclose(e.compute().to_numpy(),
+                                   np.where(a > 0, a, 0) @ b,
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestAggregation:
+    def test_all_kinds_all_axes(self, mesh8, rng):
+        a = rng.standard_normal((7, 7)).astype(np.float32)
+        a[a < 0.3] = 0  # make count/avg interesting
+        A = bm(a, mesh8)
+        cases = {
+            ("sum", "row"): a.sum(1, keepdims=True),
+            ("sum", "col"): a.sum(0, keepdims=True),
+            ("sum", "all"): a.sum().reshape(1, 1),
+            ("sum", "diag"): np.trace(a).reshape(1, 1),
+            ("count", "row"): (a != 0).sum(1, keepdims=True).astype(np.float32),
+            ("count", "all"): np.asarray((a != 0).sum(), np.float32).reshape(1, 1),
+            ("max", "row"): a.max(1, keepdims=True),
+            ("min", "col"): a.min(0, keepdims=True),
+        }
+        for (kind, axis), expect in cases.items():
+            got = R.aggregate(A, kind, axis).compute().to_numpy()
+            np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5,
+                                       err_msg=f"{kind}/{axis}")
+
+    def test_avg_counts_nonzero_only(self, mesh8):
+        a = np.array([[2.0, 0.0, 4.0]], dtype=np.float32)
+        got = R.aggregate(bm(a, mesh8), "avg", "row").compute().to_numpy()
+        np.testing.assert_allclose(got, [[3.0]])  # (2+4)/2 nonzero entries
+
+
+class TestJoins:
+    def test_join_on_index(self, mesh8, rng):
+        a = rng.standard_normal((6, 6)).astype(np.float32)
+        b = rng.standard_normal((6, 6)).astype(np.float32)
+        e = R.join_on_index(bm(a, mesh8), bm(b, mesh8), lambda x, y: x * y + 1)
+        # merge(0,0)=1 in the padded region must NOT leak (masked)
+        out = e.compute()
+        np.testing.assert_allclose(out.to_numpy(), a * b + 1, rtol=1e-5)
+        full = np.asarray(out.data)
+        assert np.all(full[6:, :] == 0)
+
+    def test_join_on_rows(self, mesh8):
+        a = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+        b = np.array([[10.0], [20.0]], dtype=np.float32)
+        e = R.join_on_rows(bm(a, mesh8), bm(b, mesh8), lambda x, y: x + y)
+        np.testing.assert_allclose(e.compute().to_numpy(),
+                                   [[11.0, 12.0], [23.0, 24.0]])
+
+    def test_join_on_cols(self, mesh8):
+        a = np.array([[1.0, 2.0]], dtype=np.float32)
+        b = np.array([[10.0, 20.0], [30.0, 40.0]], dtype=np.float32)
+        e = R.join_on_cols(bm(a, mesh8), bm(b, mesh8), lambda x, y: y - x)
+        np.testing.assert_allclose(e.compute().to_numpy(),
+                                   [[9.0, 18.0], [29.0, 38.0]])
+
+    def test_join_on_values(self, mesh8):
+        a = np.array([[1.0, 2.0]], dtype=np.float32)       # entries 1,2
+        b = np.array([[2.0], [3.0]], dtype=np.float32)     # entries 2,3
+        e = R.join_on_values(bm(a, mesh8), bm(b, mesh8),
+                             merge=lambda x, y: x * y,
+                             predicate=lambda x, y: x == y)
+        out = e.compute().to_numpy()
+        assert out.shape == (2, 2)
+        # only the pair (2,2) matches → value 4 at (entry#2 of A, entry#1 of B)
+        assert out.sum() == pytest.approx(4.0)
+        assert out[1, 0] == pytest.approx(4.0)
+
+    def test_index_join_then_aggregate(self, mesh8, rng):
+        # the paper's pattern: join on index, filter, then aggregate
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        b = rng.standard_normal((8, 8)).astype(np.float32)
+        e = R.join_on_index(bm(a, mesh8), bm(b, mesh8), jnp.maximum)
+        s = R.aggregate(e, "sum", "all").compute().to_numpy()[0, 0]
+        assert s == pytest.approx(np.maximum(a, b).sum(), rel=1e-4)
