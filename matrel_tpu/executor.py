@@ -87,6 +87,10 @@ class Lowerer:
         k = node.kind
         if k == "leaf":
             return leaf_arrays[leaf_pos[node.uid]]
+        if k == "sparse_leaf":
+            # densify when a sparse matrix is used outside a matmul; the
+            # SpMM fast path handles the matmul case below
+            return node.attrs["matrix"].to_dense(self.config).data
         if k == "transpose":
             return ev(node.children[0]).T
         if k == "matmul":
@@ -149,6 +153,15 @@ class Lowerer:
         return out
 
     def _matmul(self, node: MatExpr, ev) -> Array:
+        l, r = node.children
+        if l.kind == "sparse_leaf":
+            from matrel_tpu.ops import spmm as spmm_lib
+            return spmm_lib.apply(l.attrs["matrix"], ev(r), r.shape,
+                                  self.config)
+        if r.kind == "sparse_leaf" and l.kind != "sparse_leaf":
+            # A·S = (Sᵀ·Aᵀ)ᵀ would transpose the tile stack; round-trip
+            # through dense for now (rare in the reference workloads).
+            pass
         a, b = ev(node.children[0]), ev(node.children[1])
         strategy = node.attrs.get("strategy", "xla")
         out = strategies.run_matmul(strategy, a, b, self.mesh, self.config)

@@ -1,0 +1,122 @@
+"""Pallas TPU kernel for block-sparse × dense MatMul.
+
+The hot op of BASELINE row 4, hand-scheduled: the sparse tile list drives a
+scalar-prefetched grid, so the kernel DMAs exactly the dense row-blocks the
+nonzero tiles touch — no gather materialisation, no segment-sum pass, and
+revisit-accumulation directly in the output VMEM block.
+
+Grid: (m_tiles, nnzb) — tile index varies fastest, so all sparse tiles are
+processed consecutively for a fixed output column tile, and output blocks
+are revisited consecutively for runs of equal block_rows (the tile list is
+row-major sorted; TPU grids execute sequentially, which makes the
+accumulate-in-place safe).
+
+Tile payloads stay in the input dtype (bf16 friendly); accumulation is f32
+in the MXU via preferred_element_type.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import NamedSharding
+
+from matrel_tpu.config import MatrelConfig
+
+
+def _kernel(brows, bcols, blocks_ref, d_ref, out_ref):
+    i = pl.program_id(1)  # sparse-tile index (fastest)
+    row = brows[i]
+    first_visit = jnp.logical_or(i == 0, brows[jnp.maximum(i - 1, 0)] != row)
+
+    @pl.when(first_visit)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    tile = blocks_ref[0]          # [bs, bs]
+    dtile = d_ref[0]              # [bs, tm]
+    out_ref[:] += jax.lax.dot(
+        tile, dtile,
+        precision=jax.lax.Precision.HIGHEST,   # full f32 on the MXU
+        preferred_element_type=jnp.float32,
+    ).astype(out_ref.dtype)
+
+
+def make_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg: MatrelConfig,
+              interpret: bool = False):
+    """Build a jitted SpMM runner bound to S's static tile metadata."""
+    import numpy as np
+
+    bs = S.block_size
+    gr, gc = S.grid
+
+    # Every output row-block must be visited at least once or its VMEM block
+    # is never initialised: statically append one zero tile per empty row
+    # and re-sort row-major so revisit-accumulation stays consecutive.
+    host_rows = np.asarray(S.block_rows)
+    host_cols = np.asarray(S.block_cols)
+    empty_rows = np.setdiff1d(np.arange(gr, dtype=np.int32), host_rows)
+    all_rows = np.concatenate([host_rows, empty_rows]).astype(np.int32)
+    all_cols = np.concatenate(
+        [host_cols, np.zeros_like(empty_rows)]).astype(np.int32)
+    perm = np.lexsort((all_cols, all_rows))
+    all_rows, all_cols = all_rows[perm], all_cols[perm]
+    n_pad_tiles = len(empty_rows)
+    # position of each combined tile in the original payload stack; padded
+    # tiles point at index nnzb (the appended zero tile)
+    src = np.concatenate([np.arange(S.nnzb), np.full(n_pad_tiles, S.nnzb)])
+    src = src[perm].astype(np.int32)
+    nnzb = S.nnzb + n_pad_tiles
+    # output column tile: whole padded m if small, else 512-wide strips
+    tm = pm if pm <= 512 else 512
+    while pm % tm != 0:  # pm is a multiple of the device count, keep it even
+        tm //= 2
+        if tm < 128:
+            tm = pm  # fall back to one strip
+            break
+    m_tiles = pm // tm
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,           # block_rows, block_cols
+        grid=(m_tiles, nnzb),
+        in_specs=[
+            pl.BlockSpec((1, bs, bs), lambda j, i, brows, bcols: (i, 0, 0)),
+            pl.BlockSpec((1, bs, tm), lambda j, i, brows, bcols: (bcols[i], 0, j)),
+        ],
+        out_specs=pl.BlockSpec((bs, tm), lambda j, i, brows, bcols: (brows[i], j)),
+    )
+
+    out_dtype = S.blocks.dtype
+    kernel = pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((gr * bs, pm), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )
+
+    @jax.jit
+    def run(blocks, brows, bcols, dd):
+        del brows, bcols  # replaced by the coverage-padded static metadata
+        mesh = S.mesh
+        dd = jax.lax.with_sharding_constraint(dd, NamedSharding(mesh, d_spec))
+        want_rows = gc * bs
+        if dd.shape[0] < want_rows:
+            dd = jnp.pad(dd, ((0, want_rows - dd.shape[0]), (0, 0)))
+        dblocks = dd.reshape(gc, bs, pm)
+        payload = jnp.concatenate(
+            [blocks, jnp.zeros((1, bs, bs), blocks.dtype)])[jnp.asarray(src)]
+        out = kernel(jnp.asarray(all_rows), jnp.asarray(all_cols),
+                     payload, dblocks)
+        out = out[: out_pshape[0], : out_pshape[1]]
+        if out.shape != out_pshape:
+            out = jnp.pad(out, ((0, out_pshape[0] - out.shape[0]),
+                                (0, out_pshape[1] - out.shape[1])))
+        return jax.lax.with_sharding_constraint(out, out_sharding)
+
+    return run

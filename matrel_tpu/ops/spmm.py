@@ -1,0 +1,117 @@
+"""Block-sparse × dense MatMul (SpMM) — the BASELINE row-4 op.
+
+Portable XLA path: gather the dense operand's row-blocks for each sparse
+tile, one batched MXU matmul over the tile stack, segment-sum partial
+products into output row-blocks. Everything is static-shaped; the MXU sees
+one big [nnzb, bs, bs] × [nnzb, bs, m] batch — exactly the shape it likes.
+
+Distribution: the sparse operand (tile stack) is replicated — the broadcast
+side of a BMM-style plan (SURVEY.md §2 BMM) — and the dense operand is
+column-sharded, so each device computes full rows × its column slice with
+ZERO execution-time collectives.
+
+The Pallas fast path (ops/pallas_spmm.py) replaces the gather+segment-sum
+with scalar-prefetched DMA when running on real TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from matrel_tpu.config import MatrelConfig, default_config
+from matrel_tpu.core import mesh as mesh_lib, padding
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.core.sparse import BlockSparseMatrix
+
+
+def _use_pallas(cfg: MatrelConfig) -> bool:
+    return cfg.use_pallas and jax.default_backend() not in ("cpu",)
+
+
+def _dense_spec(pm: int, mesh) -> P:
+    x, y = mesh.axis_names
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    if pm % (gx * gy) == 0 and pm >= gx * gy:
+        return P(None, (x, y))
+    return P()
+
+
+def apply(S: BlockSparseMatrix, dd: jax.Array,
+          d_shape: Tuple[int, int],
+          config: Optional[MatrelConfig] = None,
+          interpret: bool = False) -> jax.Array:
+    """Trace-compatible SpMM: S (static metadata) × dense padded array
+    ``dd`` of logical shape ``d_shape``. Returns the padded product with
+    canonical output sharding."""
+    cfg = config or default_config()
+    n, k = S.shape
+    k2, m = d_shape
+    if k != k2:
+        raise ValueError(f"spmm shape mismatch: {S.shape} x {d_shape}")
+    mesh = S.mesh
+    out_pshape = padding.padded_shape((n, m), mesh)
+    out_sharding = padding.canonical_sharding(out_pshape, mesh)
+    pm = dd.shape[1]
+    d_spec = _dense_spec(pm, mesh)
+    if _use_pallas(cfg) or interpret:
+        from matrel_tpu.ops import pallas_spmm
+        run = pallas_spmm.make_spmm(S, pm, out_pshape, d_spec, out_sharding,
+                                    cfg, interpret=interpret)
+    else:
+        run = _xla_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg)
+    return run(S.blocks, S.block_rows, S.block_cols, dd)
+
+
+def spmm(S: BlockSparseMatrix, D: BlockMatrix,
+         config: Optional[MatrelConfig] = None,
+         interpret: bool = False) -> BlockMatrix:
+    """C = S @ D with S block-sparse (n×k), D dense (k×m)."""
+    cfg = config or default_config()
+    n, _ = S.shape
+    _, m = D.shape
+    data = apply(S, D.data, D.shape, cfg, interpret=interpret)
+    return BlockMatrix.from_array(
+        data, (n, m), S.mesh,
+        padding.canonical_spec(tuple(data.shape), S.mesh),
+        nnz=None, block_size=S.block_size)
+
+
+def _xla_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg):
+    bs = S.block_size
+    gr, gc = S.grid
+    mesh = S.mesh
+    prec = getattr(jax.lax.Precision, cfg.matmul_precision.upper(),
+                   jax.lax.Precision.HIGHEST)
+
+    @jax.jit
+    def run(blocks, brows, bcols, dd):
+        dd = jax.lax.with_sharding_constraint(dd, NamedSharding(mesh, d_spec))
+        want_rows = gc * bs
+        if dd.shape[0] < want_rows:
+            dd = jnp.pad(dd, ((0, want_rows - dd.shape[0]), (0, 0)))
+        dblocks = dd[: want_rows].reshape(gc, bs, pm)
+        gathered = jnp.take(dblocks, bcols, axis=0)        # [nnzb, bs, pm]
+        partial = jax.lax.dot_general(
+            blocks, gathered,
+            (((2,), (1,)), ((0,), (0,))),                   # batched tile GEMM
+            precision=prec,
+            preferred_element_type=jnp.float32)             # [nnzb, bs, pm]
+        summed = jax.ops.segment_sum(partial, brows, num_segments=gr)
+        out = summed.reshape(gr * bs, pm).astype(blocks.dtype)
+        out = out[: out_pshape[0], : out_pshape[1]]
+        if out.shape != out_pshape:
+            out = jnp.pad(out, ((0, out_pshape[0] - out.shape[0]),
+                                (0, out_pshape[1] - out.shape[1])))
+        return jax.lax.with_sharding_constraint(out, out_sharding)
+
+    return run
+
+
+def spmv(S: BlockSparseMatrix, v: BlockMatrix,
+         config: Optional[MatrelConfig] = None) -> BlockMatrix:
+    """Sparse matrix × vector — the PageRank building block."""
+    return spmm(S, v, config)

@@ -1,0 +1,114 @@
+"""Block-sparse path tests (SURVEY.md §7.7, BASELINE row 4): representation
+round-trips, XLA SpMM vs oracle, Pallas kernel in interpret mode, IR
+integration."""
+
+import numpy as np
+import pytest
+
+from matrel_tpu.config import MatrelConfig
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.core.sparse import BlockSparseMatrix
+from matrel_tpu.ops import spmm as spmm_lib
+
+
+def random_block_sparse_np(rng, n, k, bs, density):
+    """Host oracle generator: block-sparse numpy matrix."""
+    gr, gc = n // bs, k // bs
+    a = np.zeros((n, k), dtype=np.float32)
+    nblocks = max(1, int(gr * gc * density))
+    flat = rng.choice(gr * gc, size=nblocks, replace=False)
+    for f in flat:
+        bi, bj = f // gc, f % gc
+        a[bi * bs:(bi + 1) * bs, bj * bs:(bj + 1) * bs] = rng.standard_normal((bs, bs))
+    return a
+
+
+class TestRepresentation:
+    def test_from_numpy_roundtrip(self, mesh8, rng):
+        a = random_block_sparse_np(rng, 32, 24, 8, 0.3)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        np.testing.assert_allclose(S.to_numpy(), a, rtol=1e-6)
+        assert S.nnzb < (32 // 8) * (24 // 8)  # actually sparse
+
+    def test_ragged_shape(self, mesh8, rng):
+        a = np.zeros((13, 11), dtype=np.float32)
+        a[0, 0] = 5.0
+        a[12, 10] = 7.0
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        np.testing.assert_allclose(S.to_numpy(), a, rtol=1e-6)
+
+    def test_to_dense(self, mesh8, rng):
+        a = random_block_sparse_np(rng, 16, 16, 8, 0.5)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        D = S.to_dense()
+        assert isinstance(D, BlockMatrix)
+        np.testing.assert_allclose(D.to_numpy(), a, rtol=1e-6)
+
+    def test_random_density(self, mesh8):
+        S = BlockSparseMatrix.random((64, 64), block_density=0.25,
+                                     block_size=8, mesh=mesh8, seed=3)
+        assert S.nnzb == 16  # 64 blocks * 0.25
+        assert S.density == pytest.approx(0.25)
+
+
+class TestSpMM:
+    def test_xla_spmm_matches_oracle(self, mesh8, rng):
+        a = random_block_sparse_np(rng, 32, 24, 8, 0.3)
+        d = rng.standard_normal((24, 16)).astype(np.float32)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        D = BlockMatrix.from_numpy(d, mesh=mesh8)
+        cfg = MatrelConfig(use_pallas=False)
+        out = spmm_lib.spmm(S, D, cfg)
+        np.testing.assert_allclose(out.to_numpy(), a @ d, rtol=1e-4, atol=1e-4)
+
+    def test_spmm_with_empty_rows(self, mesh8, rng):
+        # entire block-rows with no tiles: output rows must be exactly zero
+        a = np.zeros((32, 16), dtype=np.float32)
+        a[8:16, 0:8] = rng.standard_normal((8, 8))  # only block-row 1
+        d = rng.standard_normal((16, 8)).astype(np.float32)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        D = BlockMatrix.from_numpy(d, mesh=mesh8)
+        out = spmm_lib.spmm(S, D, MatrelConfig(use_pallas=False))
+        np.testing.assert_allclose(out.to_numpy(), a @ d, rtol=1e-4, atol=1e-4)
+
+    def test_pallas_interpret_matches_oracle(self, mesh8, rng):
+        a = random_block_sparse_np(rng, 32, 32, 8, 0.3)
+        a[8:16, :] = 0  # leave an empty block-row for coverage-padding path
+        d = rng.standard_normal((32, 16)).astype(np.float32)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        D = BlockMatrix.from_numpy(d, mesh=mesh8)
+        out = spmm_lib.spmm(S, D, MatrelConfig(use_pallas=False),
+                            interpret=True)
+        np.testing.assert_allclose(out.to_numpy(), a @ d, rtol=1e-4, atol=1e-4)
+
+    def test_spmv(self, mesh8, rng):
+        a = random_block_sparse_np(rng, 32, 32, 8, 0.4)
+        v = rng.standard_normal((32, 1)).astype(np.float32)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        V = BlockMatrix.from_numpy(v, mesh=mesh8)
+        out = spmm_lib.spmv(S, V, MatrelConfig(use_pallas=False))
+        np.testing.assert_allclose(out.to_numpy(), a @ v, rtol=1e-4, atol=1e-4)
+
+
+class TestIRIntegration:
+    def test_sparse_multiply_via_dsl(self, mesh8, rng):
+        a = random_block_sparse_np(rng, 16, 16, 8, 0.5)
+        d = rng.standard_normal((16, 16)).astype(np.float32)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        D = BlockMatrix.from_numpy(d, mesh=mesh8)
+        e = S.multiply(D)
+        np.testing.assert_allclose(e.compute().to_numpy(), a @ d,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_sparse_leaf_densifies_elsewhere(self, mesh8, rng):
+        a = random_block_sparse_np(rng, 16, 16, 8, 0.5)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        e = S.expr().row_sum()
+        np.testing.assert_allclose(e.compute().to_numpy(),
+                                   a.sum(1, keepdims=True), rtol=1e-4, atol=1e-4)
+
+    def test_sparse_stats_feed_chain_dp(self, mesh8, rng):
+        e = BlockSparseMatrix.random((64, 64), 0.1, block_size=8,
+                                     mesh=mesh8).expr()
+        assert e.nnz is not None
+        assert e.density <= 0.15
