@@ -1,0 +1,237 @@
+"""SQL-ish entry point over registered matrix tables.
+
+The reference exposes matrix queries through SQL extensions on Spark SQL
+(SURVEY.md §2 "SQL entry point" — syntax unverifiable from the empty mount,
+confidence LOW, so this module defines a documented surface rather than
+guessing the exact grammar): an expression language over the session
+catalog, compiled to the same MatExpr IR as the DSL, hence optimized and
+executed identically.
+
+Grammar (Python-expression syntax, parsed via ``ast`` — no eval):
+    SELECT <expr> [FROM t1, t2, ...]     -- FROM optional; names resolve
+                                            against the session catalog
+    <expr> :=
+        A * B            matrix multiply        A + B | A - B  elementwise
+        A .* B  → elemmul(A, B)                 A / B          elementwise
+        2 * A | A * 2    scalar multiply        A + 2          scalar add
+        transpose(A) | t(A)
+        rowsum(e) colsum(e) sum(e) trace(e) vec(e)
+        rowmax/rowmin/colmax/colmin/rowcount/rowavg/colcount/colavg(e)
+        power(e, p)
+        select(e, "v > 0" [, fill])     σ on entry values
+        selectrows(e, "i % 2 == 0")     σ on row index
+        selectcols(e, "j < 4")          σ on col index
+        joinindex(a, b, "x * y")        ⋈ on index with merge expr
+
+Predicate / merge strings are tiny lambdas over (v) / (i) / (j) / (x, y),
+parsed with the same restricted-ast machinery.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Callable, Dict
+
+import jax.numpy as jnp
+
+from matrel_tpu.ir import expr as E
+
+_BINOPS = {
+    ast.Add: "add", ast.Sub: "sub", ast.Div: "div",
+}
+
+_AGG_FNS = {
+    "rowsum": ("sum", "row"), "colsum": ("sum", "col"),
+    "sum": ("sum", "all"), "trace": ("sum", "diag"),
+    "rowmax": ("max", "row"), "rowmin": ("min", "row"),
+    "colmax": ("max", "col"), "colmin": ("min", "col"),
+    "rowcount": ("count", "row"), "colcount": ("count", "col"),
+    "rowavg": ("avg", "row"), "colavg": ("avg", "col"),
+}
+
+
+class SqlError(ValueError):
+    pass
+
+
+def _compile_lambda(src: str, argnames: tuple) -> Callable:
+    """Compile a restricted arithmetic/comparison expression into a fn over
+    jnp arrays. Only names in ``argnames``, literals, arithmetic,
+    comparisons, and boolean ops are allowed."""
+    tree = ast.parse(src, mode="eval")
+
+    allowed = (ast.Expression, ast.BinOp, ast.UnaryOp, ast.Compare,
+               ast.BoolOp, ast.Name, ast.Constant, ast.Load,
+               ast.Add, ast.Sub, ast.Mult, ast.Div, ast.Mod, ast.Pow,
+               ast.USub, ast.UAdd, ast.Not,
+               ast.Eq, ast.NotEq, ast.Lt, ast.LtE, ast.Gt, ast.GtE,
+               ast.And, ast.Or)
+    for node in ast.walk(tree):
+        if not isinstance(node, allowed):
+            raise SqlError(f"disallowed syntax in predicate: "
+                           f"{type(node).__name__} in {src!r}")
+        if isinstance(node, ast.Name) and node.id not in argnames:
+            raise SqlError(f"unknown name {node.id!r} in predicate {src!r}; "
+                           f"allowed: {argnames}")
+
+    def fn(*args):
+        env = dict(zip(argnames, args))
+
+        def ev(n):
+            if isinstance(n, ast.Expression):
+                return ev(n.body)
+            if isinstance(n, ast.Constant):
+                return n.value
+            if isinstance(n, ast.Name):
+                return env[n.id]
+            if isinstance(n, ast.UnaryOp):
+                v = ev(n.operand)
+                if isinstance(n.op, ast.USub):
+                    return -v
+                if isinstance(n.op, ast.UAdd):
+                    return +v
+                return jnp.logical_not(v)
+            if isinstance(n, ast.BinOp):
+                l, r = ev(n.left), ev(n.right)
+                return {ast.Add: lambda: l + r, ast.Sub: lambda: l - r,
+                        ast.Mult: lambda: l * r, ast.Div: lambda: l / r,
+                        ast.Mod: lambda: l % r, ast.Pow: lambda: l ** r,
+                        }[type(n.op)]()
+            if isinstance(n, ast.Compare):
+                l = ev(n.left)
+                out = None
+                for op, cmp in zip(n.ops, n.comparators):
+                    r = ev(cmp)
+                    res = {ast.Eq: lambda: l == r, ast.NotEq: lambda: l != r,
+                           ast.Lt: lambda: l < r, ast.LtE: lambda: l <= r,
+                           ast.Gt: lambda: l > r, ast.GtE: lambda: l >= r,
+                           }[type(op)]()
+                    out = res if out is None else jnp.logical_and(out, res)
+                    l = r
+                return out
+            if isinstance(n, ast.BoolOp):
+                vals = [ev(v) for v in n.values]
+                acc = vals[0]
+                for v in vals[1:]:
+                    acc = (jnp.logical_and(acc, v)
+                           if isinstance(n.op, ast.And)
+                           else jnp.logical_or(acc, v))
+                return acc
+            raise SqlError(f"unhandled node {type(n).__name__}")
+
+        return ev(tree)
+
+    return fn
+
+
+class _Compiler(ast.NodeVisitor):
+    def __init__(self, catalog: Dict[str, Any]):
+        self.catalog = catalog
+
+    def compile(self, src: str) -> E.MatExpr:
+        tree = ast.parse(src, mode="eval")
+        return self._expr(tree.body)
+
+    def _expr(self, n: ast.AST):
+        if isinstance(n, ast.Name):
+            if n.id not in self.catalog:
+                raise SqlError(f"unknown table {n.id!r}")
+            return E.as_expr(self.catalog[n.id])
+        if isinstance(n, ast.Constant) and isinstance(n.value, (int, float)):
+            return float(n.value)
+        if isinstance(n, ast.BinOp):
+            return self._binop(n)
+        if isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.USub):
+            v = self._expr(n.operand)
+            if isinstance(v, float):
+                return -v
+            return v.multiply_scalar(-1.0)
+        if isinstance(n, ast.Call):
+            return self._call(n)
+        raise SqlError(f"unsupported syntax: {type(n).__name__}")
+
+    def _binop(self, n: ast.BinOp):
+        l, r = self._expr(n.left), self._expr(n.right)
+        scalar_l, scalar_r = isinstance(l, float), isinstance(r, float)
+        if isinstance(n.op, ast.Mult):
+            if scalar_l and scalar_r:
+                return l * r
+            if scalar_l:
+                return r.multiply_scalar(l)
+            if scalar_r:
+                return l.multiply_scalar(r)
+            return l.multiply(r)          # '*' between matrices = matmul
+        if isinstance(n.op, ast.MatMult):
+            return l.multiply(r)
+        if type(n.op) in _BINOPS:
+            op = _BINOPS[type(n.op)]
+            if scalar_r and op == "add":
+                return l.add_scalar(r)
+            if scalar_r and op == "sub":
+                return l.add_scalar(-r)
+            if scalar_r and op == "div":
+                return l.multiply_scalar(1.0 / r)
+            if scalar_l:
+                raise SqlError("scalar on the left only supported for *")
+            return E.elemwise(op, l, r)
+        raise SqlError(f"unsupported operator {type(n.op).__name__}")
+
+    def _call(self, n: ast.Call):
+        name = n.func.id.lower() if isinstance(n.func, ast.Name) else None
+        args = n.args
+        if name in ("transpose", "t"):
+            return self._expr(args[0]).t()
+        if name in ("elemmult", "elemmul"):
+            return self._expr(args[0]).elem_multiply(self._expr(args[1]))
+        if name == "multiply":
+            return self._expr(args[0]).multiply(self._expr(args[1]))
+        if name == "add":
+            return self._expr(args[0]).add(self._expr(args[1]))
+        if name == "power":
+            return self._expr(args[0]).power(self._lit(args[1]))
+        if name == "vec":
+            return self._expr(args[0]).vec()
+        if name in _AGG_FNS:
+            kind, axis = _AGG_FNS[name]
+            return E.agg(self._expr(args[0]), kind, axis)
+        if name == "select":
+            pred = _compile_lambda(self._str(args[1]), ("v",))
+            fill = self._lit(args[2]) if len(args) > 2 else 0.0
+            return self._expr(args[0]).select_value(pred, fill=fill)
+        if name == "selectrows":
+            pred = _compile_lambda(self._str(args[1]), ("i",))
+            return self._expr(args[0]).select_index(rows=pred)
+        if name == "selectcols":
+            pred = _compile_lambda(self._str(args[1]), ("j",))
+            return self._expr(args[0]).select_index(cols=pred)
+        if name == "joinindex":
+            merge = _compile_lambda(self._str(args[2]), ("x", "y"))
+            return self._expr(args[0]).join_on_index(self._expr(args[1]), merge)
+        raise SqlError(f"unknown function {name!r}")
+
+    @staticmethod
+    def _str(node) -> str:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        raise SqlError("expected a string literal")
+
+    @staticmethod
+    def _lit(node) -> float:
+        if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+            return float(node.value)
+        if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)
+                and isinstance(node.operand, ast.Constant)):
+            return -float(node.operand.value)
+        raise SqlError("expected a numeric literal")
+
+
+_SELECT_RE = re.compile(r"^\s*select\s+(.*?)(\s+from\s+[\w\s,]+)?\s*;?\s*$",
+                        re.IGNORECASE | re.DOTALL)
+
+
+def parse_sql(query: str, session) -> E.MatExpr:
+    """Compile a SQL-ish query against the session catalog into a MatExpr."""
+    m = _SELECT_RE.match(query)
+    body = m.group(1) if m else query
+    return _Compiler(session.catalog).compile(body.strip())

@@ -1,0 +1,136 @@
+"""Checkpoint / resume — the RDD.checkpoint()/persist() analogue
+(SURVEY.md §5 "Checkpoint / resume").
+
+The reference cuts lineage on iterative jobs by persisting RDDs; recovery is
+lineage recomputation (Spark substrate). XLA has no mid-program retry, so
+the TPU-native mechanism is driver-level checkpoint-and-restart: persist
+named arrays per shard with atomic rename, restore into the same sharding,
+and resume the iteration loop (see resilience.py).
+
+Format: a directory per checkpoint step —
+    <dir>/step_000042.tmp/...  → atomic rename → <dir>/step_000042/
+        meta.json              (shapes, dtypes, specs, user state)
+        <name>.npy             (one file per array, full host gather)
+
+Full-gather is correct on one host; multi-host sharded IO would write one
+file per addressable shard (the layout leaves room: files are per-name).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import tempfile
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from matrel_tpu.core.blockmatrix import BlockMatrix
+
+
+def _spec_to_json(spec: P) -> list:
+    out = []
+    for part in spec:
+        if part is None:
+            out.append(None)
+        elif isinstance(part, (tuple, list)):
+            out.append(list(part))
+        else:
+            out.append(part)
+    return out
+
+
+def _spec_from_json(parts: list) -> P:
+    return P(*[tuple(p) if isinstance(p, list) else p for p in parts])
+
+
+class CheckpointManager:
+    """Writes/reads checkpoints of BlockMatrices + pytree-of-arrays state."""
+
+    def __init__(self, directory: str, keep: int = 2):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    # -- save ---------------------------------------------------------------
+
+    def save(self, step: int,
+             matrices: Optional[Mapping[str, BlockMatrix]] = None,
+             arrays: Optional[Mapping[str, jax.Array]] = None,
+             state: Optional[Dict[str, Any]] = None) -> str:
+        matrices = dict(matrices or {})
+        arrays = dict(arrays or {})
+        final = os.path.join(self.directory, f"step_{step:09d}")
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        meta: Dict[str, Any] = {"step": step, "state": state or {},
+                                "matrices": {}, "arrays": []}
+        for name, bm in matrices.items():
+            bm.data.block_until_ready()
+            np.save(os.path.join(tmp, f"{name}.npy"), np.asarray(bm.data))
+            meta["matrices"][name] = {
+                "shape": list(bm.shape), "spec": _spec_to_json(bm.spec),
+                "nnz": bm.nnz, "block_size": bm.block_size,
+            }
+        for name, arr in arrays.items():
+            np.save(os.path.join(tmp, f"{name}.npy"), np.asarray(arr))
+            meta["arrays"].append(name)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)  # atomic commit
+        self._gc()
+        return final
+
+    # -- restore ------------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        steps = self._steps()
+        return steps[-1] if steps else None
+
+    def restore(self, mesh: Mesh, step: Optional[int] = None
+                ) -> Optional[Tuple[int, Dict[str, BlockMatrix],
+                                    Dict[str, jax.Array], Dict[str, Any]]]:
+        """Returns (step, matrices, arrays, state) or None if empty."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        d = os.path.join(self.directory, f"step_{step:09d}")
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        matrices: Dict[str, BlockMatrix] = {}
+        for name, m in meta["matrices"].items():
+            host = np.load(os.path.join(d, f"{name}.npy"))
+            spec = _spec_from_json(m["spec"])
+            data = jax.device_put(host, NamedSharding(mesh, spec))
+            matrices[name] = BlockMatrix(
+                data=data, shape=tuple(m["shape"]), mesh=mesh, spec=spec,
+                nnz=m["nnz"], block_size=m["block_size"])
+        arrays = {name: jax.device_put(np.load(os.path.join(d, f"{name}.npy")))
+                  for name in meta["arrays"]}
+        return meta["step"], matrices, arrays, meta["state"]
+
+    # -- housekeeping -------------------------------------------------------
+
+    def _steps(self):
+        pat = re.compile(r"^step_(\d{9})$")
+        out = []
+        for name in os.listdir(self.directory):
+            m = pat.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _gc(self):
+        steps = self._steps()
+        for s in steps[: -self.keep] if self.keep > 0 else []:
+            shutil.rmtree(os.path.join(self.directory, f"step_{s:09d}"),
+                          ignore_errors=True)

@@ -1,0 +1,73 @@
+"""Tracing / profiling — the Spark-UI/SparkListener analogue
+(SURVEY.md §5 "Tracing / profiling").
+
+The reference gets stage/task timelines from the Spark UI for free; here:
+  - ``trace(dir)``: jax.profiler context writing TensorBoard/Perfetto traces
+  - ``annotate``: named_scope so each physical operator is visible in XLA
+    traces (the executor wraps every node lowering)
+  - ``StepTimer``: wall-clock per-step table with device sync, the
+    accumulator-style counter surface
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture an XLA profiler trace (view in TensorBoard/Perfetto)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named scope that shows up in profiler timelines per operator."""
+    return jax.named_scope(name)
+
+
+class StepTimer:
+    """Per-step wall-clock accounting with explicit device sync.
+
+    Usage:
+        t = StepTimer()
+        with t.step("matmul"):
+            out = plan.run(); out.block_until_ready()
+        print(t.table())
+    """
+
+    def __init__(self):
+        self.records: List[tuple] = []
+        self.counters: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def step(self, name: str, sync: Optional[jax.Array] = None):
+        t0 = time.perf_counter()
+        yield
+        if sync is not None:
+            sync.block_until_ready()
+        self.records.append((name, time.perf_counter() - t0))
+
+    def count(self, name: str, value: float = 1.0) -> None:
+        """Accumulator-style counter (the reference counts e.g. nnz
+        processed via Spark accumulators)."""
+        self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def table(self) -> str:
+        by_name: Dict[str, List[float]] = {}
+        for name, dt in self.records:
+            by_name.setdefault(name, []).append(dt)
+        lines = [f"{'step':<28}{'count':>6}{'total_s':>10}{'mean_ms':>10}"]
+        for name, ds in by_name.items():
+            lines.append(f"{name:<28}{len(ds):>6}{sum(ds):>10.3f}"
+                         f"{1e3 * sum(ds) / len(ds):>10.2f}")
+        for name, v in self.counters.items():
+            lines.append(f"{name:<28}{'-':>6}{v:>10.0f}{'':>10}")
+        return "\n".join(lines)

@@ -1,0 +1,81 @@
+"""Failure detection / recovery — the task-retry/lineage analogue
+(SURVEY.md §5 "Failure detection / elastic recovery").
+
+The Spark substrate retries failed tasks and recomputes lost partitions
+from RDD lineage; the driver is the SPOF. XLA programs have no mid-program
+retry, so the TPU-native shape of the same guarantee is:
+
+  run_resilient(body, cm, ...):  a driver loop that checkpoints every
+  ``interval`` iterations and, on device/runtime failure, re-enters from the
+  last durable checkpoint (restart-and-resume; multi-slice DCN failures
+  collapse to the same story). ``checkify``-style NaN/shape guards stand in
+  for sanitizers: the RDD model designed races out, and so does SPMD
+  functional purity (SURVEY.md §5 "Race detection").
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+import jax
+
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.utils.checkpoint import CheckpointManager
+
+log = logging.getLogger("matrel_tpu.resilience")
+
+# Exceptions that indicate a device/runtime fault worth a restart (rather
+# than a programming error): XlaRuntimeError covers device loss, OOM, and
+# collective timeouts.
+_RETRYABLE = (jax.errors.JaxRuntimeError,) if hasattr(jax.errors, "JaxRuntimeError") else ()
+
+
+def _is_retryable(e: BaseException) -> bool:
+    name = type(e).__name__
+    return isinstance(e, _RETRYABLE) or name in (
+        "XlaRuntimeError", "JaxRuntimeError", "InternalError")
+
+
+def run_resilient(
+    body: Callable[[int, Dict[str, BlockMatrix], Dict[str, Any]],
+                   Tuple[Dict[str, BlockMatrix], Dict[str, Any]]],
+    cm: CheckpointManager,
+    mesh,
+    init_matrices: Mapping[str, BlockMatrix],
+    init_state: Optional[Dict[str, Any]] = None,
+    num_steps: int = 1,
+    checkpoint_interval: int = 10,
+    max_restarts: int = 3,
+) -> Tuple[Dict[str, BlockMatrix], Dict[str, Any]]:
+    """Run ``body(step, matrices, state)`` for num_steps with checkpointing
+    and restart-on-failure from the last durable step."""
+    restarts = 0
+    restored = cm.restore(mesh)
+    if restored is not None:
+        start, matrices, _, state = restored
+        start += 1
+        log.info("resuming from checkpoint step %d", start - 1)
+    else:
+        start, matrices, state = 0, dict(init_matrices), dict(init_state or {})
+
+    step = start
+    while step < num_steps:
+        try:
+            matrices, state = body(step, matrices, state)
+            if (step + 1) % checkpoint_interval == 0 or step == num_steps - 1:
+                cm.save(step, matrices=matrices, state=state)
+            step += 1
+        except Exception as e:  # noqa: BLE001 — gate below
+            if not _is_retryable(e) or restarts >= max_restarts:
+                raise
+            restarts += 1
+            log.warning("step %d failed (%s); restart %d/%d from checkpoint",
+                        step, type(e).__name__, restarts, max_restarts)
+            restored = cm.restore(mesh)
+            if restored is None:
+                step, matrices, state = 0, dict(init_matrices), dict(init_state or {})
+            else:
+                s, matrices, _, state = restored
+                step = s + 1
+    return matrices, state
