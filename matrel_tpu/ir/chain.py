@@ -30,10 +30,30 @@ def optimal_order(operands: List[MatExpr]) -> Tuple[MatExpr, float]:
                  + multiplyCost(dims, densities)
     Densities of intermediates are re-estimated per split via the same
     propagation the stats module uses, so sparse chains order correctly.
+
+    For chains of ≥3 operands the O(n³) loop runs in the native optimizer
+    core (native/chain_dp.cc, same cost semantics) when built; the pure-
+    Python DP below is the always-available fallback and the reference
+    implementation for equivalence tests.
     """
     n = len(operands)
     if n == 1:
         return operands[0], 0.0
+    if n >= 3:
+        from matrel_tpu.utils import native
+        dims = [op.shape[0] for op in operands] + [operands[-1].shape[1]]
+        dens = [op.density for op in operands]
+        res = native.chain_dp(dims, dens)
+        if res is not None:
+            splits, cost = res
+
+            def build(i: int, j: int) -> MatExpr:
+                if i == j:
+                    return operands[i]
+                s = int(splits[i][j])
+                return matmul(build(i, s), build(s + 1, j))
+
+            return build(0, n - 1), cost
     # best[i][j] = (cost, expr) for operands[i..j] inclusive
     best: List[List[Optional[Tuple[float, MatExpr]]]] = [
         [None] * n for _ in range(n)

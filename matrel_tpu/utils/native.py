@@ -1,0 +1,90 @@
+"""ctypes bridge to the native optimizer core (native/chain_dp.cc).
+
+Builds libmatrel_opt.so on first use if g++ is available (no pybind11 in
+this image — plain C ABI + ctypes per the environment constraints), caches
+the handle, and degrades silently to the pure-Python DP when the toolchain
+or library is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger("matrel_tpu.native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libmatrel_opt.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "chain_dp.cc")
+    if not os.path.exists(src):
+        return False
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", "-o", _LIB_PATH, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.debug("native build failed: %s", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.matrel_chain_dp.restype = ctypes.c_int
+            lib.matrel_chain_dp.argtypes = [
+                ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.POINTER(ctypes.c_double),
+            ]
+            _lib = lib
+        except OSError as e:
+            log.debug("native load failed: %s", e)
+        return _lib
+
+
+def chain_dp(dims: Sequence[int], densities: Sequence[float]
+             ) -> Optional[Tuple[np.ndarray, float]]:
+    """Run the native interval DP. dims has n+1 entries; densities n.
+    Returns (split table [n,n] int32, total cost) or None if the native
+    path is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(densities)
+    if len(dims) != n + 1:
+        raise ValueError("dims must have len(densities)+1 entries")
+    dims_arr = np.ascontiguousarray(dims, dtype=np.int64)
+    dens_arr = np.ascontiguousarray(densities, dtype=np.float64)
+    splits = np.zeros((n, n), dtype=np.int32)
+    cost = ctypes.c_double(0.0)
+    rc = lib.matrel_chain_dp(n, dims_arr, dens_arr, splits.reshape(-1),
+                             ctypes.byref(cost))
+    if rc != 0:
+        return None
+    return splits, float(cost.value)

@@ -1,0 +1,84 @@
+// matrel_tpu native optimizer core: matrix-chain DP with sparsity-aware
+// cost — the C++ equivalent of the reference's driver-side Catalyst
+// optimizer hot loop (SURVEY.md §2 "Optimizer: matrix-chain DP"; §3.3).
+//
+// The reference runs this O(n³) interval DP on the Spark driver (JVM).
+// For long chains the Python fallback (ir/chain.py) dominates planning
+// time, so the planner calls into this library via ctypes when built
+// (utils/native.py). Semantics mirror ir/chain.py + ir/stats.py exactly:
+//
+//   cost(i,j,s) = cost(i,s) + cost(s+1,j)
+//               + 2 * rows(i) * cols(s) * cols(j) * d(i,s) * d(s+1,j)
+//   d over an interval: matmul_density(d_left, d_right, k)
+//                     = 1 - (1 - d_l*d_r)^k   (stable via expm1/log1p)
+//
+// Build: make -C native   →  libmatrel_opt.so
+//
+// C ABI only — consumed with ctypes, no pybind11 dependency.
+
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+double matmul_density(double da, double db, double k) {
+  double p = da * db;
+  if (p <= 0.0) return 0.0;
+  if (p >= 1.0) return 1.0;
+  return -std::expm1(k * std::log1p(-p));
+}
+
+}  // namespace
+
+extern "C" {
+
+// dims: n+1 entries — operand i is dims[i] x dims[i+1]
+// dens: n entries   — density of operand i (1.0 = dense)
+// split_out: n*n row-major; split_out[i*n+j] = optimal split s for the
+//            inclusive interval [i, j] (undefined for i >= j)
+// cost_out:  total optimal FLOP cost of [0, n-1]
+// returns 0 on success, nonzero on bad input
+int matrel_chain_dp(int32_t n, const int64_t* dims, const double* dens,
+                    int32_t* split_out, double* cost_out) {
+  if (n <= 0 || dims == nullptr || dens == nullptr || split_out == nullptr ||
+      cost_out == nullptr)
+    return 1;
+  if (n == 1) {
+    *cost_out = 0.0;
+    return 0;
+  }
+  std::vector<double> cost(static_cast<size_t>(n) * n, 0.0);
+  std::vector<double> density(static_cast<size_t>(n) * n, 1.0);
+  for (int i = 0; i < n; ++i) density[i * n + i] = dens[i];
+
+  for (int span = 2; span <= n; ++span) {
+    for (int i = 0; i + span - 1 < n; ++i) {
+      int j = i + span - 1;
+      double best = -1.0;
+      int best_s = i;
+      double best_d = 1.0;
+      for (int s = i; s < j; ++s) {
+        double dl = density[i * n + s];
+        double dr = density[(s + 1) * n + j];
+        double rows = static_cast<double>(dims[i]);
+        double mid = static_cast<double>(dims[s + 1]);
+        double colsj = static_cast<double>(dims[j + 1]);
+        double step = 2.0 * rows * mid * colsj * dl * dr;
+        double total = cost[i * n + s] + cost[(s + 1) * n + j] + step;
+        if (best < 0.0 || total < best) {
+          best = total;
+          best_s = s;
+          best_d = matmul_density(dl, dr, mid);
+        }
+      }
+      cost[i * n + j] = best;
+      density[i * n + j] = best_d;
+      split_out[i * n + j] = best_s;
+    }
+  }
+  *cost_out = cost[0 * n + (n - 1)];
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,101 @@
+"""Native optimizer core tests: the C++ chain DP must exist (toolchain is
+part of the environment), agree with the pure-Python DP, and beat it on
+long chains."""
+
+import time
+
+import numpy as np
+import pytest
+
+from matrel_tpu.ir import chain as chain_lib
+from matrel_tpu.ir.expr import leaf, matmul
+from matrel_tpu.utils import native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load()
+    assert lib is not None, "native build must succeed (g++ is in the image)"
+    return lib
+
+
+def _mk_ops(mesh, dims, dens=None):
+    import dataclasses
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    base = BlockMatrix.from_numpy(np.zeros((8, 8), np.float32), mesh=mesh)
+    ops = []
+    for i in range(len(dims) - 1):
+        shape = (dims[i], dims[i + 1])
+        nnz = None if dens is None else int(dens[i] * shape[0] * shape[1])
+        ops.append(leaf(dataclasses.replace(base, shape=shape, nnz=nnz)))
+    return ops
+
+
+def _python_dp(operands):
+    """The pure-Python reference DP (bypasses the native fast path)."""
+    from matrel_tpu.ir import stats
+    from matrel_tpu.ir.expr import matmul as mm
+    n = len(operands)
+    best = [[None] * n for _ in range(n)]
+    for i in range(n):
+        best[i][i] = (0.0, operands[i])
+    for span in range(2, n + 1):
+        for i in range(0, n - span + 1):
+            j = i + span - 1
+            cand = None
+            for s in range(i, j):
+                cl, el = best[i][s]
+                cr, er = best[s + 1][j]
+                step = stats.matmul_cost(el.shape[0], el.shape[1],
+                                         er.shape[1], el.density, er.density)
+                if cand is None or cl + cr + step < cand[0]:
+                    cand = (cl + cr + step, mm(el, er))
+            best[i][j] = cand
+    return best[0][n - 1]
+
+
+def test_native_matches_python_dense(lib, mesh8):
+    dims = [30, 35, 15, 5, 10, 20, 25]
+    ops = _mk_ops(mesh8, dims)
+    got, cost = chain_lib.optimal_order(ops)
+    pcost, pexpr = _python_dp(ops)
+    assert cost == pytest.approx(pcost)
+    assert cost == pytest.approx(2 * 15125)  # CLRS optimum × FLOP factor
+    assert chain_lib.parenthesise_equal(got, pexpr) if hasattr(
+        chain_lib, "parenthesise_equal") else True
+    from matrel_tpu.workloads.chain_bench import parenthesisation
+    assert parenthesisation(got) == parenthesisation(pexpr)
+
+
+def test_native_matches_python_sparse(lib, mesh8):
+    rng = np.random.default_rng(7)
+    for _ in range(10):
+        n = int(rng.integers(3, 8))
+        dims = [int(rng.integers(2, 400)) for _ in range(n + 1)]
+        dens = [float(rng.choice([1.0, 1.0, 0.1, 0.01])) for _ in range(n)]
+        ops = _mk_ops(mesh8, dims, dens)
+        got, cost = chain_lib.optimal_order(ops)
+        pcost, pexpr = _python_dp(ops)
+        # same optimum cost (ties may differ in structure; cost must agree
+        # within float/rounding tolerance of the nnz-int rounding)
+        assert cost == pytest.approx(pcost, rel=0.05)
+
+
+def test_native_raw_api(lib):
+    splits, cost = native.chain_dp([10, 1000, 10, 1000], [1.0, 1.0, 1.0])
+    # (A·B)·C: split after operand 1 for the full interval [0,2]
+    assert splits[0][2] == 1
+    assert cost == pytest.approx(2 * (10 * 1000 * 10 + 10 * 10 * 1000))
+
+
+def test_native_faster_than_python_on_long_chain(lib, mesh8):
+    rng = np.random.default_rng(1)
+    dims = [int(rng.integers(10, 2000)) for _ in range(101)]
+    ops = _mk_ops(mesh8, dims)
+    t0 = time.perf_counter()
+    chain_lib.optimal_order(ops)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _python_dp(ops)
+    t_python = time.perf_counter() - t0
+    assert t_native < t_python
