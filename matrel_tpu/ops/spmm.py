@@ -32,6 +32,27 @@ def _use_pallas(cfg: MatrelConfig) -> bool:
     return cfg.use_pallas and jax.default_backend() not in ("cpu",)
 
 
+# Runner cache: make_spmm/_xla_spmm build a fresh jitted closure per call,
+# which would recompile on every spmm() of the same matrix (jit caches by
+# function identity). Key on the static pieces of the plan.
+_RUNNER_CACHE: dict = {}
+
+
+def _cached_runner(S, pm, out_pshape, d_spec, out_sharding, cfg, interpret):
+    key = (id(S), pm, out_pshape, str(d_spec), cfg.use_pallas,
+           cfg.matmul_precision, interpret)
+    run = _RUNNER_CACHE.get(key)
+    if run is None:
+        if _use_pallas(cfg) or interpret:
+            from matrel_tpu.ops import pallas_spmm
+            run = pallas_spmm.make_spmm(S, pm, out_pshape, d_spec,
+                                        out_sharding, cfg, interpret=interpret)
+        else:
+            run = _xla_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg)
+        _RUNNER_CACHE[key] = run
+    return run
+
+
 def _dense_spec(pm: int, mesh) -> P:
     x, y = mesh.axis_names
     gx, gy = mesh_lib.mesh_grid_shape(mesh)
@@ -57,12 +78,8 @@ def apply(S: BlockSparseMatrix, dd: jax.Array,
     out_sharding = padding.canonical_sharding(out_pshape, mesh)
     pm = dd.shape[1]
     d_spec = _dense_spec(pm, mesh)
-    if _use_pallas(cfg) or interpret:
-        from matrel_tpu.ops import pallas_spmm
-        run = pallas_spmm.make_spmm(S, pm, out_pshape, d_spec, out_sharding,
-                                    cfg, interpret=interpret)
-    else:
-        run = _xla_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg)
+    run = _cached_runner(S, pm, out_pshape, d_spec, out_sharding, cfg,
+                         interpret)
     return run(S.blocks, S.block_rows, S.block_cols, dd)
 
 

@@ -86,6 +86,65 @@ def fit_fused(X: BlockMatrix, y: BlockMatrix, l2: float = 0.0,
     return step(X.data, y.data)
 
 
+def fit_streaming(n_rows: int, k: int,
+                  panel_fn,
+                  panel_rows: int = 262_144,
+                  l2: float = 0.0,
+                  mesh=None,
+                  dtype=None,
+                  config: Optional[MatrelConfig] = None) -> jax.Array:
+    """Tall-skinny normal equations when X exceeds HBM (BASELINE row 3:
+    10M×1k f32 = 40 GB on a 16 GB chip).
+
+    The Gram matrix is a sum over row panels: XᵀX = Σ_p X_pᵀX_p, so the
+    loop streams panels through a ``lax.fori_loop`` — panels are produced
+    on device by ``panel_fn(panel_index) -> (X_p, y_p)`` (a traceable
+    generator: synthetic data, or a gather from a device-resident shard) —
+    and only the k×k accumulators live across iterations. One jitted
+    program, O(panel) memory, every FLOP on the MXU.
+    """
+    import math as _math
+    cfg = config or default_config()
+    mesh = mesh or _default_mesh(cfg)
+    n_panels = _math.ceil(n_rows / panel_rows)
+    key = (panel_fn, n_panels, k, l2)
+    run = _stream_cache.get(key)
+    if run is None:
+
+        @jax.jit
+        def run():
+            prec = jax.lax.Precision.HIGHEST
+
+            def body(p, carry):
+                gram, rhs = carry
+                xp, yp = panel_fn(p)
+                gram = gram + jnp.einsum("nk,nj->kj", xp, xp, precision=prec,
+                                         preferred_element_type=jnp.float32)
+                rhs = rhs + jnp.einsum("nk,nj->kj", xp, yp, precision=prec,
+                                       preferred_element_type=jnp.float32)
+                return gram, rhs
+
+            gram0 = jnp.zeros((k, k), jnp.float32)
+            rhs0 = jnp.zeros((k, 1), jnp.float32)
+            gram, rhs = jax.lax.fori_loop(0, n_panels, body, (gram0, rhs0))
+            gl = gram + l2 * jnp.eye(k, dtype=gram.dtype)
+            c, low = jax.scipy.linalg.cho_factor(gl)
+            return jax.scipy.linalg.cho_solve((c, low), rhs)
+
+        _stream_cache[key] = run
+    return run()
+
+
+# jitted-program cache for fit_streaming (fresh closures would recompile
+# per call; keyed on the panel generator identity + static dims)
+_stream_cache: dict = {}
+
+
+def _default_mesh(cfg):
+    from matrel_tpu.core import mesh as mesh_lib
+    return mesh_lib.make_mesh(cfg.mesh_shape, cfg.mesh_axis_names)
+
+
 def predict(X: BlockMatrix, theta: jax.Array) -> jax.Array:
     @jax.jit
     def f(xd, t):

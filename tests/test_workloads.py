@@ -81,3 +81,36 @@ class TestPageRank:
         assert r.sum() == pytest.approx(1.0, rel=1e-4)
         oracle = pagerank.pagerank_numpy_oracle(a, rounds=50)
         np.testing.assert_allclose(r, oracle, rtol=1e-3, atol=1e-6)
+
+
+class TestStreamingLinreg:
+    def test_streaming_matches_dense(self, mesh8):
+        import jax
+        import jax.numpy as jnp
+        from matrel_tpu.workloads.linreg import fit_streaming
+        k, n, panel = 8, 512, 128
+        theta_true = jnp.arange(1.0, k + 1.0).reshape(k, 1)
+
+        def panel_fn(p):
+            key = jax.random.fold_in(jax.random.PRNGKey(0), p)
+            xp = jax.random.normal(key, (panel, k), jnp.float32)
+            yp = xp @ theta_true
+            return xp, yp
+
+        theta = np.asarray(fit_streaming(n, k, panel_fn, panel_rows=panel,
+                                         mesh=mesh8))
+        np.testing.assert_allclose(theta, np.asarray(theta_true),
+                                   rtol=1e-3, atol=1e-3)
+
+
+class TestEdgePageRank:
+    def test_edges_matches_dense_oracle(self, mesh8, rng):
+        from matrel_tpu.workloads.pagerank import pagerank_edges
+        n = 60
+        a = (rng.random((n, n)) < 0.08).astype(np.float32)
+        np.fill_diagonal(a, 0)
+        src, dst = np.nonzero(a)
+        r = np.asarray(pagerank_edges(src, dst, n, rounds=30))
+        oracle = pagerank.pagerank_numpy_oracle(a, rounds=30).ravel()
+        np.testing.assert_allclose(r, oracle, rtol=1e-3, atol=1e-7)
+        assert r.sum() == pytest.approx(1.0, rel=1e-3)
