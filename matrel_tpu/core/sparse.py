@@ -107,6 +107,41 @@ class BlockSparseMatrix:
         )
 
     @classmethod
+    def from_scipy(cls, sp, block_size: Optional[int] = None,
+                   mesh: Optional[Mesh] = None,
+                   config: Optional[MatrelConfig] = None,
+                   dtype: Any = None) -> "BlockSparseMatrix":
+        """From a scipy.sparse matrix (the CSC-block ingestion path of the
+        reference, SURVEY.md §2 'Local matrix kernels'): element-sparse
+        input is bucketed into block-granular payloads WITHOUT densifying
+        the full matrix — only touched tiles are materialised."""
+        cfg = config or default_config()
+        bs = block_size or cfg.block_size
+        mesh = mesh or mesh_lib.make_mesh(cfg.mesh_shape, cfg.mesh_axis_names)
+        dtype = dtype or cfg.default_dtype
+        coo = sp.tocoo()
+        n, m = coo.shape
+        gc = math.ceil(m / bs)
+        bi = (coo.row // bs).astype(np.int64)
+        bj = (coo.col // bs).astype(np.int64)
+        keys = bi * gc + bj
+        uniq, tile_idx = np.unique(keys, return_inverse=True)
+        payload = np.zeros((max(len(uniq), 1), bs, bs), dtype=dtype)
+        np.add.at(payload,
+                  (tile_idx, coo.row % bs, coo.col % bs),
+                  coo.data.astype(dtype))
+        rows = (uniq // gc).astype(np.int32)
+        cols = (uniq % gc).astype(np.int32)
+        if len(uniq) == 0:
+            rows = np.zeros(1, np.int32)
+            cols = np.zeros(1, np.int32)
+        rep = NamedSharding(mesh, P())
+        return cls(blocks=jax.device_put(payload, rep),
+                   block_rows=jax.device_put(rows, rep),
+                   block_cols=jax.device_put(cols, rep),
+                   shape=(n, m), block_size=bs, mesh=mesh)
+
+    @classmethod
     def random(cls, shape: Tuple[int, int], block_density: float,
                block_size: Optional[int] = None, mesh: Optional[Mesh] = None,
                seed: int = 0, config: Optional[MatrelConfig] = None,

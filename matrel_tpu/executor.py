@@ -321,13 +321,33 @@ class CompiledPlan:
     optimized: MatExpr
     mesh: Mesh
     config: MatrelConfig
+    _donating: Dict[tuple, Callable] = dataclasses.field(default_factory=dict)
 
-    def run(self, bindings: Optional[Dict[int, BlockMatrix]] = None) -> BlockMatrix:
+    def run(self, bindings: Optional[Dict[int, BlockMatrix]] = None,
+            donate: bool = False) -> BlockMatrix:
+        """Execute with current or rebound leaves.
+
+        donate=True hands the REBOUND leaf buffers to XLA (input/output
+        aliasing — halves HBM traffic in C←f(C) iteration patterns); the
+        donated BlockMatrices must not be used afterwards.
+        """
         arrays = []
-        for l in self.leaf_order:
-            m = (bindings or {}).get(l.uid, l.attrs["matrix"])
+        donated = []
+        for i, l in enumerate(self.leaf_order):
+            bound = (bindings or {}).get(l.uid)
+            if bound is not None:
+                donated.append(i)
+            m = bound if bound is not None else l.attrs["matrix"]
             arrays.append(m.data)
-        out = self.jitted(*arrays)
+        if donate and donated and self.config.donate_intermediates:
+            key = tuple(donated)
+            jfn = self._donating.get(key)
+            if jfn is None:
+                jfn = jax.jit(self.jitted.__wrapped__, donate_argnums=key)
+                self._donating[key] = jfn
+            out = jfn(*arrays)
+        else:
+            out = self.jitted(*arrays)
         return BlockMatrix.from_array(
             out, self.optimized.shape, self.mesh,
             padding.canonical_spec(tuple(out.shape), self.mesh),

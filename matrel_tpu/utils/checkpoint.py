@@ -61,16 +61,18 @@ class CheckpointManager:
     def save(self, step: int,
              matrices: Optional[Mapping[str, BlockMatrix]] = None,
              arrays: Optional[Mapping[str, jax.Array]] = None,
+             sparse: Optional[Mapping[str, Any]] = None,
              state: Optional[Dict[str, Any]] = None) -> str:
         matrices = dict(matrices or {})
         arrays = dict(arrays or {})
+        sparse = dict(sparse or {})
         final = os.path.join(self.directory, f"step_{step:09d}")
         tmp = final + ".tmp"
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         meta: Dict[str, Any] = {"step": step, "state": state or {},
-                                "matrices": {}, "arrays": []}
+                                "matrices": {}, "arrays": [], "sparse": {}}
         for name, bm in matrices.items():
             bm.data.block_until_ready()
             np.save(os.path.join(tmp, f"{name}.npy"), np.asarray(bm.data))
@@ -81,6 +83,13 @@ class CheckpointManager:
         for name, arr in arrays.items():
             np.save(os.path.join(tmp, f"{name}.npy"), np.asarray(arr))
             meta["arrays"].append(name)
+        for name, sm in sparse.items():
+            np.savez(os.path.join(tmp, f"{name}.npz"),
+                     blocks=np.asarray(sm.blocks),
+                     block_rows=np.asarray(sm.block_rows),
+                     block_cols=np.asarray(sm.block_cols))
+            meta["sparse"][name] = {"shape": list(sm.shape),
+                                    "block_size": sm.block_size}
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
         if os.path.exists(final):
@@ -117,6 +126,28 @@ class CheckpointManager:
         arrays = {name: jax.device_put(np.load(os.path.join(d, f"{name}.npy")))
                   for name in meta["arrays"]}
         return meta["step"], matrices, arrays, meta["state"]
+
+    def restore_sparse(self, mesh: Mesh, step: Optional[int] = None) -> Dict[str, Any]:
+        """Restore BlockSparseMatrix entries saved via ``save(sparse=...)``."""
+        from matrel_tpu.core.sparse import BlockSparseMatrix
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return {}
+        d = os.path.join(self.directory, f"step_{step:09d}")
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        rep = NamedSharding(mesh, P())
+        out = {}
+        for name, m in meta.get("sparse", {}).items():
+            z = np.load(os.path.join(d, f"{name}.npz"))
+            out[name] = BlockSparseMatrix(
+                blocks=jax.device_put(z["blocks"], rep),
+                block_rows=jax.device_put(z["block_rows"], rep),
+                block_cols=jax.device_put(z["block_cols"], rep),
+                shape=tuple(m["shape"]), block_size=m["block_size"],
+                mesh=mesh)
+        return out
 
     # -- housekeeping -------------------------------------------------------
 

@@ -1,0 +1,52 @@
+"""Numerical guards — the sanitizer-role subsystem (SURVEY.md §5 "Race
+detection / sanitizers").
+
+The RDD model designs data races out; so does SPMD functional purity — there
+is nothing for TSan to find. What CAN go wrong numerically (NaN/Inf from
+ill-conditioned solves, division, overflow in bf16) is guarded here:
+
+  - ``checked(fn)``: wrap a jittable fn with ``checkify`` so NaN/Inf and
+    out-of-bounds errors surface as Python exceptions with locations.
+  - ``assert_finite(bm)``: eager device-side finiteness check for
+    BlockMatrix / arrays, cheap enough for test/debug paths.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+from matrel_tpu.core.blockmatrix import BlockMatrix
+
+
+def checked(fn: Callable, errors=None) -> Callable:
+    """checkify + jit: returns a callable that raises on NaN/Inf/OOB."""
+    errs = errors if errors is not None else (
+        checkify.float_checks | checkify.index_checks)
+    cfn = checkify.checkify(fn, errors=errs)
+    jfn = jax.jit(cfn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kw):
+        err, out = jfn(*args, **kw)
+        checkify.check_error(err)
+        return out
+
+    return wrapper
+
+
+@jax.jit
+def _finite_count(x) -> jax.Array:
+    return jnp.sum(~jnp.isfinite(x))
+
+
+def assert_finite(m, name: str = "array") -> None:
+    x = m.data if isinstance(m, BlockMatrix) else m
+    bad = int(_finite_count(x))
+    if bad:
+        raise FloatingPointError(
+            f"{name}: {bad} non-finite entries (shape {tuple(x.shape)})")
