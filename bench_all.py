@@ -124,7 +124,6 @@ def bench_spmm(mesh, cfg):
 
 
 def bench_pagerank(mesh, cfg):
-    from matrel_tpu.workloads.pagerank import pagerank_edges
     n, n_edges, rounds = 1_000_000, 10_000_000, 30
     from matrel_tpu.workloads.pagerank import _edges_runner
     import jax.numpy as jnp

@@ -26,6 +26,25 @@ def _near_square_factors(n: int) -> Tuple[int, int]:
     return a, n // a
 
 
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up — the executor-registration analogue
+    (SURVEY.md §3.1: "mesh construction replaces executor registration").
+
+    On a multi-host TPU slice, call once per host before make_mesh();
+    jax.devices() then spans the full slice and the 2D mesh lays out over
+    ICI within a slice and DCN across slices. No-op when JAX is already
+    initialized or args are absent (single-process dev loop, tests, CI).
+    """
+    if coordinator_address is None:
+        return
+    import jax.distributed
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+
+
 def make_mesh(
     shape: Optional[Tuple[int, int]] = None,
     axis_names: Tuple[str, str] = ("x", "y"),

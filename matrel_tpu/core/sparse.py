@@ -219,6 +219,24 @@ class BlockSparseMatrix:
             nnz=min(self.nnz, self.shape[0] * self.shape[1]),
             block_size=bs)
 
+    def transpose(self) -> "BlockSparseMatrix":
+        """Sᵀ: swap tile coordinates and transpose payloads (one device op);
+        re-sorted row-major to keep the kernel invariants."""
+        rows = np.asarray(self.block_cols)
+        cols = np.asarray(self.block_rows)
+        order = np.lexsort((cols, rows))
+        rep = NamedSharding(self.mesh, P())
+        blocks_t = jax.jit(
+            lambda b: jax.lax.with_sharding_constraint(
+                jnp.transpose(b, (0, 2, 1))[jnp.asarray(order)], rep)
+        )(self.blocks)
+        return BlockSparseMatrix(
+            blocks=blocks_t,
+            block_rows=jax.device_put(rows[order].astype(np.int32), rep),
+            block_cols=jax.device_put(cols[order].astype(np.int32), rep),
+            shape=(self.shape[1], self.shape[0]),
+            block_size=self.block_size, mesh=self.mesh)
+
     # -- lazy DSL -----------------------------------------------------------
 
     def expr(self):

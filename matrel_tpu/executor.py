@@ -17,12 +17,11 @@ mask padding where zeros would change the answer (max/min/avg/count).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from matrel_tpu.config import MatrelConfig, default_config
 from matrel_tpu.core import mesh as mesh_lib, padding
@@ -159,9 +158,14 @@ class Lowerer:
             return spmm_lib.apply(l.attrs["matrix"], ev(r), r.shape,
                                   self.config)
         if r.kind == "sparse_leaf" and l.kind != "sparse_leaf":
-            # A·S = (Sᵀ·Aᵀ)ᵀ would transpose the tile stack; round-trip
-            # through dense for now (rare in the reference workloads).
-            pass
+            # A·S = (Sᵀ·Aᵀ)ᵀ — transpose the tile stack (cheap, done once
+            # at trace time) and reuse the left-sparse SpMM path.
+            from matrel_tpu.ops import spmm as spmm_lib
+            st = r.attrs["matrix"].transpose()
+            at = ev(l).T
+            out = spmm_lib.apply(st, at, (l.shape[1], l.shape[0]),
+                                 self.config)
+            return out.T
         a, b = ev(node.children[0]), ev(node.children[1])
         strategy = node.attrs.get("strategy", "xla")
         out = strategies.run_matmul(strategy, a, b, self.mesh, self.config)
@@ -358,6 +362,30 @@ class CompiledPlan:
         """Optimized HLO text — for plan-shape assertions on collectives."""
         arrays = [l.attrs["matrix"].data for l in self.leaf_order]
         return self.jitted.lower(*arrays).compile().as_text()
+
+    def collectives(self) -> Dict[str, int]:
+        """Count of each collective op in the compiled HLO — the assertable
+        'plan shape' (SURVEY.md §4: the Catalyst comparePlans analogue at
+        the physical level)."""
+        import re as _re
+        text = self.hlo()
+        counts: Dict[str, int] = {}
+        for op in ("all-gather", "reduce-scatter", "all-reduce",
+                   "collective-permute", "all-to-all"):
+            n = len(_re.findall(rf"\b{op}\b", text))
+            if n:
+                counts[op] = n
+        return counts
+
+    def explain(self) -> str:
+        """Logical/physical plan summary incl. strategies and collectives."""
+        from matrel_tpu.ir.expr import pretty
+        lines = ["== Optimized plan ==", pretty(self.optimized)]
+        try:
+            lines += ["== Collectives ==", str(self.collectives())]
+        except Exception:  # HLO dump can fail on exotic backends
+            pass
+        return "\n".join(lines)
 
 
 def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,

@@ -29,7 +29,7 @@ from typing import Callable, List, Optional
 from matrel_tpu.config import MatrelConfig, default_config
 from matrel_tpu.ir import chain as chain_lib
 from matrel_tpu.ir.expr import (
-    MatExpr, agg, elemwise, matmul, scalar_op, select_index, transpose, vec,
+    MatExpr, agg, elemwise, matmul, scalar_op, select_index, transpose,
 )
 
 Rule = Callable[[MatExpr], Optional[MatExpr]]

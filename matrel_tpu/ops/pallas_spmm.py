@@ -17,8 +17,6 @@ in the MXU via preferred_element_type.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl

@@ -22,7 +22,6 @@ import json
 import os
 import re
 import shutil
-import tempfile
 from typing import Any, Dict, Mapping, Optional, Tuple
 
 import jax

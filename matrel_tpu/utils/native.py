@@ -13,7 +13,7 @@ import logging
 import os
 import subprocess
 import threading
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 

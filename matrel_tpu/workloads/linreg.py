@@ -11,14 +11,13 @@ replicated on-device via Cholesky — no host round trip at all.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from matrel_tpu.config import MatrelConfig, default_config
-from matrel_tpu.core import padding
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.executor import compile_expr
 from matrel_tpu.ir.expr import matmul, transpose

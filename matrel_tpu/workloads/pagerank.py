@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from matrel_tpu.config import MatrelConfig, default_config
+from matrel_tpu.config import MatrelConfig
 from matrel_tpu.core.blockmatrix import BlockMatrix
 
 
@@ -112,6 +112,60 @@ def _edges_runner(n: int, rounds: int, alpha: float):
         return jax.lax.fori_loop(0, rounds, body, r0)
 
     return prepare, run
+
+
+def pagerank_csr(src, dst, n: int, rounds: int = 30, alpha: float = 0.85,
+                 max_degree_factor: float = 2.0):
+    """PageRank via a padded in-neighbor table — scatter-free matvec.
+
+    Build (host-side, once) a dense (n, D) table of in-neighbors padded
+    with a sentinel, where D is the max in-degree; each round is then a
+    dense gather + row-sum — no scatter in the loop. The padded table does
+    D/mean-degree × the gathers of the edge-list form, so this only wins
+    when the in-degree distribution is TIGHT (near-regular graphs, D ≲
+    2×mean — measured on 1M/10M uniform-random edges, D≈3.5×mean, the
+    segment-sum form is ~2.5× faster). Anything looser falls back to
+    ``pagerank_edges``.
+    """
+    import numpy as np
+    src = np.asarray(src, dtype=np.int32)
+    dst = np.asarray(dst, dtype=np.int32)
+    indeg = np.bincount(dst, minlength=n)
+    D = int(indeg.max()) if len(dst) else 0
+    mean_deg = max(len(dst) / max(n, 1), 1.0)
+    if D > max_degree_factor * mean_deg:
+        return pagerank_edges(src, dst, n, rounds, alpha)
+    order = np.argsort(dst, kind="stable")
+    dst_s, src_s = dst[order], src[order]
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(indeg, out=offsets[1:])
+    slot = np.arange(len(dst_s)) - offsets[dst_s]
+    neighbors = np.full((n, max(D, 1)), n, dtype=np.int32)  # n = sentinel
+    neighbors[dst_s, slot] = src_s
+    outdeg = np.bincount(src, minlength=n).astype(np.float32)
+    run = _csr_runner(int(n), int(rounds), float(alpha), int(max(D, 1)))
+    return run(jnp.asarray(neighbors), jnp.asarray(outdeg))
+
+
+@functools.lru_cache(maxsize=32)
+def _csr_runner(n: int, rounds: int, alpha: float, D: int):
+    @jax.jit
+    def run(neighbors, outdeg):
+        inv_deg = jnp.where(outdeg > 0, 1.0 / jnp.maximum(outdeg, 1.0), 0.0)
+        dangling = (outdeg == 0).astype(jnp.float32)
+        teleport = (1.0 - alpha) / n
+
+        def body(_, r):
+            w = r * inv_deg
+            w_pad = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])  # sentinel
+            contrib = jnp.sum(w_pad[neighbors], axis=1)
+            dmass = jnp.sum(dangling * r)
+            return alpha * (contrib + dmass / n) + teleport
+
+        r0 = jnp.full((n,), 1.0 / n, dtype=jnp.float32)
+        return jax.lax.fori_loop(0, rounds, body, r0)
+
+    return run
 
 
 def pagerank_numpy_oracle(a, rounds=30, alpha=0.85):

@@ -2,7 +2,6 @@
 (SURVEY.md §4): numerics vs numpy oracles on a simulated 8-device mesh."""
 
 import numpy as np
-import pytest
 
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.core import padding

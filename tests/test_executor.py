@@ -5,7 +5,6 @@ numpy oracles, including ragged (padded) shapes."""
 import numpy as np
 import pytest
 
-from matrel_tpu import execute
 from matrel_tpu.core.blockmatrix import BlockMatrix
 
 

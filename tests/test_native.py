@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from matrel_tpu.ir import chain as chain_lib
-from matrel_tpu.ir.expr import leaf, matmul
+from matrel_tpu.ir.expr import leaf
 from matrel_tpu.utils import native
 
 

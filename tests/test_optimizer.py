@@ -5,7 +5,6 @@ rules, chain DP decisions, statistics propagation."""
 import numpy as np
 import pytest
 
-from matrel_tpu.config import MatrelConfig
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.ir import chain, stats
 from matrel_tpu.ir.expr import leaf, matmul, transpose

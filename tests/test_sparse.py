@@ -112,3 +112,19 @@ class TestIRIntegration:
                                      mesh=mesh8).expr()
         assert e.nnz is not None
         assert e.density <= 0.15
+
+
+class TestSparseTranspose:
+    def test_transpose_roundtrip(self, mesh8, rng):
+        a = random_block_sparse_np(rng, 24, 16, 8, 0.4)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        np.testing.assert_allclose(S.transpose().to_numpy(), a.T, rtol=1e-6)
+
+    def test_dense_times_sparse_via_ir(self, mesh8, rng):
+        a = rng.standard_normal((16, 24)).astype(np.float32)
+        s_np = random_block_sparse_np(rng, 24, 16, 8, 0.4)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        S = BlockSparseMatrix.from_numpy(s_np, block_size=8, mesh=mesh8)
+        e = A.expr().multiply(S.expr())
+        np.testing.assert_allclose(e.compute().to_numpy(), a @ s_np,
+                                   rtol=1e-4, atol=1e-4)

@@ -141,3 +141,14 @@ class TestPlannerChoice:
         node = self._mk(100_000, 512, 64, mesh8)
         plan = executor.compile_expr(node, mesh8)
         assert "strategy" in plan.optimized.attrs
+
+
+def test_compiled_plan_collectives_summary(mesh8):
+    import dataclasses
+    cfg = MatrelConfig(broadcast_threshold_bytes=1024, strategy_override="cpmm")
+    a = BlockMatrix.random((64, 64), mesh=mesh8, seed=0)
+    b = BlockMatrix.random((64, 64), mesh=mesh8, seed=1)
+    plan = executor.compile_expr(matmul(leaf(a), leaf(b)), mesh8, cfg)
+    cols = plan.collectives()
+    assert cols.get("reduce-scatter", 0) >= 1
+    assert "strategy=cpmm" in plan.explain()
