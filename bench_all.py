@@ -144,6 +144,20 @@ def bench_pagerank(mesh, cfg):
             "total_s": round(dt, 3)}
 
 
+def bench_north_star(mesh, cfg):
+    import jax.numpy as jnp
+    from matrel_tpu.workloads.big_chain import (
+        streaming_chain, default_gen, north_star_flops)
+    n, tile, panel = 65_536, 8192, 16_384
+    gens = tuple(default_gen(s, tile) for s in (1, 2, 3))
+    def run():
+        float(streaming_chain(n, *gens, tile=tile, panel=panel))
+    dt = _timed(run, warm=1, reps=2)
+    return {"metric": "north_star_65k_chain_wallclock", "value": round(dt, 2),
+            "unit": "s", "tflops_per_chip": round(north_star_flops(n) / dt / 1e12, 1),
+            "note": "streamed on ONE v5e chip (spec target: v5e-64)"}
+
+
 def main():
     from matrel_tpu.config import MatrelConfig, set_default_config
     from matrel_tpu.core import mesh as mesh_lib
@@ -151,7 +165,7 @@ def main():
     set_default_config(cfg)
     mesh = mesh_lib.make_mesh()
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
-               bench_pagerank):
+               bench_pagerank, bench_north_star):
         try:
             print(json.dumps(fn(mesh, cfg)), flush=True)
         except Exception as e:  # keep the suite running

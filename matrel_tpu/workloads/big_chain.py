@@ -1,0 +1,137 @@
+"""North-star workload: the 65k×65k chain A·B·C (BASELINE.json:2).
+
+65k² f32 is 17 GB per matrix — three operands plus intermediates cannot be
+resident on a 16 GB v5e chip, and the pod-scale path (v5e-64: operands
+sharded P(x,y), strategies from parallel/) is exercised by dryrun_multichip.
+This module makes the chain FEASIBLE AND FAST on chips it doesn't fit on,
+by streaming:
+
+    out_panel_i = (A_i · B) · C         for row panels A_i
+
+with B and C never fully resident — their k-tiles are produced on demand by
+traceable generator functions (synthetic data, checkpoint shards, or
+gathers from host storage). Memory is O(panel × n); every FLOP is an MXU
+tile GEMM; the whole triple loop is ONE jitted program (fori_loops).
+
+This is the blockwise-accumulation answer SURVEY.md §6/§7 calls for
+("intermediates force thought about donation/accumulation order; blockwise
+chain evaluation may be needed").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Gen = Callable[[jax.Array, jax.Array], jax.Array]
+# Gen(bi, bj) -> tile of shape (tile, tile): block (bi, bj) of the operand.
+
+
+def default_gen(seed: int, tile: int, dtype=jnp.bfloat16, scale: float = None
+                ) -> Gen:
+    """Cheap deterministic tile generator (iota arithmetic — RNG at 65k²
+    costs more than the matmuls). Scaled ~1/sqrt(n) so chained products
+    stay in bf16 range."""
+    s = scale if scale is not None else 0.01
+
+    def gen(bi, bj):
+        r = jax.lax.broadcasted_iota(jnp.float32, (tile, tile), 0)
+        c = jax.lax.broadcasted_iota(jnp.float32, (tile, tile), 1)
+        v = jnp.sin(r * 0.1 + c * 0.37 + bi * 1.7 + bj * 0.3 + seed) * s
+        return v.astype(dtype)
+
+    return gen
+
+
+def streaming_chain(n: int,
+                    gen_a: Gen, gen_b: Gen, gen_c: Gen,
+                    tile: int = 8192,
+                    panel: int = 16384,
+                    dtype=jnp.bfloat16,
+                    reduce: str = "fro") -> jax.Array:
+    """Evaluate reduce(A·B·C) for n×n operands produced tile-wise.
+
+    Per output row panel i:
+        T_i[., :]  = Σ_k gen_a(i, k) · B_k      (B_k = row-block k of B)
+        O_i[., :]  = Σ_k T_i[., k] · C_k
+        acc       += reduction(O_i)
+    The returned scalar (Frobenius² by default, or 'sum') certifies the
+    whole product was computed without materialising any n×n array.
+    """
+    if n % tile or n % panel or panel % tile:
+        raise ValueError("n must divide by tile and panel; panel by tile")
+    kt = n // tile         # tiles along contraction
+    npan = n // panel      # row panels
+    prec = jax.lax.Precision.DEFAULT
+
+    run = _chain_runner(n, tile, panel, kt, npan, gen_a, gen_b, gen_c,
+                        dtype, reduce, prec)
+    return run()
+
+
+@functools.lru_cache(maxsize=8)
+def _chain_runner(n, tile, panel, kt, npan, gen_a, gen_b, gen_c, dtype,
+                  reduce, prec):
+    def row_block(gen, k, width_tiles):
+        """Assemble row-block k (tile × n) from width_tiles generated tiles."""
+        def one(j, acc):
+            t = gen(k, j).astype(dtype)
+            return jax.lax.dynamic_update_slice(acc, t, (0, j * tile))
+        return jax.lax.fori_loop(
+            0, width_tiles, one,
+            jnp.zeros((tile, n), dtype=dtype))
+
+    pt = panel // tile
+
+    def col_panel(gen, i, k):
+        """(panel, tile) column slab: tiles (i*pt+ti, k) stacked."""
+        def one(ti, acc):
+            t = gen(i * pt + ti, k).astype(dtype)
+            return jax.lax.dynamic_update_slice(acc, t, (ti * tile, 0))
+        return jax.lax.fori_loop(
+            0, pt, one, jnp.zeros((panel, tile), dtype=dtype))
+
+    @jax.jit
+    def run():
+        def panel_body(i, acc):
+            # --- T_i = A_i · B, contracted k-block by k-block so each B
+            #     row-block is generated ONCE per panel (not once per
+            #     tile-row — an 8× generation saving at panel=8*tile)
+            def contract_b(k, part):
+                a_col = col_panel(gen_a, i, k)                # (panel, tile)
+                b_row = row_block(gen_b, k, kt)               # (tile, n)
+                return part + jax.lax.dot_general(
+                    a_col, b_row, (((1,), (0,)), ((), ())),
+                    precision=prec, preferred_element_type=jnp.float32)
+
+            t_i = jax.lax.fori_loop(
+                0, kt, contract_b,
+                jnp.zeros((panel, n), jnp.float32)).astype(dtype)
+
+            # --- O_i = T_i · C, contracted tile-column by tile-column
+            def contract_c(k, part):
+                t_slice = jax.lax.dynamic_slice(
+                    t_i, (0, k * tile), (panel, tile))
+                c_row = row_block(gen_c, k, kt)               # (tile, n)
+                return part + jax.lax.dot_general(
+                    t_slice, c_row, (((1,), (0,)), ((), ())),
+                    precision=prec, preferred_element_type=jnp.float32)
+
+            o_i = jax.lax.fori_loop(
+                0, kt, contract_c, jnp.zeros((panel, n), jnp.float32))
+            if reduce == "fro":
+                return acc + jnp.sum(o_i * o_i)
+            return acc + jnp.sum(o_i)
+
+        return jax.lax.fori_loop(0, npan, panel_body,
+                                 jnp.zeros((), jnp.float32))
+
+    return run
+
+
+def north_star_flops(n: int) -> float:
+    """A·B then ·C: 2n³ + 2n³."""
+    return 4.0 * n ** 3

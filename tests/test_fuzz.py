@@ -1,0 +1,148 @@
+"""Optimizer metamorphic fuzzing: for random expression trees, the
+optimized plan must produce the same numbers as the unoptimized one, and
+both must match a numpy evaluation of the tree. This is the strongest
+correctness net over the rewrite rules + chain DP + planner + executor
+stack (SURVEY.md §4: numerics vs oracles, extended to generated plans)."""
+
+import numpy as np
+import pytest
+
+from matrel_tpu.config import MatrelConfig
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.executor import compile_expr
+from matrel_tpu.ir import expr as E
+
+
+def np_eval(e, env):
+    """Reference evaluation of a MatExpr over numpy leaf values."""
+    k = e.kind
+    if k == "leaf":
+        return env[e.uid]
+    if k == "transpose":
+        return np_eval(e.children[0], env).T
+    if k == "matmul":
+        return np_eval(e.children[0], env) @ np_eval(e.children[1], env)
+    if k == "elemwise":
+        a, b = (np_eval(c, env) for c in e.children)
+        op = e.attrs["op"]
+        if op == "add":
+            return a + b
+        if op == "sub":
+            return a - b
+        if op == "mul":
+            return a * b
+        if op == "div":
+            return np.where(b == 0, 0.0, a / np.where(b == 0, 1.0, b))
+        raise NotImplementedError(op)
+    if k == "scalar":
+        x = np_eval(e.children[0], env)
+        op, v = e.attrs["op"], e.attrs["value"]
+        if op == "add":
+            return x + v
+        if op == "mul":
+            return x * v
+        return np.power(x, v)
+    if k == "agg":
+        x = np_eval(e.children[0], env)
+        kind, axis = e.attrs["agg"], e.attrs["axis"]
+        if kind == "sum":
+            if axis == "row":
+                return x.sum(1, keepdims=True)
+            if axis == "col":
+                return x.sum(0, keepdims=True)
+            if axis == "all":
+                return x.sum().reshape(1, 1)
+            return np.trace(x).reshape(1, 1)
+        raise NotImplementedError(kind)
+    if k == "select_index":
+        x = np_eval(e.children[0], env).copy()
+        rows, cols = e.attrs["rows"], e.attrs["cols"]
+        if rows is not None:
+            keep = np.asarray(rows(np.arange(x.shape[0])))
+            x[~keep, :] = 0
+        if cols is not None:
+            keep = np.asarray(cols(np.arange(x.shape[1])))
+            x[:, ~keep] = 0
+        return x
+    raise NotImplementedError(k)
+
+
+def gen_expr(rng, env, mesh, depth, shape=None):
+    """Random expression with consistent shapes; fills env[uid] for leaves."""
+    def leaf_of(shape):
+        a = rng.standard_normal(shape).astype(np.float32)
+        bm = BlockMatrix.from_numpy(a, mesh=mesh)
+        l = E.leaf(bm)
+        env[l.uid] = a
+        return l
+
+    dims = [1, 3, 5, 8, 13]
+    if shape is None:
+        shape = (int(rng.choice(dims[1:])), int(rng.choice(dims[1:])))
+    if depth <= 0:
+        return leaf_of(shape)
+    choice = rng.choice(
+        ["matmul", "elemwise", "scalar", "transpose", "agg_chain",
+         "select", "leaf"])
+    if choice == "matmul":
+        k = int(rng.choice(dims[1:]))
+        a = gen_expr(rng, env, mesh, depth - 1, (shape[0], k))
+        b = gen_expr(rng, env, mesh, depth - 1, (k, shape[1]))
+        return E.matmul(a, b)
+    if choice == "elemwise":
+        op = str(rng.choice(["add", "sub", "mul"]))
+        a = gen_expr(rng, env, mesh, depth - 1, shape)
+        b = gen_expr(rng, env, mesh, depth - 1, shape)
+        return E.elemwise(op, a, b)
+    if choice == "scalar":
+        op = str(rng.choice(["add", "mul"]))
+        c = gen_expr(rng, env, mesh, depth - 1, shape)
+        return E.scalar_op(op, c, float(rng.uniform(-2, 2)))
+    if choice == "transpose":
+        c = gen_expr(rng, env, mesh, depth - 1, (shape[1], shape[0]))
+        return E.transpose(c)
+    if choice == "agg_chain":
+        # produce shape via aggregation of a larger operand when possible
+        if shape[1] == 1 and shape[0] > 1:
+            inner = gen_expr(rng, env, mesh, depth - 1,
+                             (shape[0], int(rng.choice(dims[1:]))))
+            return E.agg(inner, "sum", "row")
+        if shape == (1, 1):
+            inner = gen_expr(rng, env, mesh, depth - 1,
+                             (int(rng.choice(dims[1:])),) * 2)
+            return E.agg(inner, "sum", "all")
+        return leaf_of(shape)
+    if choice == "select":
+        c = gen_expr(rng, env, mesh, depth - 1, shape)
+        m = int(rng.integers(2, 5))
+        return E.select_index(c, rows=lambda i, m=m: i % m != 0)
+    return leaf_of(shape)
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_optimized_matches_unoptimized_and_numpy(seed, mesh8):
+    rng = np.random.default_rng(seed)
+    env = {}
+    e = gen_expr(rng, env, mesh8, depth=int(rng.integers(2, 5)))
+    oracle = np_eval(e, env)
+
+    plan_opt = compile_expr(e, mesh8, MatrelConfig())
+    got_opt = plan_opt.run().to_numpy()
+    plan_raw = compile_expr(
+        e, mesh8, MatrelConfig(rewrite_rules=False, chain_opt=False))
+    got_raw = plan_raw.run().to_numpy()
+
+    np.testing.assert_allclose(got_raw, oracle, rtol=2e-3, atol=2e-3,
+                               err_msg=f"unoptimized != numpy (seed {seed})")
+    np.testing.assert_allclose(got_opt, oracle, rtol=2e-3, atol=2e-3,
+                               err_msg=f"optimized != numpy (seed {seed})")
+
+
+@pytest.mark.parametrize("seed", range(20, 28))
+def test_fuzz_on_square_mesh(seed, mesh_square):
+    rng = np.random.default_rng(seed)
+    env = {}
+    e = gen_expr(rng, env, mesh_square, depth=3)
+    oracle = np_eval(e, env)
+    got = compile_expr(e, mesh_square, MatrelConfig()).run().to_numpy()
+    np.testing.assert_allclose(got, oracle, rtol=2e-3, atol=2e-3)

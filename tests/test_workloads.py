@@ -133,3 +133,25 @@ class TestEdgePageRank:
         dst = np.zeros(n - 1, dtype=np.int32)
         r = np.asarray(pr.pagerank_csr(src, dst, n, rounds=10))
         assert r.shape == (n,) and abs(r.sum() - 1.0) < 1e-3
+
+
+class TestStreamingBigChain:
+    def test_streaming_chain_matches_numpy(self, mesh8):
+        import jax.numpy as jnp
+        from matrel_tpu.workloads.big_chain import streaming_chain, default_gen
+        n, tile, panel = 64, 8, 16
+        gens = tuple(default_gen(s, tile, jnp.float32, 0.05) for s in (1, 2, 3))
+        got = float(streaming_chain(n, *gens, tile=tile, panel=panel,
+                                    dtype=jnp.float32))
+        kt = n // tile
+        full = [np.block([[np.asarray(g(jnp.int32(i), jnp.int32(j)))
+                           for j in range(kt)] for i in range(kt)])
+                for g in gens]
+        oracle = float(((full[0] @ full[1] @ full[2]) ** 2).sum())
+        assert got == pytest.approx(oracle, rel=1e-4)
+
+    def test_rejects_misaligned(self):
+        from matrel_tpu.workloads.big_chain import streaming_chain, default_gen
+        g = default_gen(0, 8)
+        with pytest.raises(ValueError):
+            streaming_chain(60, g, g, g, tile=8, panel=16)
