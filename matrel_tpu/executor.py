@@ -66,7 +66,14 @@ class Lowerer:
             def ev(node: MatExpr) -> Array:
                 if node.uid in memo:
                     return memo[node.uid]
-                out = self._eval(node, ev, leaf_arrays, leaf_pos)
+                # named scope per physical operator: the profiler-timeline
+                # visibility the reference gets from Spark stage names
+                # (SURVEY.md §5 "Tracing / profiling")
+                label = node.kind
+                if node.kind == "matmul":
+                    label += ":" + node.attrs.get("strategy", "xla")
+                with jax.named_scope(f"matrel.{label}"):
+                    out = self._eval(node, ev, leaf_arrays, leaf_pos)
                 memo[node.uid] = out
                 return out
 

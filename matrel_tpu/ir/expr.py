@@ -97,6 +97,12 @@ class MatExpr:
     def divide(self, other) -> "MatExpr":
         return elemwise("div", self, as_expr(other))
 
+    def elem_min(self, other) -> "MatExpr":
+        return elemwise("min", self, as_expr(other))
+
+    def elem_max(self, other) -> "MatExpr":
+        return elemwise("max", self, as_expr(other))
+
     def add_scalar(self, s: float) -> "MatExpr":
         return scalar_op("add", self, s)
 

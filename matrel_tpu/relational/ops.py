@@ -52,14 +52,6 @@ def select_blocks(m: MatLike, predicate: Callable,
     block-granular selection, expressed through index predicates."""
     e = E.as_expr(m)
     bs = block_size or getattr(m, "block_size", 512)
-    import jax.numpy as jnp
-
-    def rows(i):
-        return jnp.ones_like(i, dtype=bool)
-
-    # encode 2D block predicate as a value-level mask via join of row/col
-    # block ids; realised as a select_index with both callables closed over
-    # the block size.
     return E.MatExpr("select_block", (e,), e.shape, e.nnz,
                      {"predicate": predicate, "block_size": bs})
 

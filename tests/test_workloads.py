@@ -155,3 +155,16 @@ class TestStreamingBigChain:
         g = default_gen(0, 8)
         with pytest.raises(ValueError):
             streaming_chain(60, g, g, g, tile=8, panel=16)
+
+    def test_sharded_matches_single(self, mesh8):
+        import jax.numpy as jnp
+        from matrel_tpu.workloads.big_chain import (
+            streaming_chain, streaming_chain_sharded, default_gen)
+        n, tile, panel = 128, 8, 16  # 8 panels = 1 per device
+        gens = tuple(default_gen(s, tile, jnp.float32, 0.05) for s in (1, 2, 3))
+        single = float(streaming_chain(n, *gens, tile=tile, panel=panel,
+                                       dtype=jnp.float32))
+        sharded = float(streaming_chain_sharded(n, *gens, mesh=mesh8,
+                                                tile=tile, panel=panel,
+                                                dtype=jnp.float32))
+        assert sharded == pytest.approx(single, rel=1e-5)
