@@ -58,9 +58,20 @@ class Lowerer:
         self.config = config
 
     def lower(self, root: MatExpr, leaf_order: List[MatExpr]) -> Callable:
-        leaf_pos = {l.uid: i for i, l in enumerate(leaf_order)}
+        multi = self.lower_multi((root,), leaf_order)
 
         def fn(*leaf_arrays: Array) -> Array:
+            return multi(*leaf_arrays)[0]
+
+        return fn
+
+    def lower_multi(self, roots, leaf_order: List[MatExpr]) -> Callable:
+        """Lower several roots into ONE traced function with a SHARED memo:
+        common subexpressions (by node identity) are computed once — e.g.
+        XᵀX and Xᵀy of the normal equations share the Xᵀ resharding."""
+        leaf_pos = {l.uid: i for i, l in enumerate(leaf_order)}
+
+        def fn(*leaf_arrays: Array):
             memo: Dict[int, Array] = {}
 
             def ev(node: MatExpr) -> Array:
@@ -77,13 +88,16 @@ class Lowerer:
                 memo[node.uid] = out
                 return out
 
-            out = ev(root)
-            pshape = padding.padded_shape(root.shape, self.mesh)
-            if tuple(out.shape) != pshape:
-                out = jnp.pad(out, ((0, pshape[0] - out.shape[0]),
-                                    (0, pshape[1] - out.shape[1])))
-            return jax.lax.with_sharding_constraint(
-                out, padding.canonical_sharding(pshape, self.mesh))
+            outs = []
+            for root in roots:
+                out = ev(root)
+                pshape = padding.padded_shape(root.shape, self.mesh)
+                if tuple(out.shape) != pshape:
+                    out = jnp.pad(out, ((0, pshape[0] - out.shape[0]),
+                                        (0, pshape[1] - out.shape[1])))
+                outs.append(jax.lax.with_sharding_constraint(
+                    out, padding.canonical_sharding(pshape, self.mesh)))
+            return tuple(outs)
 
         return fn
 
@@ -393,6 +407,62 @@ class CompiledPlan:
         except Exception:  # HLO dump can fail on exotic backends
             pass
         return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class MultiPlan:
+    """Several optimized roots compiled into ONE XLA program (one fusion
+    and CSE domain, one dispatch) — the analogue of a multi-action Spark
+    job sharing its lineage."""
+
+    jitted: Callable
+    leaf_order: List[MatExpr]
+    optimized: Tuple[MatExpr, ...]
+    mesh: Mesh
+    config: MatrelConfig
+
+    def run(self, bindings: Optional[Dict[int, BlockMatrix]] = None
+            ) -> Tuple[BlockMatrix, ...]:
+        arrays = []
+        for l in self.leaf_order:
+            m = (bindings or {}).get(l.uid, l.attrs["matrix"])
+            arrays.append(m.data)
+        outs = self.jitted(*arrays)
+        return tuple(
+            BlockMatrix.from_array(
+                out, root.shape, self.mesh,
+                padding.canonical_spec(tuple(out.shape), self.mesh),
+                nnz=root.nnz)
+            for out, root in zip(outs, self.optimized))
+
+
+def compile_exprs(exprs, mesh: Optional[Mesh] = None,
+                  config: Optional[MatrelConfig] = None) -> MultiPlan:
+    """Compile several expressions into one program with shared leaves."""
+    cfg = config or default_config()
+    exprs = tuple(exprs)
+    all_leaves = []
+    seen = set()
+    for e in exprs:
+        for l in expr_leaves(e):
+            if l.uid not in seen:
+                seen.add(l.uid)
+                all_leaves.append(l)
+    if mesh is None:
+        mesh = (all_leaves[0].attrs["matrix"].mesh if all_leaves
+                else mesh_lib.make_mesh(cfg.mesh_shape, cfg.mesh_axis_names))
+    opts = tuple(planner.annotate_strategies(rules.optimize(e, cfg), mesh, cfg)
+                 for e in exprs)
+    leaf_order = []
+    seen = set()
+    for o in opts:
+        for l in expr_leaves(o):
+            if l.uid not in seen:
+                seen.add(l.uid)
+                leaf_order.append(l)
+    fn = Lowerer(mesh, cfg).lower_multi(opts, leaf_order)
+    return MultiPlan(jitted=jax.jit(fn), leaf_order=leaf_order,
+                     optimized=opts, mesh=mesh, config=cfg)
 
 
 def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,

@@ -107,6 +107,20 @@ def agg_pushdown(e: MatExpr) -> Optional[MatExpr]:
             and c.children[0].shape == c.children[1].shape:
         a, b = c.children
         return elemwise(c.attrs["op"], agg(a, "sum", axis), agg(b, "sum", axis))
+    if c.kind == "rank1":
+        # rowSum(A + u·vᵀ) = rowSum(A) + u·sum(v)   (MatFast's rank-1
+        # update rules: never materialise the outer product for aggregates)
+        a, u, v = c.children
+        if axis == "row":
+            return elemwise("add", agg(a, "sum", "row"),
+                            matmul(u, agg(v, "sum", "all")))
+        if axis == "col":
+            return elemwise("add", agg(a, "sum", "col"),
+                            matmul(agg(u, "sum", "all"), transpose(v)))
+        if axis == "all":
+            # sum(u·vᵀ) = sum(u)·sum(v)
+            return elemwise("add", agg(a, "sum", "all"),
+                            matmul(agg(u, "sum", "all"), agg(v, "sum", "all")))
     return None
 
 

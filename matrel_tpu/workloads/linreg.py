@@ -19,7 +19,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from matrel_tpu.config import MatrelConfig, default_config
 from matrel_tpu.core.blockmatrix import BlockMatrix
-from matrel_tpu.executor import compile_expr
+from matrel_tpu.executor import compile_expr, compile_exprs
 from matrel_tpu.ir.expr import matmul, transpose
 
 
@@ -41,10 +41,7 @@ def fit(X: BlockMatrix, y: BlockMatrix,
     """
     cfg = config or default_config()
     gram_e, rhs_e = normal_equations_expr(X, y)
-    gram_plan = compile_expr(gram_e, X.mesh, cfg)
-    rhs_plan = compile_expr(rhs_e, X.mesh, cfg)
-    gram = gram_plan.run()
-    rhs = rhs_plan.run()
+    gram, rhs = compile_exprs((gram_e, rhs_e), X.mesh, cfg).run()
     k = X.shape[1]
 
     @jax.jit

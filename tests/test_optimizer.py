@@ -153,3 +153,55 @@ class TestStats:
         assert add.nnz == pytest.approx(200, rel=0.01)
         em = a.elem_multiply(b)
         assert em.density == pytest.approx(0.0001, rel=0.01)
+
+
+class TestRank1Rules:
+    def test_rowsum_of_rank1_avoids_outer_product(self, mesh8):
+        a = L(100, 80, mesh8)
+        u = L(100, 1, mesh8)
+        v = L(80, 1, mesh8)
+        from matrel_tpu.ir.expr import rank_one_update
+        e = apply_rewrites(rank_one_update(a, u, v).row_sum())
+        # no rank1 node survives
+        def kinds(n):
+            out = {n.kind}
+            for c in n.children:
+                out |= kinds(c)
+            return out
+        assert "rank1" not in kinds(e)
+        assert e.shape == (100, 1)
+
+    def test_rank1_rule_numerics(self, mesh8, rng):
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.ir.expr import leaf as mk_leaf, rank_one_update
+        a = rng.standard_normal((9, 7)).astype(np.float32)
+        u = rng.standard_normal((9, 1)).astype(np.float32)
+        v = rng.standard_normal((7, 1)).astype(np.float32)
+        A = mk_leaf(BlockMatrix.from_numpy(a, mesh=mesh8))
+        U = mk_leaf(BlockMatrix.from_numpy(u, mesh=mesh8))
+        V = mk_leaf(BlockMatrix.from_numpy(v, mesh=mesh8))
+        for e, expect in [
+            (rank_one_update(A, U, V).row_sum(), (a + u @ v.T).sum(1, keepdims=True)),
+            (rank_one_update(A, U, V).col_sum(), (a + u @ v.T).sum(0, keepdims=True)),
+            (rank_one_update(A, U, V).sum(), (a + u @ v.T).sum().reshape(1, 1)),
+        ]:
+            got = e.compute().to_numpy()
+            np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-4)
+
+
+class TestMultiPlan:
+    def test_shared_leaves_one_program(self, mesh8, rng):
+        from matrel_tpu.executor import compile_exprs
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.ir.expr import leaf as mk_leaf, matmul, transpose
+        x = rng.standard_normal((32, 8)).astype(np.float32)
+        y = rng.standard_normal((32, 1)).astype(np.float32)
+        X = mk_leaf(BlockMatrix.from_numpy(x, mesh=mesh8))
+        Y = mk_leaf(BlockMatrix.from_numpy(y, mesh=mesh8))
+        plan = compile_exprs((matmul(transpose(X), X),
+                              matmul(transpose(X), Y)), mesh8)
+        gram, rhs = plan.run()
+        np.testing.assert_allclose(gram.to_numpy(), x.T @ x, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(rhs.to_numpy(), x.T @ y, rtol=1e-4, atol=1e-4)
+        # X appears once in the shared leaf order
+        assert len(plan.leaf_order) == 2
