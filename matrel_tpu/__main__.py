@@ -1,0 +1,98 @@
+"""CLI: python -m matrel_tpu <command>
+
+Commands:
+  info                  device/mesh/config summary
+  bench                 headline benchmark (one JSON line)
+  serve [--port P]      run the JSON-RPC bridge server
+  sql "<query>" [--table name=path.npy ...]   one-shot SQL query
+  autotune N [K M]      time every matmul strategy for the given dims
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_info(args):
+    import jax
+    from matrel_tpu.config import default_config
+    from matrel_tpu.core import mesh as mesh_lib
+    cfg = default_config()
+    mesh = mesh_lib.make_mesh(cfg.mesh_shape, cfg.mesh_axis_names)
+    print(json.dumps({
+        "backend": jax.default_backend(),
+        "devices": [str(d) for d in jax.devices()],
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "config": {f: getattr(cfg, f) for f in (
+            "block_size", "broadcast_threshold_bytes", "strategy_override",
+            "matmul_precision", "use_pallas", "chain_opt")},
+    }, indent=2))
+
+
+def cmd_bench(args):
+    import bench
+    bench.main()
+
+
+def cmd_serve(args):
+    from matrel_tpu.bridge import BridgeServer
+    srv = BridgeServer(port=args.port)
+    print(f"matrel_tpu bridge listening on 127.0.0.1:{srv.port}", flush=True)
+    srv.serve_forever()
+
+
+def cmd_sql(args):
+    import numpy as np
+    from matrel_tpu.session import MatrelSession
+    sess = MatrelSession.builder().get_or_create()
+    for spec in args.table or []:
+        name, path = spec.split("=", 1)
+        sess.register(name, sess.from_numpy(np.load(path)))
+    out = sess.compute(sess.sql(args.query))
+    np.set_printoptions(precision=5, suppress=True, threshold=200)
+    print(out.to_numpy())
+
+
+def cmd_autotune(args):
+    from matrel_tpu.parallel.autotune import autotune_matmul
+    n = args.n
+    k = args.k or n
+    m = args.m or n
+    best, table = autotune_matmul(n, k, m)
+    print(json.dumps({"best": best,
+                      "seconds": {s: round(t, 6) for s, t in table.items()}},
+                     indent=2))
+
+
+def main(argv=None):
+    import os
+    if os.environ.get("JAX_PLATFORMS"):
+        # the axon sitecustomize pins the platform at interpreter start;
+        # honour an explicit JAX_PLATFORMS request via the config API,
+        # which still works after that (see tests/conftest.py)
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    p = argparse.ArgumentParser(prog="matrel_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("info").set_defaults(fn=cmd_info)
+    sub.add_parser("bench").set_defaults(fn=cmd_bench)
+    sp = sub.add_parser("serve")
+    sp.add_argument("--port", type=int, default=8765)
+    sp.set_defaults(fn=cmd_serve)
+    sq = sub.add_parser("sql")
+    sq.add_argument("query")
+    sq.add_argument("--table", action="append")
+    sq.set_defaults(fn=cmd_sql)
+    sa = sub.add_parser("autotune")
+    sa.add_argument("n", type=int)
+    sa.add_argument("k", type=int, nargs="?")
+    sa.add_argument("m", type=int, nargs="?")
+    sa.set_defaults(fn=cmd_autotune)
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Matrix IO — the ingestion layer (the reference loads matrices from
+HDFS text/CSV/MatrixMarket into block RDDs; SURVEY.md §2 "Block
+representation").
+
+Formats:
+  - .npy            dense, single file (numpy)
+  - .mtx            MatrixMarket via scipy → BlockSparseMatrix
+  - .csv            "i,j,value" coordinate triples → dense or block-sparse
+  - tiled directory a directory of `tile_R_C.npy` files + meta.json —
+                    the multi-file layout for matrices produced shard-wise
+                    (written/read with a thread pool; the Spark-side
+                    analogue of one part-file per partition)
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Tuple
+
+import numpy as np
+
+from matrel_tpu.config import MatrelConfig, default_config
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.core.sparse import BlockSparseMatrix
+
+
+def load_npy(path: str, mesh=None, config: Optional[MatrelConfig] = None
+             ) -> BlockMatrix:
+    return BlockMatrix.from_numpy(np.load(path), mesh=mesh, config=config)
+
+
+def save_npy(path: str, m: BlockMatrix) -> None:
+    np.save(path, m.to_numpy())
+
+
+def load_mtx(path: str, mesh=None, block_size: Optional[int] = None,
+             config: Optional[MatrelConfig] = None) -> BlockSparseMatrix:
+    """MatrixMarket coordinate file → block-sparse."""
+    import scipy.io
+    sp = scipy.io.mmread(path)
+    return BlockSparseMatrix.from_scipy(sp.tocoo(), block_size=block_size,
+                                        mesh=mesh, config=config)
+
+
+def load_coo_csv(path: str, shape: Tuple[int, int], mesh=None,
+                 block_size: Optional[int] = None, dense: bool = False,
+                 config: Optional[MatrelConfig] = None):
+    """'i,j,value' triples (the reference's text ingestion format)."""
+    data = np.loadtxt(path, delimiter=",", ndmin=2)
+    rows = data[:, 0].astype(np.int64)
+    cols = data[:, 1].astype(np.int64)
+    vals = data[:, 2].astype(np.float32)
+    if dense:
+        out = np.zeros(shape, dtype=np.float32)
+        np.add.at(out, (rows, cols), vals)
+        return BlockMatrix.from_numpy(out, mesh=mesh, config=config,
+                                      nnz=len(vals))
+    import scipy.sparse as sps
+    sp = sps.coo_matrix((vals, (rows, cols)), shape=shape)
+    return BlockSparseMatrix.from_scipy(sp, block_size=block_size, mesh=mesh,
+                                        config=config)
+
+
+# -- tiled directory format -------------------------------------------------
+
+
+def save_tiled(directory: str, m: BlockMatrix, tile: int = 4096,
+               workers: int = 8) -> None:
+    """Write a matrix as tile_R_C.npy part-files + meta.json."""
+    os.makedirs(directory, exist_ok=True)
+    host = m.to_numpy()
+    n, mm = host.shape
+    gr, gc = math.ceil(n / tile), math.ceil(mm / tile)
+
+    def write(rc):
+        r, c = rc
+        part = host[r * tile:(r + 1) * tile, c * tile:(c + 1) * tile]
+        np.save(os.path.join(directory, f"tile_{r}_{c}.npy"), part)
+
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        list(ex.map(write, [(r, c) for r in range(gr) for c in range(gc)]))
+    with open(os.path.join(directory, "meta.json"), "w") as f:
+        json.dump({"shape": [n, mm], "tile": tile, "grid": [gr, gc],
+                   "dtype": str(host.dtype)}, f)
+
+
+def load_tiled(directory: str, mesh=None,
+               config: Optional[MatrelConfig] = None,
+               workers: int = 8) -> BlockMatrix:
+    with open(os.path.join(directory, "meta.json")) as f:
+        meta = json.load(f)
+    n, mm = meta["shape"]
+    tile = meta["tile"]
+    gr, gc = meta["grid"]
+    out = np.zeros((n, mm), dtype=meta.get("dtype", "float32"))
+
+    def read(rc):
+        r, c = rc
+        part = np.load(os.path.join(directory, f"tile_{r}_{c}.npy"))
+        out[r * tile:r * tile + part.shape[0],
+            c * tile:c * tile + part.shape[1]] = part
+
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        list(ex.map(read, [(r, c) for r in range(gr) for c in range(gc)]))
+    return BlockMatrix.from_numpy(out, mesh=mesh, config=config)

@@ -1,0 +1,85 @@
+"""Strategy autotuning — empirical answer to SURVEY.md §7's hard part:
+"proving the explicit SUMMA/psum_scatter paths beat XLA's choice (and
+detecting when not)".
+
+The cost model (planner.py) is an estimate; this module MEASURES. For a
+given (n, k, m, mesh) it times every admissible strategy on-device
+(marginal timing: chained dependent runs with a forced fetch, cancelling
+dispatch latency — see bench.py methodology) and caches the winner. Use
+``config.strategy_override`` per-session, or consult the returned table.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from matrel_tpu.config import MatrelConfig, default_config
+from matrel_tpu.core import mesh as mesh_lib, padding
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.parallel import planner, strategies
+
+_CACHE: Dict[tuple, Tuple[str, Dict[str, float]]] = {}
+
+
+def measure_strategy(strategy: str, A: BlockMatrix, B: BlockMatrix,
+                     config: MatrelConfig, reps: Tuple[int, int] = (2, 8)
+                     ) -> float:
+    """Marginal seconds per multiply for one strategy."""
+    mesh = A.mesh
+    f = jax.jit(lambda x, y: strategies.run_matmul(strategy, x, y, mesh,
+                                                   config))
+    fetch = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+
+    def chained(n: int):
+        cur = A.data
+        for _ in range(n):
+            cur = f(cur, B.data).astype(A.dtype)
+        float(fetch(cur))
+
+    chained(2)  # compile + warm
+    lo, hi = reps
+    t0 = time.perf_counter()
+    chained(lo)
+    t_lo = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    chained(hi)
+    t_hi = time.perf_counter() - t0
+    return max((t_hi - t_lo) / (hi - lo), 1e-9)
+
+
+def autotune_matmul(n: int, k: int, m: int,
+                    mesh=None, dtype="float32",
+                    config: Optional[MatrelConfig] = None
+                    ) -> Tuple[str, Dict[str, float]]:
+    """Times every admissible strategy for an (n×k)·(k×m) multiply on this
+    mesh; returns (best_strategy, {strategy: seconds}). Results cached per
+    (dims, mesh shape, dtype). Chained timing needs n == m == k for the
+    feedback loop, so non-square requests are measured square at
+    max(n, k, m) — the MXU/collective behaviour is shape-dominated."""
+    cfg = config or default_config()
+    mesh = mesh or mesh_lib.make_mesh(cfg.mesh_shape, cfg.mesh_axis_names)
+    side = max(n, k, m)
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    key = (side, gx, gy, str(dtype))
+    if key in _CACHE:
+        return _CACHE[key]
+    A = BlockMatrix.random((side, side), mesh=mesh, seed=0, dtype=dtype)
+    B = BlockMatrix.random((side, side), mesh=mesh, seed=1, dtype=dtype)
+    pn, pk = padding.padded_shape((side, side), mesh)
+    results: Dict[str, float] = {}
+    for s in strategies.STRATEGIES:
+        if s == "summa" and gx != gy:
+            continue
+        if not planner.admissible(s, pn, pk, pn, gx, gy):
+            continue
+        try:
+            results[s] = measure_strategy(s, A, B, cfg)
+        except Exception:  # noqa: BLE001 — a strategy failing to compile
+            continue       # on this backend just drops out of the table
+    best = min(results, key=results.get)
+    _CACHE[key] = (best, results)
+    return best, results

@@ -1,0 +1,88 @@
+"""IO formats, CLI, and autotune smoke tests."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from matrel_tpu import io as mio
+from matrel_tpu.core.blockmatrix import BlockMatrix
+
+
+class TestIO:
+    def test_npy_roundtrip(self, mesh8, rng, tmp_path):
+        a = rng.standard_normal((12, 9)).astype(np.float32)
+        p = str(tmp_path / "a.npy")
+        np.save(p, a)
+        m = mio.load_npy(p, mesh=mesh8)
+        np.testing.assert_allclose(m.to_numpy(), a, rtol=1e-6)
+        p2 = str(tmp_path / "b.npy")
+        mio.save_npy(p2, m)
+        np.testing.assert_allclose(np.load(p2), a, rtol=1e-6)
+
+    def test_coo_csv_dense_and_sparse(self, mesh8, tmp_path):
+        p = str(tmp_path / "m.csv")
+        with open(p, "w") as f:
+            f.write("0,0,1.5\n2,3,-2.0\n0,0,0.5\n")  # duplicate sums
+        m = mio.load_coo_csv(p, (4, 5), mesh=mesh8, dense=True)
+        got = m.to_numpy()
+        assert got[0, 0] == pytest.approx(2.0)
+        assert got[2, 3] == pytest.approx(-2.0)
+        s = mio.load_coo_csv(p, (4, 5), mesh=mesh8, block_size=2)
+        np.testing.assert_allclose(s.to_numpy(), got, rtol=1e-6)
+
+    def test_mtx(self, mesh8, tmp_path):
+        import scipy.io, scipy.sparse
+        dense = np.zeros((6, 6), np.float32)
+        dense[1, 2] = 3.25
+        dense[5, 0] = -1.0
+        p = str(tmp_path / "m.mtx")
+        scipy.io.mmwrite(p, scipy.sparse.coo_matrix(dense))
+        s = mio.load_mtx(p, mesh=mesh8, block_size=4)
+        np.testing.assert_allclose(s.to_numpy(), dense, rtol=1e-6)
+
+    def test_tiled_roundtrip(self, mesh8, rng, tmp_path):
+        a = rng.standard_normal((20, 13)).astype(np.float32)
+        m = BlockMatrix.from_numpy(a, mesh=mesh8)
+        d = str(tmp_path / "tiles")
+        mio.save_tiled(d, m, tile=8)
+        m2 = mio.load_tiled(d, mesh=mesh8)
+        np.testing.assert_allclose(m2.to_numpy(), a, rtol=1e-6)
+
+
+class TestAutotune:
+    def test_returns_admissible_best(self, mesh8):
+        from matrel_tpu.parallel.autotune import autotune_matmul
+        best, table = autotune_matmul(64, 64, 64, mesh=mesh8)
+        assert best in table and len(table) >= 3
+        assert all(t > 0 for t in table.values())
+        # cached second call
+        best2, _ = autotune_matmul(64, 64, 64, mesh=mesh8)
+        assert best2 == best
+
+
+class TestCLI:
+    def _run(self, *args):
+        import os
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        return subprocess.run(
+            [sys.executable, "-m", "matrel_tpu", *args],
+            capture_output=True, text=True, cwd="/root/repo", env=env,
+            timeout=240)
+
+    def test_info(self):
+        r = self._run("info")
+        assert r.returncode == 0, r.stderr
+        out = json.loads(r.stdout)
+        assert out["backend"] == "cpu" and "mesh" in out
+
+    def test_sql_oneshot(self, tmp_path):
+        p = str(tmp_path / "x.npy")
+        np.save(p, np.eye(3, dtype=np.float32) * 2)
+        r = self._run("sql", "trace(X)", "--table", f"X={p}")
+        assert r.returncode == 0, r.stderr
+        assert "6." in r.stdout
