@@ -451,6 +451,8 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
     if mesh is None:
         mesh = (all_leaves[0].attrs["matrix"].mesh if all_leaves
                 else mesh_lib.make_mesh(cfg.mesh_shape, cfg.mesh_axis_names))
+    for e in exprs:
+        _check_one_mesh(e, mesh)
     opts = tuple(planner.annotate_strategies(rules.optimize(e, cfg), mesh, cfg)
                  for e in exprs)
     leaf_order = []
@@ -465,6 +467,22 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
                      optimized=opts, mesh=mesh, config=cfg)
 
 
+def _check_one_mesh(expr: MatExpr, mesh: Mesh) -> None:
+    """All leaves (dense and sparse) must live on the plan's mesh — mixed
+    meshes would silently produce cross-device copies or wrong shardings."""
+    def walk(n: MatExpr):
+        if n.kind in ("leaf", "sparse_leaf"):
+            m = n.attrs["matrix"].mesh
+            if m is not mesh and tuple(m.devices.ravel()) != tuple(
+                    mesh.devices.ravel()):
+                raise ValueError(
+                    "expression mixes matrices from different meshes: "
+                    f"{dict(m.shape)} vs plan mesh {dict(mesh.shape)}")
+        for c in n.children:
+            walk(c)
+    walk(expr)
+
+
 def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
                  config: Optional[MatrelConfig] = None) -> CompiledPlan:
     """optimize → plan → lower → jit. The full Catalyst pipeline analogue."""
@@ -473,6 +491,7 @@ def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
     if mesh is None:
         mesh = lvs[0].attrs["matrix"].mesh if lvs else mesh_lib.make_mesh(
             cfg.mesh_shape, cfg.mesh_axis_names)
+    _check_one_mesh(expr, mesh)
     opt = rules.optimize(expr, cfg)
     opt = planner.annotate_strategies(opt, mesh, cfg)
     leaf_order = expr_leaves(opt)

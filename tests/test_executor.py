@@ -147,3 +147,23 @@ class TestNormalEquations:
         theta = np.linalg.solve(xtx, xty)
         oracle = np.linalg.lstsq(x, y, rcond=None)[0]
         np.testing.assert_allclose(theta, oracle, rtol=1e-2, atol=1e-3)
+
+
+class TestBf16Pipeline:
+    def test_bf16_end_to_end_keeps_dtype(self, mesh8, rng):
+        import jax.numpy as jnp
+        a = rng.standard_normal((16, 16)).astype(np.float32)
+        b = rng.standard_normal((16, 16)).astype(np.float32)
+        A = bm(a, mesh8, dtype="bfloat16")
+        B = bm(b, mesh8, dtype="bfloat16")
+        out = A.multiply(B).compute()
+        assert out.dtype == jnp.bfloat16  # f32 accumulate, bf16 storage
+        np.testing.assert_allclose(out.to_numpy().astype(np.float32),
+                                   a @ b, rtol=3e-2, atol=3e-1)
+
+    def test_mixed_mesh_leaves_rejected(self, mesh8, mesh_square, rng):
+        from matrel_tpu.executor import compile_expr
+        a = bm(rng.standard_normal((8, 8)).astype(np.float32), mesh8)
+        b = bm(rng.standard_normal((8, 8)).astype(np.float32), mesh_square)
+        with pytest.raises(ValueError, match="mesh"):
+            compile_expr(a.expr().multiply(b.expr()))

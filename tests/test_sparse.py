@@ -128,3 +128,20 @@ class TestSparseTranspose:
         e = A.expr().multiply(S.expr())
         np.testing.assert_allclose(e.compute().to_numpy(), a @ s_np,
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_session_plan_cache_distinguishes_sparse_matrices(mesh8, rng):
+    # regression: two same-shaped sparse matrices must not share a cached
+    # plan (tiles are captured as constants in the compiled program)
+    from matrel_tpu.session import MatrelSession
+    s1_np = random_block_sparse_np(rng, 16, 16, 8, 0.5)
+    s2_np = -2.0 * s1_np
+    d = rng.standard_normal((16, 8)).astype(np.float32)
+    sess = MatrelSession(mesh=mesh8)
+    D = BlockMatrix.from_numpy(d, mesh=mesh8)
+    S1 = BlockSparseMatrix.from_numpy(s1_np, block_size=8, mesh=mesh8)
+    S2 = BlockSparseMatrix.from_numpy(s2_np, block_size=8, mesh=mesh8)
+    out1 = sess.compute(S1.multiply(D)).to_numpy()
+    out2 = sess.compute(S2.multiply(D)).to_numpy()
+    np.testing.assert_allclose(out1, s1_np @ d, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(out2, s2_np @ d, rtol=1e-4, atol=1e-4)
