@@ -212,8 +212,40 @@ def _same_structure(a: MatExpr, b: MatExpr) -> bool:
     return all(_same_structure(x, y) for x, y in zip(a.children, b.children))
 
 
+def common_subexpressions(e: MatExpr) -> MatExpr:
+    """Hash-consing: structurally identical subtrees collapse to ONE node,
+    so the executor's identity-keyed memo computes them once (the analogue
+    of Catalyst's plan normalization + Spark's reused-exchange). Callable
+    attrs (predicates/merges) key by identity."""
+    table: dict = {}
+
+    def key_of(n: MatExpr, child_keys) -> tuple:
+        attr_items = []
+        for k, v in sorted(n.attrs.items()):
+            if callable(v) or not isinstance(v, (int, float, str, bool,
+                                                 type(None))):
+                attr_items.append((k, id(v)))
+            else:
+                attr_items.append((k, v))
+        return (n.kind, n.shape, tuple(attr_items), tuple(child_keys))
+
+    def walk(n: MatExpr) -> tuple:
+        child_pairs = [walk(c) for c in n.children]
+        child_keys = [k for k, _ in child_pairs]
+        new_children = tuple(c for _, c in child_pairs)
+        k = key_of(n, child_keys)
+        if k in table:
+            return k, table[k]
+        if any(nc is not oc for nc, oc in zip(new_children, n.children)):
+            n = n.with_children(new_children)
+        table[k] = n
+        return k, n
+
+    return walk(e)[1]
+
+
 def optimize(e: MatExpr, config: Optional[MatrelConfig] = None) -> MatExpr:
-    """Full logical optimization: rewrites, then chain-DP reorder."""
+    """Full logical optimization: rewrites, chain-DP reorder, CSE."""
     cfg = config or default_config()
     if cfg.rewrite_rules:
         e = apply_rewrites(e)
@@ -221,4 +253,6 @@ def optimize(e: MatExpr, config: Optional[MatrelConfig] = None) -> MatExpr:
         e = chain_lib.reorder_chains(e)
         if cfg.rewrite_rules:
             e = apply_rewrites(e)  # reorder can expose new folds
+    if cfg.rewrite_rules:
+        e = common_subexpressions(e)
     return e

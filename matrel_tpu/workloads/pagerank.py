@@ -168,6 +168,56 @@ def _csr_runner(n: int, rounds: int, alpha: float, D: int):
     return run
 
 
+def pagerank_block_sparse(S, rounds: int = 30, alpha: float = 0.85,
+                          config: Optional[MatrelConfig] = None) -> jax.Array:
+    """PageRank on a block-sparse adjacency (clustered graphs where tiles
+    are dense enough to pay — web/community graphs; for uniform-random
+    edge lists use pagerank_edges). The matvec is the SpMM fast path over
+    Âᵀ; the loop is host-driven but each round is one cached compiled
+    program (no re-trace), mirroring the reference's per-round plan
+    execution without its shuffle."""
+    import numpy as np
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.ops import spmm as spmm_lib
+
+    n = S.shape[0]
+    if S.shape[0] != S.shape[1]:
+        raise ValueError(f"adjacency must be square, got {S.shape}")
+    st = S.transpose()
+    mesh = S.mesh
+    deg_bm = spmm_lib.spmm(
+        S, BlockMatrix.from_numpy(np.ones((n, 1), np.float32), mesh=mesh),
+        config)
+
+    @jax.jit
+    def prep(deg):
+        inv = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1.0), 0.0)
+        dangling = ((deg == 0) &
+                    (jnp.arange(deg.shape[0])[:, None] < n)).astype(jnp.float32)
+        return inv, dangling
+
+    inv_deg, dangling = prep(deg_bm.data)
+    teleport = (1.0 - alpha) / n
+    r = BlockMatrix.from_numpy(np.full((n, 1), 1.0 / n, np.float32),
+                               mesh=mesh)
+
+    @jax.jit
+    def poststep(contrib, r_old):
+        dmass = jnp.sum(dangling * r_old)
+        r_new = alpha * (contrib + dmass / n) + teleport
+        valid = (jnp.arange(r_new.shape[0]) < n)[:, None]
+        return jnp.where(valid, r_new, 0.0)
+
+    for _ in range(rounds):
+        weighted = BlockMatrix.from_array(
+            jax.jit(lambda rd, iv: rd * iv)(r.data, inv_deg),
+            (n, 1), mesh, r.spec)
+        contrib = spmm_lib.spmm(st, weighted, config)
+        r = BlockMatrix.from_array(poststep(contrib.data, r.data),
+                                   (n, 1), mesh, r.spec)
+    return r.data[:n]
+
+
 def pagerank_numpy_oracle(a, rounds=30, alpha=0.85):
     """Naive host oracle for tests."""
     import numpy as np

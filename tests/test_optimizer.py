@@ -205,3 +205,26 @@ class TestMultiPlan:
         np.testing.assert_allclose(rhs.to_numpy(), x.T @ y, rtol=1e-4, atol=1e-4)
         # X appears once in the shared leaf order
         assert len(plan.leaf_order) == 2
+
+
+class TestCSE:
+    def test_duplicate_subtrees_collapse(self, mesh8):
+        from matrel_tpu.ir.rules import common_subexpressions
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        A = BlockMatrix.from_numpy(np.zeros((8, 8), np.float32), mesh=mesh8)
+        B = BlockMatrix.from_numpy(np.zeros((8, 8), np.float32), mesh=mesh8)
+        # A·B built twice from scratch (distinct nodes, same structure)
+        e = A.multiply(B).t().add(A.multiply(B).t())
+        opt = common_subexpressions(e)
+        l, r = opt.children
+        assert l is r  # one shared node after hash-consing
+
+    def test_cse_numerics_via_compute(self, mesh8, rng):
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        b = rng.standard_normal((8, 8)).astype(np.float32)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        B = BlockMatrix.from_numpy(b, mesh=mesh8)
+        e = A.multiply(B).t().add(A.multiply(B).t())
+        np.testing.assert_allclose(e.compute().to_numpy(), 2 * (a @ b).T,
+                                   rtol=1e-4, atol=1e-4)
