@@ -25,6 +25,7 @@ import numpy as np
 from matrel_tpu.config import MatrelConfig, default_config
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.core.sparse import BlockSparseMatrix
+from matrel_tpu.utils import native
 
 
 def load_npy(path: str, mesh=None, config: Optional[MatrelConfig] = None
@@ -38,7 +39,19 @@ def save_npy(path: str, m: BlockMatrix) -> None:
 
 def load_mtx(path: str, mesh=None, block_size: Optional[int] = None,
              config: Optional[MatrelConfig] = None) -> BlockSparseMatrix:
-    """MatrixMarket coordinate file → block-sparse."""
+    """MatrixMarket coordinate file → block-sparse.
+
+    Parses with the native C++ reader (native/mtx_reader.cc) when built;
+    falls back to scipy for formats it declines (complex field)."""
+    parsed = native.mtx_read(path)
+    if parsed is not None:
+        shape, rows, cols, vals = parsed
+        import scipy.sparse as sps
+        # Keep float64 here; from_scipy casts to the configured dtype, so
+        # native and scipy-fallback paths yield identical matrices.
+        sp = sps.coo_matrix((vals, (rows, cols)), shape=shape)
+        return BlockSparseMatrix.from_scipy(sp, block_size=block_size,
+                                            mesh=mesh, config=config)
     import scipy.io
     sp = scipy.io.mmread(path)
     return BlockSparseMatrix.from_scipy(sp.tocoo(), block_size=block_size,
@@ -49,10 +62,15 @@ def load_coo_csv(path: str, shape: Tuple[int, int], mesh=None,
                  block_size: Optional[int] = None, dense: bool = False,
                  config: Optional[MatrelConfig] = None):
     """'i,j,value' triples (the reference's text ingestion format)."""
-    data = np.loadtxt(path, delimiter=",", ndmin=2)
-    rows = data[:, 0].astype(np.int64)
-    cols = data[:, 1].astype(np.int64)
-    vals = data[:, 2].astype(np.float32)
+    parsed = native.coo_csv_read(path)
+    if parsed is not None:
+        rows, cols, v64 = parsed
+        vals = v64.astype(np.float32)
+    else:
+        data = np.loadtxt(path, delimiter=",", ndmin=2)
+        rows = data[:, 0].astype(np.int64)
+        cols = data[:, 1].astype(np.int64)
+        vals = data[:, 2].astype(np.float32)
     if dense:
         out = np.zeros(shape, dtype=np.float32)
         np.add.at(out, (rows, cols), vals)

@@ -29,12 +29,27 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+_SOURCES = ("chain_dp.cc", "mtx_reader.cc")
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(
+        os.path.exists(s) and os.path.getmtime(s) > lib_mtime
+        for s in (os.path.join(_NATIVE_DIR, name) for name in _SOURCES)
+    )
+
+
 def _build() -> bool:
-    src = os.path.join(_NATIVE_DIR, "chain_dp.cc")
-    if not os.path.exists(src):
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
+    srcs = [s for s in srcs if os.path.exists(s)]
+    if not srcs:
         return False
     os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
-    cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", "-o", _LIB_PATH, src]
+    cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-pthread", "-shared",
+           "-o", _LIB_PATH] + srcs
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
@@ -50,10 +65,15 @@ def load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
-            return None
+        if _stale() and not _build():
+            if not os.path.exists(_LIB_PATH):
+                return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            log.debug("native load failed: %s", e)
+            return None
+        try:
             lib.matrel_chain_dp.restype = ctypes.c_int
             lib.matrel_chain_dp.argtypes = [
                 ctypes.c_int32,
@@ -62,9 +82,38 @@ def load() -> Optional[ctypes.CDLL]:
                 np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
                 ctypes.POINTER(ctypes.c_double),
             ]
-            _lib = lib
-        except OSError as e:
-            log.debug("native load failed: %s", e)
+            _has_dp = True
+        except AttributeError as e:
+            log.debug("native chain-dp symbols unavailable: %s", e)
+            _has_dp = False
+        lib._matrel_has_dp = _has_dp
+        _lib = lib
+        try:
+            # Ingestion symbols bind separately so a stale prebuilt lib
+            # (pre-mtx_reader) still serves the chain DP.
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+            lib.matrel_mtx_open.restype = ctypes.c_void_p
+            lib.matrel_mtx_open.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.matrel_coo_csv_open.restype = ctypes.c_void_p
+            lib.matrel_coo_csv_open.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+            lib.matrel_parse_fill.restype = ctypes.c_int64
+            lib.matrel_parse_fill.argtypes = [
+                ctypes.c_void_p, i64p, i64p, f64p, ctypes.c_int64]
+            lib.matrel_parse_close.restype = None
+            lib.matrel_parse_close.argtypes = [ctypes.c_void_p]
+            _has_ingest = True
+        except AttributeError as e:
+            log.debug("native ingestion symbols unavailable: %s", e)
+            _has_ingest = False
+        lib._matrel_has_ingest = _has_ingest
         return _lib
 
 
@@ -74,7 +123,7 @@ def chain_dp(dims: Sequence[int], densities: Sequence[float]
     Returns (split table [n,n] int32, total cost) or None if the native
     path is unavailable."""
     lib = load()
-    if lib is None:
+    if lib is None or not getattr(lib, "_matrel_has_dp", False):
         return None
     n = len(densities)
     if len(dims) != n + 1:
@@ -88,3 +137,79 @@ def chain_dp(dims: Sequence[int], densities: Sequence[float]
     if rc != 0:
         return None
     return splits, float(cost.value)
+
+
+# -- native text ingestion (mtx_reader.cc) ----------------------------------
+
+_MTX_SYMMETRIC = 1
+_MTX_PATTERN = 2
+_MTX_SKEW = 4
+_MTX_COMPLEX = 8
+_MTX_ARRAY = 16
+
+
+def mtx_read(path: str) -> Optional[Tuple[Tuple[int, int], np.ndarray,
+                                          np.ndarray, np.ndarray]]:
+    """Parse a MatrixMarket file natively.
+
+    Returns ((rows, cols), row_idx, col_idx, values) with symmetry already
+    expanded (mirror/negated-mirror of off-diagonal entries), or None when
+    the native library is unavailable or the file needs the scipy fallback
+    (complex field, parse error).
+    """
+    lib = load()
+    if lib is None or not getattr(lib, "_matrel_has_ingest", False):
+        return None
+    r = ctypes.c_int64(0)
+    c = ctypes.c_int64(0)
+    nnz = ctypes.c_int64(0)
+    flags = ctypes.c_int32(0)
+    h = lib.matrel_mtx_open(path.encode(), ctypes.byref(r), ctypes.byref(c),
+                            ctypes.byref(nnz), ctypes.byref(flags))
+    if not h:
+        return None
+    try:
+        if flags.value & _MTX_COMPLEX:
+            return None
+        cap = max(1, nnz.value)
+        ri = np.empty(cap, dtype=np.int64)
+        ci = np.empty(cap, dtype=np.int64)
+        vals = np.empty(cap, dtype=np.float64)
+        got = lib.matrel_parse_fill(h, ri, ci, vals, cap)
+    finally:
+        lib.matrel_parse_close(h)
+    if got < 0:
+        return None
+    ri, ci, vals = ri[:got], ci[:got], vals[:got]
+    if flags.value & _MTX_SYMMETRIC:
+        off = ri != ci
+        mr, mc = ci[off], ri[off]
+        mv = -vals[off] if flags.value & _MTX_SKEW else vals[off]
+        ri = np.concatenate([ri, mr])
+        ci = np.concatenate([ci, mc])
+        vals = np.concatenate([vals, mv])
+    return (r.value, c.value), ri, ci, vals
+
+
+def coo_csv_read(path: str) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                              np.ndarray]]:
+    """Parse 'i,j,value' coordinate text natively (0-based indices as
+    stored). Returns (row_idx, col_idx, values) or None if unavailable."""
+    lib = load()
+    if lib is None or not getattr(lib, "_matrel_has_ingest", False):
+        return None
+    n = ctypes.c_int64(0)
+    h = lib.matrel_coo_csv_open(path.encode(), ctypes.byref(n))
+    if not h:
+        return None
+    try:
+        cap = max(1, int(n.value))
+        ri = np.empty(cap, dtype=np.int64)
+        ci = np.empty(cap, dtype=np.int64)
+        vals = np.empty(cap, dtype=np.float64)
+        got = lib.matrel_parse_fill(h, ri, ci, vals, cap)
+    finally:
+        lib.matrel_parse_close(h)
+    if got < 0:
+        return None
+    return ri[:got], ci[:got], vals[:got]

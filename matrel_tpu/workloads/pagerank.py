@@ -191,7 +191,9 @@ def pagerank_block_sparse(S, rounds: int = 30, alpha: float = 0.85,
 
     @jax.jit
     def prep(deg):
-        inv = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1.0), 0.0)
+        # epsilon (not 1.0) floor: weighted adjacencies can have row sums
+        # below 1, and clamping those would silently skew the ranks
+        inv = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1e-30), 0.0)
         dangling = ((deg == 0) &
                     (jnp.arange(deg.shape[0])[:, None] < n)).astype(jnp.float32)
         return inv, dangling
@@ -209,9 +211,8 @@ def pagerank_block_sparse(S, rounds: int = 30, alpha: float = 0.85,
         return jnp.where(valid, r_new, 0.0)
 
     for _ in range(rounds):
-        weighted = BlockMatrix.from_array(
-            jax.jit(lambda rd, iv: rd * iv)(r.data, inv_deg),
-            (n, 1), mesh, r.spec)
+        weighted = BlockMatrix.from_array(r.data * inv_deg,
+                                          (n, 1), mesh, r.spec)
         contrib = spmm_lib.spmm(st, weighted, config)
         r = BlockMatrix.from_array(poststep(contrib.data, r.data),
                                    (n, 1), mesh, r.spec)

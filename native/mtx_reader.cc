@@ -1,0 +1,375 @@
+// matrel_tpu native ingestion core: MatrixMarket + COO-CSV parsers.
+//
+// The reference's ingestion path reads coordinate text (HDFS CSV /
+// MatrixMarket) into block RDDs on the JVM (SURVEY.md §2 "Block
+// representation"); its throughput is set by JVM text parsing. Here the
+// equivalent hot loop is host-side text→COO parsing before device
+// placement, so it lives in C++: one fread of the whole file, then a
+// pointer scan with a hand-rolled float parser (glibc strtod costs
+// ~200ns/number; this is ~5× faster) — multithreaded on multicore hosts.
+//
+// C ABI only — consumed with ctypes (utils/native.py), no pybind11.
+// Handle-based: `open` slurps the file ONCE and parses the header;
+// `fill` parses the data section into caller buffers; `close` frees.
+// Indices are returned 0-based. Symmetry expansion is left to the Python
+// side (vectorised numpy mirror), so buffers are sized by the STORED nnz.
+
+#include <algorithm>
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <utility>
+#include <vector>
+
+namespace {
+
+// Whole-file read. Returns false on open/read failure.
+bool slurp(const char* path, std::string* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  if (sz < 0) {
+    std::fclose(f);
+    return false;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(static_cast<size_t>(sz));
+  size_t got = sz ? std::fread(&(*out)[0], 1, static_cast<size_t>(sz), f) : 0;
+  std::fclose(f);
+  out->resize(got);
+  return true;
+}
+
+const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+const char* next_line(const char* p, const char* end) {
+  while (p < end && *p != '\n') ++p;
+  return p < end ? p + 1 : end;
+}
+
+// Flags shared with utils/native.py.
+constexpr int32_t kSymmetric = 1;
+constexpr int32_t kPattern = 2;
+constexpr int32_t kSkew = 4;
+constexpr int32_t kComplexUnsupported = 8;
+constexpr int32_t kDenseArray = 16;
+
+// -- fast number parsing ----------------------------------------------------
+
+inline const char* parse_int_fast(const char* p, const char* end,
+                                  int64_t* out) {
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) {
+    neg = *p == '-';
+    ++p;
+  }
+  if (p >= end || *p < '0' || *p > '9') return nullptr;
+  int64_t v = 0;
+  while (p < end && *p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+  *out = neg ? -v : v;
+  return p;
+}
+
+const double kPow10[] = {1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+                         1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
+                         1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+inline const char* parse_double_fast(const char* p, const char* end,
+                                     double* out) {
+  const char* start = p;
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) {
+    neg = *p == '-';
+    ++p;
+  }
+  uint64_t mant = 0;
+  int digits = 0, frac = 0;
+  bool any = false;
+  while (p < end && *p >= '0' && *p <= '9') {
+    mant = mant * 10 + static_cast<uint64_t>(*p - '0');
+    ++digits;
+    ++p;
+    any = true;
+  }
+  if (p < end && *p == '.') {
+    ++p;
+    while (p < end && *p >= '0' && *p <= '9') {
+      mant = mant * 10 + static_cast<uint64_t>(*p - '0');
+      ++digits;
+      ++frac;
+      ++p;
+      any = true;
+    }
+  }
+  if (!any) return nullptr;
+  int exp10 = -frac;
+  if (p < end && (*p == 'e' || *p == 'E' || *p == 'd' || *p == 'D')) {
+    int64_t e = 0;
+    const char* q = parse_int_fast(p + 1, end, &e);
+    if (q) {
+      exp10 += static_cast<int>(e);
+      p = q;
+    }
+  }
+  // Fast path: mantissa→double rounds once, pow10 scale rounds once →
+  // ≤1 ulp total in double, invisible after the float32 cast downstream.
+  // uint64 holds 19 digits without overflow; harder cases → strtod.
+  if (digits <= 19 && exp10 >= -22 && exp10 <= 22) {
+    double v = static_cast<double>(mant);
+    v = exp10 >= 0 ? v * kPow10[exp10] : v / kPow10[-exp10];
+    *out = neg ? -v : v;
+    return p;
+  }
+  char* q = nullptr;
+  *out = std::strtod(start, &q);
+  return q == start ? nullptr : q;
+}
+
+// -- coordinate-section parsing ---------------------------------------------
+
+// One tokenizer for every consumer. `sink(i, j, v)` returns false on
+// overflow; parse returns false on malformed input or sink refusal.
+template <typename Sink>
+bool parse_coord(const char* p, const char* end, bool pattern, int64_t base,
+                 Sink&& sink) {
+  while (p < end) {
+    p = skip_ws(p, end);
+    if (p >= end) break;
+    if (*p == '\n') {
+      ++p;
+      continue;
+    }
+    if (*p == '%' || *p == '#') {
+      p = next_line(p, end);
+      continue;
+    }
+    int64_t i = 0, j = 0;
+    const char* q = parse_int_fast(p, end, &i);
+    if (!q) return false;
+    while (q < end && (*q == ',' || *q == ' ' || *q == '\t')) ++q;
+    q = parse_int_fast(q, end, &j);
+    if (!q) return false;
+    double v = 1.0;
+    if (!pattern) {
+      while (q < end && (*q == ',' || *q == ' ' || *q == '\t')) ++q;
+      q = parse_double_fast(q, end, &v);
+      if (!q) return false;
+    }
+    p = next_line(q, end);
+    if (!sink(i - base, j - base, v)) return false;
+  }
+  return true;
+}
+
+struct Entry {
+  int64_t i, j;
+  double v;
+};
+
+// Parse [p, end): one chunk per hardware thread on multicore hosts
+// (per-thread vectors, stitched in order), straight into the caller's
+// buffers when single-threaded. Returns total entries, -1 on error.
+int64_t parse_coord_parallel(const char* p, const char* end, bool pattern,
+                             int64_t base, int64_t expected_hint,
+                             int64_t* ri, int64_t* ci, double* vals,
+                             int64_t capacity) {
+  const int64_t bytes = end - p;
+  unsigned hw = std::thread::hardware_concurrency();
+  int nthreads = static_cast<int>(std::max(1u, std::min(hw, 16u)));
+  if (bytes < (1 << 20)) nthreads = 1;  // small files: skip thread setup
+  if (nthreads == 1) {
+    int64_t n = 0;
+    bool ok = parse_coord(p, end, pattern, base,
+                          [&](int64_t i, int64_t j, double v) {
+                            if (n >= capacity) return false;
+                            ri[n] = i;
+                            ci[n] = j;
+                            vals[n] = v;
+                            ++n;
+                            return true;
+                          });
+    return ok ? n : -1;
+  }
+  std::vector<const char*> bounds(nthreads + 1);
+  bounds[0] = p;
+  bounds[nthreads] = end;
+  for (int t = 1; t < nthreads; ++t) {
+    const char* cut = p + bytes * t / nthreads;
+    while (cut < end && *cut != '\n') ++cut;
+    bounds[t] = cut < end ? cut + 1 : end;
+  }
+  std::vector<std::vector<Entry>> parts(nthreads);
+  std::vector<char> oks(nthreads, 1);
+  int64_t reserve = expected_hint > 0 ? expected_hint / nthreads + 16
+                                      : bytes / (8 * nthreads) + 16;
+  auto work = [&](int t) {
+    parts[t].reserve(static_cast<size_t>(reserve));
+    oks[t] = parse_coord(bounds[t], bounds[t + 1], pattern, base,
+                         [&parts, t](int64_t i, int64_t j, double v) {
+                           parts[t].push_back({i, j, v});
+                           return true;
+                         })
+                 ? 1
+                 : 0;
+  };
+  std::vector<std::thread> threads;
+  threads.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t) threads.emplace_back(work, t);
+  for (auto& th : threads) th.join();
+  int64_t total = 0;
+  for (int t = 0; t < nthreads; ++t) {
+    if (!oks[t]) return -1;
+    total += static_cast<int64_t>(parts[t].size());
+  }
+  if (total > capacity) return -1;
+  int64_t off = 0;
+  for (int t = 0; t < nthreads; ++t) {
+    for (const Entry& e : parts[t]) {
+      ri[off] = e.i;
+      ci[off] = e.j;
+      vals[off] = e.v;
+      ++off;
+    }
+  }
+  return total;
+}
+
+// -- handles ----------------------------------------------------------------
+
+struct ParseHandle {
+  std::string buf;
+  size_t data_off = 0;  // offset of the data section into buf
+  int64_t rows = 0, cols = 0, nnz = 0;
+  int32_t flags = 0;
+  int64_t base = 0;  // 1 for MatrixMarket, 0 for raw COO text
+};
+
+// Parses the MatrixMarket banner/comments/size line into h. Returns false
+// on malformed header.
+bool parse_mtx_header(ParseHandle* h) {
+  const char* begin = h->buf.data();
+  const char* p = begin;
+  const char* end = p + h->buf.size();
+  if (h->buf.size() < 14 || std::strncmp(p, "%%MatrixMarket", 14) != 0)
+    return false;
+  const char* eol = p;
+  while (eol < end && *eol != '\n') ++eol;
+  std::string banner(p, eol - p);
+  for (auto& ch : banner) ch = static_cast<char>(std::tolower(ch));
+  if (banner.find("array") != std::string::npos) h->flags |= kDenseArray;
+  if (banner.find("pattern") != std::string::npos) h->flags |= kPattern;
+  if (banner.find("complex") != std::string::npos)
+    h->flags |= kComplexUnsupported;
+  if (banner.find("skew-symmetric") != std::string::npos)
+    h->flags |= kSkew | kSymmetric;
+  else if (banner.find("symmetric") != std::string::npos ||
+           banner.find("hermitian") != std::string::npos)
+    h->flags |= kSymmetric;
+  p = next_line(p, end);
+  while (p < end && *p == '%') p = next_line(p, end);
+  char* q = nullptr;
+  h->rows = std::strtoll(p, &q, 10);
+  h->cols = std::strtoll(q, &q, 10);
+  h->nnz = (h->flags & kDenseArray) ? h->rows * h->cols
+                                    : std::strtoll(q, &q, 10);
+  if (h->rows < 0 || h->cols < 0 || h->nnz < 0 || q == p) return false;
+  // Data starts after the size line's LAST parsed number — strtoll may
+  // have skipped blank lines between comments and the size line, so
+  // advancing from `p` could leave data_off pointing at the size line
+  // itself (corrupting dense-array payloads).
+  h->data_off = static_cast<size_t>(next_line(q, end) - begin);
+  h->base = 1;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open a MatrixMarket file: slurp once, parse the header. Returns an
+// opaque handle (NULL on open/parse failure) and fills rows/cols/nnz
+// (STORED entry count) + format flags.
+void* matrel_mtx_open(const char* path, int64_t* rows, int64_t* cols,
+                      int64_t* nnz, int32_t* flags) {
+  auto* h = new ParseHandle();
+  if (!slurp(path, &h->buf) || !parse_mtx_header(h)) {
+    delete h;
+    return nullptr;
+  }
+  *rows = h->rows;
+  *cols = h->cols;
+  *nnz = h->nnz;
+  *flags = h->flags;
+  return h;
+}
+
+// Open an "i,j,value" COO text file ('#'/'%' comments; separators ','
+// or whitespace). Fills *count with the number of data lines.
+void* matrel_coo_csv_open(const char* path, int64_t* count) {
+  auto* h = new ParseHandle();
+  if (!slurp(path, &h->buf)) {
+    delete h;
+    return nullptr;
+  }
+  const char* p = h->buf.data();
+  const char* end = p + h->buf.size();
+  int64_t n = 0;
+  while (p < end) {
+    p = skip_ws(p, end);
+    if (p < end && *p != '\n' && *p != '#' && *p != '%') ++n;
+    p = next_line(p, end);
+  }
+  h->nnz = n;
+  *count = n;
+  return h;
+}
+
+// Parse the opened file's data section (0-based indices) into caller
+// buffers of `capacity` elements. Pattern entries yield 1.0; dense
+// "array" payloads yield column-major coordinates. Returns entries
+// written, -1 on malformed input/overflow/unsupported field.
+int64_t matrel_parse_fill(void* handle, int64_t* ri, int64_t* ci,
+                          double* vals, int64_t capacity) {
+  auto* h = static_cast<ParseHandle*>(handle);
+  if (!h || (h->flags & kComplexUnsupported)) return -1;
+  const char* p = h->buf.data() + h->data_off;
+  const char* end = h->buf.data() + h->buf.size();
+  if (h->flags & kDenseArray) {
+    if (h->nnz > capacity) return -1;
+    int64_t n = 0;
+    for (int64_t j = 0; j < h->cols; ++j) {
+      for (int64_t i = 0; i < h->rows; ++i) {
+        p = skip_ws(p, end);
+        while (p < end && *p == '\n') p = skip_ws(p + 1, end);
+        double v = 0.0;
+        const char* q = parse_double_fast(p, end, &v);
+        if (!q) return -1;
+        p = q;
+        ri[n] = i;
+        ci[n] = j;
+        vals[n] = v;
+        ++n;
+      }
+    }
+    return n;
+  }
+  int64_t n = parse_coord_parallel(p, end, h->flags & kPattern, h->base,
+                                   h->nnz, ri, ci, vals, capacity);
+  // A coordinate header states its entry count; enforce it.
+  if (h->base == 1 && n != h->nnz) return -1;
+  return n;
+}
+
+void matrel_parse_close(void* handle) {
+  delete static_cast<ParseHandle*>(handle);
+}
+
+}  // extern "C"

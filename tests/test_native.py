@@ -99,3 +99,140 @@ def test_native_faster_than_python_on_long_chain(lib, mesh8):
     _python_dp(ops)
     t_python = time.perf_counter() - t0
     assert t_native < t_python
+
+
+# -- native text ingestion (mtx_reader.cc) -----------------------------------
+
+
+class TestNativeMtxReader:
+    """The C++ MatrixMarket/COO parser must agree with the scipy oracle on
+    every format variant and feed io.load_mtx / io.load_coo_csv."""
+
+    def _roundtrip(self, tmp_path, sp, **mmwrite_kw):
+        import scipy.io
+        import scipy.sparse as sps
+        p = str(tmp_path / "m.mtx")
+        scipy.io.mmwrite(p, sp, **mmwrite_kw)
+        parsed = native.mtx_read(p)
+        assert parsed is not None
+        shape, ri, ci, vals = parsed
+        got = sps.coo_matrix((vals, (ri, ci)), shape=shape).toarray()
+        want = scipy.io.mmread(p)
+        want = want.toarray() if hasattr(want, "toarray") else np.asarray(want)
+        np.testing.assert_allclose(got.astype(np.float32),
+                                   want.astype(np.float32), rtol=0, atol=0)
+        return shape
+
+    def test_general(self, lib, tmp_path):
+        import scipy.sparse as sps
+        sp = sps.random(97, 61, density=0.07, random_state=1, format="coo")
+        assert self._roundtrip(tmp_path, sp) == (97, 61)
+
+    def test_symmetric(self, lib, tmp_path):
+        import scipy.sparse as sps
+        a = sps.random(80, 80, density=0.05, random_state=2, format="coo")
+        self._roundtrip(tmp_path, (a + a.T).tocoo(), symmetry="symmetric")
+
+    def test_skew_symmetric(self, lib, tmp_path):
+        import scipy.sparse as sps
+        b = np.triu(np.random.default_rng(3).standard_normal((40, 40)), 1)
+        self._roundtrip(tmp_path, sps.coo_matrix(b - b.T),
+                        symmetry="skew-symmetric")
+
+    def test_dense_array_format(self, lib, tmp_path):
+        dm = np.random.default_rng(4).standard_normal((13, 7))
+        self._roundtrip(tmp_path, dm)
+
+    def test_pattern(self, lib, tmp_path):
+        import scipy.sparse as sps
+        p = str(tmp_path / "pat.mtx")
+        rng = np.random.default_rng(5)
+        rows = rng.integers(0, 30, 50)
+        cols = rng.integers(0, 20, 50)
+        with open(p, "w") as f:
+            f.write("%%MatrixMarket matrix coordinate pattern general\n")
+            f.write("% a comment line\n30 20 50\n")
+            for i, j in zip(rows, cols):
+                f.write(f"{i + 1} {j + 1}\n")
+        shape, ri, ci, vals = native.mtx_read(p)
+        assert shape == (30, 20)
+        assert len(ri) == 50 and np.all(vals == 1.0)
+        got = sps.coo_matrix((vals, (ri, ci)), shape=shape).toarray()
+        want = np.zeros((30, 20))
+        np.add.at(want, (rows, cols), 1.0)
+        np.testing.assert_allclose(got, want)
+
+    def test_complex_falls_back(self, lib, tmp_path):
+        p = str(tmp_path / "c.mtx")
+        with open(p, "w") as f:
+            f.write("%%MatrixMarket matrix coordinate complex general\n")
+            f.write("2 2 1\n1 1 3.0 4.0\n")
+        assert native.mtx_read(p) is None  # scipy fallback territory
+
+    def test_malformed_returns_none(self, lib, tmp_path):
+        p = str(tmp_path / "bad.mtx")
+        with open(p, "w") as f:
+            f.write("%%MatrixMarket matrix coordinate real general\n")
+            f.write("2 2 3\n1 1 1.0\n")  # claims 3 entries, has 1
+        assert native.mtx_read(p) is None
+
+    def test_coo_csv_mixed_separators(self, lib, tmp_path):
+        p = str(tmp_path / "t.csv")
+        with open(p, "w") as f:
+            f.write("# comment\n0,1,2.5\n3, 4 ,-1.0\n5\t6\t7e-3\n\n")
+        ri, ci, vals = native.coo_csv_read(p)
+        assert list(ri) == [0, 3, 5] and list(ci) == [1, 4, 6]
+        np.testing.assert_allclose(vals, [2.5, -1.0, 7e-3])
+
+    def test_value_precision_matches_strtod(self, lib, tmp_path):
+        # 17-significant-digit values (scipy mmwrite default) must parse
+        # to the same float32 as the strtod oracle.
+        vals = np.random.default_rng(6).standard_normal(2000)
+        vals = np.concatenate([vals, vals * 1e-20, vals * 1e17,
+                               [0.0, 1.0, -1.0, 1e-300, 1e300]])
+        p = str(tmp_path / "prec.csv")
+        with open(p, "w") as f:
+            for k, v in enumerate(vals):
+                f.write(f"{k},0,{v:.17g}\n")
+        _, _, got = native.coo_csv_read(p)
+        want = np.array([float(f"{v:.17g}") for v in vals])
+        np.testing.assert_array_equal(got.astype(np.float32),
+                                      want.astype(np.float32))
+
+    def test_io_load_mtx_uses_native(self, lib, tmp_path, mesh8):
+        import scipy.sparse as sps
+        from matrel_tpu import io as mio
+        sp = sps.random(64, 64, density=0.2, random_state=7, format="coo")
+        p = str(tmp_path / "m.mtx")
+        import scipy.io
+        scipy.io.mmwrite(p, sp)
+        bsm = mio.load_mtx(p, mesh=mesh8, block_size=16)
+        np.testing.assert_allclose(bsm.to_numpy(), sp.toarray(), rtol=1e-6)
+
+    def test_io_load_coo_csv_native(self, lib, tmp_path, mesh8):
+        from matrel_tpu import io as mio
+        p = str(tmp_path / "m.csv")
+        with open(p, "w") as f:
+            f.write("0,0,1.5\n2,3,-2.0\n7,7,4.0\n")
+        bm = mio.load_coo_csv(p, shape=(8, 8), mesh=mesh8, dense=True)
+        want = np.zeros((8, 8), np.float32)
+        want[0, 0], want[2, 3], want[7, 7] = 1.5, -2.0, 4.0
+        np.testing.assert_allclose(bm.to_numpy(), want)
+
+    def test_array_format_blank_line_before_size(self, lib, tmp_path):
+        # strtoll skips blank lines; data_off must follow the parsed
+        # numbers, not the pre-skip line pointer (regression).
+        p = str(tmp_path / "blank.mtx")
+        with open(p, "w") as f:
+            f.write("%%MatrixMarket matrix array real general\n"
+                    "% comment\n\n2 2\n1.0\n2.0\n3.0\n4.0\n")
+        shape, ri, ci, vals = native.mtx_read(p)
+        got = np.zeros(shape)
+        got[ri, ci] = vals
+        np.testing.assert_allclose(got, [[1.0, 3.0], [2.0, 4.0]])
+
+    def test_stale_lib_keeps_working_symbols(self, lib):
+        # Partial symbol sets must degrade per-feature, not disable the
+        # whole library.
+        assert getattr(lib, "_matrel_has_dp", False)
+        assert getattr(lib, "_matrel_has_ingest", False)

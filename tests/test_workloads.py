@@ -188,3 +188,22 @@ class TestBlockSparsePageRank:
                                              config=MatrelConfig(use_pallas=False)))
         oracle = pagerank_numpy_oracle(a, rounds=20)
         np.testing.assert_allclose(r, oracle, rtol=1e-3, atol=1e-6)
+
+    def test_weighted_adjacency_small_row_sums(self, mesh8, rng):
+        # Row sums < 1 (weighted graph): the inverse-degree floor must be
+        # an epsilon, not 1.0, or ranks skew silently (regression).
+        from matrel_tpu.core.sparse import BlockSparseMatrix
+        from matrel_tpu.workloads.pagerank import (
+            pagerank_block_sparse, pagerank_numpy_oracle)
+        from matrel_tpu.config import MatrelConfig
+        n, bs = 32, 8
+        a = np.zeros((n, n), dtype=np.float32)
+        a[0:8, 8:16] = 0.1 * (rng.random((8, 8)) < 0.6)
+        a[8:16, 16:24] = 0.1 * (rng.random((8, 8)) < 0.6)
+        a[16:24, 0:8] = 0.1 * (rng.random((8, 8)) < 0.6)
+        np.fill_diagonal(a, 0)
+        S = BlockSparseMatrix.from_numpy(a, block_size=bs, mesh=mesh8)
+        r = np.asarray(pagerank_block_sparse(
+            S, rounds=20, config=MatrelConfig(use_pallas=False)))
+        oracle = pagerank_numpy_oracle(a, rounds=20)
+        np.testing.assert_allclose(r, oracle, rtol=1e-3, atol=1e-6)
