@@ -124,24 +124,26 @@ def bench_spmm(mesh, cfg):
 
 
 def bench_pagerank(mesh, cfg):
+    """One-hot MXU SpMV path (ops/spmv.py): plan built once per graph
+    (host + one device expansion), then 30 rounds in one fori_loop."""
     n, n_edges, rounds = 1_000_000, 10_000_000, 30
-    from matrel_tpu.workloads.pagerank import _edges_runner
-    import jax.numpy as jnp
+    from matrel_tpu.workloads.pagerank import (
+        prepare_pagerank_onehot, run_pagerank_onehot)
     rng = np.random.default_rng(0)
     src = rng.integers(0, n, n_edges, dtype=np.int32)
     dst = rng.integers(0, n, n_edges, dtype=np.int32)
-    prepare, runner = _edges_runner(n, rounds, 0.85)
-    s_dev, d_dev = prepare(jnp.asarray(src), jnp.asarray(dst))
-    np.asarray(s_dev[:1])  # force transfer+sort before timing
+    prepared = prepare_pagerank_onehot(src, dst, n)
 
-    def run():
-        r = runner(s_dev, d_dev)
-        np.asarray(r[:1])
+    def run(r=rounds):
+        out = run_pagerank_onehot(prepared, rounds=r)
+        np.asarray(out[:1])
 
-    dt = _timed(run, warm=1, reps=2)
+    run(1)          # table expansion + compile of the small program
+    run(rounds)     # warm the 30-round program
+    dt = _timed(run, warm=0, reps=2)
     return {"metric": "pagerank_1M_30rounds_wallclock_per_round",
             "value": round(dt / rounds * 1e3, 2), "unit": "ms/round",
-            "total_s": round(dt, 3)}
+            "total_s": round(dt, 3), "impl": "onehot-mxu-spmv"}
 
 
 def bench_north_star(mesh, cfg):

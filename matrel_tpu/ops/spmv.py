@@ -1,0 +1,246 @@
+"""TPU-idiomatic sparse matrix-vector product over edge lists (SpMV).
+
+The reference's graph workloads run matvecs through Spark's shuffle
+(SURVEY.md §3.5: the per-round shuffle dominates PageRank). The naive TPU
+translation — ``r[src]`` gather + ``segment_sum`` scatter-add — hits XLA's
+serialized scalar gather/scatter path (~150M rows/s measured on v5e: 67 ms
+for a 10M gather, 88 ms for the matching scatter). Neither the MXU nor the
+VPU has per-lane random access, so this module reshapes the irregular ops
+into the two forms the hardware executes well:
+
+* **Width-W row gather** (``gather_1d``): XLA's TPU gather runs ~3.3×
+  faster per row when each row is W≥8 elements wide (measured: 10M rows at
+  20 ms for W∈[8,128] vs 66 ms for W=1). So gather width-8 rows and select
+  the wanted lane with a precomputed one-hot — the select is cheap VPU work.
+
+* **Blocked one-hot MXU scatter** (``EdgeSpMVPlan``): destination indices,
+  pre-sorted and padded into fixed-capacity rows of 512-node blocks, are
+  factored as ``off = hi*16 + lo``; the segment sum becomes a batched
+  ``dot_general`` of two one-hot factors:
+
+      y[b, hi, lo] = Σ_c OH_hi[b, c, hi] · (OH_lo[b, c, lo] · w[b, c])
+
+  All FLOPs ride the MXU; there is no scatter anywhere. Per-edge weights
+  (e.g. 1/outdeg for PageRank) are folded into the gather-select table for
+  free.
+
+Everything is static-shaped per plan (one compile per graph), matching the
+reference's plan-per-query model. Plans whose padding would blow past
+``max_padding`` (heavy-tailed degree distributions) fall back partially via
+a small overflow COO handled by ``segment_sum``, or entirely (build returns
+None) so callers can use the plain path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+WIDTH = 8        # gather row width (measured flat cost for 8..128 on v5e)
+BLOCK = 512      # scatter block: nodes per one-hot block row
+HI = 32          # off = hi*LO + lo one-hot factor sizes; HI*LO == BLOCK
+LO = 16
+
+
+def _ext_table(x: jax.Array, width: int = WIDTH) -> jax.Array:
+    """Pad a 1-D table to (rows, width) with ≥1 zero row so index ``n``
+    (the sentinel) and any padded slot read 0."""
+    n = x.shape[0]
+    rows = n // width + 1
+    pad = rows * width - n
+    return jnp.concatenate([x, jnp.zeros((pad,), x.dtype)]).reshape(
+        rows, width)
+
+
+def gather_1d(table: jax.Array, idx: jax.Array,
+              width: int = WIDTH) -> jax.Array:
+    """``table[idx]`` for a 1-D table, via width-row gather + one-hot select.
+
+    ~3.3× faster than the scalar gather on TPU for large ``idx``; exact
+    (the select is a VPU multiply by a 0/1 mask, no matmul rounding).
+    ``idx == table.shape[0]`` is a valid sentinel reading 0.
+    """
+    t2 = _ext_table(table, width)
+    hi, lo = idx // width, idx % width
+    g = jnp.take(t2, hi, axis=0)                       # (..., width)
+    sel = (lo[..., None] == jnp.arange(width, dtype=lo.dtype)
+           ).astype(table.dtype)
+    return jnp.sum(g * sel, axis=-1)
+
+
+@dataclasses.dataclass
+class EdgeSpMVPlan:
+    """Compiled layout for ``y[i] = Σ_{e: rows[e]=i} vals[e] · x[cols[e]]``.
+
+    The host build stores only compact per-slot integers (~13 bytes/slot);
+    the fat one-hot tables (~192 bytes/slot) are expanded ON DEVICE once,
+    lazily — host↔device transfer through the axon tunnel is the scarce
+    resource (~60 MB/s measured), not HBM.
+
+    Shapes: B = #row blocks, C = per-block capacity.
+      src8    (B, C) int32 — width-row index of x per padded edge slot
+      lane    (B, C) int8  — cols[e] % WIDTH
+      off     (B, C) int32 — rows[e] % block
+      val     (B, C) f32   — vals[e] (0 in padded slots)
+    Materialized device tables:
+      sel (B, C, WIDTH) f32; oh_hi (B, C, block//LO) f32; oh_lo (B, C, LO).
+    Overflow: optional (cols, rows, vals) COO for edges beyond capacity,
+    rows sorted ascending, handled by segment_sum.
+    """
+    n_rows: int
+    n_cols: int
+    block: int
+    capacity: int
+    src8: jax.Array
+    lane: jax.Array
+    off: jax.Array
+    val: jax.Array
+    ov_cols: Optional[jax.Array]
+    ov_rows: Optional[jax.Array]
+    ov_vals: Optional[jax.Array]
+    padding_ratio: float
+    _tables: Optional[tuple] = dataclasses.field(default=None, repr=False)
+
+    def arrays(self):
+        """Flat device-array tuple for passing through jit boundaries.
+        First call expands the one-hot tables on device (one fused jitted
+        program; ~130 MB shipped instead of ~2.4 GB)."""
+        if self._tables is None:
+            sel, oh_hi, oh_lo = _expand_tables(self.block // LO)(
+                self.src8, self.lane, self.off, self.val)
+            self._tables = (self.src8, sel, oh_hi, oh_lo)
+            # the compact arrays are never read again once expanded —
+            # drop them so ~9 B/slot of HBM isn't pinned by the plan
+            self.lane = self.off = self.val = None
+        ov = () if self.ov_cols is None else (self.ov_cols, self.ov_rows,
+                                              self.ov_vals)
+        return self._tables + ov
+
+
+@functools.lru_cache(maxsize=8)
+def _expand_tables(hi_n: int):
+    @jax.jit
+    def expand(src8, lane, off, val):
+        sel = jnp.where(
+            lane[..., None] == jnp.arange(WIDTH, dtype=lane.dtype),
+            val[..., None], 0.0)
+        oh_hi = ((off // LO)[..., None] ==
+                 jnp.arange(hi_n, dtype=off.dtype)).astype(jnp.float32)
+        oh_lo = ((off % LO)[..., None] ==
+                 jnp.arange(LO, dtype=off.dtype)).astype(jnp.float32)
+        return sel, oh_hi, oh_lo
+
+    return expand
+
+
+def build_spmv_plan(rows, cols, vals=None, n_rows: int = None,
+                    n_cols: int = None, *, block: int = BLOCK,
+                    capacity_quantile: float = 0.995,
+                    max_padding: float = 4.0) -> Optional[EdgeSpMVPlan]:
+    """Host-side plan build (numpy, once per graph).
+
+    Capacity is the ``capacity_quantile`` of per-block edge counts rounded
+    up to a multiple of 128; edges past it go to the overflow COO. Returns
+    None when even that layout pads worse than ``max_padding``× the edge
+    count — callers should then fall back to plain segment_sum.
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    m = rows.shape[0]
+    if n_rows is None:
+        n_rows = int(rows.max()) + 1 if m else 1
+    if n_cols is None:
+        n_cols = int(cols.max()) + 1 if m else 1
+    if vals is None:
+        vals = np.ones((m,), np.float32)
+    else:
+        vals = np.asarray(vals, dtype=np.float32)
+    if block % LO:
+        raise ValueError("block must be a multiple of LO")
+    hi_n = block // LO
+
+    order = np.argsort(rows, kind="stable")
+    rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
+    nb = -(-n_rows // block)
+    blk = rows_s // block
+    cnt = np.bincount(blk, minlength=nb)
+    if m == 0:
+        cap = 128
+    else:
+        cap_q = int(np.quantile(cnt[cnt > 0], capacity_quantile)) \
+            if (cnt > 0).any() else 0
+        cap = max(128, -(-cap_q // 128) * 128)
+    # Refuse only when padding hurts at scale: small plans are cheap no
+    # matter the ratio, so the gate needs both the relative and an
+    # absolute (1M padded slots) threshold. Callers fall back to the
+    # plain segment_sum path on None.
+    if m and nb * cap > max_padding * m and nb * cap > (1 << 20):
+        return None
+
+    starts = np.zeros(nb + 1, np.int64)
+    np.cumsum(cnt, out=starts[1:])
+    slot = np.arange(m, dtype=np.int64) - starts[blk]
+    in_main = slot < cap
+
+    src_pad = np.full((nb, cap), n_cols, np.int64)   # sentinel -> reads 0
+    val_pad = np.zeros((nb, cap), np.float32)
+    off_pad = np.zeros((nb, cap), np.int64)
+    b_main, s_main = blk[in_main], slot[in_main]
+    src_pad[b_main, s_main] = cols_s[in_main]
+    val_pad[b_main, s_main] = vals_s[in_main]
+    off_pad[b_main, s_main] = rows_s[in_main] % block
+
+    n_ov = int((~in_main).sum())
+    if n_ov:
+        ov_c = jnp.asarray(cols_s[~in_main], jnp.int32)
+        ov_r = jnp.asarray(rows_s[~in_main], jnp.int32)
+        ov_v = jnp.asarray(vals_s[~in_main], jnp.float32)
+    else:
+        ov_c = ov_r = ov_v = None
+
+    return EdgeSpMVPlan(
+        n_rows=n_rows, n_cols=n_cols, block=block, capacity=cap,
+        src8=jnp.asarray(src_pad // WIDTH, jnp.int32),
+        lane=jnp.asarray(src_pad % WIDTH, jnp.int8),
+        off=jnp.asarray(off_pad, jnp.int32),
+        val=jnp.asarray(val_pad),
+        ov_cols=ov_c, ov_rows=ov_r, ov_vals=ov_v,
+        padding_ratio=(nb * cap + n_ov) / max(m, 1))
+
+
+def spmv_apply(plan_static, arrays, x: jax.Array) -> jax.Array:
+    """Traceable body: y = A·x given a plan. ``plan_static`` is the
+    (n_rows, n_cols, block) tuple; ``arrays`` is plan.arrays(). Safe to
+    call inside jit/fori_loop with the arrays as loop-invariant args."""
+    n_rows, n_cols, block = plan_static
+    src8, sel, oh_hi, oh_lo = arrays[:4]
+    x_ext = _ext_table(x.astype(jnp.float32))
+    g = jnp.take(x_ext, src8, axis=0)                  # (B, C, W) row gather
+    w = jnp.sum(g * sel, axis=-1)                      # exact f32 select
+    # MXU segment-sum: batch B, contract C. bf16_3x ≈ f32 accuracy at 3
+    # passes; the one-hots are exact in bf16.
+    contrib = jax.lax.dot_general(
+        oh_hi, oh_lo * w[..., None],
+        (((1,), (1,)), ((0,), (0,))),
+        precision=jax.lax.Precision.HIGH)              # (B, HI', LO)
+    y = contrib.reshape(-1)[:n_rows]
+    if len(arrays) > 4:
+        ov_c, ov_r, ov_v = arrays[4:]
+        w_ov = gather_1d(x.astype(jnp.float32), ov_c) * ov_v
+        y = y + jax.ops.segment_sum(w_ov, ov_r, num_segments=n_rows,
+                                    indices_are_sorted=True)
+    return y
+
+
+_spmv_jitted = jax.jit(spmv_apply, static_argnums=0)
+
+
+def spmv(plan: EdgeSpMVPlan, x: jax.Array) -> jax.Array:
+    """y = A·x (convenience wrapper; jit-cached per plan shape)."""
+    return _spmv_jitted((plan.n_rows, plan.n_cols, plan.block),
+                        plan.arrays(), x)

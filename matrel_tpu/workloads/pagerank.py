@@ -14,6 +14,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from matrel_tpu.config import MatrelConfig
@@ -61,7 +62,7 @@ def pagerank(A: BlockMatrix, rounds: int = 30, alpha: float = 0.85,
 
 def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
                    rounds: int = 30, alpha: float = 0.85,
-                   mesh=None) -> jax.Array:
+                   mesh=None, impl: str = "auto") -> jax.Array:
     """PageRank over an edge list — the BASELINE row-5 scale (1M nodes).
 
     A dense or block-sparse 1M×1M adjacency is off the table (4 TB dense;
@@ -74,11 +75,156 @@ def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
     30-round loop is one jitted fori_loop, no host round trips. Edge arrays
     may be sharded over the mesh (segment_sum psums over ICI).
     """
+    if impl not in ("auto", "segment", "onehot"):
+        raise ValueError(f"unknown impl {impl!r}")
+    if impl == "onehot":
+        if mesh is not None:
+            raise ValueError(
+                "impl='onehot' runs single-device (host-side plan build); "
+                "it cannot honour mesh=. Use impl='segment' (or 'auto') "
+                "for mesh-sharded edge arrays")
+        out = _pagerank_onehot(src, dst, n, rounds, alpha)
+        if out is None:
+            raise ValueError(
+                "impl='onehot' requested but the graph's degree "
+                "distribution is too heavy-tailed for the one-hot plan "
+                "(build_spmv_plan refused); use impl='segment' or 'auto'")
+        return out
+    if impl == "auto" and mesh is None:
+        # The one-hot MXU matvec (ops/spmv.py) beats segment_sum ~5× on
+        # TPU; on CPU the extra one-hot FLOPs lose, so auto keeps the
+        # segment path there. The plan build is host-side numpy, so
+        # mesh-sharded edge arrays (mesh=...) stay on the segment path.
+        # Falls back when the degree distribution is too heavy-tailed to
+        # pad (build returns None).
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        if on_tpu:
+            out = _pagerank_onehot(src, dst, n, rounds, alpha)
+            if out is not None:
+                return out
     src = jnp.asarray(src, dtype=jnp.int32)
     dst = jnp.asarray(dst, dtype=jnp.int32)
     prepare, run = _edges_runner(int(n), int(rounds), float(alpha))
     src, dst = prepare(src, dst)
     return run(src, dst)
+
+
+def prepare_pagerank_onehot(src, dst, n: int):
+    """Build the one-hot SpMV plan for a graph (ops/spmv.py), reusable
+    across pagerank runs — plan construction is the expensive, per-graph
+    step (host sort + pad, one device table expansion).
+
+    The contribution matvec is contrib = Âᵀ·r with Â[i,j] = 1/outdeg[i]
+    for each edge i→j — so the plan is rows=dst, cols=src, vals=1/outdeg
+    [src]; the normalisation rides the gather-select table for free.
+    Returns (plan, dangling_mask), or None when the plan refuses the
+    graph (heavy-tailed padding).
+    """
+    from matrel_tpu.ops import spmv as spmv_lib
+
+    src_np = np.asarray(src, dtype=np.int64)
+    dst_np = np.asarray(dst, dtype=np.int64)
+    outdeg = np.bincount(src_np, minlength=n).astype(np.float32)
+    inv = np.where(outdeg > 0, 1.0 / np.maximum(outdeg, 1.0), 0.0)
+    plan = spmv_lib.build_spmv_plan(dst_np, src_np, vals=inv[src_np],
+                                    n_rows=n, n_cols=n)
+    if plan is None:
+        return None
+    dangling = jnp.asarray((outdeg == 0).astype(np.float32))
+    return plan, dangling
+
+
+def run_pagerank_onehot(prepared, rounds: int = 30,
+                        alpha: float = 0.85) -> jax.Array:
+    """Execute PageRank rounds over a prepared one-hot plan."""
+    if prepared is None:
+        raise ValueError(
+            "prepare_pagerank_onehot returned None for this graph "
+            "(degree distribution too heavy-tailed for the one-hot "
+            "plan); use the segment-sum path instead")
+    plan, dangling = prepared
+    run = _onehot_runner(plan.n_rows, int(rounds), float(alpha),
+                         (plan.n_rows, plan.n_cols, plan.block),
+                         len(plan.arrays()))
+    return run(plan.arrays(), dangling)
+
+
+# Prepared-plan cache for the auto path: repeated pagerank_edges calls on
+# the same graph (alpha/round sweeps) must not repay the host sort + table
+# transfer. Keyed by a SAMPLED content fingerprint (ends + ~1M strided
+# elements), so a cache probe costs ~20 ms, not a 160 MB hash; callers who
+# need a guaranteed-fresh plan (the sample is not collision-proof against
+# adversarial inputs) use prepare_pagerank_onehot/run_pagerank_onehot
+# directly. Eviction is byte-aware: expanded one-hot tables are ~224 B per
+# padded slot, and pinning several multi-GB plans would OOM a 16 GB chip.
+_PLAN_CACHE: dict = {}
+_PLAN_CACHE_MAX_SLOTS = 24_000_000   # ≈5.4 GB of expanded tables
+
+
+def _graph_fingerprint(src, dst, n: int) -> tuple:
+    import hashlib
+    h = hashlib.blake2b(digest_size=16)
+    m = int(np.asarray(src).shape[0] if hasattr(src, "shape") else len(src))
+    stride = max(1, m // 1_000_000)
+    for a in (src, dst):
+        # slice BEFORE np.asarray so device arrays ship only the sample
+        for part in (a[:4096], a[-4096:], a[::stride]):
+            h.update(np.ascontiguousarray(
+                np.asarray(part, dtype=np.int64)).tobytes())
+    return (n, m, h.hexdigest())
+
+
+def _plan_slots(prepared) -> int:
+    plan, _ = prepared
+    return plan.src8.shape[0] * plan.src8.shape[1]
+
+
+def _pagerank_onehot(src, dst, n: int, rounds: int, alpha: float):
+    key = _graph_fingerprint(src, dst, n)
+    if key in _PLAN_CACHE:
+        prepared = _PLAN_CACHE[key]
+    else:
+        prepared = prepare_pagerank_onehot(src, dst, n)
+        if prepared is None:
+            return None
+        total = sum(map(_plan_slots, _PLAN_CACHE.values()))
+        while _PLAN_CACHE and total + _plan_slots(prepared) > \
+                _PLAN_CACHE_MAX_SLOTS:
+            total -= _plan_slots(_PLAN_CACHE.pop(next(iter(_PLAN_CACHE))))
+        _PLAN_CACHE[key] = prepared
+    return run_pagerank_onehot(prepared, rounds, alpha)
+
+
+def _power_body(matvec, n: int, alpha: float, dangling):
+    """The shared PageRank update: one body for every edge-based impl so
+    the teleport/dangling semantics (and precision) cannot drift apart."""
+    teleport = (1.0 - alpha) / n
+
+    def body(_, r):
+        contrib = matvec(r)
+        dmass = jnp.sum(dangling * r)
+        return alpha * (contrib + dmass / n) + teleport
+
+    return body
+
+
+def _r0(n: int):
+    return jnp.full((n,), 1.0 / n, dtype=jnp.float32)
+
+
+@functools.lru_cache(maxsize=32)
+def _onehot_runner(n: int, rounds: int, alpha: float, plan_static,
+                   n_arrays: int):
+    from matrel_tpu.ops import spmv as spmv_lib
+
+    @jax.jit
+    def run(arrays, dangling):
+        body = _power_body(
+            lambda r: spmv_lib.spmv_apply(plan_static, arrays, r),
+            n, alpha, dangling)
+        return jax.lax.fori_loop(0, rounds, body, _r0(n))
+
+    return run
 
 
 @functools.lru_cache(maxsize=32)
@@ -99,17 +245,14 @@ def _edges_runner(n: int, rounds: int, alpha: float):
         outdeg = jax.ops.segment_sum(ones, s, num_segments=n)
         inv_deg = jnp.where(outdeg > 0, 1.0 / jnp.maximum(outdeg, 1.0), 0.0)
         dangling = (outdeg == 0).astype(jnp.float32)
-        teleport = (1.0 - alpha) / n
 
-        def body(_, r):
+        def matvec(r):
             w = r * inv_deg
-            contrib = jax.ops.segment_sum(w[s], d, num_segments=n,
-                                          indices_are_sorted=True)
-            dmass = jnp.sum(dangling * r)
-            return alpha * (contrib + dmass / n) + teleport
+            return jax.ops.segment_sum(w[s], d, num_segments=n,
+                                       indices_are_sorted=True)
 
-        r0 = jnp.full((n,), 1.0 / n, dtype=jnp.float32)
-        return jax.lax.fori_loop(0, rounds, body, r0)
+        body = _power_body(matvec, n, alpha, dangling)
+        return jax.lax.fori_loop(0, rounds, body, _r0(n))
 
     return prepare, run
 
@@ -127,7 +270,6 @@ def pagerank_csr(src, dst, n: int, rounds: int = 30, alpha: float = 0.85,
     segment-sum form is ~2.5× faster). Anything looser falls back to
     ``pagerank_edges``.
     """
-    import numpy as np
     src = np.asarray(src, dtype=np.int32)
     dst = np.asarray(dst, dtype=np.int32)
     indeg = np.bincount(dst, minlength=n)
@@ -153,17 +295,14 @@ def _csr_runner(n: int, rounds: int, alpha: float, D: int):
     def run(neighbors, outdeg):
         inv_deg = jnp.where(outdeg > 0, 1.0 / jnp.maximum(outdeg, 1.0), 0.0)
         dangling = (outdeg == 0).astype(jnp.float32)
-        teleport = (1.0 - alpha) / n
 
-        def body(_, r):
+        def matvec(r):
             w = r * inv_deg
             w_pad = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])  # sentinel
-            contrib = jnp.sum(w_pad[neighbors], axis=1)
-            dmass = jnp.sum(dangling * r)
-            return alpha * (contrib + dmass / n) + teleport
+            return jnp.sum(w_pad[neighbors], axis=1)
 
-        r0 = jnp.full((n,), 1.0 / n, dtype=jnp.float32)
-        return jax.lax.fori_loop(0, rounds, body, r0)
+        body = _power_body(matvec, n, alpha, dangling)
+        return jax.lax.fori_loop(0, rounds, body, _r0(n))
 
     return run
 
@@ -176,7 +315,6 @@ def pagerank_block_sparse(S, rounds: int = 30, alpha: float = 0.85,
     Âᵀ; the loop is host-driven but each round is one cached compiled
     program (no re-trace), mirroring the reference's per-round plan
     execution without its shuffle."""
-    import numpy as np
     from matrel_tpu.core.blockmatrix import BlockMatrix
     from matrel_tpu.ops import spmm as spmm_lib
 
@@ -221,7 +359,6 @@ def pagerank_block_sparse(S, rounds: int = 30, alpha: float = 0.85,
 
 def pagerank_numpy_oracle(a, rounds=30, alpha=0.85):
     """Naive host oracle for tests."""
-    import numpy as np
     n = a.shape[0]
     deg = a.sum(1, keepdims=True)
     inv = np.where(deg > 0, 1.0 / np.maximum(deg, 1e-30), 0.0)

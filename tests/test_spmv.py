@@ -1,0 +1,172 @@
+"""ops/spmv.py — blocked one-hot SpMV and the width-row gather.
+
+Oracle: scipy-style COO accumulation in numpy f64. The plan layouts are
+data-dependent (per-graph static shapes), so the suite sweeps shapes,
+duplicates, weights, skew (overflow path) and the refusal fallback.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from matrel_tpu.ops import spmv as spmv_lib
+
+
+def coo_oracle(rows, cols, vals, x, n_rows):
+    y = np.zeros((n_rows,), np.float64)
+    np.add.at(y, rows, vals * x[cols])
+    return y
+
+
+class TestGather1D:
+    def test_matches_plain_indexing(self):
+        rng = np.random.default_rng(0)
+        for n, m in [(17, 5), (1000, 2048), (8, 8), (4096, 100_000)]:
+            table = rng.standard_normal(n).astype(np.float32)
+            idx = rng.integers(0, n, m).astype(np.int32)
+            got = np.asarray(spmv_lib.gather_1d(jnp.asarray(table),
+                                                jnp.asarray(idx)))
+            np.testing.assert_array_equal(got, table[idx])
+
+    def test_sentinel_reads_zero(self):
+        table = jnp.arange(1, 11, dtype=jnp.float32)
+        idx = jnp.asarray([0, 10, 5], jnp.int32)   # 10 == len(table)
+        got = np.asarray(spmv_lib.gather_1d(table, idx))
+        np.testing.assert_array_equal(got, [1.0, 0.0, 6.0])
+
+    def test_2d_index_shape(self):
+        rng = np.random.default_rng(1)
+        table = rng.standard_normal(97).astype(np.float32)
+        idx = rng.integers(0, 97, (13, 29)).astype(np.int32)
+        got = np.asarray(spmv_lib.gather_1d(jnp.asarray(table),
+                                            jnp.asarray(idx)))
+        np.testing.assert_array_equal(got, table[idx])
+
+
+def random_coo(rng, n_rows, n_cols, m, weighted=True):
+    rows = rng.integers(0, n_rows, m).astype(np.int64)
+    cols = rng.integers(0, n_cols, m).astype(np.int64)
+    vals = (rng.standard_normal(m).astype(np.float32) if weighted
+            else np.ones(m, np.float32))
+    return rows, cols, vals
+
+
+class TestSpMVPlan:
+    @pytest.mark.parametrize("n_rows,n_cols,m", [
+        (1000, 1000, 10_000),     # square, multi-block
+        (300, 700, 5_000),        # rectangular, n_rows not /512
+        (512, 512, 512),          # exactly one block
+        (100, 100, 3),            # nearly empty
+        (2000, 50, 20_000),       # many duplicate cols
+    ])
+    def test_matches_oracle(self, n_rows, n_cols, m):
+        rng = np.random.default_rng(n_rows + m)
+        rows, cols, vals = random_coo(rng, n_rows, n_cols, m)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=n_rows, n_cols=n_cols)
+        assert plan is not None
+        x = rng.standard_normal(n_cols).astype(np.float32)
+        got = np.asarray(spmv_lib.spmv(plan, jnp.asarray(x)))
+        want = coo_oracle(rows, cols, vals, x, n_rows)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+    def test_unsorted_input_and_duplicate_edges(self):
+        rng = np.random.default_rng(7)
+        rows = np.array([5, 5, 5, 0, 999, 0, 5], np.int64)
+        cols = np.array([1, 1, 2, 3, 4, 3, 1], np.int64)
+        vals = rng.standard_normal(7).astype(np.float32)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=1000, n_cols=10)
+        x = rng.standard_normal(10).astype(np.float32)
+        got = np.asarray(spmv_lib.spmv(plan, jnp.asarray(x)))
+        want = coo_oracle(rows, cols, vals, x, 1000)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_empty_edge_list(self):
+        plan = spmv_lib.build_spmv_plan(np.zeros(0), np.zeros(0),
+                                        n_rows=100, n_cols=100)
+        got = np.asarray(spmv_lib.spmv(plan, jnp.ones((100,), jnp.float32)))
+        np.testing.assert_array_equal(got, np.zeros(100))
+
+    def test_skewed_degrees_use_overflow(self):
+        # one hub row receives most edges -> quantile capacity forces an
+        # overflow COO; numerics must still match
+        rng = np.random.default_rng(3)
+        m = 20_000
+        rows = np.where(rng.random(m) < 0.3, 7,
+                        rng.integers(0, 4096, m)).astype(np.int64)
+        cols = rng.integers(0, 512, m).astype(np.int64)
+        vals = rng.standard_normal(m).astype(np.float32)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=4096, n_cols=512)
+        assert plan is not None and plan.ov_rows is not None
+        x = rng.standard_normal(512).astype(np.float32)
+        got = np.asarray(spmv_lib.spmv(plan, jnp.asarray(x)))
+        want = coo_oracle(rows, cols, vals, x, 4096)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_refuses_pathological_padding(self):
+        # edges spread one-per-block over a huge row space: capacity 128
+        # per block pads >4x the edge count (and >1M slots absolute)
+        # -> build returns None
+        n_rows = 512 * 20_000
+        rows = (np.arange(20_000, dtype=np.int64) * 512)
+        cols = np.zeros(20_000, np.int64)
+        plan = spmv_lib.build_spmv_plan(rows, cols, n_rows=n_rows,
+                                        n_cols=1, max_padding=4.0)
+        assert plan is None
+
+    def test_padding_ratio_reported(self):
+        rng = np.random.default_rng(11)
+        rows, cols, vals = random_coo(rng, 1024, 1024, 50_000)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=1024, n_cols=1024)
+        assert 1.0 <= plan.padding_ratio < 2.0
+
+
+class TestPageRankOneHot:
+    def test_matches_segment_impl_and_oracle(self):
+        from matrel_tpu.workloads.pagerank import (
+            pagerank_edges, pagerank_numpy_oracle)
+        rng = np.random.default_rng(5)
+        n, m = 2000, 16_000
+        src = rng.integers(0, n, m)
+        dst = rng.integers(0, n, m)
+        r_hot = np.asarray(pagerank_edges(src, dst, n, rounds=15,
+                                          impl="onehot"))
+        r_seg = np.asarray(pagerank_edges(src, dst, n, rounds=15,
+                                          impl="segment"))
+        np.testing.assert_allclose(r_hot, r_seg, rtol=5e-4, atol=1e-9)
+        a = np.zeros((n, n), np.float32)
+        a[src, dst] = 1.0   # duplicates collapse; rebuild edges to match
+        s2, d2 = np.nonzero(a)
+        r_hot2 = np.asarray(pagerank_edges(s2, d2, n, rounds=15,
+                                           impl="onehot"))
+        want = pagerank_numpy_oracle(a, rounds=15).ravel()
+        np.testing.assert_allclose(r_hot2, want, rtol=1e-3, atol=1e-10)
+
+    def test_explicit_onehot_raises_on_refused_graph(self):
+        # same pathological spread as the plan-refusal test: explicit
+        # impl='onehot' must raise, not silently run the segment path
+        from matrel_tpu.workloads.pagerank import pagerank_edges
+        n = 512 * 20_000
+        src = np.zeros(20_000, np.int64)
+        dst = np.arange(20_000, dtype=np.int64) * 512
+        with pytest.raises(ValueError, match="heavy-tailed"):
+            pagerank_edges(src, dst, n, rounds=2, impl="onehot")
+
+    def test_dangling_nodes(self):
+        # node 3 has no out-edges; its mass must redistribute
+        src = np.array([0, 1, 2, 0])
+        dst = np.array([1, 2, 3, 3])
+        n = 4
+        from matrel_tpu.workloads.pagerank import (
+            pagerank_edges, pagerank_numpy_oracle)
+        a = np.zeros((n, n), np.float32)
+        a[src, dst] = 1.0
+        got = np.asarray(pagerank_edges(src, dst, n, rounds=25,
+                                        impl="onehot"))
+        want = pagerank_numpy_oracle(a, rounds=25).ravel()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-8)
+        assert abs(got.sum() - 1.0) < 1e-3
