@@ -141,13 +141,18 @@ def _expand_tables(hi_n: int):
 def build_spmv_plan(rows, cols, vals=None, n_rows: int = None,
                     n_cols: int = None, *, block: int = BLOCK,
                     capacity_quantile: float = 0.995,
-                    max_padding: float = 4.0) -> Optional[EdgeSpMVPlan]:
+                    max_padding: float = 4.0,
+                    max_slots: Optional[int] = None
+                    ) -> Optional[EdgeSpMVPlan]:
     """Host-side plan build (numpy, once per graph).
 
     Capacity is the ``capacity_quantile`` of per-block edge counts rounded
     up to a multiple of 128; edges past it go to the overflow COO. Returns
     None when even that layout pads worse than ``max_padding``× the edge
-    count — callers should then fall back to plain segment_sum.
+    count, or when the padded slot count exceeds ``max_slots`` (the
+    expanded device tables cost ~224 B/slot of HBM — pass a cap when the
+    caller would rather fall back than spend that) — callers should then
+    use the plain segment_sum path.
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
@@ -180,6 +185,8 @@ def build_spmv_plan(rows, cols, vals=None, n_rows: int = None,
     # absolute (1M padded slots) threshold. Callers fall back to the
     # plain segment_sum path on None.
     if m and nb * cap > max_padding * m and nb * cap > (1 << 20):
+        return None
+    if max_slots is not None and nb * cap > max_slots:
         return None
 
     starts = np.zeros(nb + 1, np.int64)

@@ -32,9 +32,10 @@ Gen = Callable[[jax.Array, jax.Array], jax.Array]
 
 def default_gen(seed: int, tile: int, dtype=jnp.bfloat16, scale: float = None
                 ) -> Gen:
-    """Cheap deterministic tile generator (iota arithmetic — RNG at 65k²
-    costs more than the matmuls). Scaled ~1/sqrt(n) so chained products
-    stay in bf16 range."""
+    """Deterministic tile generator (iota arithmetic — RNG at 65k² costs
+    more than the matmuls). Scaled ~1/sqrt(n) so chained products stay in
+    bf16 range. Carries a ``.slab(r0, c0, shape)`` fast path generating an
+    arbitrary global-coordinate rectangle in one fused elementwise op."""
     s = scale if scale is not None else 0.01
 
     def gen(bi, bj):
@@ -43,6 +44,42 @@ def default_gen(seed: int, tile: int, dtype=jnp.bfloat16, scale: float = None
         v = jnp.sin(r * 0.1 + c * 0.37 + bi * 1.7 + bj * 0.3 + seed) * s
         return v.astype(dtype)
 
+    def slab(r0, c0, shape):
+        rg = jax.lax.broadcasted_iota(jnp.float32, shape, 0) + r0
+        cg = jax.lax.broadcasted_iota(jnp.float32, shape, 1) + c0
+        r, bi = rg % tile, rg // tile
+        c, bj = cg % tile, cg // tile
+        v = jnp.sin(r * 0.1 + c * 0.37 + bi * 1.7 + bj * 0.3 + seed) * s
+        return v.astype(dtype)
+
+    gen.slab = slab
+    return gen
+
+
+def cheap_gen(seed: int, tile: int, dtype=jnp.bfloat16, scale: float = None
+              ) -> Gen:
+    """Generator with a ~4-op elementwise body (fractional-part mixing
+    instead of sin) — at 65k² the transcendental in ``default_gen`` is
+    VPU time stolen from the MXU. Values are uniform-ish in [-s, s];
+    statistically crude but plenty for exercising/benchmarking the
+    pipeline, and fully deterministic."""
+    s = scale if scale is not None else 0.01
+
+    def _vals(rg, cg):
+        x = rg * 0.6180339887 + cg * 0.7548776662 + (seed + 1) * 0.5545497
+        return ((x - jnp.floor(x)) * 2.0 - 1.0) * s
+
+    def gen(bi, bj):
+        r = jax.lax.broadcasted_iota(jnp.float32, (tile, tile), 0)
+        c = jax.lax.broadcasted_iota(jnp.float32, (tile, tile), 1)
+        return _vals(r + bi * tile, c + bj * tile).astype(dtype)
+
+    def slab(r0, c0, shape):
+        rg = jax.lax.broadcasted_iota(jnp.float32, shape, 0) + r0
+        cg = jax.lax.broadcasted_iota(jnp.float32, shape, 1) + c0
+        return _vals(rg, cg).astype(dtype)
+
+    gen.slab = slab
     return gen
 
 
@@ -70,6 +107,80 @@ def streaming_chain(n: int,
     run = _chain_runner(n, tile, panel, kt, npan, gen_a, gen_b, gen_c,
                         dtype, reduce, prec)
     return run()
+
+
+def streaming_chain_slab(n: int,
+                         gen_a: Gen, gen_b: Gen, gen_c: Gen,
+                         tile: int = 8192,
+                         panel: int = 16384,
+                         dtype=jnp.bfloat16,
+                         reduce: str = "fro") -> jax.Array:
+    """Slab-structured evaluation of reduce(A·B·C) — the fast single-chip
+    north-star path.
+
+    Differs from ``streaming_chain`` in how the contraction is scheduled:
+    instead of accumulating a (panel, n) f32 carry across k-steps (which
+    round-trips the 4 GB accumulator through HBM kt× per phase), every
+    output slab is ONE ``dot_general`` over the full 65k contraction —
+    the f32 accumulation happens inside the MXU's tiling, never touching
+    HBM. Operand column slabs (n, tile) are produced by the generators'
+    ``.slab`` fast path in one fused elementwise op each.
+
+        T_i[:, j] = A_i · B[:, j]      (one dot per slab, full k)
+        acc      += reduce(T_i · C[:, j])
+
+    Requires gens built by ``default_gen``/``cheap_gen`` (anything with
+    ``.slab(r0, c0, shape)``).
+    """
+    if n % tile or n % panel or panel % tile:
+        raise ValueError("n must divide by tile and panel; panel by tile")
+    for g in (gen_a, gen_b, gen_c):
+        if not hasattr(g, "slab"):
+            raise ValueError("streaming_chain_slab needs .slab-capable "
+                             "generators (default_gen / cheap_gen)")
+    run = _slab_runner(n, tile, panel, gen_a, gen_b, gen_c, dtype, reduce)
+    return run()
+
+
+@functools.lru_cache(maxsize=8)
+def _slab_runner(n, tile, panel, gen_a, gen_b, gen_c, dtype, reduce):
+    kt = n // tile
+    npan = n // panel
+
+    @jax.jit
+    def run():
+        def panel_body(i, acc):
+            a_i = gen_a.slab(i * panel, 0, (panel, n)).astype(dtype)
+
+            # (Unrolling these j loops was measured identical to
+            # fori_loop — 6.30 s either way at n=65k — so keep the
+            # compact loop form.)
+            def fill_t(j, t):
+                b_j = gen_b.slab(0, j * tile, (n, tile)).astype(dtype)
+                s = jax.lax.dot_general(
+                    a_i, b_j, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return jax.lax.dynamic_update_slice(
+                    t, s.astype(dtype), (0, j * tile))
+
+            t_i = jax.lax.fori_loop(0, kt, fill_t,
+                                    jnp.zeros((panel, n), dtype))
+
+            def reduce_o(j, a2):
+                c_j = gen_c.slab(0, j * tile, (n, tile)).astype(dtype)
+                o = jax.lax.dot_general(
+                    t_i, c_j, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return a2 + (jnp.sum(o * o) if reduce == "fro"
+                             else jnp.sum(o))
+
+            return acc + jax.lax.fori_loop(0, kt, reduce_o,
+                                           jnp.zeros((), jnp.float32))
+
+        return jax.lax.fori_loop(0, npan, panel_body,
+                                 jnp.zeros((), jnp.float32))
+
+    return run
 
 
 def streaming_chain_sharded(n: int,

@@ -96,10 +96,13 @@ def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
         # segment path there. The plan build is host-side numpy, so
         # mesh-sharded edge arrays (mesh=...) stay on the segment path.
         # Falls back when the degree distribution is too heavy-tailed to
-        # pad (build returns None).
+        # pad, or when the expanded tables would exceed the HBM budget
+        # (~224 B/slot; the cap keeps auto from OOMing on huge graphs
+        # that the 8 B/edge segment path handles fine).
         on_tpu = jax.default_backend() in ("tpu", "axon")
         if on_tpu:
-            out = _pagerank_onehot(src, dst, n, rounds, alpha)
+            out = _pagerank_onehot(src, dst, n, rounds, alpha,
+                                   max_slots=_PLAN_CACHE_MAX_SLOTS)
             if out is not None:
                 return out
     src = jnp.asarray(src, dtype=jnp.int32)
@@ -109,7 +112,7 @@ def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
     return run(src, dst)
 
 
-def prepare_pagerank_onehot(src, dst, n: int):
+def prepare_pagerank_onehot(src, dst, n: int, max_slots: int = None):
     """Build the one-hot SpMV plan for a graph (ops/spmv.py), reusable
     across pagerank runs — plan construction is the expensive, per-graph
     step (host sort + pad, one device table expansion).
@@ -127,7 +130,8 @@ def prepare_pagerank_onehot(src, dst, n: int):
     outdeg = np.bincount(src_np, minlength=n).astype(np.float32)
     inv = np.where(outdeg > 0, 1.0 / np.maximum(outdeg, 1.0), 0.0)
     plan = spmv_lib.build_spmv_plan(dst_np, src_np, vals=inv[src_np],
-                                    n_rows=n, n_cols=n)
+                                    n_rows=n, n_cols=n,
+                                    max_slots=max_slots)
     if plan is None:
         return None
     dangling = jnp.asarray((outdeg == 0).astype(np.float32))
@@ -151,12 +155,14 @@ def run_pagerank_onehot(prepared, rounds: int = 30,
 
 # Prepared-plan cache for the auto path: repeated pagerank_edges calls on
 # the same graph (alpha/round sweeps) must not repay the host sort + table
-# transfer. Keyed by a SAMPLED content fingerprint (ends + ~1M strided
-# elements), so a cache probe costs ~20 ms, not a 160 MB hash; callers who
-# need a guaranteed-fresh plan (the sample is not collision-proof against
-# adversarial inputs) use prepare_pagerank_onehot/run_pagerank_onehot
-# directly. Eviction is byte-aware: expanded one-hot tables are ~224 B per
-# padded slot, and pinning several multi-GB plans would OOM a 16 GB chip.
+# transfer. Keyed by a FULL content hash (blake2b runs ~1 GB/s, so a 10M-
+# edge probe costs ~0.2 s against ~1 s of saved 30-round compute — and a
+# sampled key would silently serve a stale plan after small graph edits).
+# Callers holding device-resident edge arrays should use
+# prepare_pagerank_onehot/run_pagerank_onehot directly: a cache probe
+# pulls the arrays to host. Eviction is byte-aware: expanded one-hot
+# tables are ~224 B per padded slot, and pinning several multi-GB plans
+# would OOM a 16 GB chip; plans above the cap run uncached.
 _PLAN_CACHE: dict = {}
 _PLAN_CACHE_MAX_SLOTS = 24_000_000   # ≈5.4 GB of expanded tables
 
@@ -164,14 +170,12 @@ _PLAN_CACHE_MAX_SLOTS = 24_000_000   # ≈5.4 GB of expanded tables
 def _graph_fingerprint(src, dst, n: int) -> tuple:
     import hashlib
     h = hashlib.blake2b(digest_size=16)
-    m = int(np.asarray(src).shape[0] if hasattr(src, "shape") else len(src))
-    stride = max(1, m // 1_000_000)
+    sizes = []
     for a in (src, dst):
-        # slice BEFORE np.asarray so device arrays ship only the sample
-        for part in (a[:4096], a[-4096:], a[::stride]):
-            h.update(np.ascontiguousarray(
-                np.asarray(part, dtype=np.int64)).tobytes())
-    return (n, m, h.hexdigest())
+        a = np.asarray(a)        # no dtype coercion: hash raw bytes
+        h.update(np.ascontiguousarray(a).tobytes())
+        sizes.append((a.shape[0], str(a.dtype)))
+    return (n, tuple(sizes), h.hexdigest())
 
 
 def _plan_slots(prepared) -> int:
@@ -179,19 +183,23 @@ def _plan_slots(prepared) -> int:
     return plan.src8.shape[0] * plan.src8.shape[1]
 
 
-def _pagerank_onehot(src, dst, n: int, rounds: int, alpha: float):
+def _pagerank_onehot(src, dst, n: int, rounds: int, alpha: float,
+                     max_slots: int = None):
     key = _graph_fingerprint(src, dst, n)
     if key in _PLAN_CACHE:
         prepared = _PLAN_CACHE[key]
     else:
-        prepared = prepare_pagerank_onehot(src, dst, n)
+        prepared = prepare_pagerank_onehot(src, dst, n,
+                                           max_slots=max_slots)
         if prepared is None:
             return None
-        total = sum(map(_plan_slots, _PLAN_CACHE.values()))
-        while _PLAN_CACHE and total + _plan_slots(prepared) > \
-                _PLAN_CACHE_MAX_SLOTS:
-            total -= _plan_slots(_PLAN_CACHE.pop(next(iter(_PLAN_CACHE))))
-        _PLAN_CACHE[key] = prepared
+        if _plan_slots(prepared) <= _PLAN_CACHE_MAX_SLOTS:
+            total = sum(map(_plan_slots, _PLAN_CACHE.values()))
+            while _PLAN_CACHE and total + _plan_slots(prepared) > \
+                    _PLAN_CACHE_MAX_SLOTS:
+                total -= _plan_slots(
+                    _PLAN_CACHE.pop(next(iter(_PLAN_CACHE))))
+            _PLAN_CACHE[key] = prepared
     return run_pagerank_onehot(prepared, rounds, alpha)
 
 

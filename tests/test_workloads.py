@@ -156,6 +156,40 @@ class TestStreamingBigChain:
         with pytest.raises(ValueError):
             streaming_chain(60, g, g, g, tile=8, panel=16)
 
+    @pytest.mark.parametrize("mk", ["default_gen", "cheap_gen"])
+    def test_slab_matches_numpy_and_accum(self, mk):
+        import jax.numpy as jnp
+        from matrel_tpu.workloads import big_chain
+        gen_factory = getattr(big_chain, mk)
+        n, tile, panel = 64, 8, 16
+        gens = tuple(gen_factory(s, tile, jnp.float32, 0.05)
+                     for s in (1, 2, 3))
+        slab = float(big_chain.streaming_chain_slab(
+            n, *gens, tile=tile, panel=panel, dtype=jnp.float32))
+        accum = float(big_chain.streaming_chain(
+            n, *gens, tile=tile, panel=panel, dtype=jnp.float32))
+        full = [np.asarray(g.slab(0, 0, (n, n)), dtype=np.float64)
+                for g in gens]
+        oracle = float(((full[0] @ full[1] @ full[2]) ** 2).sum())
+        assert slab == pytest.approx(accum, rel=1e-5)
+        assert slab == pytest.approx(oracle, rel=1e-4)
+
+    def test_slab_gen_consistency(self):
+        # .slab(r0, c0) must produce exactly the tiles gen(bi, bj) does
+        import jax.numpy as jnp
+        from matrel_tpu.workloads.big_chain import default_gen, cheap_gen
+        for mk in (default_gen, cheap_gen):
+            g = mk(3, 8, jnp.float32, 0.05)
+            tile_11 = np.asarray(g(1, 2))
+            slab = np.asarray(g.slab(8, 16, (8, 8)))
+            np.testing.assert_allclose(slab, tile_11, atol=2e-7)
+
+    def test_slab_requires_capable_gens(self):
+        from matrel_tpu.workloads.big_chain import streaming_chain_slab
+        with pytest.raises(ValueError, match="slab"):
+            streaming_chain_slab(64, lambda i, j: None, lambda i, j: None,
+                                 lambda i, j: None, tile=8, panel=16)
+
     def test_sharded_matches_single(self, mesh8):
         import jax.numpy as jnp
         from matrel_tpu.workloads.big_chain import (
