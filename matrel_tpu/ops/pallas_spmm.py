@@ -26,22 +26,35 @@ from jax.sharding import NamedSharding
 from matrel_tpu.config import MatrelConfig
 
 
-def _kernel(brows, bcols, blocks_ref, d_ref, out_ref):
-    i = pl.program_id(1)  # sparse-tile index (fastest)
-    row = brows[i]
-    first_visit = jnp.logical_or(i == 0, brows[jnp.maximum(i - 1, 0)] != row)
+def _make_kernel(precision, nnzb):
+    def _kernel(brows, bcols, blocks_ref, d_ref, out_ref, acc_ref):
+        i = pl.program_id(1)  # sparse-tile index (fastest)
+        row = brows[i]
+        first_visit = jnp.logical_or(i == 0,
+                                     brows[jnp.maximum(i - 1, 0)] != row)
+        last_visit = jnp.logical_or(
+            i == nnzb - 1, brows[jnp.minimum(i + 1, nnzb - 1)] != row)
 
-    @pl.when(first_visit)
-    def _init():
-        out_ref[:] = jnp.zeros_like(out_ref)
+        # Accumulate row-runs in an f32 VMEM scratch; the HBM-backed out
+        # block is written ONCE per run (bf16 revisit-rounding avoided
+        # without paying f32 write-back traffic per visit).
+        @pl.when(first_visit)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    tile = blocks_ref[0]          # [bs, bs]
-    dtile = d_ref[0]              # [bs, tm]
-    out_ref[:] += jax.lax.dot(
-        tile, dtile,
-        precision=jax.lax.Precision.HIGHEST,   # full f32 on the MXU
-        preferred_element_type=jnp.float32,
-    ).astype(out_ref.dtype)
+        tile = blocks_ref[0]          # [bs, bs]
+        dtile = d_ref[0]              # [bs, tm]
+        acc_ref[:] += jax.lax.dot(
+            tile, dtile,
+            precision=precision,
+            preferred_element_type=jnp.float32,
+        )
+
+        @pl.when(last_visit)
+        def _flush():
+            out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+
+    return _kernel
 
 
 def make_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg: MatrelConfig,
@@ -86,11 +99,18 @@ def make_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg: MatrelConfig,
             pl.BlockSpec((1, bs, tm), lambda j, i, brows, bcols: (bcols[i], 0, j)),
         ],
         out_specs=pl.BlockSpec((bs, tm), lambda j, i, brows, bcols: (brows[i], j)),
+        scratch_shapes=[pltpu.VMEM((bs, tm), jnp.float32)],
     )
 
     out_dtype = S.blocks.dtype
+    # bf16 payloads run the MXU's native single pass; asking Mosaic for
+    # fp32 contract precision on bf16 operands is both pointless (inputs
+    # carry bf16 information) and rejected ("Bad lhs type"). f32 payloads
+    # keep full-f32 MXU passes.
+    precision = (jax.lax.Precision.DEFAULT if out_dtype == jnp.bfloat16
+                 else jax.lax.Precision.HIGHEST)
     kernel = pl.pallas_call(
-        _kernel,
+        _make_kernel(precision, nnzb),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((gr * bs, pm), out_dtype),
         compiler_params=pltpu.CompilerParams(

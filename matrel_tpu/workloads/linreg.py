@@ -88,6 +88,7 @@ def fit_streaming(n_rows: int, k: int,
                   l2: float = 0.0,
                   mesh=None,
                   dtype=None,
+                  precision: str = "highest",
                   config: Optional[MatrelConfig] = None) -> jax.Array:
     """Tall-skinny normal equations when X exceeds HBM (BASELINE row 3:
     10M×1k f32 = 40 GB on a 16 GB chip).
@@ -98,18 +99,27 @@ def fit_streaming(n_rows: int, k: int,
     generator: synthetic data, or a gather from a device-resident shard) —
     and only the k×k accumulators live across iterations. One jitted
     program, O(panel) memory, every FLOP on the MXU.
+
+    ``precision``: MXU passes for the f32 Gram products — "highest"
+    (6-pass bf16, ≈exact f32, the safe default: cond(XᵀX) = cond(X)²) or
+    "high" (3-pass, ~2× the throughput, f32-representation-level error;
+    fine for well-conditioned problems).
     """
     import math as _math
+    if precision.lower() not in ("default", "high", "highest"):
+        raise ValueError(f"precision must be one of 'default', 'high', "
+                         f"'highest'; got {precision!r}")
+    precision = precision.lower()
     cfg = config or default_config()
     mesh = mesh or _default_mesh(cfg)
     n_panels = _math.ceil(n_rows / panel_rows)
-    key = (panel_fn, n_panels, k, l2)
+    key = (panel_fn, n_panels, k, l2, precision)
     run = _stream_cache.get(key)
     if run is None:
 
         @jax.jit
         def run():
-            prec = jax.lax.Precision.HIGHEST
+            prec = getattr(jax.lax.Precision, precision.upper())
 
             def body(p, carry):
                 gram, rhs = carry

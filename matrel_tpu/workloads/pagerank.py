@@ -172,9 +172,12 @@ def _graph_fingerprint(src, dst, n: int) -> tuple:
     h = hashlib.blake2b(digest_size=16)
     sizes = []
     for a in (src, dst):
-        a = np.asarray(a)        # no dtype coercion: hash raw bytes
-        h.update(np.ascontiguousarray(a).tobytes())
-        sizes.append((a.shape[0], str(a.dtype)))
+        # canonicalize to int32 (node ids fit by construction) so the
+        # same graph hashes identically whatever index dtype it arrives
+        # in; no copy when it already is int32
+        a = np.ascontiguousarray(np.asarray(a, dtype=np.int32))
+        h.update(a.tobytes())
+        sizes.append(a.shape[0])
     return (n, tuple(sizes), h.hexdigest())
 
 

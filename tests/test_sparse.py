@@ -81,6 +81,23 @@ class TestSpMM:
                             interpret=True)
         np.testing.assert_allclose(out.to_numpy(), a @ d, rtol=1e-4, atol=1e-4)
 
+    def test_pallas_interpret_bf16_payload(self, mesh8, rng):
+        # bf16 payloads select DEFAULT contract precision (Mosaic rejects
+        # fp32 contract on bf16 operands) and must still accumulate
+        # row-runs in the f32 scratch
+        import jax.numpy as jnp
+        a = random_block_sparse_np(rng, 32, 32, 8, 0.5)
+        d = rng.standard_normal((32, 16)).astype(np.float32)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8,
+                                         dtype=jnp.bfloat16)
+        D = BlockMatrix.from_numpy(d.astype(jnp.bfloat16), mesh=mesh8)
+        out = spmm_lib.spmm(S, D, MatrelConfig(use_pallas=False),
+                            interpret=True)
+        a16 = a.astype(jnp.bfloat16).astype(np.float32)
+        d16 = d.astype(jnp.bfloat16).astype(np.float32)
+        np.testing.assert_allclose(out.to_numpy().astype(np.float32),
+                                   a16 @ d16, rtol=2e-2, atol=2e-2)
+
     def test_spmv(self, mesh8, rng):
         a = random_block_sparse_np(rng, 32, 32, 8, 0.4)
         v = rng.standard_normal((32, 1)).astype(np.float32)
