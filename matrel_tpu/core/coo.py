@@ -1,0 +1,169 @@
+"""Element-sparse COO matrix — the TPU answer to the reference's CSC
+local payloads (SURVEY.md §2 "Local matrix kernels": MLlib `SparseMatrix`
+is element-granular CSC).
+
+Block-granular sparsity (`core/sparse.py`) is the MXU-idiomatic layout for
+matrices whose nonzeros cluster into dense tiles; uniform/graph-shaped
+sparsity (1e-5-class densities) would touch every tile. `COOMatrix` covers
+that regime: a fixed edge list compiled once into a blocked one-hot SpMV
+plan (`ops/spmv.py` — width-row gather + hi/lo one-hot MXU scatter, no
+XLA scatter anywhere), with transpose plans built lazily and a plain
+segment-sum fallback for degree distributions the planner refuses.
+
+Matvec is the hot op (PageRank-class workloads). `matmat` handles narrow
+dense right-hand sides by reusing the row gather once and cycling the
+one-hot contraction per column — fine for the tall-skinny multivector
+shapes (personalization vectors, feature panels) this type exists for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from matrel_tpu.ops import spmv as spmv_lib
+
+
+@dataclasses.dataclass
+class COOMatrix:
+    """Immutable element-sparse matrix over a fixed coordinate list."""
+
+    rows: np.ndarray          # host int64, unsorted as given
+    cols: np.ndarray
+    vals: np.ndarray          # float32
+    shape: Tuple[int, int]
+    _plan: Optional[spmv_lib.EdgeSpMVPlan] = dataclasses.field(
+        default=None, repr=False)
+    _plan_t: Optional[spmv_lib.EdgeSpMVPlan] = dataclasses.field(
+        default=None, repr=False)
+    _plan_tried: bool = dataclasses.field(default=False, repr=False)
+    _plan_t_tried: bool = dataclasses.field(default=False, repr=False)
+    # fallback-path caches: (device out_ids, device in_ids, device vals),
+    # sorted by out_ids — fixed per matrix, built once per direction
+    _seg_fwd: Optional[tuple] = dataclasses.field(default=None, repr=False)
+    _seg_bwd: Optional[tuple] = dataclasses.field(default=None, repr=False)
+
+    # ---------------------------------------------------------- build
+    @classmethod
+    def from_edges(cls, rows, cols, vals=None,
+                   shape: Optional[Tuple[int, int]] = None) -> "COOMatrix":
+        rows = np.asarray(rows, dtype=np.int64).ravel()
+        cols = np.asarray(cols, dtype=np.int64).ravel()
+        if rows.shape != cols.shape:
+            raise ValueError(f"rows/cols length mismatch: "
+                             f"{rows.shape} vs {cols.shape}")
+        if vals is None:
+            vals = np.ones(rows.shape, np.float32)
+        else:
+            vals = np.asarray(vals, dtype=np.float32).ravel()
+            if vals.shape != rows.shape:
+                raise ValueError("vals length must match rows/cols")
+        if shape is None:
+            shape = (int(rows.max()) + 1 if rows.size else 1,
+                     int(cols.max()) + 1 if cols.size else 1)
+        if rows.size and (rows.min() < 0 or rows.max() >= shape[0]
+                          or cols.min() < 0 or cols.max() >= shape[1]):
+            raise ValueError("edge indices out of bounds for shape")
+        return cls(rows=rows, cols=cols, vals=vals, shape=tuple(shape))
+
+    @classmethod
+    def from_scipy(cls, mat) -> "COOMatrix":
+        """From any scipy.sparse matrix (converted to COO)."""
+        coo = mat.tocoo()
+        return cls.from_edges(coo.row, coo.col, coo.data, shape=coo.shape)
+
+    # ------------------------------------------------------ properties
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.shape[0])
+
+    @property
+    def T(self) -> "COOMatrix":
+        """Transpose view — shares this matrix's plan caches swapped, so
+        ``A.T.matvec`` costs no rebuild once ``A.rmatvec`` (or a prior
+        ``A.T``) compiled a plan."""
+        return COOMatrix(rows=self.cols, cols=self.rows, vals=self.vals,
+                         shape=(self.shape[1], self.shape[0]),
+                         _plan=self._plan_t, _plan_t=self._plan,
+                         _plan_tried=self._plan_t_tried,
+                         _plan_t_tried=self._plan_tried,
+                         _seg_fwd=self._seg_bwd, _seg_bwd=self._seg_fwd)
+
+    # ----------------------------------------------------------- plans
+    def _get_plan(self) -> Optional[spmv_lib.EdgeSpMVPlan]:
+        if not self._plan_tried:
+            self._plan = spmv_lib.build_spmv_plan(
+                self.rows, self.cols, self.vals,
+                n_rows=self.shape[0], n_cols=self.shape[1])
+            self._plan_tried = True
+        return self._plan
+
+    def _get_plan_t(self) -> Optional[spmv_lib.EdgeSpMVPlan]:
+        if not self._plan_t_tried:
+            self._plan_t = spmv_lib.build_spmv_plan(
+                self.cols, self.rows, self.vals,
+                n_rows=self.shape[1], n_cols=self.shape[0])
+            self._plan_t_tried = True
+        return self._plan_t
+
+    # ------------------------------------------------------------ ops
+    def matvec(self, x) -> jax.Array:
+        """y = A·x, shape (n_rows,)."""
+        x = jnp.asarray(x, jnp.float32).ravel()
+        if x.shape[0] != self.shape[1]:
+            raise ValueError(f"x has {x.shape[0]} entries, A has "
+                             f"{self.shape[1]} columns")
+        plan = self._get_plan()
+        if plan is not None:
+            return spmv_lib.spmv(plan, x)
+        if self._seg_fwd is None:
+            self._seg_fwd = self._seg_arrays(self.rows, self.cols)
+        return self._segment_matvec(self._seg_fwd, x, self.shape[0])
+
+    def rmatvec(self, y) -> jax.Array:
+        """x = Aᵀ·y, shape (n_cols,) — uses the lazily-built transpose
+        plan (no re-sort of the forward plan)."""
+        y = jnp.asarray(y, jnp.float32).ravel()
+        if y.shape[0] != self.shape[0]:
+            raise ValueError(f"y has {y.shape[0]} entries, A has "
+                             f"{self.shape[0]} rows")
+        plan = self._get_plan_t()
+        if plan is not None:
+            return spmv_lib.spmv(plan, y)
+        if self._seg_bwd is None:
+            self._seg_bwd = self._seg_arrays(self.cols, self.rows)
+        return self._segment_matvec(self._seg_bwd, y, self.shape[1])
+
+    def matmat(self, X) -> jax.Array:
+        """Y = A·X for a narrow dense X (n_cols, k): the column loop
+        reuses the compiled per-column matvec program k times."""
+        X = jnp.asarray(X, jnp.float32)
+        if X.ndim != 2 or X.shape[0] != self.shape[1]:
+            raise ValueError(f"X must be ({self.shape[1]}, k), "
+                             f"got {X.shape}")
+        if X.shape[1] == 0:
+            return jnp.zeros((self.shape[0], 0), jnp.float32)
+        cols = [self.matvec(X[:, j]) for j in range(X.shape[1])]
+        return jnp.stack(cols, axis=1)
+
+    def _seg_arrays(self, out_ids, in_ids) -> tuple:
+        order = np.argsort(out_ids, kind="stable")
+        return (jnp.asarray(out_ids[order], jnp.int32),
+                jnp.asarray(in_ids[order], jnp.int32),
+                jnp.asarray(self.vals[order]))
+
+    def _segment_matvec(self, seg, x, n_out) -> jax.Array:
+        out_s, in_s, val_s = seg
+        w = val_s * spmv_lib.gather_1d(x, in_s)
+        return jax.ops.segment_sum(w, out_s, num_segments=n_out,
+                                   indices_are_sorted=True)
+
+    def to_dense(self) -> np.ndarray:
+        """Host densification (small matrices / tests)."""
+        out = np.zeros(self.shape, np.float32)
+        np.add.at(out, (self.rows, self.cols), self.vals)
+        return out

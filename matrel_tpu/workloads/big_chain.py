@@ -142,41 +142,63 @@ def streaming_chain_slab(n: int,
     return run()
 
 
+def _vma_zeros(shape, dt, vma_axes):
+    """Zeros marked varying over ``vma_axes`` (loop carries under
+    shard_map need this or the fori carry types mismatch)."""
+    z = jnp.zeros(shape, dtype=dt)
+    if vma_axes:
+        pcast = getattr(jax.lax, "pcast", None)
+        z = (pcast(z, vma_axes, to="varying") if pcast is not None
+             else jax.lax.pvary(z, vma_axes))
+    return z
+
+
+def _make_slab_panel_body(n, tile, panel, gen_a, gen_b, gen_c, dtype,
+                          reduce, vma_axes=()):
+    """Slab-scheduled per-panel contraction, shared by the single- and
+    multi-chip evaluators. ``vma_axes`` as in ``_make_panel_body``."""
+    kt = n // tile
+
+    def zeros(shape, dt):
+        return _vma_zeros(shape, dt, vma_axes)
+
+    def panel_body(i, acc):
+        a_i = gen_a.slab(i * panel, 0, (panel, n)).astype(dtype)
+
+        # (Unrolling these j loops was measured identical to fori_loop —
+        # 6.30 s either way at n=65k — so keep the compact loop form.)
+        def fill_t(j, t):
+            b_j = gen_b.slab(0, j * tile, (n, tile)).astype(dtype)
+            s = jax.lax.dot_general(
+                a_i, b_j, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return jax.lax.dynamic_update_slice(
+                t, s.astype(dtype), (0, j * tile))
+
+        t_i = jax.lax.fori_loop(0, kt, fill_t, zeros((panel, n), dtype))
+
+        def reduce_o(j, a2):
+            c_j = gen_c.slab(0, j * tile, (n, tile)).astype(dtype)
+            o = jax.lax.dot_general(
+                t_i, c_j, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return a2 + (jnp.sum(o * o) if reduce == "fro"
+                         else jnp.sum(o))
+
+        return acc + jax.lax.fori_loop(0, kt, reduce_o,
+                                       zeros((), jnp.float32))
+
+    return panel_body
+
+
 @functools.lru_cache(maxsize=8)
 def _slab_runner(n, tile, panel, gen_a, gen_b, gen_c, dtype, reduce):
-    kt = n // tile
     npan = n // panel
+    panel_body = _make_slab_panel_body(n, tile, panel, gen_a, gen_b, gen_c,
+                                       dtype, reduce)
 
     @jax.jit
     def run():
-        def panel_body(i, acc):
-            a_i = gen_a.slab(i * panel, 0, (panel, n)).astype(dtype)
-
-            # (Unrolling these j loops was measured identical to
-            # fori_loop — 6.30 s either way at n=65k — so keep the
-            # compact loop form.)
-            def fill_t(j, t):
-                b_j = gen_b.slab(0, j * tile, (n, tile)).astype(dtype)
-                s = jax.lax.dot_general(
-                    a_i, b_j, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                return jax.lax.dynamic_update_slice(
-                    t, s.astype(dtype), (0, j * tile))
-
-            t_i = jax.lax.fori_loop(0, kt, fill_t,
-                                    jnp.zeros((panel, n), dtype))
-
-            def reduce_o(j, a2):
-                c_j = gen_c.slab(0, j * tile, (n, tile)).astype(dtype)
-                o = jax.lax.dot_general(
-                    t_i, c_j, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                return a2 + (jnp.sum(o * o) if reduce == "fro"
-                             else jnp.sum(o))
-
-            return acc + jax.lax.fori_loop(0, kt, reduce_o,
-                                           jnp.zeros((), jnp.float32))
-
         return jax.lax.fori_loop(0, npan, panel_body,
                                  jnp.zeros((), jnp.float32))
 
@@ -213,8 +235,16 @@ def streaming_chain_sharded(n: int,
         raise ValueError(f"panels ({npan}) must divide over devices ({p})")
     per_dev = npan // p
     prec = jax.lax.Precision.DEFAULT
-    panel_body = _make_panel_body(n, tile, panel, kt, gen_a, gen_b, gen_c,
-                                  dtype, reduce, prec, vma_axes=axes)
+    # slab schedule when the generators support it (same fast structure
+    # as the single-chip north star); tile-assembly body otherwise
+    if all(hasattr(g, "slab") for g in (gen_a, gen_b, gen_c)):
+        panel_body = _make_slab_panel_body(n, tile, panel, gen_a, gen_b,
+                                           gen_c, dtype, reduce,
+                                           vma_axes=axes)
+    else:
+        panel_body = _make_panel_body(n, tile, panel, kt, gen_a, gen_b,
+                                      gen_c, dtype, reduce, prec,
+                                      vma_axes=axes)
 
     def kernel():
         idx = jnp.zeros((), jnp.int32)
@@ -244,12 +274,7 @@ def _make_panel_body(n, tile, panel, kt, gen_a, gen_b, gen_c, dtype,
     over (shard_map) — loop-carry zeros must be marked varying over them
     or the fori carries type-mismatch."""
     def zeros(shape, dt):
-        z = jnp.zeros(shape, dtype=dt)
-        if vma_axes:
-            pcast = getattr(jax.lax, "pcast", None)
-            z = (pcast(z, vma_axes, to="varying") if pcast is not None
-                 else jax.lax.pvary(z, vma_axes))
-        return z
+        return _vma_zeros(shape, dt, vma_axes)
 
     def row_block(gen, k, width_tiles):
         """Assemble row-block k (tile × n) from width_tiles generated tiles."""
