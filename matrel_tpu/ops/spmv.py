@@ -161,19 +161,21 @@ def build_spmv_plan(rows, cols, vals=None, n_rows: int = None,
         n_rows = int(rows.max()) + 1 if m else 1
     if n_cols is None:
         n_cols = int(cols.max()) + 1 if m else 1
-    if vals is None:
-        vals = np.ones((m,), np.float32)
-    else:
+    if vals is not None:
         vals = np.asarray(vals, dtype=np.float32)
     if block % LO:
         raise ValueError("block must be a multiple of LO")
-    hi_n = block // LO
+    if m and (rows.min() < 0 or rows.max() >= n_rows
+              or cols.min() < 0 or cols.max() >= n_cols):
+        raise ValueError("edge indices out of bounds for "
+                         f"({n_rows}, {n_cols})")
 
-    order = np.argsort(rows, kind="stable")
-    rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
     nb = -(-n_rows // block)
-    blk = rows_s // block
-    cnt = np.bincount(blk, minlength=nb)
+    from matrel_tpu.utils import native
+    cnt = native.spmv_counts(rows, block, nb)
+    use_native = cnt is not None
+    if not use_native:
+        cnt = np.bincount(rows // block, minlength=nb)
     if m == 0:
         cap = 128
     else:
@@ -188,7 +190,44 @@ def build_spmv_plan(rows, cols, vals=None, n_rows: int = None,
         return None
     if max_slots is not None and nb * cap > max_slots:
         return None
+    n_ov = int(np.maximum(cnt - cap, 0).sum())
 
+    filled = native.spmv_fill(rows, cols, vals, n_cols, block, nb, cap,
+                              WIDTH, n_ov) if use_native else None
+    if filled is not None:
+        # Native single-pass counting-sort fill (O(m), no argsort —
+        # slot order within a block is input order; the one-hot
+        # contraction is order-agnostic so results match the numpy path)
+        src8, lane, off, val, ov_r64, ov_c64, ov_v = filled
+    else:
+        src8, lane, off, val, ov_r64, ov_c64, ov_v = _numpy_fill(
+            rows, cols, vals, m, n_cols, block, nb, cap, cnt)
+
+    if n_ov:
+        ov_c = jnp.asarray(ov_c64, jnp.int32)
+        ov_r = jnp.asarray(ov_r64, jnp.int32)
+        ov_v = jnp.asarray(ov_v, jnp.float32)
+    else:
+        ov_c = ov_r = ov_v = None
+
+    return EdgeSpMVPlan(
+        n_rows=n_rows, n_cols=n_cols, block=block, capacity=cap,
+        src8=jnp.asarray(src8, jnp.int32),
+        lane=jnp.asarray(lane, jnp.int8),
+        off=jnp.asarray(off, jnp.int32),
+        val=jnp.asarray(val, jnp.float32),
+        ov_cols=ov_c, ov_rows=ov_r, ov_vals=ov_v,
+        padding_ratio=(nb * cap + n_ov) / max(m, 1))
+
+
+def _numpy_fill(rows, cols, vals, m, n_cols, block, nb, cap, cnt):
+    """Pure-numpy plan fill (fallback when the native library is
+    unavailable): stable argsort by row, then fancy-indexed scatters."""
+    if vals is None:
+        vals = np.ones((m,), np.float32)
+    order = np.argsort(rows, kind="stable")
+    rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
+    blk = rows_s // block
     starts = np.zeros(nb + 1, np.int64)
     np.cumsum(cnt, out=starts[1:])
     slot = np.arange(m, dtype=np.int64) - starts[blk]
@@ -201,23 +240,10 @@ def build_spmv_plan(rows, cols, vals=None, n_rows: int = None,
     src_pad[b_main, s_main] = cols_s[in_main]
     val_pad[b_main, s_main] = vals_s[in_main]
     off_pad[b_main, s_main] = rows_s[in_main] % block
-
-    n_ov = int((~in_main).sum())
-    if n_ov:
-        ov_c = jnp.asarray(cols_s[~in_main], jnp.int32)
-        ov_r = jnp.asarray(rows_s[~in_main], jnp.int32)
-        ov_v = jnp.asarray(vals_s[~in_main], jnp.float32)
-    else:
-        ov_c = ov_r = ov_v = None
-
-    return EdgeSpMVPlan(
-        n_rows=n_rows, n_cols=n_cols, block=block, capacity=cap,
-        src8=jnp.asarray(src_pad // WIDTH, jnp.int32),
-        lane=jnp.asarray(src_pad % WIDTH, jnp.int8),
-        off=jnp.asarray(off_pad, jnp.int32),
-        val=jnp.asarray(val_pad),
-        ov_cols=ov_c, ov_rows=ov_r, ov_vals=ov_v,
-        padding_ratio=(nb * cap + n_ov) / max(m, 1))
+    return ((src_pad // WIDTH).astype(np.int32),
+            (src_pad % WIDTH).astype(np.int8),
+            off_pad.astype(np.int32), val_pad,
+            rows_s[~in_main], cols_s[~in_main], vals_s[~in_main])
 
 
 def spmv_apply(plan_static, arrays, x: jax.Array) -> jax.Array:

@@ -29,7 +29,7 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-_SOURCES = ("chain_dp.cc", "mtx_reader.cc")
+_SOURCES = ("chain_dp.cc", "mtx_reader.cc", "spmv_plan.cc")
 
 
 def _stale() -> bool:
@@ -114,6 +114,30 @@ def load() -> Optional[ctypes.CDLL]:
             log.debug("native ingestion symbols unavailable: %s", e)
             _has_ingest = False
         lib._matrel_has_ingest = _has_ingest
+        try:
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            lib.matrel_spmv_counts.restype = ctypes.c_int
+            lib.matrel_spmv_counts.argtypes = [
+                i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, i64p]
+            lib.matrel_spmv_fill.restype = ctypes.c_int64
+            lib.matrel_spmv_fill.argtypes = [
+                i64p, i64p, ctypes.c_void_p,          # rows, cols, vals|NULL
+                ctypes.c_int64, ctypes.c_int64,        # m, n_cols
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # block,nb,cap
+                ctypes.c_int32,                        # width
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                i64p, i64p,
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                ctypes.c_int64,                        # ov_cap
+            ]
+            _has_spmv = True
+        except AttributeError as e:
+            log.debug("native spmv-plan symbols unavailable: %s", e)
+            _has_spmv = False
+        lib._matrel_has_spmv = _has_spmv
         return _lib
 
 
@@ -213,3 +237,52 @@ def coo_csv_read(path: str) -> Optional[Tuple[np.ndarray, np.ndarray,
     if got < 0:
         return None
     return ri[:got], ci[:got], vals[:got]
+
+
+# -- native SpMV plan layout (spmv_plan.cc) ---------------------------------
+
+
+def spmv_counts(rows: np.ndarray, block: int, nb: int
+                ) -> Optional[np.ndarray]:
+    """Per-block edge counts (pass 1 of the plan build); None if the
+    native path is unavailable."""
+    lib = load()
+    if lib is None or not getattr(lib, "_matrel_has_spmv", False):
+        return None
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    counts = np.zeros(nb, dtype=np.int64)
+    rc = lib.matrel_spmv_counts(rows, rows.shape[0], block, nb, counts)
+    return counts if rc == 0 else None
+
+
+def spmv_fill(rows: np.ndarray, cols: np.ndarray,
+              vals: Optional[np.ndarray], n_cols: int, block: int,
+              nb: int, cap: int, width: int, n_overflow: int):
+    """Pass 2: scatter edges into the padded plan tables. Returns
+    (src8, lane, off, val, ov_rows, ov_cols, ov_vals) or None."""
+    lib = load()
+    if lib is None or not getattr(lib, "_matrel_has_spmv", False):
+        return None
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    cols = np.ascontiguousarray(cols, dtype=np.int64)
+    m = rows.shape[0]
+    src8 = np.empty((nb, cap), dtype=np.int32)
+    lane = np.empty((nb, cap), dtype=np.int8)
+    off = np.empty((nb, cap), dtype=np.int32)
+    val = np.empty((nb, cap), dtype=np.float32)
+    ov_cap = max(1, n_overflow)
+    ov_r = np.empty(ov_cap, dtype=np.int64)
+    ov_c = np.empty(ov_cap, dtype=np.int64)
+    ov_v = np.empty(ov_cap, dtype=np.float32)
+    if vals is not None:
+        vals = np.ascontiguousarray(vals, dtype=np.float32)
+        vptr = vals.ctypes.data_as(ctypes.c_void_p)
+    else:
+        vptr = None
+    got = lib.matrel_spmv_fill(rows, cols, vptr, m, n_cols, block, nb,
+                               cap, width, src8.reshape(-1),
+                               lane.reshape(-1), off.reshape(-1),
+                               val.reshape(-1), ov_r, ov_c, ov_v, ov_cap)
+    if got < 0 or got != n_overflow:
+        return None
+    return (src8, lane, off, val, ov_r[:got], ov_c[:got], ov_v[:got])

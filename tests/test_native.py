@@ -236,3 +236,80 @@ class TestNativeMtxReader:
         # whole library.
         assert getattr(lib, "_matrel_has_dp", False)
         assert getattr(lib, "_matrel_has_ingest", False)
+
+
+class TestNativeSpMVPlan:
+    """spmv_plan.cc — counting-sort plan fill vs the numpy fallback.
+
+    Layouts may differ (slot order within a block), so the contract is
+    equal spmv RESULTS plus equal capacity/padding decisions.
+    """
+
+    @pytest.fixture(autouse=True)
+    def _need_spmv(self, lib):
+        if not getattr(lib, "_matrel_has_spmv", False):
+            pytest.skip("native spmv symbols unavailable")
+
+    def _both_plans(self, monkeypatch, rows, cols, vals, n_r, n_c):
+        from matrel_tpu.ops import spmv as spmv_lib
+        p_nat = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                         n_rows=n_r, n_cols=n_c)
+        monkeypatch.setattr(native, "spmv_counts", lambda *a, **k: None)
+        p_np = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=n_r, n_cols=n_c)
+        monkeypatch.undo()
+        return p_nat, p_np
+
+    def test_counts_match_bincount(self, lib):
+        rng = np.random.default_rng(0)
+        rows = rng.integers(0, 5000, 20_000).astype(np.int64)
+        got = native.spmv_counts(rows, 512, 10)
+        np.testing.assert_array_equal(got, np.bincount(rows // 512,
+                                                       minlength=10))
+
+    def test_results_match_numpy_path(self, lib, monkeypatch):
+        import jax.numpy as jnp
+        from matrel_tpu.ops import spmv as spmv_lib
+        rng = np.random.default_rng(1)
+        for n_r, n_c, m in [(2000, 1500, 25_000), (512, 512, 100),
+                            (100, 100, 0)]:
+            rows = rng.integers(0, n_r, m)
+            cols = rng.integers(0, n_c, m)
+            vals = rng.standard_normal(m).astype(np.float32)
+            x = rng.standard_normal(n_c).astype(np.float32)
+            p_nat, p_np = self._both_plans(monkeypatch, rows, cols, vals,
+                                           n_r, n_c)
+            assert p_nat.capacity == p_np.capacity
+            assert p_nat.padding_ratio == p_np.padding_ratio
+            np.testing.assert_allclose(
+                np.asarray(spmv_lib.spmv(p_nat, jnp.asarray(x))),
+                np.asarray(spmv_lib.spmv(p_np, jnp.asarray(x))),
+                rtol=2e-5, atol=1e-5)
+
+    def test_overflow_path_matches(self, lib, monkeypatch):
+        import jax.numpy as jnp
+        from matrel_tpu.ops import spmv as spmv_lib
+        rng = np.random.default_rng(2)
+        m = 20_000
+        rows = np.where(rng.random(m) < 0.3, 7,
+                        rng.integers(0, 4096, m)).astype(np.int64)
+        cols = rng.integers(0, 512, m).astype(np.int64)
+        vals = rng.standard_normal(m).astype(np.float32)
+        x = rng.standard_normal(512).astype(np.float32)
+        p_nat, p_np = self._both_plans(monkeypatch, rows, cols, vals,
+                                       4096, 512)
+        assert p_nat.ov_rows is not None and p_np.ov_rows is not None
+        assert p_nat.ov_rows.shape == p_np.ov_rows.shape
+        np.testing.assert_allclose(
+            np.asarray(spmv_lib.spmv(p_nat, jnp.asarray(x))),
+            np.asarray(spmv_lib.spmv(p_np, jnp.asarray(x))),
+            rtol=2e-4, atol=2e-4)
+
+    def test_none_vals_default_to_one(self, lib):
+        from matrel_tpu.ops import spmv as spmv_lib
+        import jax.numpy as jnp
+        plan = spmv_lib.build_spmv_plan(np.array([3, 3, 9]),
+                                        np.array([0, 1, 2]),
+                                        n_rows=16, n_cols=4)
+        y = np.asarray(spmv_lib.spmv(plan, jnp.ones(4, jnp.float32)))
+        assert y[3] == 2.0 and y[9] == 1.0

@@ -117,6 +117,19 @@ class TestSpMVPlan:
                                         n_cols=1, max_padding=4.0)
         assert plan is None
 
+    def test_out_of_bounds_indices_raise(self):
+        # both fill paths must fail loudly — a C++ truncating-division
+        # guard once let rows in (-block, 0) through silently (regression)
+        with pytest.raises(ValueError, match="out of bounds"):
+            spmv_lib.build_spmv_plan(np.array([-1, 3]), np.array([0, 1]),
+                                     n_rows=16, n_cols=4)
+        with pytest.raises(ValueError, match="out of bounds"):
+            spmv_lib.build_spmv_plan(np.array([1, 3]), np.array([0, -2]),
+                                     n_rows=16, n_cols=4)
+        with pytest.raises(ValueError, match="out of bounds"):
+            spmv_lib.build_spmv_plan(np.array([16]), np.array([0]),
+                                     n_rows=16, n_cols=4)
+
     def test_padding_ratio_reported(self):
         rng = np.random.default_rng(11)
         rows, cols, vals = random_coo(rng, 1024, 1024, 50_000)
