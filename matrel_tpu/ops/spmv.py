@@ -246,13 +246,9 @@ def _numpy_fill(rows, cols, vals, m, n_cols, block, nb, cap, cnt):
             rows_s[~in_main], cols_s[~in_main], vals_s[~in_main])
 
 
-def spmv_apply(plan_static, arrays, x: jax.Array) -> jax.Array:
-    """Traceable body: y = A·x given a plan. ``plan_static`` is the
-    (n_rows, n_cols, block) tuple; ``arrays`` is plan.arrays(). Safe to
-    call inside jit/fori_loop with the arrays as loop-invariant args."""
-    n_rows, n_cols, block = plan_static
-    src8, sel, oh_hi, oh_lo = arrays[:4]
-    x_ext = _ext_table(x.astype(jnp.float32))
+def _onehot_contrib(src8, sel, oh_hi, oh_lo, x_ext) -> jax.Array:
+    """The core contraction: flat (B·block,) partial sums for the blocks
+    these tables describe. ``x_ext`` is the width-padded 2-D table of x."""
     g = jnp.take(x_ext, src8, axis=0)                  # (B, C, W) row gather
     w = jnp.sum(g * sel, axis=-1)                      # exact f32 select
     # MXU segment-sum: batch B, contract C. bf16_3x ≈ f32 accuracy at 3
@@ -261,13 +257,81 @@ def spmv_apply(plan_static, arrays, x: jax.Array) -> jax.Array:
         oh_hi, oh_lo * w[..., None],
         (((1,), (1,)), ((0,), (0,))),
         precision=jax.lax.Precision.HIGH)              # (B, HI', LO)
-    y = contrib.reshape(-1)[:n_rows]
+    return contrib.reshape(-1)
+
+
+def _overflow_add(y, arrays, x, n_rows):
+    ov_c, ov_r, ov_v = arrays[4:]
+    w_ov = gather_1d(x.astype(jnp.float32), ov_c) * ov_v
+    return y + jax.ops.segment_sum(w_ov, ov_r, num_segments=n_rows,
+                                   indices_are_sorted=True)
+
+
+def spmv_apply(plan_static, arrays, x: jax.Array) -> jax.Array:
+    """Traceable body: y = A·x given a plan. ``plan_static`` is the
+    (n_rows, n_cols, block) tuple; ``arrays`` is plan.arrays(). Safe to
+    call inside jit/fori_loop with the arrays as loop-invariant args."""
+    n_rows, n_cols, block = plan_static
+    src8, sel, oh_hi, oh_lo = arrays[:4]
+    y = _onehot_contrib(src8, sel, oh_hi, oh_lo,
+                        _ext_table(x.astype(jnp.float32)))[:n_rows]
     if len(arrays) > 4:
-        ov_c, ov_r, ov_v = arrays[4:]
-        w_ov = gather_1d(x.astype(jnp.float32), ov_c) * ov_v
-        y = y + jax.ops.segment_sum(w_ov, ov_r, num_segments=n_rows,
-                                    indices_are_sorted=True)
+        y = _overflow_add(y, arrays, x, n_rows)
     return y
+
+
+def spmv_sharded_apply(plan_static, arrays, x: jax.Array,
+                       mesh) -> jax.Array:
+    """Traceable body for a MESH-SHARDED plan, to be called INSIDE a
+    ``shard_map`` over all of ``mesh``'s axes: ``arrays`` tables arrive as
+    per-device shards (the device's slice of destination blocks), x is
+    replicated; one tiled all_gather assembles the output. Overflow COO
+    is replicated — every device computes it identically (it is small by
+    construction)."""
+    n_rows, n_cols, block = plan_static
+    src8, sel, oh_hi, oh_lo = arrays[:4]
+    axes = tuple(mesh.axis_names)
+    y_loc = _onehot_contrib(src8, sel, oh_hi, oh_lo,
+                            _ext_table(x.astype(jnp.float32)))
+    y = jax.lax.all_gather(y_loc, axes, axis=0, tiled=True)[:n_rows]
+    if len(arrays) > 4:
+        y = _overflow_add(y, arrays, x, n_rows)
+    return y
+
+
+def shard_plan(plan: EdgeSpMVPlan, mesh) -> EdgeSpMVPlan:
+    """Row-decompose a plan over all devices of ``mesh``: the block axis
+    pads to the device count and the compact tables are placed with
+    ``P((axes...), None)`` sharding; the one-hot expansion (elementwise)
+    preserves it, so each device holds ~1/P of the ~224 B/slot tables.
+    Use with ``spmv_sharded_apply`` inside shard_map. Must be called
+    before the plan's tables are expanded."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if plan._tables is not None:
+        raise ValueError("shard_plan must run before table expansion "
+                         "(call it on a freshly built plan)")
+    axes = tuple(mesh.axis_names)
+    p = mesh.size
+    nb, cap = plan.src8.shape
+    nb_pad = -(-nb // p) * p
+    pad = nb_pad - nb
+
+    def padded(a, fill):
+        if pad == 0:
+            return np.asarray(a)
+        return np.concatenate(
+            [np.asarray(a),
+             np.full((pad, *a.shape[1:]), fill, np.asarray(a).dtype)])
+
+    sentinel8 = plan.n_cols // WIDTH
+    sh2 = NamedSharding(mesh, P(axes, None))
+    return dataclasses.replace(
+        plan,
+        src8=jax.device_put(padded(plan.src8, sentinel8), sh2),
+        lane=jax.device_put(padded(plan.lane, plan.n_cols % WIDTH), sh2),
+        off=jax.device_put(padded(plan.off, 0), sh2),
+        val=jax.device_put(padded(plan.val, 0.0), sh2))
 
 
 _spmv_jitted = jax.jit(spmv_apply, static_argnums=0)
@@ -277,3 +341,36 @@ def spmv(plan: EdgeSpMVPlan, x: jax.Array) -> jax.Array:
     """y = A·x (convenience wrapper; jit-cached per plan shape)."""
     return _spmv_jitted((plan.n_rows, plan.n_cols, plan.block),
                         plan.arrays(), x)
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_spmv_runner(plan_static, mesh, has_overflow: bool):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+    in_specs = (P(axes, None), P(axes, None, None), P(axes, None, None),
+                P(axes, None, None), P())
+    if has_overflow:
+        in_specs = in_specs + (P(), P(), P())
+
+    def kernel(src8, sel, oh_hi, oh_lo, x, *ov):
+        return spmv_sharded_apply(plan_static, (src8, sel, oh_hi, oh_lo)
+                                  + ov, x, mesh)
+
+    # check_vma=False: the tiled all_gather output is value-identical on
+    # every device but typed "varying", which the replication check
+    # cannot statically see through
+    return jax.jit(shard_map(
+        kernel, mesh=mesh, in_specs=in_specs, out_specs=P(),
+        check_vma=False))
+
+
+def spmv_sharded(plan: EdgeSpMVPlan, x: jax.Array, mesh) -> jax.Array:
+    """y = A·x over a mesh-sharded plan (see ``shard_plan``): each device
+    contracts its slice of destination blocks against the replicated x;
+    one tiled all_gather of the (n,) result rides ICI."""
+    arrays = plan.arrays()
+    run = _sharded_spmv_runner((plan.n_rows, plan.n_cols, plan.block),
+                               mesh, len(arrays) > 4)
+    return run(*arrays[:4], jnp.asarray(x, jnp.float32), *arrays[4:])

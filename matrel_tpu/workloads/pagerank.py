@@ -78,31 +78,42 @@ def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
     if impl not in ("auto", "segment", "onehot"):
         raise ValueError(f"unknown impl {impl!r}")
     if impl == "onehot":
-        if mesh is not None:
+        # explicit choice: any backend; with mesh= the sharded variant
+        # (plan tables row-decomposed over every device)
+        if not (_host_fetchable(src) and _host_fetchable(dst)):
             raise ValueError(
-                "impl='onehot' runs single-device (host-side plan build); "
-                "it cannot honour mesh=. Use impl='segment' (or 'auto') "
-                "for mesh-sharded edge arrays")
-        out = _pagerank_onehot(src, dst, n, rounds, alpha)
+                "impl='onehot' builds its plan on the host; edge arrays "
+                "sharded across non-addressable devices need "
+                "impl='segment'")
+        if mesh is not None:
+            out = _pagerank_onehot_sharded(src, dst, n, rounds, alpha,
+                                           mesh)
+        else:
+            out = _pagerank_onehot(src, dst, n, rounds, alpha)
         if out is None:
             raise ValueError(
                 "impl='onehot' requested but the graph's degree "
                 "distribution is too heavy-tailed for the one-hot plan "
                 "(build_spmv_plan refused); use impl='segment' or 'auto'")
         return out
-    if impl == "auto" and mesh is None:
+    if impl == "auto":
         # The one-hot MXU matvec (ops/spmv.py) beats segment_sum ~5× on
         # TPU; on CPU the extra one-hot FLOPs lose, so auto keeps the
         # segment path there. The plan build is host-side numpy, so
-        # mesh-sharded edge arrays (mesh=...) stay on the segment path.
-        # Falls back when the degree distribution is too heavy-tailed to
-        # pad, or when the expanded tables would exceed the HBM budget
-        # (~224 B/slot; the cap keeps auto from OOMing on huge graphs
-        # that the 8 B/edge segment path handles fine).
+        # edge arrays sharded across non-addressable (multi-host) devices
+        # stay on the segment path. Falls back when the degree
+        # distribution is too heavy-tailed to pad, or when the expanded
+        # tables would exceed the per-device HBM budget (~224 B/slot;
+        # the cap keeps auto from OOMing on huge graphs that the
+        # 8 B/edge segment path handles fine).
         on_tpu = jax.default_backend() in ("tpu", "axon")
-        if on_tpu:
-            out = _pagerank_onehot(src, dst, n, rounds, alpha,
-                                   max_slots=_PLAN_CACHE_MAX_SLOTS)
+        if on_tpu and _host_fetchable(src) and _host_fetchable(dst):
+            if mesh is not None:
+                out = _pagerank_onehot_sharded(src, dst, n, rounds,
+                                               alpha, mesh)
+            else:
+                out = _pagerank_onehot(src, dst, n, rounds, alpha,
+                                       max_slots=_PLAN_CACHE_MAX_SLOTS)
             if out is not None:
                 return out
     src = jnp.asarray(src, dtype=jnp.int32)
@@ -160,11 +171,39 @@ def run_pagerank_onehot(prepared, rounds: int = 30,
 # sampled key would silently serve a stale plan after small graph edits).
 # Callers holding device-resident edge arrays should use
 # prepare_pagerank_onehot/run_pagerank_onehot directly: a cache probe
-# pulls the arrays to host. Eviction is byte-aware: expanded one-hot
-# tables are ~224 B per padded slot, and pinning several multi-GB plans
-# would OOM a 16 GB chip; plans above the cap run uncached.
+# pulls the arrays to host. Eviction is byte-aware in PER-DEVICE slots
+# (expanded one-hot tables are ~224 B per padded slot; sharded plans
+# spread theirs over mesh.size devices): pinning several multi-GB plans
+# would OOM a 16 GB chip, and plans above the budget run uncached.
 _PLAN_CACHE: dict = {}
-_PLAN_CACHE_MAX_SLOTS = 24_000_000   # ≈5.4 GB of expanded tables
+_PLAN_CACHE_MAX_SLOTS = 24_000_000   # ≈5.4 GB of expanded tables/device
+
+
+def _host_fetchable(a) -> bool:
+    """True when np.asarray(a) is safe — numpy/lists always; jax arrays
+    only when every shard is addressable from this process."""
+    if isinstance(a, jax.Array):
+        return a.is_fully_addressable
+    return True
+
+
+def _cache_get_or_insert(key, build, per_dev_slots_of):
+    """Byte-aware cache: values are (prepared, per_dev_slots). ``build``
+    runs on a miss (may return None = refused); oversized results are
+    returned uncached."""
+    hit = _PLAN_CACHE.get(key)
+    if hit is not None:
+        return hit[0]
+    prepared = build()
+    if prepared is None:
+        return None
+    cost = per_dev_slots_of(prepared)
+    if cost <= _PLAN_CACHE_MAX_SLOTS:
+        total = sum(c for _, c in _PLAN_CACHE.values())
+        while _PLAN_CACHE and total + cost > _PLAN_CACHE_MAX_SLOTS:
+            total -= _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))[1]
+        _PLAN_CACHE[key] = (prepared, cost)
+    return prepared
 
 
 def _graph_fingerprint(src, dst, n: int) -> tuple:
@@ -188,22 +227,76 @@ def _plan_slots(prepared) -> int:
 
 def _pagerank_onehot(src, dst, n: int, rounds: int, alpha: float,
                      max_slots: int = None):
-    key = _graph_fingerprint(src, dst, n)
-    if key in _PLAN_CACHE:
-        prepared = _PLAN_CACHE[key]
-    else:
-        prepared = prepare_pagerank_onehot(src, dst, n,
-                                           max_slots=max_slots)
+    prepared = _cache_get_or_insert(
+        _graph_fingerprint(src, dst, n),
+        lambda: prepare_pagerank_onehot(src, dst, n, max_slots=max_slots),
+        _plan_slots)
+    if prepared is None:
+        return None
+    return run_pagerank_onehot(prepared, rounds, alpha)
+
+
+def _pagerank_onehot_sharded(src, dst, n: int, rounds: int, alpha: float,
+                             mesh):
+    """Multi-chip one-hot PageRank: the whole power iteration runs inside
+    ONE shard_map'd jitted program; each device owns a slice of
+    destination blocks and the round ends in a tiled all_gather of r."""
+    from matrel_tpu.ops import spmv as spmv_lib
+
+    p = mesh.size
+    key = _graph_fingerprint(src, dst, n) + (("mesh",) + tuple(
+        sorted(dict(mesh.shape).items())),)
+
+    def build():
+        prepared = prepare_pagerank_onehot(
+            src, dst, n, max_slots=_PLAN_CACHE_MAX_SLOTS * p)
         if prepared is None:
             return None
-        if _plan_slots(prepared) <= _PLAN_CACHE_MAX_SLOTS:
-            total = sum(map(_plan_slots, _PLAN_CACHE.values()))
-            while _PLAN_CACHE and total + _plan_slots(prepared) > \
-                    _PLAN_CACHE_MAX_SLOTS:
-                total -= _plan_slots(
-                    _PLAN_CACHE.pop(next(iter(_PLAN_CACHE))))
-            _PLAN_CACHE[key] = prepared
-    return run_pagerank_onehot(prepared, rounds, alpha)
+        return (spmv_lib.shard_plan(prepared[0], mesh), prepared[1])
+
+    prepared = _cache_get_or_insert(
+        key, build, lambda pr_: -(-_plan_slots(pr_) // p))
+    if prepared is None:
+        return None
+    plan, dangling = prepared
+    run = _onehot_sharded_runner(int(n), int(rounds), float(alpha),
+                                 (plan.n_rows, plan.n_cols, plan.block),
+                                 len(plan.arrays()), mesh)
+    return run(*plan.arrays(), dangling)
+
+
+@functools.lru_cache(maxsize=32)
+def _onehot_sharded_runner(n: int, rounds: int, alpha: float, plan_static,
+                           n_arrays: int, mesh):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from matrel_tpu.ops import spmv as spmv_lib
+
+    axes = tuple(mesh.axis_names)
+    in_specs = (P(axes, None), P(axes, None, None), P(axes, None, None),
+                P(axes, None, None))
+    if n_arrays > 4:
+        in_specs = in_specs + (P(), P(), P())
+    in_specs = in_specs + (P(),)          # dangling, replicated
+
+    def kernel(src8, sel, oh_hi, oh_lo, *rest):
+        ov, dangling = rest[:-1], rest[-1]
+        arrays = (src8, sel, oh_hi, oh_lo) + ov
+
+        body = _power_body(
+            lambda r: spmv_lib.spmv_sharded_apply(plan_static, arrays,
+                                                  r, mesh),
+            n, alpha, dangling)
+        r0 = _r0(n)
+        pcast = getattr(jax.lax, "pcast", None)
+        r0 = (pcast(r0, axes, to="varying") if pcast is not None
+              else jax.lax.pvary(r0, axes))
+        return jax.lax.fori_loop(0, rounds, body, r0)
+
+    # check_vma=False: see _sharded_spmv_runner — the all_gathered carry
+    # is value-identical per device but typed varying
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                             out_specs=P(), check_vma=False))
 
 
 def _power_body(matvec, n: int, alpha: float, dangling):

@@ -138,6 +138,74 @@ class TestSpMVPlan:
         assert 1.0 <= plan.padding_ratio < 2.0
 
 
+class TestShardedSpMV:
+    def test_spmv_sharded_matches_single(self, mesh8):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(8)
+        n_r, n_c, m = 8192, 4000, 60_000
+        rows = rng.integers(0, n_r, m)
+        cols = rng.integers(0, n_c, m)
+        vals = rng.standard_normal(m).astype(np.float32)
+        x = rng.standard_normal(n_c).astype(np.float32)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=n_r, n_cols=n_c)
+        want = np.asarray(spmv_lib.spmv(plan, jnp.asarray(x)))
+        plan_s = spmv_lib.shard_plan(
+            spmv_lib.build_spmv_plan(rows, cols, vals,
+                                     n_rows=n_r, n_cols=n_c), mesh8)
+        got = np.asarray(spmv_lib.spmv_sharded(plan_s, x, mesh8))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+    def test_shard_plan_shards_block_axis(self, mesh8):
+        rng = np.random.default_rng(9)
+        rows = rng.integers(0, 8192, 10_000)
+        cols = rng.integers(0, 512, 10_000)
+        plan = spmv_lib.shard_plan(
+            spmv_lib.build_spmv_plan(rows, cols, n_rows=8192, n_cols=512),
+            mesh8)
+        assert plan.src8.shape[0] % 8 == 0
+        assert len(plan.src8.sharding.device_set) == 8
+
+    def test_shard_plan_rejects_expanded(self, mesh8):
+        rng = np.random.default_rng(10)
+        plan = spmv_lib.build_spmv_plan(rng.integers(0, 1024, 1000),
+                                        rng.integers(0, 64, 1000),
+                                        n_rows=1024, n_cols=64)
+        plan.arrays()   # expand
+        with pytest.raises(ValueError, match="before table expansion"):
+            spmv_lib.shard_plan(plan, mesh8)
+
+    def test_sharded_with_overflow(self, mesh8):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(11)
+        m = 20_000
+        rows = np.where(rng.random(m) < 0.3, 7,
+                        rng.integers(0, 4096, m)).astype(np.int64)
+        cols = rng.integers(0, 512, m).astype(np.int64)
+        vals = rng.standard_normal(m).astype(np.float32)
+        x = rng.standard_normal(512).astype(np.float32)
+        plan = spmv_lib.shard_plan(
+            spmv_lib.build_spmv_plan(rows, cols, vals,
+                                     n_rows=4096, n_cols=512), mesh8)
+        assert plan.ov_rows is not None
+        got = np.asarray(spmv_lib.spmv_sharded(plan, x, mesh8))
+        want = coo_oracle(rows, cols, vals, x, 4096)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_pagerank_sharded_matches_single(self, mesh8):
+        from matrel_tpu.workloads import pagerank as pr
+        rng = np.random.default_rng(12)
+        n, m = 4000, 30_000
+        src = rng.integers(0, n, m)
+        dst = rng.integers(0, n, m)
+        # impl='onehot' + mesh = the sharded variant, on any backend
+        got = np.asarray(pr.pagerank_edges(src, dst, n, rounds=10,
+                                           mesh=mesh8, impl="onehot"))
+        want = np.asarray(pr.pagerank_edges(src, dst, n, rounds=10,
+                                            impl="onehot"))
+        np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-10)
+
+
 class TestPageRankOneHot:
     def test_matches_segment_impl_and_oracle(self):
         from matrel_tpu.workloads.pagerank import (
