@@ -6,6 +6,7 @@ Commands:
   serve [--port P]      run the JSON-RPC bridge server
   sql "<query>" [--table name=path.npy ...]   one-shot SQL query
   autotune N [K M]      time every matmul strategy for the given dims
+  pagerank PATH         PageRank over a .mtx adjacency or src,dst CSV
 """
 
 from __future__ import annotations
@@ -66,6 +67,29 @@ def cmd_autotune(args):
                      indent=2))
 
 
+def cmd_pagerank(args):
+    import numpy as np
+    from matrel_tpu import io as mio
+    from matrel_tpu.workloads.pagerank import pagerank_edges
+    if args.path.endswith(".mtx"):
+        A = mio.load_mtx_coo(args.path)
+        src, dst, w, n = A.rows, A.cols, A.vals, max(A.shape)
+    else:  # 'src,dst[,w]' CSV / edge list (weight defaults to 1)
+        src, dst, w = mio.read_edges_csv(args.path)
+        n = int(max(src.max(), dst.max())) + 1
+    if np.all(w == 1.0):
+        w = None                      # unweighted fast path
+    ranks = np.asarray(pagerank_edges(src, dst, int(n), rounds=args.rounds,
+                                      alpha=args.alpha, weights=w))
+    top = np.argsort(ranks)[::-1][:args.top]
+    print(json.dumps({
+        "nodes": int(n), "edges": int(len(src)),
+        "rounds": args.rounds,
+        "top": [{"node": int(i), "rank": float(ranks[i])} for i in top],
+        "rank_sum": float(ranks.sum()),
+    }, indent=2))
+
+
 def main(argv=None):
     import os
     if os.environ.get("JAX_PLATFORMS"):
@@ -90,6 +114,12 @@ def main(argv=None):
     sa.add_argument("k", type=int, nargs="?")
     sa.add_argument("m", type=int, nargs="?")
     sa.set_defaults(fn=cmd_autotune)
+    pr = sub.add_parser("pagerank")
+    pr.add_argument("path", help=".mtx adjacency or 'src,dst' CSV edges")
+    pr.add_argument("--rounds", type=int, default=30)
+    pr.add_argument("--alpha", type=float, default=0.85)
+    pr.add_argument("--top", type=int, default=10)
+    pr.set_defaults(fn=cmd_pagerank)
     args = p.parse_args(argv)
     args.fn(args)
 

@@ -58,19 +58,45 @@ def load_mtx(path: str, mesh=None, block_size: Optional[int] = None,
                                         mesh=mesh, config=config)
 
 
+def load_mtx_coo(path: str):
+    """MatrixMarket coordinate file → element-sparse ``COOMatrix``.
+
+    The right loader for graph-shaped sparsity (densities that touch
+    every 512² tile — block-sparse densification would explode); the
+    matrix compiles into the one-hot MXU SpMV plan on first matvec.
+    Native C++ parse when built, scipy fallback otherwise."""
+    from matrel_tpu.core.coo import COOMatrix
+
+    parsed = native.mtx_read(path)
+    if parsed is not None:
+        shape, rows, cols, vals = parsed
+        return COOMatrix.from_edges(rows, cols, vals.astype(np.float32),
+                                    shape=shape)
+    import scipy.io
+    return COOMatrix.from_scipy(scipy.io.mmread(path))
+
+
+def read_edges_csv(path: str):
+    """Raw 'i,j[,value]' triples → (rows, cols, vals) host arrays; the
+    value column defaults to 1.0. Native C parser when built, numpy
+    fallback otherwise. Shared by ``load_coo_csv`` and the CLI."""
+    parsed = native.coo_csv_read(path)
+    if parsed is not None:
+        rows, cols, v64 = parsed
+        return rows, cols, v64.astype(np.float32)
+    data = np.loadtxt(path, delimiter=",", ndmin=2)
+    rows = data[:, 0].astype(np.int64)
+    cols = data[:, 1].astype(np.int64)
+    vals = (data[:, 2].astype(np.float32) if data.shape[1] > 2
+            else np.ones(len(rows), np.float32))
+    return rows, cols, vals
+
+
 def load_coo_csv(path: str, shape: Tuple[int, int], mesh=None,
                  block_size: Optional[int] = None, dense: bool = False,
                  config: Optional[MatrelConfig] = None):
     """'i,j,value' triples (the reference's text ingestion format)."""
-    parsed = native.coo_csv_read(path)
-    if parsed is not None:
-        rows, cols, v64 = parsed
-        vals = v64.astype(np.float32)
-    else:
-        data = np.loadtxt(path, delimiter=",", ndmin=2)
-        rows = data[:, 0].astype(np.int64)
-        cols = data[:, 1].astype(np.int64)
-        vals = data[:, 2].astype(np.float32)
+    rows, cols, vals = read_edges_csv(path)
     if dense:
         out = np.zeros(shape, dtype=np.float32)
         np.add.at(out, (rows, cols), vals)

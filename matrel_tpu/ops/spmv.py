@@ -96,10 +96,10 @@ class EdgeSpMVPlan:
     n_cols: int
     block: int
     capacity: int
-    src8: jax.Array
-    lane: jax.Array
-    off: jax.Array
-    val: jax.Array
+    src8: "np.ndarray | jax.Array"    # host until expansion/shard_plan
+    lane: Optional["np.ndarray | jax.Array"]
+    off: Optional["np.ndarray | jax.Array"]
+    val: Optional["np.ndarray | jax.Array"]
     ov_cols: Optional[jax.Array]
     ov_rows: Optional[jax.Array]
     ov_vals: Optional[jax.Array]
@@ -109,13 +109,17 @@ class EdgeSpMVPlan:
     def arrays(self):
         """Flat device-array tuple for passing through jit boundaries.
         First call expands the one-hot tables on device (one fused jitted
-        program; ~130 MB shipped instead of ~2.4 GB)."""
+        program; ~130 MB shipped instead of ~2.4 GB). The compact tables
+        stay HOST numpy until then, so ``shard_plan`` can place them
+        sharded without ever materialising on a single device."""
         if self._tables is None:
+            self.src8 = jnp.asarray(self.src8)   # no-op if pre-placed
             sel, oh_hi, oh_lo = _expand_tables(self.block // LO)(
-                self.src8, self.lane, self.off, self.val)
+                self.src8, jnp.asarray(self.lane), jnp.asarray(self.off),
+                jnp.asarray(self.val))
             self._tables = (self.src8, sel, oh_hi, oh_lo)
             # the compact arrays are never read again once expanded —
-            # drop them so ~9 B/slot of HBM isn't pinned by the plan
+            # drop them so ~9 B/slot isn't pinned by the plan
             self.lane = self.off = self.val = None
         ov = () if self.ov_cols is None else (self.ov_cols, self.ov_rows,
                                               self.ov_vals)
@@ -210,12 +214,14 @@ def build_spmv_plan(rows, cols, vals=None, n_rows: int = None,
     else:
         ov_c = ov_r = ov_v = None
 
+    # compact tables stay host-side numpy; they move to device (default
+    # placement or sharded via shard_plan) at expansion time
     return EdgeSpMVPlan(
         n_rows=n_rows, n_cols=n_cols, block=block, capacity=cap,
-        src8=jnp.asarray(src8, jnp.int32),
-        lane=jnp.asarray(lane, jnp.int8),
-        off=jnp.asarray(off, jnp.int32),
-        val=jnp.asarray(val, jnp.float32),
+        src8=np.ascontiguousarray(src8, np.int32),
+        lane=np.ascontiguousarray(lane, np.int8),
+        off=np.ascontiguousarray(off, np.int32),
+        val=np.ascontiguousarray(val, np.float32),
         ov_cols=ov_c, ov_rows=ov_r, ov_vals=ov_v,
         padding_ratio=(nb * cap + n_ov) / max(m, 1))
 
@@ -343,16 +349,25 @@ def spmv(plan: EdgeSpMVPlan, x: jax.Array) -> jax.Array:
                         plan.arrays(), x)
 
 
+def sharded_table_specs(axes, n_arrays: int):
+    """PartitionSpecs for plan.arrays() under the row decomposition:
+    the four tables sharded on the block axis, overflow COO replicated."""
+    from jax.sharding import PartitionSpec as P
+    specs = (P(axes, None), P(axes, None, None), P(axes, None, None),
+             P(axes, None, None))
+    if n_arrays > 4:
+        specs = specs + (P(), P(), P())
+    return specs
+
+
 @functools.lru_cache(maxsize=32)
 def _sharded_spmv_runner(plan_static, mesh, has_overflow: bool):
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     axes = tuple(mesh.axis_names)
-    in_specs = (P(axes, None), P(axes, None, None), P(axes, None, None),
-                P(axes, None, None), P())
-    if has_overflow:
-        in_specs = in_specs + (P(), P(), P())
+    table_specs = sharded_table_specs(axes, 7 if has_overflow else 4)
+    in_specs = table_specs[:4] + (P(),) + table_specs[4:]  # x after tables
 
     def kernel(src8, sel, oh_hi, oh_lo, x, *ov):
         return spmv_sharded_apply(plan_static, (src8, sel, oh_hi, oh_lo)

@@ -62,7 +62,8 @@ def pagerank(A: BlockMatrix, rounds: int = 30, alpha: float = 0.85,
 
 def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
                    rounds: int = 30, alpha: float = 0.85,
-                   mesh=None, impl: str = "auto") -> jax.Array:
+                   mesh=None, impl: str = "auto",
+                   weights=None) -> jax.Array:
     """PageRank over an edge list — the BASELINE row-5 scale (1M nodes).
 
     A dense or block-sparse 1M×1M adjacency is off the table (4 TB dense;
@@ -87,9 +88,11 @@ def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
                 "impl='segment'")
         if mesh is not None:
             out = _pagerank_onehot_sharded(src, dst, n, rounds, alpha,
-                                           mesh)
+                                           mesh, max_slots=None,
+                                           weights=weights)
         else:
-            out = _pagerank_onehot(src, dst, n, rounds, alpha)
+            out = _pagerank_onehot(src, dst, n, rounds, alpha,
+                                   weights=weights)
         if out is None:
             raise ValueError(
                 "impl='onehot' requested but the graph's degree "
@@ -109,38 +112,52 @@ def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
         on_tpu = jax.default_backend() in ("tpu", "axon")
         if on_tpu and _host_fetchable(src) and _host_fetchable(dst):
             if mesh is not None:
-                out = _pagerank_onehot_sharded(src, dst, n, rounds,
-                                               alpha, mesh)
+                out = _pagerank_onehot_sharded(
+                    src, dst, n, rounds, alpha, mesh,
+                    max_slots=_PLAN_CACHE_MAX_SLOTS * mesh.size,
+                    weights=weights)
             else:
                 out = _pagerank_onehot(src, dst, n, rounds, alpha,
-                                       max_slots=_PLAN_CACHE_MAX_SLOTS)
+                                       max_slots=_PLAN_CACHE_MAX_SLOTS,
+                                       weights=weights)
             if out is not None:
                 return out
     src = jnp.asarray(src, dtype=jnp.int32)
     dst = jnp.asarray(dst, dtype=jnp.int32)
+    w = (jnp.ones_like(src, dtype=jnp.float32) if weights is None
+         else jnp.asarray(weights, jnp.float32))
     prepare, run = _edges_runner(int(n), int(rounds), float(alpha))
-    src, dst = prepare(src, dst)
-    return run(src, dst)
+    src, dst, w = prepare(src, dst, w)
+    return run(src, dst, w)
 
 
-def prepare_pagerank_onehot(src, dst, n: int, max_slots: int = None):
+def prepare_pagerank_onehot(src, dst, n: int, max_slots: int = None,
+                            weights=None):
     """Build the one-hot SpMV plan for a graph (ops/spmv.py), reusable
     across pagerank runs — plan construction is the expensive, per-graph
     step (host sort + pad, one device table expansion).
 
-    The contribution matvec is contrib = Âᵀ·r with Â[i,j] = 1/outdeg[i]
-    for each edge i→j — so the plan is rows=dst, cols=src, vals=1/outdeg
-    [src]; the normalisation rides the gather-select table for free.
-    Returns (plan, dangling_mask), or None when the plan refuses the
-    graph (heavy-tailed padding).
+    The contribution matvec is contrib = Âᵀ·r with Â[i,j] = w_ij/outdeg_w
+    [i] for each edge i→j (w ≡ 1 unweighted) — so the plan is rows=dst,
+    cols=src, vals=w/outdeg_w[src]; the normalisation rides the
+    gather-select table for free. Returns (plan, dangling_mask), or None
+    when the plan refuses the graph (heavy-tailed padding).
     """
     from matrel_tpu.ops import spmv as spmv_lib
 
     src_np = np.asarray(src, dtype=np.int64)
     dst_np = np.asarray(dst, dtype=np.int64)
-    outdeg = np.bincount(src_np, minlength=n).astype(np.float32)
-    inv = np.where(outdeg > 0, 1.0 / np.maximum(outdeg, 1.0), 0.0)
-    plan = spmv_lib.build_spmv_plan(dst_np, src_np, vals=inv[src_np],
+    if weights is None:
+        w = np.ones(src_np.shape, np.float32)
+    else:
+        w = np.asarray(weights, dtype=np.float32)
+    outdeg = np.bincount(src_np, weights=w,
+                         minlength=n).astype(np.float32)
+    # epsilon (not 1.0) floor: weighted out-masses below 1 must not be
+    # clamped or the ranks skew (same rationale as pagerank_block_sparse)
+    inv = np.where(outdeg > 0, 1.0 / np.maximum(outdeg, 1e-30), 0.0)
+    plan = spmv_lib.build_spmv_plan(dst_np, src_np,
+                                    vals=w * inv[src_np],
                                     n_rows=n, n_cols=n,
                                     max_slots=max_slots)
     if plan is None:
@@ -206,7 +223,7 @@ def _cache_get_or_insert(key, build, per_dev_slots_of):
     return prepared
 
 
-def _graph_fingerprint(src, dst, n: int) -> tuple:
+def _graph_fingerprint(src, dst, n: int, weights=None) -> tuple:
     import hashlib
     h = hashlib.blake2b(digest_size=16)
     sizes = []
@@ -217,7 +234,10 @@ def _graph_fingerprint(src, dst, n: int) -> tuple:
         a = np.ascontiguousarray(np.asarray(a, dtype=np.int32))
         h.update(a.tobytes())
         sizes.append(a.shape[0])
-    return (n, tuple(sizes), h.hexdigest())
+    if weights is not None:
+        h.update(np.ascontiguousarray(
+            np.asarray(weights, dtype=np.float32)).tobytes())
+    return (n, tuple(sizes), weights is not None, h.hexdigest())
 
 
 def _plan_slots(prepared) -> int:
@@ -226,10 +246,11 @@ def _plan_slots(prepared) -> int:
 
 
 def _pagerank_onehot(src, dst, n: int, rounds: int, alpha: float,
-                     max_slots: int = None):
+                     max_slots: int = None, weights=None):
     prepared = _cache_get_or_insert(
-        _graph_fingerprint(src, dst, n),
-        lambda: prepare_pagerank_onehot(src, dst, n, max_slots=max_slots),
+        _graph_fingerprint(src, dst, n, weights),
+        lambda: prepare_pagerank_onehot(src, dst, n, max_slots=max_slots,
+                                        weights=weights),
         _plan_slots)
     if prepared is None:
         return None
@@ -237,19 +258,21 @@ def _pagerank_onehot(src, dst, n: int, rounds: int, alpha: float,
 
 
 def _pagerank_onehot_sharded(src, dst, n: int, rounds: int, alpha: float,
-                             mesh):
+                             mesh, max_slots: int = None, weights=None):
     """Multi-chip one-hot PageRank: the whole power iteration runs inside
     ONE shard_map'd jitted program; each device owns a slice of
     destination blocks and the round ends in a tiled all_gather of r."""
     from matrel_tpu.ops import spmv as spmv_lib
 
     p = mesh.size
-    key = _graph_fingerprint(src, dst, n) + (("mesh",) + tuple(
-        sorted(dict(mesh.shape).items())),)
+    # Mesh is hashable and identity-precise: same-shaped meshes over
+    # different devices must not share cached (device-committed) plans
+    key = _graph_fingerprint(src, dst, n, weights) + (mesh,)
 
     def build():
-        prepared = prepare_pagerank_onehot(
-            src, dst, n, max_slots=_PLAN_CACHE_MAX_SLOTS * p)
+        prepared = prepare_pagerank_onehot(src, dst, n,
+                                           max_slots=max_slots,
+                                           weights=weights)
         if prepared is None:
             return None
         return (spmv_lib.shard_plan(prepared[0], mesh), prepared[1])
@@ -273,10 +296,7 @@ def _onehot_sharded_runner(n: int, rounds: int, alpha: float, plan_static,
     from matrel_tpu.ops import spmv as spmv_lib
 
     axes = tuple(mesh.axis_names)
-    in_specs = (P(axes, None), P(axes, None, None), P(axes, None, None),
-                P(axes, None, None))
-    if n_arrays > 4:
-        in_specs = in_specs + (P(), P(), P())
+    in_specs = spmv_lib.sharded_table_specs(axes, n_arrays)
     in_specs = in_specs + (P(),)          # dangling, replicated
 
     def kernel(src8, sel, oh_hi, oh_lo, *rest):
@@ -337,22 +357,22 @@ def _edges_runner(n: int, rounds: int, alpha: float):
     call would recompile on every invocation."""
 
     @jax.jit
-    def prepare(s, d):
+    def prepare(s, d, w):
         # sort edges by destination once so the per-round scatter-add runs
         # with indices_are_sorted (much cheaper on TPU)
         order = jnp.argsort(d)
-        return s[order], d[order]
+        return s[order], d[order], w[order]
 
     @jax.jit
-    def run(s, d):
-        ones = jnp.ones_like(s, dtype=jnp.float32)
-        outdeg = jax.ops.segment_sum(ones, s, num_segments=n)
-        inv_deg = jnp.where(outdeg > 0, 1.0 / jnp.maximum(outdeg, 1.0), 0.0)
+    def run(s, d, w):
+        outdeg = jax.ops.segment_sum(w, s, num_segments=n)
+        inv_deg = jnp.where(outdeg > 0,
+                            1.0 / jnp.maximum(outdeg, 1e-30), 0.0)
         dangling = (outdeg == 0).astype(jnp.float32)
 
         def matvec(r):
-            w = r * inv_deg
-            return jax.ops.segment_sum(w[s], d, num_segments=n,
+            rn = r * inv_deg
+            return jax.ops.segment_sum(rn[s] * w, d, num_segments=n,
                                        indices_are_sorted=True)
 
         body = _power_body(matvec, n, alpha, dangling)

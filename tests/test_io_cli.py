@@ -43,6 +43,28 @@ class TestIO:
         s = mio.load_mtx(p, mesh=mesh8, block_size=4)
         np.testing.assert_allclose(s.to_numpy(), dense, rtol=1e-6)
 
+    def test_mtx_coo(self, mesh8, rng, tmp_path):
+        import scipy.io, scipy.sparse
+        r = rng.integers(0, 300, 2000)
+        c = rng.integers(0, 200, 2000)
+        v = rng.standard_normal(2000).astype(np.float32)
+        S = scipy.sparse.coo_matrix((v, (r, c)), shape=(300, 200))
+        p = str(tmp_path / "g.mtx")
+        scipy.io.mmwrite(p, S)
+        A = mio.load_mtx_coo(p)
+        assert A.shape == (300, 200)
+        x = rng.standard_normal(200).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(A.matvec(x)),
+                                   S.tocsr() @ x, rtol=3e-4, atol=3e-4)
+        # symmetric file: native reader must expand the mirror entries
+        Ssym = scipy.sparse.coo_matrix(
+            np.array([[2.0, 1.0, 0], [1.0, 0, 0], [0, 0, 3.0]],
+                     np.float32))
+        p2 = str(tmp_path / "sym.mtx")
+        scipy.io.mmwrite(p2, Ssym, symmetry="symmetric")
+        B = mio.load_mtx_coo(p2)
+        np.testing.assert_allclose(B.to_dense(), Ssym.toarray())
+
     def test_tiled_roundtrip(self, mesh8, rng, tmp_path):
         a = rng.standard_normal((20, 13)).astype(np.float32)
         m = BlockMatrix.from_numpy(a, mesh=mesh8)
@@ -79,6 +101,19 @@ class TestCLI:
         assert r.returncode == 0, r.stderr
         out = json.loads(r.stdout)
         assert out["backend"] == "cpu" and "mesh" in out
+
+    def test_pagerank_cli(self, tmp_path, capsys):
+        import json
+        from matrel_tpu.__main__ import main
+        p = str(tmp_path / "edges.csv")
+        with open(p, "w") as f:
+            # star graph into node 0 + a 1->2 edge
+            f.write("1,0,1\n2,0,1\n3,0,1\n1,2,1\n")
+        main(["pagerank", p, "--rounds", "20", "--top", "2"])
+        out = json.loads(capsys.readouterr().out)
+        assert out["nodes"] == 4 and out["edges"] == 4
+        assert out["top"][0]["node"] == 0          # the hub wins
+        assert abs(out["rank_sum"] - 1.0) < 1e-3
 
     def test_sql_oneshot(self, tmp_path):
         p = str(tmp_path / "x.npy")

@@ -237,6 +237,25 @@ class TestPageRankOneHot:
         with pytest.raises(ValueError, match="heavy-tailed"):
             pagerank_edges(src, dst, n, rounds=2, impl="onehot")
 
+    def test_weighted_edges_match_oracle(self):
+        from matrel_tpu.workloads.pagerank import (
+            pagerank_edges, pagerank_numpy_oracle)
+        rng = np.random.default_rng(21)
+        n, m = 800, 6000
+        a = np.zeros((n, n), np.float32)
+        src = rng.integers(0, n, m)
+        dst = rng.integers(0, n, m)
+        w = rng.random(m).astype(np.float32) + 0.1
+        np.add.at(a, (src, dst), w)
+        s2, d2 = np.nonzero(a)
+        w2 = a[s2, d2]
+        want = pagerank_numpy_oracle(a, rounds=15).ravel()
+        for impl in ("onehot", "segment"):
+            got = np.asarray(pagerank_edges(s2, d2, n, rounds=15,
+                                            impl=impl, weights=w2))
+            np.testing.assert_allclose(got, want, rtol=2e-3, atol=1e-9,
+                                       err_msg=impl)
+
     def test_dangling_nodes(self):
         # node 3 has no out-edges; its mass must redistribute
         src = np.array([0, 1, 2, 0])
