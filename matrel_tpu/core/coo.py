@@ -167,3 +167,25 @@ class COOMatrix:
         out = np.zeros(self.shape, np.float32)
         np.add.at(out, (self.rows, self.cols), self.vals)
         return out
+
+    def to_block(self, mesh=None, config=None):
+        """Densify into a mesh-sharded BlockMatrix — the fallback when a
+        COO matrix is used where no SpMV lowering applies. O(n·m) memory:
+        meant for modest shapes; keep giant graphs on matvec/matmat."""
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        return BlockMatrix.from_numpy(self.to_dense(), mesh=mesh,
+                                      config=config, nnz=self.nnz)
+
+    # ------------------------------------------------------------ DSL
+    def expr(self):
+        """Enter the lazy IR as an element-sparse leaf: matmuls against
+        narrow dense operands lower to the one-hot SpMV plan; other uses
+        densify (see executor)."""
+        from matrel_tpu.ir import expr as E
+        return E.MatExpr("coo_leaf", (), tuple(self.shape),
+                         min(self.nnz, self.shape[0] * self.shape[1]),
+                         {"matrix": self})
+
+    def multiply(self, other):
+        from matrel_tpu.ir import expr as E
+        return E.matmul(self.expr(), E.as_expr(other))

@@ -111,6 +111,11 @@ class Lowerer:
             # densify when a sparse matrix is used outside a matmul; the
             # SpMM fast path handles the matmul case below
             return node.attrs["matrix"].to_dense(self.config).data
+        if k == "coo_leaf":
+            # same densify fallback for element-sparse leaves; matmuls
+            # take the one-hot SpMV path in _matmul
+            return node.attrs["matrix"].to_block(self.mesh,
+                                                 self.config).data
         if k == "transpose":
             return ev(node.children[0]).T
         if k == "matmul":
@@ -172,8 +177,51 @@ class Lowerer:
                                 (0, pshape[1] - out.shape[1])))
         return out
 
+    def _pad_to_node(self, out: Array, node: MatExpr) -> Array:
+        pshape = padding.padded_shape(node.shape, self.mesh)
+        return jnp.pad(out, ((0, pshape[0] - out.shape[0]),
+                             (0, pshape[1] - out.shape[1])))
+
+    @staticmethod
+    def _coo_spmv_stack(plan, vectors) -> Array:
+        """Stack SpMV results for a sequence of input vectors (columns of
+        the dense operand); plan arrays ride the trace as constants, like
+        the sparse tile stacks."""
+        from matrel_tpu.ops import spmv as spmv_lib
+        static = (plan.n_rows, plan.n_cols, plan.block)
+        arrays = plan.arrays()
+        return jnp.stack([spmv_lib.spmv_apply(static, arrays, x)
+                          for x in vectors], axis=1)
+
     def _matmul(self, node: MatExpr, ev) -> Array:
         l, r = node.children
+        # coo_leaf matmuls: per-column one-hot SpMV for narrow dense
+        # operands; wide ones (or refused plans) densify — at that point
+        # the MXU over a dense block layout beats serialized matvecs.
+        if l.kind == "coo_leaf":
+            A, k = l.attrs["matrix"], r.shape[1]
+            plan = A._get_plan() if 0 < k <= 128 else None
+            if plan is None:
+                blk = A.to_block(self.mesh, self.config).data
+                return strategies.run_matmul("xla", blk, ev(r), self.mesh,
+                                             self.config)
+            dense = ev(r)
+            out = self._coo_spmv_stack(
+                plan, [dense[: A.shape[1], j] for j in range(k)])
+            return self._pad_to_node(out, node)
+        if r.kind == "coo_leaf":
+            # A·S = (Sᵀ·Aᵀ)ᵀ — use the original matrix's cached
+            # transpose plan (_get_plan_t), built at most once
+            S, k = r.attrs["matrix"], l.shape[0]
+            plan = S._get_plan_t() if 0 < k <= 128 else None
+            if plan is None:
+                blk = S.to_block(self.mesh, self.config).data
+                return strategies.run_matmul("xla", ev(l), blk, self.mesh,
+                                             self.config)
+            a = ev(l)
+            out = self._coo_spmv_stack(
+                plan, [a[i, : l.shape[1]] for i in range(k)]).T
+            return self._pad_to_node(out, node)
         if l.kind == "sparse_leaf":
             from matrel_tpu.ops import spmm as spmm_lib
             return spmm_lib.apply(l.attrs["matrix"], ev(r), r.shape,

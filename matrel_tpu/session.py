@@ -118,12 +118,12 @@ def _plan_key(e: MatExpr) -> str:
             m = n.attrs["matrix"]
             parts.append(f"leaf:{id(m)}:{m.shape}:{m.spec}")
             return
-        if n.kind == "sparse_leaf":
-            # sparse tile stacks are captured as CONSTANTS in the compiled
+        if n.kind in ("sparse_leaf", "coo_leaf"):
+            # sparse payloads are captured as CONSTANTS in the compiled
             # program — the cache key must carry the matrix identity or two
             # same-shaped sparse matrices would share one plan
             m = n.attrs["matrix"]
-            parts.append(f"sparse_leaf:{id(m)}:{m.shape}")
+            parts.append(f"{n.kind}:{id(m)}:{m.shape}")
             return
         attrs = {k: v for k, v in sorted(n.attrs.items())
                  if isinstance(v, (int, float, str, bool))}

@@ -104,3 +104,69 @@ class TestOps:
         A = COOMatrix.from_edges([], [], shape=(10, 10))
         np.testing.assert_array_equal(np.asarray(A.matvec(np.ones(10))),
                                       np.zeros(10))
+
+
+class TestDSLIntegration:
+    """coo_leaf in the IR: SpMV lowering for matmuls, densify elsewhere."""
+
+    def test_left_multiply_via_dsl(self, rng):
+        from matrel_tpu import execute
+        r, c, v = random_coo(rng, 700, 500, 6000)
+        A = COOMatrix.from_edges(r, c, v, shape=(700, 500))
+        x = rng.standard_normal((500, 3)).astype(np.float32)
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        X = BlockMatrix.from_numpy(x)
+        out = execute(A.multiply(X.expr()))
+        want = A.to_dense() @ x
+        np.testing.assert_allclose(out.to_numpy(), want, rtol=3e-4,
+                                   atol=3e-4)
+
+    def test_right_multiply_via_dsl(self, rng):
+        from matrel_tpu import execute
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.ir import expr as E
+        r, c, v = random_coo(rng, 400, 600, 5000)
+        S = COOMatrix.from_edges(r, c, v, shape=(400, 600))
+        a = rng.standard_normal((5, 400)).astype(np.float32)
+        A = BlockMatrix.from_numpy(a)
+        out = execute(E.matmul(A.expr(), S.expr()))
+        want = a @ S.to_dense()
+        np.testing.assert_allclose(out.to_numpy(), want, rtol=3e-4,
+                                   atol=3e-4)
+
+    def test_wide_rhs_takes_densify_fallback(self, rng):
+        from matrel_tpu import execute
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        r, c, v = random_coo(rng, 200, 150, 2000)
+        A = COOMatrix.from_edges(r, c, v, shape=(200, 150))
+        x = rng.standard_normal((150, 200)).astype(np.float32)  # k > 128
+        out = execute(A.multiply(BlockMatrix.from_numpy(x).expr()))
+        np.testing.assert_allclose(out.to_numpy(), A.to_dense() @ x,
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_non_matmul_use_densifies(self, rng):
+        from matrel_tpu import execute
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.ir import expr as E
+        r, c, v = random_coo(rng, 64, 64, 500)
+        A = COOMatrix.from_edges(r, c, v, shape=(64, 64))
+        b = rng.standard_normal((64, 64)).astype(np.float32)
+        B = BlockMatrix.from_numpy(b)
+        out = execute(E.elemwise("add", A.expr(), B.expr()))
+        np.testing.assert_allclose(out.to_numpy(), A.to_dense() + b,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_chained_with_aggregation(self, rng):
+        # rowSum(S·x) exercises rewrite rules over a coo_leaf tree
+        from matrel_tpu import execute
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.ir import expr as E
+        r, c, v = random_coo(rng, 300, 250, 3000)
+        S = COOMatrix.from_edges(r, c, v, shape=(300, 250))
+        x = rng.standard_normal((250, 4)).astype(np.float32)
+        expr = E.agg(S.multiply(BlockMatrix.from_numpy(x).expr()),
+                     "sum", "row")
+        out = execute(expr)
+        want = (S.to_dense() @ x).sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(out.to_numpy(), want, rtol=3e-4,
+                                   atol=3e-4)
