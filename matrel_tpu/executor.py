@@ -413,12 +413,7 @@ class CompiledPlan:
             m = bound if bound is not None else l.attrs["matrix"]
             arrays.append(m.data)
         if donate and donated and self.config.donate_intermediates:
-            key = tuple(donated)
-            jfn = self._donating.get(key)
-            if jfn is None:
-                jfn = jax.jit(self.jitted.__wrapped__, donate_argnums=key)
-                self._donating[key] = jfn
-            out = jfn(*arrays)
+            out = self._donating_fn(tuple(donated))(*arrays)
         else:
             out = self.jitted(*arrays)
         return BlockMatrix.from_array(
@@ -426,6 +421,49 @@ class CompiledPlan:
             padding.canonical_spec(tuple(out.shape), self.mesh),
             nnz=self.optimized.nnz,
         )
+
+    def bound_runner(self, rebind_uids: tuple = (), donate: bool = False):
+        """Low-overhead repeated-execution path for iteration loops (the
+        analogue of re-executing a compiled plan across RDD iterations).
+
+        Precomputes the leaf layout ONCE and returns ``fn(*arrays) ->
+        jax.Array``: positional raw padded arrays for the leaves named in
+        ``rebind_uids`` (in that order), raw padded output — none of
+        ``run``'s per-call dict walking, spec derivation or BlockMatrix
+        wrapping. With donate=True the rebound buffers are donated
+        (C←f(C) patterns run with input/output aliasing).
+        """
+        uid_pos = {l.uid: i for i, l in enumerate(self.leaf_order)}
+        positions = [uid_pos[u] for u in rebind_uids]
+        base = [l.attrs["matrix"].data for l in self.leaf_order]
+        if donate and positions and self.config.donate_intermediates:
+            jfn = self._donating_fn(tuple(sorted(positions)))
+        else:
+            jfn = self.jitted
+
+        if not positions:
+            return lambda: jfn(*base)
+
+        def call(*arrays):
+            if len(arrays) != len(positions):
+                raise ValueError(
+                    f"bound runner expects {len(positions)} rebound "
+                    f"array(s), got {len(arrays)}")
+            argv = list(base)
+            for p, a in zip(positions, arrays):
+                argv[p] = a
+            return jfn(*argv)
+
+        return call
+
+    def _donating_fn(self, key: tuple):
+        """Cached donating variant of the jitted program (key = sorted
+        donated argument positions)."""
+        jfn = self._donating.get(key)
+        if jfn is None:
+            jfn = jax.jit(self.jitted.__wrapped__, donate_argnums=key)
+            self._donating[key] = jfn
+        return jfn
 
     def hlo(self) -> str:
         """Optimized HLO text — for plan-shape assertions on collectives."""

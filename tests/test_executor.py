@@ -167,3 +167,64 @@ class TestBf16Pipeline:
         b = bm(rng.standard_normal((8, 8)).astype(np.float32), mesh_square)
         with pytest.raises(ValueError, match="mesh"):
             compile_expr(a.expr().multiply(b.expr()))
+
+
+class TestBoundRunner:
+    def test_matches_run_and_rebinds(self, mesh8, rng):
+        from matrel_tpu.executor import compile_expr
+        a = rng.standard_normal((24, 24)).astype(np.float32)
+        b = rng.standard_normal((24, 24)).astype(np.float32)
+        A, B = bm(a, mesh8), bm(b, mesh8)
+        plan = compile_expr(A.expr().multiply(B.expr()), mesh8)
+        a_leaf = plan.leaf_order[0]
+        step = plan.bound_runner(rebind_uids=(a_leaf.uid,))
+        cur = step(A.data)                    # A·B
+        np.testing.assert_allclose(np.asarray(cur)[:24, :24], a @ b,
+                                   rtol=1e-4, atol=1e-4)
+        cur = step(cur)                       # (A·B)·B
+        np.testing.assert_allclose(np.asarray(cur)[:24, :24], a @ b @ b,
+                                   rtol=1e-4, atol=1e-3)
+        # parity with the general run() path
+        got = plan.run(bindings={a_leaf.uid: plan.run()}).to_numpy()
+        np.testing.assert_allclose(np.asarray(cur)[:24, :24], got,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_no_rebind_closure(self, mesh8, rng):
+        from matrel_tpu.executor import compile_expr
+        a = rng.standard_normal((16, 16)).astype(np.float32)
+        A = bm(a, mesh8)
+        plan = compile_expr(A.expr().multiply(A.expr().t()), mesh8)
+        fixed = plan.bound_runner()
+        np.testing.assert_allclose(np.asarray(fixed())[:16, :16], a @ a.T,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_unknown_uid_raises(self, mesh8, rng):
+        from matrel_tpu.executor import compile_expr
+        A = bm(rng.standard_normal((8, 8)).astype(np.float32), mesh8)
+        plan = compile_expr(A.expr().multiply(A.expr()), mesh8)
+        with pytest.raises(KeyError):
+            plan.bound_runner(rebind_uids=(999999,))
+
+    def test_donate_chain(self, mesh8, rng):
+        from matrel_tpu.executor import compile_expr
+        a = rng.standard_normal((16, 16)).astype(np.float32)
+        b = rng.standard_normal((16, 16)).astype(np.float32)
+        A, B = bm(a, mesh8), bm(b, mesh8)
+        plan = compile_expr(A.expr().multiply(B.expr()), mesh8)
+        leaf = plan.leaf_order[0]
+        step = plan.bound_runner(rebind_uids=(leaf.uid,), donate=True)
+        cur = step(A.data + 0)        # fresh buffer (A.data stays live)
+        cur = step(cur)
+        cur = step(cur)
+        np.testing.assert_allclose(np.asarray(cur)[:16, :16], a @ b @ b @ b,
+                                   rtol=1e-3, atol=1e-2)
+
+    def test_wrong_arity_raises(self, mesh8, rng):
+        from matrel_tpu.executor import compile_expr
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        A, B = bm(a, mesh8), bm(a, mesh8)
+        plan = compile_expr(A.expr().multiply(B.expr()), mesh8)
+        step = plan.bound_runner(
+            rebind_uids=tuple(l.uid for l in plan.leaf_order))
+        with pytest.raises(ValueError, match="rebound"):
+            step(A.data)
