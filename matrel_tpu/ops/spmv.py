@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -389,3 +390,52 @@ def spmv_sharded(plan: EdgeSpMVPlan, x: jax.Array, mesh) -> jax.Array:
     run = _sharded_spmv_runner((plan.n_rows, plan.n_cols, plan.block),
                                mesh, len(arrays) > 4)
     return run(*arrays[:4], jnp.asarray(x, jnp.float32), *arrays[4:])
+
+
+# -- plan persistence --------------------------------------------------------
+
+
+def save_plan(path: str, plan: EdgeSpMVPlan) -> None:
+    """Persist a plan's compact layout (one .npz). The expensive build
+    (host sort/fill) is skipped on load; one-hot expansion still happens
+    on the loading process's device. Plans must be saved before table
+    expansion (save the freshly built plan, or rebuild)."""
+    if plan._tables is not None:
+        raise ValueError("plan already expanded; save it before first use")
+    payload = dict(
+        meta=np.asarray([plan.n_rows, plan.n_cols, plan.block,
+                         plan.capacity], np.int64),
+        padding_ratio=np.asarray([plan.padding_ratio], np.float64),
+        src8=np.asarray(plan.src8), lane=np.asarray(plan.lane),
+        off=np.asarray(plan.off), val=np.asarray(plan.val))
+    if plan.ov_rows is not None:
+        payload.update(ov_rows=np.asarray(plan.ov_rows),
+                       ov_cols=np.asarray(plan.ov_cols),
+                       ov_vals=np.asarray(plan.ov_vals))
+    import tempfile
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path))
+                               or ".", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_plan(path: str) -> EdgeSpMVPlan:
+    """Load a plan saved by ``save_plan``."""
+    with np.load(path) as z:
+        n_rows, n_cols, block, cap = (int(v) for v in z["meta"])
+        has_ov = "ov_rows" in z.files
+        return EdgeSpMVPlan(
+            n_rows=n_rows, n_cols=n_cols, block=block, capacity=cap,
+            src8=z["src8"], lane=z["lane"], off=z["off"], val=z["val"],
+            ov_rows=jnp.asarray(z["ov_rows"]) if has_ov else None,
+            ov_cols=jnp.asarray(z["ov_cols"]) if has_ov else None,
+            ov_vals=jnp.asarray(z["ov_vals"]) if has_ov else None,
+            padding_ratio=float(z["padding_ratio"][0]))

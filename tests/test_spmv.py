@@ -192,6 +192,25 @@ class TestShardedSpMV:
         want = coo_oracle(rows, cols, vals, x, 4096)
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
+    def test_sharded_hlo_contains_all_gather(self, mesh8):
+        # plan-shape assertion (the Catalyst comparePlans analogue): the
+        # sharded matvec's only collective is one tiled all-gather
+        import jax
+        rng = np.random.default_rng(14)
+        rows = rng.integers(0, 8192, 20_000)
+        cols = rng.integers(0, 1024, 20_000)
+        plan = spmv_lib.shard_plan(
+            spmv_lib.build_spmv_plan(rows, cols, n_rows=8192,
+                                     n_cols=1024), mesh8)
+        arrays = plan.arrays()
+        run = spmv_lib._sharded_spmv_runner(
+            (plan.n_rows, plan.n_cols, plan.block), mesh8,
+            len(arrays) > 4)
+        x = np.zeros(1024, np.float32)
+        hlo = run.lower(*arrays[:4], x, *arrays[4:]).compile().as_text()
+        assert "all-gather" in hlo
+        assert "reduce-scatter" not in hlo and "all-to-all" not in hlo
+
     def test_pagerank_sharded_matches_single(self, mesh8):
         from matrel_tpu.workloads import pagerank as pr
         rng = np.random.default_rng(12)
@@ -204,6 +223,36 @@ class TestShardedSpMV:
         want = np.asarray(pr.pagerank_edges(src, dst, n, rounds=10,
                                             impl="onehot"))
         np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-10)
+
+
+class TestPlanPersistence:
+    def test_save_load_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(13)
+        m = 20_000
+        rows = np.where(rng.random(m) < 0.3, 7,
+                        rng.integers(0, 4096, m)).astype(np.int64)
+        cols = rng.integers(0, 512, m).astype(np.int64)
+        vals = rng.standard_normal(m).astype(np.float32)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=4096, n_cols=512)
+        p = str(tmp_path / "plan.npz")
+        spmv_lib.save_plan(p, plan)
+        loaded = spmv_lib.load_plan(p)
+        assert loaded.capacity == plan.capacity
+        assert loaded.padding_ratio == plan.padding_ratio
+        x = rng.standard_normal(512).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(spmv_lib.spmv(loaded, jnp.asarray(x))),
+            np.asarray(spmv_lib.spmv(plan, jnp.asarray(x))))
+
+    def test_save_after_expansion_raises(self, tmp_path):
+        import jax.numpy as jnp
+        plan = spmv_lib.build_spmv_plan(np.array([1, 2]), np.array([0, 1]),
+                                        n_rows=8, n_cols=4)
+        spmv_lib.spmv(plan, jnp.ones(4, jnp.float32))   # expands
+        with pytest.raises(ValueError, match="expanded"):
+            spmv_lib.save_plan(str(tmp_path / "x.npz"), plan)
 
 
 class TestPageRankOneHot:
