@@ -403,8 +403,11 @@ def save_plan(path: str, plan: EdgeSpMVPlan) -> None:
     if plan._tables is not None:
         raise ValueError("plan already expanded; save it before first use")
     payload = dict(
+        # trailing fields: format version + the WIDTH/LO constants baked
+        # into src8/lane/off at build time — loading under different
+        # constants must fail loudly, not gather from wrong rows
         meta=np.asarray([plan.n_rows, plan.n_cols, plan.block,
-                         plan.capacity], np.int64),
+                         plan.capacity, 1, WIDTH, LO], np.int64),
         padding_ratio=np.asarray([plan.padding_ratio], np.float64),
         src8=np.asarray(plan.src8), lane=np.asarray(plan.lane),
         off=np.asarray(plan.off), val=np.asarray(plan.val))
@@ -413,9 +416,12 @@ def save_plan(path: str, plan: EdgeSpMVPlan) -> None:
                        ov_cols=np.asarray(plan.ov_cols),
                        ov_vals=np.asarray(plan.ov_vals))
     import tempfile
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path))
-                               or ".", suffix=".tmp")
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)),
+                               suffix=".tmp")
     try:
+        umask = os.umask(0)
+        os.umask(umask)
+        os.fchmod(fd, 0o666 & ~umask)   # mkstemp's 0600 ignores the umask
         with os.fdopen(fd, "wb") as f:
             np.savez_compressed(f, **payload)
         os.replace(tmp, path)
@@ -430,7 +436,14 @@ def save_plan(path: str, plan: EdgeSpMVPlan) -> None:
 def load_plan(path: str) -> EdgeSpMVPlan:
     """Load a plan saved by ``save_plan``."""
     with np.load(path) as z:
-        n_rows, n_cols, block, cap = (int(v) for v in z["meta"])
+        meta = [int(v) for v in z["meta"]]
+        n_rows, n_cols, block, cap = meta[:4]
+        version, width, lo = (meta[4:7] if len(meta) >= 7 else (0, -1, -1))
+        if version != 1 or width != WIDTH or lo != LO:
+            raise ValueError(
+                f"plan file {path!r} was saved with format v{version} "
+                f"(WIDTH={width}, LO={lo}); this build expects v1 "
+                f"(WIDTH={WIDTH}, LO={LO}) — rebuild the plan")
         has_ov = "ov_rows" in z.files
         return EdgeSpMVPlan(
             n_rows=n_rows, n_cols=n_cols, block=block, capacity=cap,

@@ -16,7 +16,7 @@ from matrel_tpu.ir import expr as E
 def np_eval(e, env):
     """Reference evaluation of a MatExpr over numpy leaf values."""
     k = e.kind
-    if k == "leaf":
+    if k in ("leaf", "sparse_leaf", "coo_leaf"):
         return env[e.uid]
     if k == "transpose":
         return np_eval(e.children[0], env).T
@@ -67,12 +67,26 @@ def np_eval(e, env):
     raise NotImplementedError(k)
 
 
-def gen_expr(rng, env, mesh, depth, shape=None):
-    """Random expression with consistent shapes; fills env[uid] for leaves."""
+def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",)):
+    """Random expression with consistent shapes; fills env[uid] for leaves.
+    ``leaf_kinds``: population for leaf flavors — "dense" (BlockMatrix),
+    "sparse" (BlockSparseMatrix tile stack), "coo" (element-sparse plan);
+    all three enter the same IR and must agree with the numpy oracle."""
     def leaf_of(shape):
         a = rng.standard_normal(shape).astype(np.float32)
-        bm = BlockMatrix.from_numpy(a, mesh=mesh)
-        l = E.leaf(bm)
+        kind = str(rng.choice(leaf_kinds))
+        if kind == "sparse":
+            a = a * (rng.random(shape) < 0.6)
+            from matrel_tpu.core.sparse import BlockSparseMatrix
+            l = BlockSparseMatrix.from_numpy(a, block_size=4,
+                                             mesh=mesh).expr()
+        elif kind == "coo":
+            from matrel_tpu.core.coo import COOMatrix
+            a = a * (rng.random(shape) < 0.6)
+            r, c = np.nonzero(a)
+            l = COOMatrix.from_edges(r, c, a[r, c], shape=shape).expr()
+        else:
+            l = E.leaf(BlockMatrix.from_numpy(a, mesh=mesh))
         env[l.uid] = a
         return l
 
@@ -86,34 +100,35 @@ def gen_expr(rng, env, mesh, depth, shape=None):
          "select", "leaf"])
     if choice == "matmul":
         k = int(rng.choice(dims[1:]))
-        a = gen_expr(rng, env, mesh, depth - 1, (shape[0], k))
-        b = gen_expr(rng, env, mesh, depth - 1, (k, shape[1]))
+        a = gen_expr(rng, env, mesh, depth - 1, (shape[0], k), leaf_kinds)
+        b = gen_expr(rng, env, mesh, depth - 1, (k, shape[1]), leaf_kinds)
         return E.matmul(a, b)
     if choice == "elemwise":
         op = str(rng.choice(["add", "sub", "mul"]))
-        a = gen_expr(rng, env, mesh, depth - 1, shape)
-        b = gen_expr(rng, env, mesh, depth - 1, shape)
+        a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
+        b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
         return E.elemwise(op, a, b)
     if choice == "scalar":
         op = str(rng.choice(["add", "mul"]))
-        c = gen_expr(rng, env, mesh, depth - 1, shape)
+        c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
         return E.scalar_op(op, c, float(rng.uniform(-2, 2)))
     if choice == "transpose":
-        c = gen_expr(rng, env, mesh, depth - 1, (shape[1], shape[0]))
+        c = gen_expr(rng, env, mesh, depth - 1, (shape[1], shape[0]), leaf_kinds)
         return E.transpose(c)
     if choice == "agg_chain":
         # produce shape via aggregation of a larger operand when possible
         if shape[1] == 1 and shape[0] > 1:
             inner = gen_expr(rng, env, mesh, depth - 1,
-                             (shape[0], int(rng.choice(dims[1:]))))
+                             (shape[0], int(rng.choice(dims[1:]))),
+                             leaf_kinds)
             return E.agg(inner, "sum", "row")
         if shape == (1, 1):
             inner = gen_expr(rng, env, mesh, depth - 1,
-                             (int(rng.choice(dims[1:])),) * 2)
+                             (int(rng.choice(dims[1:])),) * 2, leaf_kinds)
             return E.agg(inner, "sum", "all")
         return leaf_of(shape)
     if choice == "select":
-        c = gen_expr(rng, env, mesh, depth - 1, shape)
+        c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
         m = int(rng.integers(2, 5))
         return E.select_index(c, rows=lambda i, m=m: i % m != 0)
     return leaf_of(shape)
@@ -132,6 +147,26 @@ def test_optimized_matches_unoptimized_and_numpy(seed, mesh8):
         e, mesh8, MatrelConfig(rewrite_rules=False, chain_opt=False))
     got_raw = plan_raw.run().to_numpy()
 
+    np.testing.assert_allclose(got_raw, oracle, rtol=2e-3, atol=2e-3,
+                               err_msg=f"unoptimized != numpy (seed {seed})")
+    np.testing.assert_allclose(got_opt, oracle, rtol=2e-3, atol=2e-3,
+                               err_msg=f"optimized != numpy (seed {seed})")
+
+
+@pytest.mark.parametrize("seed", range(40, 55))
+def test_fuzz_mixed_leaf_kinds(seed, mesh8):
+    """Dense, block-sparse and element-sparse leaves mixed in one tree:
+    every lowering path (strategy matmuls, SpMM, one-hot SpMV, densify
+    fallbacks) must produce the oracle numbers, optimized or not."""
+    rng = np.random.default_rng(seed)
+    env = {}
+    e = gen_expr(rng, env, mesh8, depth=int(rng.integers(2, 4)),
+                 leaf_kinds=("dense", "dense", "sparse", "coo"))
+    oracle = np_eval(e, env)
+    got_opt = compile_expr(e, mesh8, MatrelConfig()).run().to_numpy()
+    got_raw = compile_expr(
+        e, mesh8, MatrelConfig(rewrite_rules=False,
+                               chain_opt=False)).run().to_numpy()
     np.testing.assert_allclose(got_raw, oracle, rtol=2e-3, atol=2e-3,
                                err_msg=f"unoptimized != numpy (seed {seed})")
     np.testing.assert_allclose(got_opt, oracle, rtol=2e-3, atol=2e-3,
