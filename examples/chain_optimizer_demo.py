@@ -1,0 +1,81 @@
+"""The heart of the reference: cost-based matrix-chain reordering.
+
+MatRel's flagship optimization is the linear-algebra analogue of join-order
+enumeration — an O(n³) interval DP over a multiply chain, with
+sparsity-aware cost estimates (SURVEY.md §3.3). This demo builds a skewed
+chain where evaluation order changes the FLOP count by ~50×, shows the
+optimizer picking the cheap parenthesisation, and times both plans.
+
+Run: python examples/chain_optimizer_demo.py
+     JAX_PLATFORMS=cpu python examples/chain_optimizer_demo.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from matrel_tpu import MatrelConfig, MatrelSession
+
+
+def flops_of(dims):
+    (n, k), (_, m), (_, p) = dims
+    left = 2 * n * k * m + 2 * n * m * p       # (A·B)·C
+    right = 2 * k * m * p + 2 * n * k * p      # A·(B·C)
+    return left, right
+
+
+def main():
+    sess = MatrelSession.builder().get_or_create()
+    print(f"mesh: {dict(sess.mesh.shape)}")
+
+    # A: 4096×64, B: 64×4096, C: 4096×64 — the DSL's natural left-assoc
+    # order materialises a 4096² intermediate; right-assoc keeps every
+    # intermediate 64-wide (160× fewer FLOPs)
+    dims = [(4096, 64), (64, 4096), (4096, 64)]
+    rng = np.random.default_rng(0)
+    A, B, C = (sess.from_numpy(
+        rng.standard_normal(d).astype(np.float32) / 64) for d in dims)
+    expr = A.expr().multiply(B.expr()).multiply(C.expr())
+
+    left, right = flops_of(dims)
+    print(f"(A·B)·C costs {left/1e6:.0f} MFLOPs; "
+          f"A·(B·C) costs {right/1e6:.0f} MFLOPs")
+
+    print("\n--- optimizer explain ---")
+    print(sess.explain(expr))
+
+    def compiled_flops(plan):
+        arrays = [l.attrs["matrix"].data for l in plan.leaf_order]
+        return plan.jitted.lower(*arrays).compile().cost_analysis()["flops"]
+
+    def timed(plan, label):
+        run = plan.bound_runner()
+        float(np.asarray(run()).sum())       # warm + force
+        t0 = time.perf_counter()
+        for _ in range(20):
+            out = run()
+        s = float(np.asarray(out).sum())     # force completion
+        dt = (time.perf_counter() - t0) / 20
+        print(f"{label:>12}: {compiled_flops(plan)/1e6:7.0f} MFLOPs "
+              f"compiled, {dt*1e3:7.3f} ms/exec  (checksum {s:+.4f})")
+        return dt
+
+    opt = sess.compile(expr)
+    raw_cfg = MatrelConfig(chain_opt=False, rewrite_rules=False)
+    from matrel_tpu.executor import compile_expr
+    raw = compile_expr(expr, sess.mesh, raw_cfg)
+
+    t_raw = timed(raw, "left-assoc")
+    t_opt = timed(opt, "DP-reordered")
+    ratio = compiled_flops(raw) / compiled_flops(opt)
+    print(f"\nchain DP cut compiled FLOPs {ratio:.0f}x "
+          f"(wall-clock {t_raw/t_opt:.1f}x here; small plans are "
+          f"dispatch-bound on fast hosts — the FLOP ratio is what scales)")
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,12 @@ class COOMatrix:
     # sorted by out_ids — fixed per matrix, built once per direction
     _seg_fwd: Optional[tuple] = dataclasses.field(default=None, repr=False)
     _seg_bwd: Optional[tuple] = dataclasses.field(default=None, repr=False)
+    # set by .shard(): forward matvec runs this mesh-sharded plan; kept
+    # separate from _plan so the DSL/transpose paths (which expect
+    # default-placement plans) never see sharded tables
+    _mesh: Optional[object] = dataclasses.field(default=None, repr=False)
+    _plan_sharded: Optional[spmv_lib.EdgeSpMVPlan] = dataclasses.field(
+        default=None, repr=False)
 
     # ---------------------------------------------------------- build
     @classmethod
@@ -110,6 +116,36 @@ class COOMatrix:
             self._plan_t_tried = True
         return self._plan_t
 
+    def shard(self, mesh) -> "COOMatrix":
+        """Return a copy whose forward ``matvec`` runs a plan
+        row-decomposed over every device of ``mesh``
+        (ops/spmv.py::shard_plan): each device contracts its slice of
+        output blocks against the replicated x and one tiled all_gather
+        assembles the result. DSL/transpose/rmatvec paths keep their own
+        default-placement plans.
+
+        Raises when the planner refuses this graph — distribution was
+        requested explicitly, and silently degrading to a single-device
+        segment-sum would mask the perf cliff; catch and use the
+        unsharded matrix if that degradation is acceptable."""
+        if self._plan_tried and self._plan is None:
+            plan = None                      # known-refused: don't rebuild
+        elif (self._plan_tried and self._plan is not None
+              and self._plan._tables is None):
+            plan = self._plan                # fresh unexpanded plan: reuse
+        else:
+            plan = spmv_lib.build_spmv_plan(self.rows, self.cols,
+                                            self.vals,
+                                            n_rows=self.shape[0],
+                                            n_cols=self.shape[1])
+        if plan is None:
+            raise ValueError(
+                "degree distribution too heavy-tailed for the one-hot "
+                "plan; sharded matvec unavailable for this graph")
+        return COOMatrix(rows=self.rows, cols=self.cols, vals=self.vals,
+                         shape=self.shape, _mesh=mesh,
+                         _plan_sharded=spmv_lib.shard_plan(plan, mesh))
+
     # ------------------------------------------------------------ ops
     def matvec(self, x) -> jax.Array:
         """y = A·x, shape (n_rows,)."""
@@ -117,6 +153,9 @@ class COOMatrix:
         if x.shape[0] != self.shape[1]:
             raise ValueError(f"x has {x.shape[0]} entries, A has "
                              f"{self.shape[1]} columns")
+        if self._plan_sharded is not None:
+            return spmv_lib.spmv_sharded(self._plan_sharded, x,
+                                         self._mesh)
         plan = self._get_plan()
         if plan is not None:
             return spmv_lib.spmv(plan, x)

@@ -47,6 +47,11 @@ BLOCK = 512      # scatter block: nodes per one-hot block row
 HI = 32          # off = hi*LO + lo one-hot factor sizes; HI*LO == BLOCK
 LO = 16
 
+# probed once at import (os.umask is process-global; toggling it per save
+# would race concurrent file creation in other threads)
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
 
 def _ext_table(x: jax.Array, width: int = WIDTH) -> jax.Array:
     """Pad a 1-D table to (rows, width) with ≥1 zero row so index ``n``
@@ -419,9 +424,7 @@ def save_plan(path: str, plan: EdgeSpMVPlan) -> None:
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)),
                                suffix=".tmp")
     try:
-        umask = os.umask(0)
-        os.umask(umask)
-        os.fchmod(fd, 0o666 & ~umask)   # mkstemp's 0600 ignores the umask
+        os.fchmod(fd, 0o666 & ~_UMASK)  # mkstemp's 0600 ignores the umask
         with os.fdopen(fd, "wb") as f:
             np.savez_compressed(f, **payload)
         os.replace(tmp, path)

@@ -106,6 +106,24 @@ class TestOps:
                                       np.zeros(10))
 
 
+class TestShardedCOO:
+    def test_sharded_matvec_matches_single(self, mesh8, rng):
+        r, c, v = random_coo(rng, 6000, 4000, 50_000)
+        A = COOMatrix.from_edges(r, c, v, shape=(6000, 4000))
+        x = rng.standard_normal(4000).astype(np.float32)
+        want = np.asarray(A.matvec(x))
+        As = A.shard(mesh8)
+        got = np.asarray(As.matvec(x))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+    def test_shard_refused_graph_raises(self, mesh8):
+        rows = np.arange(20_000, dtype=np.int64) * 512
+        A = COOMatrix.from_edges(rows, np.zeros(20_000, np.int64),
+                                 shape=(512 * 20_000, 1))
+        with pytest.raises(ValueError, match="heavy-tailed"):
+            A.shard(mesh8)
+
+
 class TestDSLIntegration:
     """coo_leaf in the IR: SpMV lowering for matmuls, densify elsewhere."""
 
