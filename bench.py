@@ -88,7 +88,7 @@ def measure_tpu() -> float:
     chained(2)  # warm both programs
     lo, hi = 5, 5 + REPEATS
     dts = []
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
         chained(lo)
         t_lo = time.perf_counter() - t0
