@@ -178,14 +178,25 @@ class COOMatrix:
         return self._segment_matvec(self._seg_bwd, y, self.shape[1])
 
     def matmat(self, X) -> jax.Array:
-        """Y = A·X for a narrow dense X (n_cols, k): the column loop
-        reuses the compiled per-column matvec program k times."""
+        """Y = A·X for dense X (n_cols, k): the k-wide SpMM shares ONE
+        row gather across all columns (ops/spmv.py::spmm; wide X is
+        processed in column chunks). Falls back to a per-column matvec
+        loop only when the planner refused the graph."""
         X = jnp.asarray(X, jnp.float32)
         if X.ndim != 2 or X.shape[0] != self.shape[1]:
             raise ValueError(f"X must be ({self.shape[1]}, k), "
                              f"got {X.shape}")
         if X.shape[1] == 0:
             return jnp.zeros((self.shape[0], 0), jnp.float32)
+        if self._plan_sharded is not None:
+            # sharded matrices stay on the sharded matvec per column —
+            # building a second full-size unsharded plan here would
+            # defeat the reason the matrix was sharded
+            cols = [self.matvec(X[:, j]) for j in range(X.shape[1])]
+            return jnp.stack(cols, axis=1)
+        plan = self._get_plan()
+        if plan is not None:
+            return spmv_lib.spmm(plan, X)
         cols = [self.matvec(X[:, j]) for j in range(X.shape[1])]
         return jnp.stack(cols, axis=1)
 

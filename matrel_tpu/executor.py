@@ -184,14 +184,25 @@ class Lowerer:
 
     @staticmethod
     def _coo_spmv_stack(plan, vectors) -> Array:
-        """Stack SpMV results for a sequence of input vectors (columns of
-        the dense operand); plan arrays ride the trace as constants, like
-        the sparse tile stacks."""
+        """SpMV results for a sequence of input vectors (columns of the
+        dense operand) as a (n_rows, k) array; plan arrays ride the trace
+        as constants, like the sparse tile stacks. Single vectors take
+        the matvec kernel; wider stacks the k-wide SpMM (one shared
+        gather for all columns)."""
         from matrel_tpu.ops import spmv as spmv_lib
         static = (plan.n_rows, plan.n_cols, plan.block)
         arrays = plan.arrays()
-        return jnp.stack([spmv_lib.spmv_apply(static, arrays, x)
-                          for x in vectors], axis=1)
+        if len(vectors) == 1:
+            return spmv_lib.spmv_apply(static, arrays, vectors[0])[:, None]
+        X = jnp.stack(vectors, axis=1)
+        extra = plan.spmm_extra()
+        # ≤64-column chunks bound the (B, C, k) gather/weight
+        # intermediates, matching spmv.spmm's col_chunk
+        parts = [spmv_lib.spmm_apply(static, arrays, extra,
+                                     X[:, j:j + 64])
+                 for j in range(0, X.shape[1], 64)]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts,
+                                                                axis=1)
 
     def _matmul(self, node: MatExpr, ev) -> Array:
         l, r = node.children

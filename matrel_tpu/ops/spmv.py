@@ -111,6 +111,8 @@ class EdgeSpMVPlan:
     ov_vals: Optional[jax.Array]
     padding_ratio: float
     _tables: Optional[tuple] = dataclasses.field(default=None, repr=False)
+    _spmm_tables: Optional[tuple] = dataclasses.field(default=None,
+                                                      repr=False)
 
     def arrays(self):
         """Flat device-array tuple for passing through jit boundaries.
@@ -118,18 +120,45 @@ class EdgeSpMVPlan:
         program; ~130 MB shipped instead of ~2.4 GB). The compact tables
         stay HOST numpy until then, so ``shard_plan`` can place them
         sharded without ever materialising on a single device."""
+        ov = () if self.ov_cols is None else (self.ov_cols, self.ov_rows,
+                                              self.ov_vals)
         if self._tables is None:
-            self.src8 = jnp.asarray(self.src8)   # no-op if pre-placed
+            src8 = jnp.asarray(self.src8)        # no-op if pre-placed
             sel, oh_hi, oh_lo = _expand_tables(self.block // LO)(
-                self.src8, jnp.asarray(self.lane), jnp.asarray(self.off),
+                src8, jnp.asarray(self.lane), jnp.asarray(self.off),
                 jnp.asarray(self.val))
-            self._tables = (self.src8, sel, oh_hi, oh_lo)
+            if isinstance(sel, jax.core.Tracer):
+                # called inside an outer trace (executor lowering): the
+                # expansion was staged and returned tracers — caching
+                # them would poison the plan for every later use
+                return (src8, sel, oh_hi, oh_lo) + ov
+            self.src8 = src8
+            self._tables = (src8, sel, oh_hi, oh_lo)
             # the compact arrays are never read again once expanded —
             # drop them so ~9 B/slot isn't pinned by the plan
             self.lane = self.off = self.val = None
-        ov = () if self.ov_cols is None else (self.ov_cols, self.ov_rows,
-                                              self.ov_vals)
         return self._tables + ov
+
+    def spmm_extra(self):
+        """(src_full, val) tables for the k-wide SpMM path, derived once
+        from the expanded tables (src8·W + the lane sel marks; padded
+        slots have all-zero sel, so they read a real-but-ignored row —
+        val 0 kills the contribution)."""
+        if self._spmm_tables is None:
+            src8, sel = self.arrays()[:2]
+            tables = _derive_spmm_tables(src8, sel)
+            if isinstance(tables[0], jax.core.Tracer):
+                return tables                # in-trace: don't cache
+            self._spmm_tables = tables
+        return self._spmm_tables
+
+
+@jax.jit
+def _derive_spmm_tables(src8, sel):
+    lane = jnp.argmax(sel != 0.0, axis=-1).astype(jnp.int32)
+    src_full = src8 * WIDTH + lane
+    val = jnp.sum(sel, axis=-1)
+    return src_full, val
 
 
 @functools.lru_cache(maxsize=8)
@@ -290,6 +319,75 @@ def spmv_apply(plan_static, arrays, x: jax.Array) -> jax.Array:
     if len(arrays) > 4:
         y = _overflow_add(y, arrays, x, n_rows)
     return y
+
+
+_SPMM_B_CHUNK = 128   # blocks per scatter chunk: bounds the (chunk, C,
+                      # LO·k) one-hot⊗w intermediate to a few hundred MB
+
+
+def spmm_apply(plan_static, arrays, extra, X: jax.Array) -> jax.Array:
+    """Traceable k-wide SpMM body: Y = A·X for dense X (n_cols, k).
+
+    One shared row gather serves every column (vs k full passes of
+    ``spmv_apply``); the scatter contracts oh_hi against (oh_lo ⊗ w)
+    per B-chunk so the widened one-hot never materialises whole.
+    Traffic scales ~linearly in k; callers chunk very wide X.
+    """
+    n_rows, n_cols, block = plan_static
+    _, _, oh_hi, oh_lo = arrays[:4]
+    src_full, val = extra
+    k = X.shape[1]
+    x_ext = jnp.concatenate(
+        [X.astype(jnp.float32), jnp.zeros((WIDTH, k), jnp.float32)])
+    g = jnp.take(x_ext, src_full, axis=0)              # (B, C, k)
+    w = g * val[..., None]
+    nb, cap = src_full.shape
+    nch = -(-nb // _SPMM_B_CHUNK)
+    pad = nch * _SPMM_B_CHUNK - nb
+
+    def pad_b(a):
+        if pad == 0:
+            return a
+        return jnp.concatenate(
+            [a, jnp.zeros((pad, *a.shape[1:]), a.dtype)])
+
+    hh = pad_b(oh_hi).reshape(nch, _SPMM_B_CHUNK, cap, -1)
+    ll = pad_b(oh_lo).reshape(nch, _SPMM_B_CHUNK, cap, LO)
+    ww = pad_b(w).reshape(nch, _SPMM_B_CHUNK, cap, k)
+
+    def chunk(args):
+        h, l, v = args
+        rhs = (l[..., :, None] * v[..., None, :]).reshape(
+            _SPMM_B_CHUNK, cap, LO * k)
+        return jax.lax.dot_general(
+            h, rhs, (((1,), (1,)), ((0,), (0,))),
+            precision=jax.lax.Precision.HIGH)          # (CH, H, LO·k)
+
+    out = jax.lax.map(chunk, (hh, ll, ww))             # (nch, CH, H, LO·k)
+    y = out.reshape(nch * _SPMM_B_CHUNK, -1, LO, k).reshape(-1, k)[:n_rows]
+    if len(arrays) > 4:
+        ov_c, ov_r, ov_v = arrays[4:]
+        w_ov = jnp.take(x_ext, ov_c, axis=0) * ov_v[:, None]
+        y = y + jax.ops.segment_sum(w_ov, ov_r, num_segments=n_rows,
+                                    indices_are_sorted=True)
+    return y
+
+
+_spmm_jitted = jax.jit(spmm_apply, static_argnums=0)
+
+
+def spmm(plan: EdgeSpMVPlan, X: jax.Array,
+         col_chunk: int = 64) -> jax.Array:
+    """Y = A·X for dense X (n_cols, k), k columns processed ``col_chunk``
+    at a time (scatter traffic grows linearly in k)."""
+    X = jnp.asarray(X, jnp.float32)
+    static = (plan.n_rows, plan.n_cols, plan.block)
+    if X.shape[1] == 0:
+        return jnp.zeros((plan.n_rows, 0), jnp.float32)
+    outs = [_spmm_jitted(static, plan.arrays(), plan.spmm_extra(),
+                         X[:, j:j + col_chunk])
+            for j in range(0, X.shape[1], col_chunk)]
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
 
 
 def spmv_sharded_apply(plan_static, arrays, x: jax.Array,

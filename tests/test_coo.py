@@ -116,6 +116,36 @@ class TestShardedCOO:
         got = np.asarray(As.matvec(x))
         np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
 
+    def test_sharded_matmat_uses_sharded_plan(self, mesh8, rng):
+        r, c, v = random_coo(rng, 3000, 2000, 20_000)
+        A = COOMatrix.from_edges(r, c, v, shape=(3000, 2000))
+        As = A.shard(mesh8)
+        X = rng.standard_normal((2000, 3)).astype(np.float32)
+        got = np.asarray(As.matmat(X))
+        np.testing.assert_allclose(got, np.asarray(A.matmat(X)),
+                                   rtol=2e-5, atol=1e-5)
+        # the sharded matrix must not have grown an unsharded plan
+        assert As._plan is None and not As._plan_tried
+
+    def test_dsl_then_eager_no_tracer_poisoning(self, rng):
+        # arrays()/spmm_extra() first invoked INSIDE the executor's
+        # trace must not cache tracers (regression: UnexpectedTracerError
+        # on any later eager use of the same matrix)
+        from matrel_tpu import execute
+        r, c, v = random_coo(rng, 500, 400, 4000)
+        A = COOMatrix.from_edges(r, c, v, shape=(500, 400))
+        X = rng.standard_normal((400, 3)).astype(np.float32)
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        out = execute(A.multiply(BlockMatrix.from_numpy(X).expr()))
+        np.testing.assert_allclose(out.to_numpy(), A.to_dense() @ X,
+                                   rtol=3e-4, atol=3e-4)
+        # eager uses after the traced one must work and agree
+        np.testing.assert_allclose(np.asarray(A.matmat(X)),
+                                   A.to_dense() @ X, rtol=3e-4, atol=3e-4)
+        np.testing.assert_allclose(np.asarray(A.matvec(X[:, 0])),
+                                   A.to_dense() @ X[:, 0],
+                                   rtol=3e-4, atol=3e-4)
+
     def test_shard_refused_graph_raises(self, mesh8):
         rows = np.arange(20_000, dtype=np.int64) * 512
         A = COOMatrix.from_edges(rows, np.zeros(20_000, np.int64),

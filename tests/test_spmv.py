@@ -225,6 +225,57 @@ class TestShardedSpMV:
         np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-10)
 
 
+class TestSpMM:
+    @pytest.mark.parametrize("k", [1, 2, 7, 64, 100])
+    def test_matches_scipy(self, k):
+        import scipy.sparse as sp
+        import jax.numpy as jnp
+        rng = np.random.default_rng(k)
+        n_r, n_c, m = 2500, 1800, 30_000
+        rows = rng.integers(0, n_r, m)
+        cols = rng.integers(0, n_c, m)
+        vals = rng.standard_normal(m).astype(np.float32)
+        S = sp.coo_matrix((vals, (rows, cols)), shape=(n_r, n_c)).tocsr()
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=n_r, n_cols=n_c)
+        X = rng.standard_normal((n_c, k)).astype(np.float32)
+        got = np.asarray(spmv_lib.spmm(plan, jnp.asarray(X)))
+        np.testing.assert_allclose(got, S @ X, rtol=3e-4, atol=3e-4)
+
+    def test_overflow_and_column_chunking(self):
+        import scipy.sparse as sp
+        import jax.numpy as jnp
+        rng = np.random.default_rng(3)
+        m = 20_000
+        rows = np.where(rng.random(m) < 0.3, 7,
+                        rng.integers(0, 4096, m)).astype(np.int64)
+        cols = rng.integers(0, 512, m).astype(np.int64)
+        vals = rng.standard_normal(m).astype(np.float32)
+        S = sp.coo_matrix((vals, (rows, cols)), shape=(4096, 512)).tocsr()
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=4096, n_cols=512)
+        assert plan.ov_rows is not None
+        X = rng.standard_normal((512, 9)).astype(np.float32)
+        got = np.asarray(spmv_lib.spmm(plan, jnp.asarray(X), col_chunk=4))
+        np.testing.assert_allclose(got, S @ X, rtol=3e-4, atol=3e-4)
+
+    def test_consistent_with_spmv_columns(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(5)
+        rows = rng.integers(0, 1500, 10_000)
+        cols = rng.integers(0, 1000, 10_000)
+        vals = rng.standard_normal(10_000).astype(np.float32)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=1500, n_cols=1000)
+        X = rng.standard_normal((1000, 3)).astype(np.float32)
+        via_spmm = np.asarray(spmv_lib.spmm(plan, jnp.asarray(X)))
+        via_spmv = np.stack(
+            [np.asarray(spmv_lib.spmv(plan, jnp.asarray(X[:, j])))
+             for j in range(3)], axis=1)
+        np.testing.assert_allclose(via_spmm, via_spmv, rtol=2e-5,
+                                   atol=1e-5)
+
+
 class TestPlanPersistence:
     def test_save_load_roundtrip(self, tmp_path):
         import jax.numpy as jnp
