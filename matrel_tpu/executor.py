@@ -195,7 +195,7 @@ class Lowerer:
         if len(vectors) == 1:
             return spmv_lib.spmv_apply(static, arrays, vectors[0])[:, None]
         X = jnp.stack(vectors, axis=1)
-        extra = plan.spmm_extra()
+        extra = plan.spmm_extra(arrays)   # reuse the staged expansion
         # ≤64-column chunks bound the (B, C, k) gather/weight
         # intermediates, matching spmv.spmm's col_chunk
         parts = [spmv_lib.spmm_apply(static, arrays, extra,
@@ -238,10 +238,17 @@ class Lowerer:
             return spmm_lib.apply(l.attrs["matrix"], ev(r), r.shape,
                                   self.config)
         if r.kind == "sparse_leaf" and l.kind != "sparse_leaf":
-            # A·S = (Sᵀ·Aᵀ)ᵀ — transpose the tile stack (cheap, done once
-            # at trace time) and reuse the left-sparse SpMM path.
+            # A·S = (Sᵀ·Aᵀ)ᵀ — transpose the tile stack once, EAGERLY:
+            # this code runs inside the executor's trace, and a traced
+            # transpose()/device_put would turn the matrix's static tile
+            # metadata into tracers (the SpMM builder reads it on host).
             from matrel_tpu.ops import spmm as spmm_lib
-            st = r.attrs["matrix"].transpose()
+            S = r.attrs["matrix"]
+            st = getattr(S, "_transposed_memo", None)
+            if st is None:
+                with jax.ensure_compile_time_eval():
+                    st = S.transpose()
+                S._transposed_memo = st
             at = ev(l).T
             out = spmm_lib.apply(st, at, (l.shape[1], l.shape[0]),
                                  self.config)

@@ -139,13 +139,14 @@ class EdgeSpMVPlan:
             self.lane = self.off = self.val = None
         return self._tables + ov
 
-    def spmm_extra(self):
+    def spmm_extra(self, arrays=None):
         """(src_full, val) tables for the k-wide SpMM path, derived once
         from the expanded tables (src8·W + the lane sel marks; padded
         slots have all-zero sel, so they read a real-but-ignored row —
-        val 0 kills the contribution)."""
+        val 0 kills the contribution). In-trace callers pass their
+        already-staged ``arrays`` so the expansion isn't staged twice."""
         if self._spmm_tables is None:
-            src8, sel = self.arrays()[:2]
+            src8, sel = (arrays or self.arrays())[:2]
             tables = _derive_spmm_tables(src8, sel)
             if isinstance(tables[0], jax.core.Tracer):
                 return tables                # in-trace: don't cache
@@ -342,8 +343,9 @@ def spmm_apply(plan_static, arrays, extra, X: jax.Array) -> jax.Array:
     g = jnp.take(x_ext, src_full, axis=0)              # (B, C, k)
     w = g * val[..., None]
     nb, cap = src_full.shape
-    nch = -(-nb // _SPMM_B_CHUNK)
-    pad = nch * _SPMM_B_CHUNK - nb
+    ch = min(_SPMM_B_CHUNK, max(nb, 1))   # don't pad tiny plans up to 128
+    nch = -(-nb // ch)
+    pad = nch * ch - nb
 
     def pad_b(a):
         if pad == 0:
@@ -351,20 +353,19 @@ def spmm_apply(plan_static, arrays, extra, X: jax.Array) -> jax.Array:
         return jnp.concatenate(
             [a, jnp.zeros((pad, *a.shape[1:]), a.dtype)])
 
-    hh = pad_b(oh_hi).reshape(nch, _SPMM_B_CHUNK, cap, -1)
-    ll = pad_b(oh_lo).reshape(nch, _SPMM_B_CHUNK, cap, LO)
-    ww = pad_b(w).reshape(nch, _SPMM_B_CHUNK, cap, k)
+    hh = pad_b(oh_hi).reshape(nch, ch, cap, -1)
+    ll = pad_b(oh_lo).reshape(nch, ch, cap, LO)
+    ww = pad_b(w).reshape(nch, ch, cap, k)
 
     def chunk(args):
         h, l, v = args
-        rhs = (l[..., :, None] * v[..., None, :]).reshape(
-            _SPMM_B_CHUNK, cap, LO * k)
+        rhs = (l[..., :, None] * v[..., None, :]).reshape(ch, cap, LO * k)
         return jax.lax.dot_general(
             h, rhs, (((1,), (1,)), ((0,), (0,))),
-            precision=jax.lax.Precision.HIGH)          # (CH, H, LO·k)
+            precision=jax.lax.Precision.HIGH)          # (ch, H, LO·k)
 
-    out = jax.lax.map(chunk, (hh, ll, ww))             # (nch, CH, H, LO·k)
-    y = out.reshape(nch * _SPMM_B_CHUNK, -1, LO, k).reshape(-1, k)[:n_rows]
+    out = jax.lax.map(chunk, (hh, ll, ww))             # (nch, ch, H, LO·k)
+    y = out.reshape(nch * ch, -1, LO, k).reshape(-1, k)[:n_rows]
     if len(arrays) > 4:
         ov_c, ov_r, ov_v = arrays[4:]
         w_ov = jnp.take(x_ext, ov_c, axis=0) * ov_v[:, None]
@@ -379,11 +380,14 @@ _spmm_jitted = jax.jit(spmm_apply, static_argnums=0)
 def spmm(plan: EdgeSpMVPlan, X: jax.Array,
          col_chunk: int = 64) -> jax.Array:
     """Y = A·X for dense X (n_cols, k), k columns processed ``col_chunk``
-    at a time (scatter traffic grows linearly in k)."""
+    at a time (scatter traffic grows linearly in k). k == 1 takes the
+    matvec kernel — its width-8 row gather beats spmm's width-1."""
     X = jnp.asarray(X, jnp.float32)
     static = (plan.n_rows, plan.n_cols, plan.block)
     if X.shape[1] == 0:
         return jnp.zeros((plan.n_rows, 0), jnp.float32)
+    if X.shape[1] == 1:
+        return spmv(plan, X[:, 0])[:, None]
     outs = [_spmm_jitted(static, plan.arrays(), plan.spmm_extra(),
                          X[:, j:j + col_chunk])
             for j in range(0, X.shape[1], col_chunk)]

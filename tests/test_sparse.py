@@ -162,3 +162,32 @@ def test_session_plan_cache_distinguishes_sparse_matrices(mesh8, rng):
     out2 = sess.compute(S2.multiply(D)).to_numpy()
     np.testing.assert_allclose(out1, s1_np @ d, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(out2, s2_np @ d, rtol=1e-4, atol=1e-4)
+
+
+class TestRightSparseMatmul:
+    def test_dense_times_sparse_via_dsl(self, mesh8, rng):
+        # A·S (sparse on the RIGHT) — regression: transpose() at trace
+        # time turned tile metadata into tracers (found by tools/soak.py)
+        from matrel_tpu.config import MatrelConfig
+        from matrel_tpu.executor import compile_expr
+        from matrel_tpu.ir import expr as E
+        a = rng.standard_normal((5, 24)).astype(np.float32)
+        sp_np = random_block_sparse_np(rng, 24, 16, 8, 0.5)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        S = BlockSparseMatrix.from_numpy(sp_np, block_size=8, mesh=mesh8)
+        e = E.matmul(A.expr(), S.expr())
+        out = compile_expr(e, mesh8, MatrelConfig()).run().to_numpy()
+        np.testing.assert_allclose(out, a @ sp_np, rtol=1e-4, atol=1e-4)
+        # the memoised transpose must hold CONCRETE arrays — the Pallas
+        # builder reads its tile metadata on host (np.asarray), which is
+        # exactly what crashed when transpose() ran inside the trace
+        import jax
+        st = S._transposed_memo
+        assert st is not None
+        assert not isinstance(st.block_rows, jax.core.Tracer)
+        np.asarray(st.block_rows)   # host-readable
+        # run twice: the memo is reused, results stay correct
+        out2 = compile_expr(
+            E.matmul(A.expr(), S.expr()), mesh8,
+            MatrelConfig()).run().to_numpy()
+        np.testing.assert_allclose(out2, a @ sp_np, rtol=1e-4, atol=1e-4)

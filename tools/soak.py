@@ -1,0 +1,149 @@
+"""Randomized soak harness — many more cases than the pytest suite runs.
+
+Three batteries, all oracle-checked against numpy/scipy:
+  fuzz   random mixed-leaf expression trees (dense/block-sparse/COO)
+         through optimizer + executor            (tests/test_fuzz.py gen)
+  spmv   random graphs (uniform/hub/banded/degenerate) through the
+         one-hot SpMV/SpMM plans
+  all    both
+
+Run on the CPU mesh (default) or the real chip:
+  python tools/soak.py all --seeds 150
+  JAX_PLATFORMS= python tools/soak.py fuzz --seeds 25 --tpu
+
+Exit code = number of failing cases (0 = clean).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _setup(tpu: bool):
+    if not tpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        # the axon sitecustomize pins the platform at interpreter start;
+        # env vars alone do NOT override it (see tests/conftest.py)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, REPO)
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+
+
+def soak_fuzz(n_seeds: int, base: int, tol: float):
+    import importlib.util
+    import numpy as np
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.executor import compile_expr
+
+    spec = importlib.util.spec_from_file_location(
+        "fuzzmod", os.path.join(REPO, "tests", "test_fuzz.py"))
+    fuzz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fuzz)
+    mesh = mesh_lib.make_mesh()
+    fails = []
+    for seed in range(base, base + n_seeds):
+        rng = np.random.default_rng(seed)
+        env = {}
+        try:
+            e = fuzz.gen_expr(rng, env, mesh,
+                              depth=int(rng.integers(2, 5)),
+                              leaf_kinds=("dense", "dense", "sparse",
+                                          "coo"))
+            oracle = fuzz.np_eval(e, env)
+            got = compile_expr(e, mesh, MatrelConfig()).run().to_numpy()
+            np.testing.assert_allclose(got, oracle, rtol=tol, atol=tol)
+        except Exception as ex:  # noqa: BLE001 — soak collects everything
+            fails.append((seed, type(ex).__name__, str(ex)[:200]))
+        done = seed - base + 1
+        if done % 30 == 0:
+            print(f"  fuzz {done}/{n_seeds}, {len(fails)} failures",
+                  flush=True)
+    return fails
+
+
+def soak_spmv(n_trials: int, base: int, tol: float):
+    import numpy as np
+    import scipy.sparse as sp
+    import jax.numpy as jnp
+    from matrel_tpu.ops import spmv as spmv_lib
+
+    fails = []
+    for trial in range(n_trials):
+        rng = np.random.default_rng(base + trial)
+        n_r = int(rng.integers(1, 5000))
+        n_c = int(rng.integers(1, 5000))
+        m = int(rng.integers(0, 30_000))
+        style = rng.choice(["uniform", "hub", "banded", "single-col"])
+        if style == "uniform" or n_r < 4 or n_c < 4:
+            rows = rng.integers(0, n_r, m)
+            cols = rng.integers(0, n_c, m)
+        elif style == "hub":
+            rows = np.where(rng.random(m) < 0.5,
+                            rng.integers(0, max(n_r // 100, 1)),
+                            rng.integers(0, n_r, m))
+            cols = rng.integers(0, n_c, m)
+        elif style == "banded":
+            rows = rng.integers(0, n_r, m)
+            cols = np.clip(rows * n_c // n_r + rng.integers(-3, 4, m),
+                           0, n_c - 1)
+        else:
+            rows = rng.integers(0, n_r, m)
+            cols = np.zeros(m, np.int64)
+        vals = rng.standard_normal(m).astype(np.float32)
+        try:
+            S = sp.coo_matrix((vals, (rows, cols)),
+                              shape=(n_r, n_c)).tocsr()
+            plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                            n_rows=n_r, n_cols=n_c)
+            if plan is None:
+                continue
+            x = rng.standard_normal(n_c).astype(np.float32)
+            want = S @ x
+            scale = max(float(np.abs(want).max()), 1.0)
+            got = np.asarray(spmv_lib.spmv(plan, jnp.asarray(x)))
+            np.testing.assert_allclose(got / scale, want / scale,
+                                       rtol=tol, atol=tol)
+            k = int(rng.integers(1, 9))
+            X = rng.standard_normal((n_c, k)).astype(np.float32)
+            got2 = np.asarray(spmv_lib.spmm(plan, jnp.asarray(X)))
+            np.testing.assert_allclose(got2 / scale, (S @ X) / scale,
+                                       rtol=tol, atol=tol)
+        except Exception as ex:  # noqa: BLE001
+            fails.append((trial, style, n_r, n_c, m,
+                          type(ex).__name__, str(ex)[:150]))
+    return fails
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("battery", choices=["fuzz", "spmv", "all"])
+    p.add_argument("--seeds", type=int, default=100)
+    p.add_argument("--base", type=int, default=10_000)
+    p.add_argument("--tpu", action="store_true",
+                   help="run on the real chip (looser tolerance)")
+    args = p.parse_args()
+    _setup(args.tpu)
+    tol = 5e-3 if args.tpu else 3e-3
+    fails = []
+    if args.battery in ("fuzz", "all"):
+        fails += soak_fuzz(args.seeds, args.base, tol)
+    if args.battery in ("spmv", "all"):
+        fails += soak_spmv(args.seeds, args.base, 2e-4)
+    print(f"SOAK COMPLETE: {len(fails)} failures")
+    for f in fails[:20]:
+        print(" ", f)
+    sys.exit(min(len(fails), 125))
+
+
+if __name__ == "__main__":
+    main()
