@@ -54,6 +54,19 @@ def np_eval(e, env):
                 return x.sum().reshape(1, 1)
             return np.trace(x).reshape(1, 1)
         raise NotImplementedError(kind)
+    if k == "vec":
+        x = np_eval(e.children[0], env)
+        return x.T.reshape(-1, 1)
+    if k == "rank1":
+        a, u, v = (np_eval(c, env) for c in e.children)
+        return a + u @ v.T
+    if k == "select_value":
+        x = np_eval(e.children[0], env)
+        pred, fill = e.attrs["predicate"], e.attrs["fill"]
+        return np.where(np.asarray(pred(x)), x, fill).astype(np.float32)
+    if k == "join_index":
+        a, b = (np_eval(c, env) for c in e.children)
+        return np.asarray(e.attrs["merge"](a, b), dtype=np.float32)
     if k == "select_index":
         x = np_eval(e.children[0], env).copy()
         rows, cols = e.attrs["rows"], e.attrs["cols"]
@@ -97,7 +110,7 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",)):
         return leaf_of(shape)
     choice = rng.choice(
         ["matmul", "elemwise", "scalar", "transpose", "agg_chain",
-         "select", "leaf"])
+         "select", "select_value", "join_index", "rank1", "leaf"])
     if choice == "matmul":
         k = int(rng.choice(dims[1:]))
         a = gen_expr(rng, env, mesh, depth - 1, (shape[0], k), leaf_kinds)
@@ -131,6 +144,19 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",)):
         c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
         m = int(rng.integers(2, 5))
         return E.select_index(c, rows=lambda i, m=m: i % m != 0)
+    if choice == "select_value":
+        c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
+        t = float(rng.uniform(-0.5, 0.5))
+        return E.select_value(c, lambda v, t=t: v > t)
+    if choice == "join_index":
+        a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
+        b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
+        return E.join_on_index(a, b, lambda x, y: x * y + x)
+    if choice == "rank1":
+        a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
+        u = gen_expr(rng, env, mesh, depth - 1, (shape[0], 1), leaf_kinds)
+        v = gen_expr(rng, env, mesh, depth - 1, (shape[1], 1), leaf_kinds)
+        return E.rank_one_update(a, u, v)
     return leaf_of(shape)
 
 

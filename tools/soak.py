@@ -138,7 +138,8 @@ def main():
     if args.battery in ("fuzz", "all"):
         fails += soak_fuzz(args.seeds, args.base, tol)
     if args.battery in ("spmv", "all"):
-        fails += soak_spmv(args.seeds, args.base, 2e-4)
+        fails += soak_spmv(args.seeds, args.base,
+                           1e-3 if args.tpu else 2e-4)
     print(f"SOAK COMPLETE: {len(fails)} failures")
     for f in fails[:20]:
         print(" ", f)
