@@ -188,13 +188,12 @@ class COOMatrix:
                              f"got {X.shape}")
         if X.shape[1] == 0:
             return jnp.zeros((self.shape[0], 0), jnp.float32)
-        # Sharded matrices stay on the (sharded) matvec per column —
-        # building a second full-size unsharded plan here would defeat
-        # the reason the matrix was sharded.
-        if self._plan_sharded is None:
-            plan = self._get_plan()
-            if plan is not None:
-                return spmv_lib.spmm(plan, X)
+        if self._plan_sharded is not None:
+            return spmv_lib.spmm_sharded(self._plan_sharded, X,
+                                         self._mesh)
+        plan = self._get_plan()
+        if plan is not None:
+            return spmv_lib.spmm(plan, X)
         cols = [self.matvec(X[:, j]) for j in range(X.shape[1])]
         return jnp.stack(cols, axis=1)
 

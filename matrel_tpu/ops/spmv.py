@@ -367,11 +367,18 @@ def spmm_apply(plan_static, arrays, extra, X: jax.Array) -> jax.Array:
     out = jax.lax.map(chunk, (hh, ll, ww))             # (nch, ch, H, LO·k)
     y = out.reshape(nch * ch, -1, LO, k).reshape(-1, k)[:n_rows]
     if len(arrays) > 4:
-        ov_c, ov_r, ov_v = arrays[4:]
-        w_ov = jnp.take(x_ext, ov_c, axis=0) * ov_v[:, None]
-        y = y + jax.ops.segment_sum(w_ov, ov_r, num_segments=n_rows,
-                                    indices_are_sorted=True)
+        y = _overflow_add_wide(y, arrays, X, n_rows)
     return y
+
+
+def _overflow_add_wide(y, arrays, X, n_rows):
+    """k-wide overflow COO accumulation. Overflow indices are always real
+    columns (< n_cols — sentinels never overflow), so gather straight
+    from X, no padded copy."""
+    ov_c, ov_r, ov_v = arrays[4:]
+    w_ov = jnp.take(X.astype(jnp.float32), ov_c, axis=0) * ov_v[:, None]
+    return y + jax.ops.segment_sum(w_ov, ov_r, num_segments=n_rows,
+                                   indices_are_sorted=True)
 
 
 _spmm_jitted = jax.jit(spmm_apply, static_argnums=0)
@@ -411,6 +418,64 @@ def spmv_sharded_apply(plan_static, arrays, x: jax.Array,
     if len(arrays) > 4:
         y = _overflow_add(y, arrays, x, n_rows)
     return y
+
+
+def spmm_sharded_apply(plan_static, arrays, extra, X: jax.Array,
+                       mesh) -> jax.Array:
+    """k-wide variant of ``spmv_sharded_apply`` (call inside shard_map
+    over all mesh axes): per-device block-slice contraction of the
+    replicated X, one tiled all_gather of the (n, k) result."""
+    n_rows, n_cols, block = plan_static
+    axes = tuple(mesh.axis_names)
+    # local contribution: full spmm body minus overflow/slicing
+    y_loc = spmm_apply((block * arrays[0].shape[0], n_cols, block),
+                       arrays[:4], extra, X)
+    y = jax.lax.all_gather(y_loc, axes, axis=0, tiled=True)[:n_rows]
+    if len(arrays) > 4:
+        y = _overflow_add_wide(y, arrays, X, n_rows)
+    return y
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_spmm_runner(plan_static, mesh, has_overflow: bool):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+    table_specs = sharded_table_specs(axes, 7 if has_overflow else 4)
+    # the spmm extra tables are derived from sharded tables elementwise,
+    # so they carry the same block-axis sharding
+    in_specs = (table_specs[:4]
+                + (P(axes, None), P(axes, None))   # src_full, val
+                + (P(),)                            # X replicated
+                + table_specs[4:])
+
+    def kernel(src8, sel, oh_hi, oh_lo, src_full, val, x, *ov):
+        arrays = (src8, sel, oh_hi, oh_lo) + ov
+        return spmm_sharded_apply(plan_static, arrays, (src_full, val),
+                                  x, mesh)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                             out_specs=P(), check_vma=False))
+
+
+def spmm_sharded(plan: EdgeSpMVPlan, X: jax.Array, mesh,
+                 col_chunk: int = 64) -> jax.Array:
+    """Y = A·X over a mesh-sharded plan (see ``shard_plan``)."""
+    X = jnp.asarray(X, jnp.float32)
+    if X.shape[1] == 0:
+        return jnp.zeros((plan.n_rows, 0), jnp.float32)
+    if X.shape[1] == 1:
+        return spmv_sharded(plan, X[:, 0], mesh)[:, None]
+    arrays = plan.arrays()
+    extra = plan.spmm_extra(arrays)
+    run = _sharded_spmm_runner((plan.n_rows, plan.n_cols, plan.block),
+                               mesh, len(arrays) > 4)
+    outs = []
+    for j in range(0, X.shape[1], col_chunk):
+        outs.append(run(*arrays[:4], *extra, X[:, j:j + col_chunk],
+                        *arrays[4:]))
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
 
 
 def shard_plan(plan: EdgeSpMVPlan, mesh) -> EdgeSpMVPlan:

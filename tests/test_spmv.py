@@ -211,6 +211,24 @@ class TestShardedSpMV:
         assert "all-gather" in hlo
         assert "reduce-scatter" not in hlo and "all-to-all" not in hlo
 
+    @pytest.mark.parametrize("k", [1, 3, 70])
+    def test_spmm_sharded_matches_single(self, mesh8, k):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(15 + k)
+        n_r, n_c, m = 6000, 3000, 40_000
+        rows = rng.integers(0, n_r, m)
+        cols = rng.integers(0, n_c, m)
+        vals = rng.standard_normal(m).astype(np.float32)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=n_r, n_cols=n_c)
+        X = rng.standard_normal((n_c, k)).astype(np.float32)
+        want = np.asarray(spmv_lib.spmm(plan, jnp.asarray(X)))
+        plan_s = spmv_lib.shard_plan(
+            spmv_lib.build_spmv_plan(rows, cols, vals,
+                                     n_rows=n_r, n_cols=n_c), mesh8)
+        got = np.asarray(spmv_lib.spmm_sharded(plan_s, X, mesh8))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
     def test_pagerank_sharded_matches_single(self, mesh8):
         from matrel_tpu.workloads import pagerank as pr
         rng = np.random.default_rng(12)
