@@ -121,10 +121,15 @@ def bench_spmm(mesh, cfg):
         np.asarray(fetch(cur.data))
 
     chained(2)
-    lo, hi = 2, 12
-    t0 = time.perf_counter(); chained(lo); t_lo = time.perf_counter() - t0
-    t0 = time.perf_counter(); chained(hi); t_hi = time.perf_counter() - t0
-    dt = max((t_hi - t_lo) / (hi - lo), 1e-9)
+    # sub-ms op on a shared chip: median of several marginal estimates
+    # over long chains, or the relay's dispatch jitter swamps the signal
+    lo, hi = 5, 45
+    ests = []
+    for _ in range(3):
+        t0 = time.perf_counter(); chained(lo); t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter(); chained(hi); t_hi = time.perf_counter() - t0
+        ests.append(max((t_hi - t_lo) / (hi - lo), 1e-9))
+    dt = sorted(ests)[1]
     fl = 2.0 * S.nnzb * bs * bs * 512
     return {"metric": "blocksparse_spmm_100k_1pct_wallclock",
             "value": round(dt * 1e3, 2), "unit": "ms", "nnzb": S.nnzb,

@@ -16,6 +16,7 @@ with scalar-prefetched DMA when running on real TPU.
 
 from __future__ import annotations
 
+import weakref
 from typing import Optional, Tuple
 
 import jax
@@ -34,8 +35,19 @@ def _use_pallas(cfg: MatrelConfig) -> bool:
 
 # Runner cache: make_spmm/_xla_spmm build a fresh jitted closure per call,
 # which would recompile on every spmm() of the same matrix (jit caches by
-# function identity). Key on the static pieces of the plan.
+# function identity). Key on the static pieces of the plan. Runner
+# closures capture values from S but never S itself, and a weakref
+# finalizer purges a matrix's entries when it is collected — the Pallas
+# runner bakes a permuted copy of the whole tile stack, so entries
+# outliving their matrix would pin ~2× the stack in HBM per matrix.
 _RUNNER_CACHE: dict = {}
+_FINALIZER_IDS: set = set()
+
+
+def _purge_runners(sid: int) -> None:
+    _FINALIZER_IDS.discard(sid)
+    for k in [k for k in _RUNNER_CACHE if k[0] == sid]:
+        del _RUNNER_CACHE[k]
 
 
 def _cached_runner(S, pm, out_pshape, d_spec, out_sharding, cfg, interpret):
@@ -50,6 +62,9 @@ def _cached_runner(S, pm, out_pshape, d_spec, out_sharding, cfg, interpret):
         else:
             run = _xla_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg)
         _RUNNER_CACHE[key] = run
+        if id(S) not in _FINALIZER_IDS:
+            _FINALIZER_IDS.add(id(S))
+            weakref.finalize(S, _purge_runners, id(S))
     return run
 
 

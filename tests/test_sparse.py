@@ -191,3 +191,81 @@ class TestRightSparseMatmul:
             E.matmul(A.expr(), S.expr()), mesh8,
             MatrelConfig()).run().to_numpy()
         np.testing.assert_allclose(out2, a @ sp_np, rtol=1e-4, atol=1e-4)
+
+class TestRunnerCacheHygiene:
+    def test_runner_cache_purged_on_gc(self, mesh8, rng):
+        # the Pallas runner bakes a permuted copy of the tile stack, so
+        # cache entries must die with their matrix or HBM residency grows
+        # ~2x tile stack per matrix built
+        import gc
+        a = random_block_sparse_np(rng, 16, 16, 8, 0.5)
+        d = rng.standard_normal((16, 8)).astype(np.float32)
+        D = BlockMatrix.from_numpy(d, mesh=mesh8)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        spmm_lib.spmm(S, D, MatrelConfig(use_pallas=False), interpret=True)
+        sid = id(S)
+        assert any(k[0] == sid for k in spmm_lib._RUNNER_CACHE)
+        del S
+        gc.collect()
+        assert not any(k[0] == sid for k in spmm_lib._RUNNER_CACHE)
+
+    def test_blocks_reassignment_raises_on_pallas_path(self, mesh8, rng):
+        # the baked payload cannot see a reassigned S.blocks; the XLA
+        # fallback would honor it, so the Pallas runner fails loudly
+        import jax.numpy as jnp
+        a = random_block_sparse_np(rng, 16, 16, 8, 0.5)
+        d = rng.standard_normal((16, 8)).astype(np.float32)
+        D = BlockMatrix.from_numpy(d, mesh=mesh8)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        cfg = MatrelConfig(use_pallas=False)
+        spmm_lib.spmm(S, D, cfg, interpret=True)
+        S.blocks = jnp.zeros_like(S.blocks)
+        with pytest.raises(ValueError, match="reassigned"):
+            spmm_lib.spmm(S, D, cfg, interpret=True)
+
+    def test_runner_build_inside_trace_no_tracer_leak(self, mesh8, rng):
+        # regression (2026-07-30): a runner-cache miss inside an outer
+        # jit trace must not leak tracers into the cached closure —
+        # the build-time payload permutation runs under
+        # ensure_compile_time_eval
+        import jax
+        a = random_block_sparse_np(rng, 16, 16, 8, 0.5)
+        d = rng.standard_normal((16, 8)).astype(np.float32)
+        D = BlockMatrix.from_numpy(d, mesh=mesh8)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        cfg = MatrelConfig(use_pallas=False)
+
+        @jax.jit
+        def f(dd):
+            return spmm_lib.apply(S, dd, (16, 8), cfg, interpret=True)
+
+        out1 = np.asarray(f(D.data))
+        # fresh, independent use of the now-cached runner
+        out2 = np.asarray(
+            spmm_lib.apply(S, D.data, (16, 8), cfg, interpret=True))
+        np.testing.assert_allclose(out1[:16, :8], a @ d, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(out2, out1, rtol=0, atol=0)
+
+    def test_memo_rebuilt_for_runner_built_after_reassignment(self, mesh8,
+                                                              rng):
+        # a runner built AFTER S.blocks is reassigned must bake the NEW
+        # stack, not reuse the memoised payload from the old one
+        import jax.numpy as jnp
+        a = random_block_sparse_np(rng, 16, 16, 8, 0.5)
+        d = rng.standard_normal((16, 8)).astype(np.float32)
+        d2 = rng.standard_normal((16, 16)).astype(np.float32)
+        D = BlockMatrix.from_numpy(d, mesh=mesh8)
+        D2 = BlockMatrix.from_numpy(d2, mesh=mesh8)
+        S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        cfg = MatrelConfig(use_pallas=False)
+        spmm_lib.spmm(S, D, cfg, interpret=True)     # memo built from a
+        S.blocks = 2.0 * S.blocks                    # reassignment
+        # different dense width -> cache miss -> fresh runner: must
+        # compute with the NEW blocks
+        out = spmm_lib.spmm(S, D2, cfg, interpret=True)
+        np.testing.assert_allclose(out.to_numpy(), (2.0 * a) @ d2,
+                                   rtol=1e-4, atol=1e-4)
+        # the pre-reassignment runner still refuses loudly
+        with pytest.raises(ValueError, match="reassigned"):
+            spmm_lib.spmm(S, D, cfg, interpret=True)
