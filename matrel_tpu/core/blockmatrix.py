@@ -316,6 +316,12 @@ class BlockMatrix:
     def trace(self):
         return self.expr().trace()
 
+    def inverse(self):
+        return self.expr().inverse()
+
+    def solve(self, b):
+        return self.expr().solve(b)
+
     def vec(self):
         return self.expr().vec()
 

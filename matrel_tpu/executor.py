@@ -120,6 +120,10 @@ class Lowerer:
             return ev(node.children[0]).T
         if k == "matmul":
             return self._matmul(node, ev)
+        if k == "solve":
+            return self._solve(node, ev)
+        if k == "inverse":
+            return self._inverse(node, ev)
         if k == "elemwise":
             return self._elemwise(node, ev)
         if k == "scalar":
@@ -157,6 +161,37 @@ class Lowerer:
         if k in ("join_rows", "join_cols"):
             return self._join_axis(node, ev)
         raise NotImplementedError(f"lowering for node kind {k!r}")
+
+    def _solve(self, node: MatExpr, ev) -> Array:
+        """X = A⁻¹·B as a dense LU solve on the LOGICAL shapes.
+
+        Padded rows/cols must be sliced off first — a zero-padded square
+        matrix is singular. Like the reference's normal-equations
+        workload, this is a local (replicated) solve intended for
+        small/medium systems (e.g. the k×k Gram matrix); it is not a
+        distributed triangular solve. Computed in f32 for stability,
+        cast back when keep_input_dtype asks for it."""
+        l, r = node.children
+        n = l.shape[0]
+        m = r.shape[1]
+        a = ev(l)[:n, :n]
+        b = ev(r)[:n, :m]
+        out = jnp.linalg.solve(a.astype(jnp.float32),
+                               b.astype(jnp.float32))
+        if self.config.keep_input_dtype and a.dtype == b.dtype:
+            out = out.astype(a.dtype)
+        return self._pad_to_node(out, node)
+
+    def _inverse(self, node: MatExpr, ev) -> Array:
+        """A⁻¹ on the logical shape (see _solve for the padding/dtype
+        contract). Prefer solve(A, B) — R7 rewrites A⁻¹·B into it."""
+        (c,) = node.children
+        n = c.shape[0]
+        a = ev(c)[:n, :n]
+        out = jnp.linalg.inv(a.astype(jnp.float32))
+        if self.config.keep_input_dtype:
+            out = out.astype(a.dtype)
+        return self._pad_to_node(out, node)
 
     def _join_axis(self, node: MatExpr, ev) -> Array:
         """Row/col-index joins: statically-shaped pairwise merge along the

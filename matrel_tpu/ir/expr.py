@@ -11,6 +11,7 @@ Node set mirrors the reference's logical operators:
   Leaf, Transpose, MatMul, Add/Sub/ElemMul/ElemDiv (elementwise),
   ScalarOp (add/mul/pow by a scalar), Agg (sum/count/avg/max/min over
   row/col/all/diag — covers rowSum/colSum/sum/trace), Vec, RankOneUpdate,
+  Inverse/Solve (dense local linear solves — the normal-equations step),
   SelectValue/SelectIndex (relational σ), JoinOnIndex/JoinOnValue (⋈).
 
 All shape/sparsity metadata lives on the nodes so the optimizer runs as pure
@@ -147,6 +148,12 @@ class MatExpr:
 
     def col_avg(self) -> "MatExpr":
         return agg(self, "avg", "col")
+
+    def inverse(self) -> "MatExpr":
+        return inverse(self)
+
+    def solve(self, b) -> "MatExpr":
+        return solve(self, as_expr(b))
 
     def vec(self) -> "MatExpr":
         return vec(self)
@@ -304,6 +311,31 @@ def rank_one_update(a: MatExpr, u: MatExpr, v: MatExpr) -> MatExpr:
         raise ValueError(
             f"rank_one_update expects u:({n},1) v:({m},1); got {u.shape}, {v.shape}")
     return MatExpr("rank1", (a, u, v), a.shape, None)
+
+
+def inverse(a: MatExpr) -> MatExpr:
+    """A⁻¹ for square A. Dense local solve on the logical (unpadded)
+    matrix — the analogue of the reference's driver-side inverse in the
+    normal-equations workload ((XᵀX)⁻¹Xᵀy, SURVEY.md §2 workloads row):
+    the Gram matrix is small, so the reference inverts it locally, not
+    distributively. Prefer ``solve(a, b)`` over ``inverse(a) @ b`` —
+    the optimizer rewrites the latter into the former (R7).
+    """
+    n, m = a.shape
+    if n != m:
+        raise ValueError(f"inverse needs a square matrix, got {a.shape}")
+    return MatExpr("inverse", (a,), a.shape, None)
+
+
+def solve(a: MatExpr, b: MatExpr) -> MatExpr:
+    """X = A⁻¹·B (solve A·X = B) for square A. ``assume`` can be set via
+    attrs later; lowering uses a dense LU solve on the logical shapes."""
+    n, m = a.shape
+    if n != m:
+        raise ValueError(f"solve needs a square lhs, got {a.shape}")
+    if b.shape[0] != n:
+        raise ValueError(f"solve shape mismatch: {a.shape} x {b.shape}")
+    return MatExpr("solve", (a, b), b.shape, None)
 
 
 def select_value(a: MatExpr, predicate: Callable, fill: float = 0.0) -> MatExpr:

@@ -17,6 +17,9 @@ Rules, mirroring the reference's Catalyst batch:
      transposes (σ_rows through transpose becomes σ_cols).
   R6 matrix-chain DP reorder (chain.py), run after the structure-exposing
      rules above.
+  R7 solve fusion: A⁻¹·B → solve(A,B) ; A·B⁻¹ → solve(Bᵀ,Aᵀ)ᵀ ;
+     (A⁻¹)⁻¹ → A — the normal-equations pattern (XᵀX)⁻¹·Xᵀy never
+     materialises an inverse.
 
 Each rule is a bottom-up tree transform; the batch runs to fixpoint with a
 bound, Catalyst-style.
@@ -29,7 +32,8 @@ from typing import Callable, List, Optional
 from matrel_tpu.config import MatrelConfig, default_config
 from matrel_tpu.ir import chain as chain_lib
 from matrel_tpu.ir.expr import (
-    MatExpr, agg, elemwise, matmul, scalar_op, select_index, transpose,
+    MatExpr, agg, elemwise, matmul, scalar_op, select_index, solve,
+    transpose,
 )
 
 Rule = Callable[[MatExpr], Optional[MatExpr]]
@@ -178,11 +182,34 @@ def selection_pushdown(e: MatExpr) -> Optional[MatExpr]:
     return None
 
 
+# -- R7: solve fusion --------------------------------------------------------
+
+
+def solve_fusion(e: MatExpr) -> Optional[MatExpr]:
+    """A⁻¹·B → solve(A, B); A·B⁻¹ → solve(Bᵀ, Aᵀ)ᵀ; (A⁻¹)⁻¹ → A.
+
+    The reference's normal-equations workload writes (XᵀX)⁻¹·(Xᵀy); an
+    explicit inverse materialises n² solve results to use n·m of them
+    and is less numerically stable than LU-solving against B directly.
+    """
+    if e.kind == "inverse" and e.children[0].kind == "inverse":
+        return e.children[0].children[0]
+    if e.kind != "matmul":
+        return None
+    a, b = e.children
+    if a.kind == "inverse":
+        return solve(a.children[0], b)
+    if b.kind == "inverse":
+        return transpose(solve(transpose(b.children[0]), transpose(a)))
+    return None
+
+
 _RULES: List[Rule] = [
     transpose_rules,
     agg_pushdown,
     scalar_folding,
     selection_pushdown,
+    solve_fusion,
 ]
 
 _MAX_ITERS = 10

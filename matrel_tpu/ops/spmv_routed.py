@@ -99,15 +99,14 @@ class RoutedSpMVPlan:
         return self.g_src * self.g_dst * self.cap
 
     def arrays(self):
-        """Device-array tuple for jit boundaries (placed on first use)."""
+        """Device-array tuple for jit boundaries (placed on first use).
+        The tables are host numpy from the build, so jnp.asarray yields
+        concrete constants even inside an outer trace — safe to cache."""
         ov = () if self.ov_rows is None else (self.ov_rows, self.ov_cols,
                                               self.ov_vals)
         if self._dev is None:
-            dev = (jnp.asarray(self.loc_src), jnp.asarray(self.loc_dst),
-                   jnp.asarray(self.val))
-            if any(isinstance(d, jax.core.Tracer) for d in dev):
-                return dev + ov        # in-trace: don't cache tracers
-            self._dev = dev
+            self._dev = (jnp.asarray(self.loc_src),
+                         jnp.asarray(self.loc_dst), jnp.asarray(self.val))
             self.loc_src = self.loc_dst = self.val = None
         return self._dev + ov
 
@@ -116,7 +115,8 @@ def build_routed_plan(rows, cols, vals=None, n_rows: int = None,
                       n_cols: int = None, *,
                       capacity_quantile: float = 0.997,
                       max_padding: float = 3.0,
-                      max_slots: Optional[int] = None
+                      max_slots: Optional[int] = None,
+                      max_cap: int = 4096
                       ) -> Optional[RoutedSpMVPlan]:
     """Host-side plan build (numpy, once per graph).
 
@@ -124,8 +124,11 @@ def build_routed_plan(rows, cols, vals=None, n_rows: int = None,
     rounded up to a multiple of 128 (the matmul row dim); edges past it
     go to the overflow COO. Returns None when the padded slot count
     exceeds ``max_padding``× the edge count (sparse cells — small or
-    very skewed graphs are better served by ops/spmv.py) or
-    ``max_slots``.
+    very skewed graphs are better served by ops/spmv.py), ``max_slots``,
+    or when capacity exceeds ``max_cap`` — the kernels keep ~(cap, 128)
+    one-hot and (cap, 128·passes) contraction buffers in VMEM (~16 MB),
+    so edge-dense cells must fall back rather than fail at Mosaic
+    compile time.
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
@@ -154,6 +157,8 @@ def build_routed_plan(rows, cols, vals=None, n_rows: int = None,
         pos = cnt[cnt > 0]
         cap_q = int(np.quantile(pos, capacity_quantile)) if pos.size else 0
         cap = max(LANE, -(-cap_q // LANE) * LANE)
+    if cap > max_cap:
+        return None
     if m and n_cells * cap > max_padding * m:
         return None
     if max_slots is not None and n_cells * cap > max_slots:

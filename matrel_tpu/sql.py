@@ -192,6 +192,10 @@ class _Compiler(ast.NodeVisitor):
             return self._expr(args[0]).power(self._lit(args[1]))
         if name == "vec":
             return self._expr(args[0]).vec()
+        if name in ("inverse", "inv"):
+            return self._expr(args[0]).inverse()
+        if name == "solve":
+            return self._expr(args[0]).solve(self._expr(args[1]))
         if name in _AGG_FNS:
             kind, axis = _AGG_FNS[name]
             return E.agg(self._expr(args[0]), kind, axis)

@@ -228,3 +228,55 @@ class TestBoundRunner:
             rebind_uids=tuple(l.uid for l in plan.leaf_order))
         with pytest.raises(ValueError, match="rebound"):
             step(A.data)
+
+
+class TestSolveInverse:
+    """inverse/solve nodes — the normal-equations building blocks."""
+
+    def _spd(self, rng, n):
+        m = rng.standard_normal((n, n)).astype(np.float32)
+        return m @ m.T + n * np.eye(n, dtype=np.float32)
+
+    def test_inverse_matches_numpy(self, mesh8, rng):
+        a = self._spd(rng, 12)
+        out = bm(a, mesh8).inverse().compute().to_numpy()
+        np.testing.assert_allclose(out, np.linalg.inv(a), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_solve_matches_numpy(self, mesh8, rng):
+        a = self._spd(rng, 12)
+        b = rng.standard_normal((12, 5)).astype(np.float32)
+        out = bm(a, mesh8).solve(bm(b, mesh8)).compute().to_numpy()
+        np.testing.assert_allclose(out, np.linalg.solve(a, b), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_ragged_padding_not_singular(self, mesh8, rng):
+        # 13x13 pads to a larger grid: the zero padding must be sliced
+        # off before the LU factorisation or the system is singular
+        a = self._spd(rng, 13)
+        b = rng.standard_normal((13, 3)).astype(np.float32)
+        out = bm(a, mesh8).solve(bm(b, mesh8)).compute().to_numpy()
+        np.testing.assert_allclose(out, np.linalg.solve(a, b), rtol=1e-3,
+                                   atol=1e-4)
+        assert np.isfinite(out).all()
+
+    def test_normal_equations_end_to_end(self, mesh8, rng):
+        # the reference's flagship expression, straight from the DSL:
+        # theta = (XᵀX)⁻¹ · (Xᵀy)
+        x = rng.standard_normal((40, 6)).astype(np.float32)
+        y = (x @ np.arange(1, 7, dtype=np.float32)[:, None]
+             + 0.01 * rng.standard_normal((40, 1)).astype(np.float32))
+        X, Y = bm(x, mesh8), bm(y, mesh8)
+        theta = (X.t().matmul(X)).inverse().matmul(
+            X.t().matmul(Y)).compute().to_numpy()
+        oracle = np.linalg.solve(x.T @ x, x.T @ y)
+        np.testing.assert_allclose(theta, oracle, rtol=1e-2, atol=1e-3)
+
+    def test_shape_validation(self, mesh8, rng):
+        import matrel_tpu.ir.expr as E
+        A = bm(rng.standard_normal((4, 6)), mesh8)
+        with pytest.raises(ValueError, match="square"):
+            A.inverse()
+        B = bm(rng.standard_normal((6, 6)), mesh8)
+        with pytest.raises(ValueError, match="mismatch"):
+            E.solve(B.expr(), bm(rng.standard_normal((4, 2)), mesh8).expr())

@@ -228,3 +228,37 @@ class TestCSE:
         e = A.multiply(B).t().add(A.multiply(B).t())
         np.testing.assert_allclose(e.compute().to_numpy(), 2 * (a @ b).T,
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestSolveFusion:
+    """R7: inverses never materialise when they feed a multiply."""
+
+    def _exprs(self, mesh8, rng):
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        b = rng.standard_normal((8, 4)).astype(np.float32)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        B = BlockMatrix.from_numpy(b, mesh=mesh8)
+        return A.expr(), B.expr()
+
+    def test_left_inverse_becomes_solve(self, mesh8, rng):
+        from matrel_tpu.ir import rules
+        import matrel_tpu.ir.expr as E
+        A, B = self._exprs(mesh8, rng)
+        e = rules.apply_rewrites(E.matmul(E.inverse(A), B))
+        assert e.kind == "solve"
+        assert e.children[0] is A and e.children[1] is B
+
+    def test_right_inverse_becomes_transposed_solve(self, mesh8, rng):
+        from matrel_tpu.ir import rules
+        import matrel_tpu.ir.expr as E
+        A, B = self._exprs(mesh8, rng)
+        e = rules.apply_rewrites(E.matmul(A, E.inverse(A)))
+        assert e.kind == "transpose" and e.children[0].kind == "solve"
+
+    def test_double_inverse_cancels(self, mesh8, rng):
+        from matrel_tpu.ir import rules
+        import matrel_tpu.ir.expr as E
+        A, _ = self._exprs(mesh8, rng)
+        e = rules.apply_rewrites(E.inverse(E.inverse(A)))
+        assert e is A

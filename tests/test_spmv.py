@@ -396,7 +396,7 @@ class TestRoutedSpMV:
 
     def test_matches_oracle_two_groups(self, rng):
         from matrel_tpu.ops import spmv_routed as rt
-        n, m = 40_000, 60_000          # spans 3 groups of 16384
+        n, m = 40_000, 20_000          # spans 3 groups of 16384
         rows, cols, vals = random_coo(rng, n, n, m)
         plan = rt.build_routed_plan(rows, cols, vals, n, n)
         assert plan is not None
@@ -409,7 +409,7 @@ class TestRoutedSpMV:
 
     def test_three_passes_f32_faithful(self, rng):
         from matrel_tpu.ops import spmv_routed as rt
-        n, m = 20_000, 30_000
+        n, m = 20_000, 5_000
         rows, cols, vals = random_coo(rng, n, n, m)
         plan = rt.build_routed_plan(rows, cols, vals, n, n)
         x = rng.standard_normal(n).astype(np.float32)
@@ -478,3 +478,13 @@ class TestRoutedSpMV:
             rel = np.abs(back - np.asarray(v, np.float64))
             rel = rel / np.maximum(np.abs(np.asarray(v)), 1e-30)
             assert rel.max() < tol
+
+    def test_cap_ceiling_gates(self, rng):
+        from matrel_tpu.ops import spmv_routed as rt
+        # one edge-dense cell: capacity would exceed the VMEM-safe
+        # ceiling, so the build must refuse (fallback contract), not
+        # fail at kernel compile time
+        n, m = 16_000, 300_000
+        rows, cols, vals = random_coo(rng, n, n, m)
+        assert rt.build_routed_plan(rows, cols, vals, n, n,
+                                    max_padding=100.0) is None

@@ -78,3 +78,17 @@ def test_unsafe_predicate_rejected(sess):
         s.sql("select(A, '__import__(\"os\").system(\"true\")')")
     with pytest.raises(SqlError):
         s.sql("select(A, 'v.__class__')")
+
+
+def test_solve_and_inverse(sess):
+    s, a, b = sess
+    # normal equations in SQL: solve(AᵀA, Aᵀb) over the 8x6 table A
+    out = s.compute(
+        s.sql("solve(multiply(transpose(A), A), multiply(transpose(A), transpose(B)))")
+    ).to_numpy()
+    oracle = np.linalg.solve(a.T @ a, a.T @ b.T)
+    np.testing.assert_allclose(out, oracle, rtol=1e-2, atol=1e-3)
+    gram_inv = s.compute(
+        s.sql("inverse(multiply(transpose(A), A))")).to_numpy()
+    np.testing.assert_allclose(gram_inv, np.linalg.inv(a.T @ a),
+                               rtol=1e-2, atol=1e-3)
