@@ -237,6 +237,13 @@ class BlockSparseMatrix:
             shape=(self.shape[1], self.shape[0]),
             block_size=self.block_size, mesh=self.mesh)
 
+    def shard(self, mesh: Optional[Mesh] = None):
+        """Distribute the tile stack over a mesh (each device holds
+        ~nnzb/P tiles in its output row range) — the scale-out SpMM
+        plan; see ops/spmm_sharded.py."""
+        from matrel_tpu.ops.spmm_sharded import shard_block_sparse
+        return shard_block_sparse(self, mesh)
+
     # -- lazy DSL -----------------------------------------------------------
 
     def expr(self):

@@ -60,6 +60,11 @@ def np_eval(e, env):
     if k == "rank1":
         a, u, v = (np_eval(c, env) for c in e.children)
         return a + u @ v.T
+    if k == "solve":
+        a, b = (np_eval(c, env) for c in e.children)
+        return np.linalg.solve(a, b).astype(np.float32)
+    if k == "inverse":
+        return np.linalg.inv(np_eval(e.children[0], env)).astype(np.float32)
     if k == "select_value":
         x = np_eval(e.children[0], env)
         pred, fill = e.attrs["predicate"], e.attrs["fill"]
@@ -110,7 +115,8 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",)):
         return leaf_of(shape)
     choice = rng.choice(
         ["matmul", "elemwise", "scalar", "transpose", "agg_chain",
-         "select", "select_value", "join_index", "rank1", "leaf"])
+         "select", "select_value", "join_index", "rank1", "solve",
+         "leaf"])
     if choice == "matmul":
         k = int(rng.choice(dims[1:]))
         a = gen_expr(rng, env, mesh, depth - 1, (shape[0], k), leaf_kinds)
@@ -152,6 +158,19 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",)):
         a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
         b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
         return E.join_on_index(a, b, lambda x, y: x * y + x)
+    if choice == "solve":
+        # well-conditioned lhs: a random leaf shifted to diagonal
+        # dominance, so the numpy oracle and the LU solve both stay
+        # far from singularity across all seeds
+        n = shape[0]
+        m_np = rng.standard_normal((n, n)).astype(np.float32)
+        m_np = (m_np @ m_np.T / n + 2.0 * np.eye(n, dtype=np.float32))
+        l = E.leaf(BlockMatrix.from_numpy(m_np, mesh=mesh))
+        env[l.uid] = m_np
+        b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
+        if rng.random() < 0.5:
+            return E.solve(l, b)
+        return E.matmul(E.inverse(l), b)   # exercises the R7 fusion
     if choice == "rank1":
         a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
         u = gen_expr(rng, env, mesh, depth - 1, (shape[0], 1), leaf_kinds)
