@@ -50,7 +50,8 @@ def main():
 
     def compiled_flops(plan):
         arrays = [l.attrs["matrix"].data for l in plan.leaf_order]
-        return plan.jitted.lower(*arrays).compile().cost_analysis()["flops"]
+        lowered = plan.jitted.lower(*arrays, *plan.extra_args)
+        return lowered.compile().cost_analysis()["flops"]
 
     def timed(plan, label):
         run = plan.bound_runner()

@@ -437,16 +437,86 @@ class Lowerer:
         return out
 
 
+_HOIST_BYTES = 1 << 20
+
+
+def _hoist_large_consts(fn, example_args):
+    """Turn large trace constants into call-time arguments.
+
+    Sparse leaves embed their payloads (tile stacks, one-hot plan
+    tables) as constants of the traced program. XLA treats array
+    constants as parameters, but they still ship INSIDE the compile
+    request — and the axon relay rejects multi-GB requests (measured
+    2026-07-30: a 10M-edge COO plan through compile_expr fails at
+    remote_compile; the same op with arrays passed as arguments works).
+    Small constants (masks, iotas) stay embedded so XLA can fold them.
+
+    Returns (wrapped_fn, big_consts): call wrapped_fn(*leaves,
+    *big_consts). (jax.closure_convert is NOT usable here: it only
+    hoists consts that might carry AD perturbations; concrete payload
+    arrays stay closed over.)
+    """
+    from jax.tree_util import tree_unflatten
+
+    import numpy as _np
+
+    def _nbytes(c):
+        # consts may be jax Arrays, numpy arrays, or TypedNdArray
+        # wrappers (jax 0.9) that expose shape/dtype but not nbytes
+        try:
+            return int(_np.prod(c.shape)) * _np.dtype(c.dtype).itemsize
+        except (AttributeError, TypeError):
+            return 0
+
+    closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(
+        *example_args)
+    consts = closed.consts
+    big_ix = [i for i, c in enumerate(consts)
+              if _nbytes(c) >= _HOIST_BYTES]
+    # keep only the jaxpr and the SMALL consts: holding `closed` (or the
+    # full consts list) in the closure would pin the big payload host
+    # copies for the plan's lifetime — the very arrays the hoist manages
+    jaxpr = closed.jaxpr
+    small = {i: c for i, c in enumerate(consts) if i not in set(big_ix)}
+    big_vals = [jnp.asarray(consts[i]) for i in big_ix]
+    n_leaf = len(example_args)
+    n_consts = len(consts)
+    out_tree = jax.tree_util.tree_structure(out_shape)
+    del closed, consts
+
+    def hoisted(*args):
+        leafs, bigs = args[:n_leaf], args[n_leaf:]
+        it = iter(bigs)
+        cs = [small[i] if i in small else next(it)
+              for i in range(n_consts)]
+        flat = jax.core.eval_jaxpr(jaxpr, cs, *leafs)
+        return tree_unflatten(out_tree, flat)
+
+    # returned even when nothing was hoisted: the trace is already paid
+    # for, and handing back the raw fn would make jax.jit trace the
+    # whole program a second time on every dense compile
+    return hoisted, big_vals
+
+
+def _example_avals(leaf_order):
+    return [jax.ShapeDtypeStruct(l.attrs["matrix"].data.shape,
+                                 l.attrs["matrix"].data.dtype)
+            for l in leaf_order]
+
+
 @dataclasses.dataclass
 class CompiledPlan:
     """A jitted plan plus its leaf binding order — re-runnable with fresh
-    leaf data (the analogue of re-executing an RDD lineage on new blocks)."""
+    leaf data (the analogue of re-executing an RDD lineage on new blocks).
+    ``extra_args`` are hoisted large constants (sparse payloads), appended
+    to every call."""
 
     jitted: Callable
     leaf_order: List[MatExpr]
     optimized: MatExpr
     mesh: Mesh
     config: MatrelConfig
+    extra_args: List = dataclasses.field(default_factory=list)
     _donating: Dict[tuple, Callable] = dataclasses.field(default_factory=dict)
 
     def run(self, bindings: Optional[Dict[int, BlockMatrix]] = None,
@@ -466,9 +536,10 @@ class CompiledPlan:
             m = bound if bound is not None else l.attrs["matrix"]
             arrays.append(m.data)
         if donate and donated and self.config.donate_intermediates:
-            out = self._donating_fn(tuple(donated))(*arrays)
+            out = self._donating_fn(tuple(donated))(*arrays,
+                                                    *self.extra_args)
         else:
-            out = self.jitted(*arrays)
+            out = self.jitted(*arrays, *self.extra_args)
         return BlockMatrix.from_array(
             out, self.optimized.shape, self.mesh,
             padding.canonical_spec(tuple(out.shape), self.mesh),
@@ -494,8 +565,9 @@ class CompiledPlan:
         else:
             jfn = self.jitted
 
+        extra = tuple(self.extra_args)
         if not positions:
-            return lambda: jfn(*base)
+            return lambda: jfn(*base, *extra)
 
         def call(*arrays):
             if len(arrays) != len(positions):
@@ -505,7 +577,7 @@ class CompiledPlan:
             argv = list(base)
             for p, a in zip(positions, arrays):
                 argv[p] = a
-            return jfn(*argv)
+            return jfn(*argv, *extra)
 
         return call
 
@@ -521,7 +593,8 @@ class CompiledPlan:
     def hlo(self) -> str:
         """Optimized HLO text — for plan-shape assertions on collectives."""
         arrays = [l.attrs["matrix"].data for l in self.leaf_order]
-        return self.jitted.lower(*arrays).compile().as_text()
+        return self.jitted.lower(*arrays,
+                                 *self.extra_args).compile().as_text()
 
     def collectives(self) -> Dict[str, int]:
         """Count of each collective op in the compiled HLO — the assertable
@@ -559,6 +632,7 @@ class MultiPlan:
     optimized: Tuple[MatExpr, ...]
     mesh: Mesh
     config: MatrelConfig
+    extra_args: List = dataclasses.field(default_factory=list)
 
     def run(self, bindings: Optional[Dict[int, BlockMatrix]] = None
             ) -> Tuple[BlockMatrix, ...]:
@@ -566,7 +640,7 @@ class MultiPlan:
         for l in self.leaf_order:
             m = (bindings or {}).get(l.uid, l.attrs["matrix"])
             arrays.append(m.data)
-        outs = self.jitted(*arrays)
+        outs = self.jitted(*arrays, *self.extra_args)
         return tuple(
             BlockMatrix.from_array(
                 out, root.shape, self.mesh,
@@ -602,8 +676,10 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
                 seen.add(l.uid)
                 leaf_order.append(l)
     fn = Lowerer(mesh, cfg).lower_multi(opts, leaf_order)
+    fn, extra = _hoist_large_consts(fn, _example_avals(leaf_order))
     return MultiPlan(jitted=jax.jit(fn), leaf_order=leaf_order,
-                     optimized=opts, mesh=mesh, config=cfg)
+                     optimized=opts, mesh=mesh, config=cfg,
+                     extra_args=extra)
 
 
 def _check_one_mesh(expr: MatExpr, mesh: Mesh) -> None:
@@ -635,9 +711,10 @@ def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
     opt = planner.annotate_strategies(opt, mesh, cfg)
     leaf_order = expr_leaves(opt)
     fn = Lowerer(mesh, cfg).lower(opt, leaf_order)
+    fn, extra = _hoist_large_consts(fn, _example_avals(leaf_order))
     jitted = jax.jit(fn)
     return CompiledPlan(jitted=jitted, leaf_order=leaf_order, optimized=opt,
-                        mesh=mesh, config=cfg)
+                        mesh=mesh, config=cfg, extra_args=extra)
 
 
 def execute(expr: MatExpr, mesh: Optional[Mesh] = None,

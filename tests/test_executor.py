@@ -280,3 +280,51 @@ class TestSolveInverse:
         B = bm(rng.standard_normal((6, 6)), mesh8)
         with pytest.raises(ValueError, match="mismatch"):
             E.solve(B.expr(), bm(rng.standard_normal((4, 2)), mesh8).expr())
+
+
+class TestLargeConstHoisting:
+    """compile_expr hoists big sparse payloads into call-time args — the
+    axon relay rejects compile requests with multi-GB embedded constants
+    (the 10M-edge COO plan measured ~GBs of one-hot tables)."""
+
+    def test_sparse_payload_hoisted_and_correct(self, mesh8, rng):
+        from matrel_tpu.core.sparse import BlockSparseMatrix
+        from matrel_tpu.executor import compile_expr
+        from matrel_tpu.config import MatrelConfig
+        # tile stack > 1 MB: 64 tiles of 64x64 f32 = 1.05 MB
+        n = 512
+        a = np.zeros((n, n), np.float32)
+        for bi in range(8):
+            for bj in range(8):
+                a[bi*64:(bi+1)*64, bj*64:(bj+1)*64] = \
+                    rng.standard_normal((64, 64))
+        d = rng.standard_normal((n, 16)).astype(np.float32)
+        S = BlockSparseMatrix.from_numpy(a, block_size=64, mesh=mesh8)
+        D = bm(d, mesh8)
+        plan = compile_expr(S.multiply(D), mesh8, MatrelConfig())
+        assert len(plan.extra_args) >= 1        # payload rides as an arg
+        assert sum(c.nbytes for c in plan.extra_args) >= 1 << 20
+        np.testing.assert_allclose(plan.run().to_numpy(), a @ d,
+                                   rtol=1e-4, atol=1e-4)
+        # repeated runs and the iteration path both append the extras
+        np.testing.assert_allclose(plan.run().to_numpy(), a @ d,
+                                   rtol=1e-4, atol=1e-4)
+        out = np.asarray(plan.bound_runner()())
+        np.testing.assert_allclose(out[:n, :16], a @ d, rtol=1e-4,
+                                   atol=1e-4)
+        # donation paths must append the extras too (C <- f(C) loops)
+        D2 = bm(d, plan.mesh)
+        leaf_uid = plan.leaf_order[0].uid
+        out2 = plan.run(bindings={leaf_uid: D2}, donate=True).to_numpy()
+        np.testing.assert_allclose(out2, a @ d, rtol=1e-4, atol=1e-4)
+        run3 = plan.bound_runner(rebind_uids=(leaf_uid,), donate=True)
+        out3 = np.asarray(run3(bm(d, plan.mesh).data))
+        np.testing.assert_allclose(out3[:n, :16], a @ d, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_small_consts_stay_embedded(self, mesh8, rng):
+        from matrel_tpu.executor import compile_expr
+        from matrel_tpu.config import MatrelConfig
+        A = bm(rng.standard_normal((16, 16)), mesh8)
+        plan = compile_expr(A.expr().row_sum(), mesh8, MatrelConfig())
+        assert plan.extra_args == []            # nothing above 1 MB
