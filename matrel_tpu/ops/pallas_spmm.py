@@ -57,6 +57,33 @@ def _make_kernel(precision, nnzb):
     return _kernel
 
 
+def _pick_tm(pm: int) -> int:
+    """Output column-tile width: whole padded m if small, else 512-wide
+    strips (same policy as make_spmm's grid construction)."""
+    tm = pm if pm <= 512 else 512
+    while pm % tm != 0:
+        tm //= 2
+        if tm < 128:
+            return pm
+    return tm
+
+
+def pallas_eligible(S, pm: int) -> bool:
+    """Mosaic requires each block's last two dims to divide (8, 128) or
+    equal the array's. The out block is (bs, tm) on (gr·bs, pm); tiny or
+    odd block sizes (the fuzzer's bs=4 caught this on real TPU) must
+    fall back to the XLA path. bf16 payloads at bs=8/16/24 were probed
+    on-chip (2026-07-30) and compile fine, so the 8-sublane rule is not
+    dtype-widened here. The tm conjunct is currently always true by
+    _pick_tm's contract (pm itself or a multiple of 128) — kept as a
+    guard should that policy change."""
+    bs = S.block_size
+    gr = S.grid[0]
+    tm = _pick_tm(pm)
+    return ((bs % 8 == 0 or gr == 1)
+            and (tm % 128 == 0 or tm == pm))
+
+
 def make_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg: MatrelConfig,
               interpret: bool = False):
     """Build a jitted SpMM runner bound to S's static tile metadata."""
@@ -83,12 +110,7 @@ def make_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg: MatrelConfig,
     src = src[perm].astype(np.int32)
     nnzb = S.nnzb + n_pad_tiles
     # output column tile: whole padded m if small, else 512-wide strips
-    tm = pm if pm <= 512 else 512
-    while pm % tm != 0:  # pm is a multiple of the device count, keep it even
-        tm //= 2
-        if tm < 128:
-            tm = pm  # fall back to one strip
-            break
+    tm = _pick_tm(pm)
     m_tiles = pm // tm
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

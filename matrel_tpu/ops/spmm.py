@@ -55,8 +55,11 @@ def _cached_runner(S, pm, out_pshape, d_spec, out_sharding, cfg, interpret):
            cfg.matmul_precision, interpret)
     run = _RUNNER_CACHE.get(key)
     if run is None:
-        if _use_pallas(cfg) or interpret:
-            from matrel_tpu.ops import pallas_spmm
+        from matrel_tpu.ops import pallas_spmm
+        # interpret mode skips the eligibility gate on purpose: it has
+        # no Mosaic tiling constraints and the tests drive tiny blocks
+        if interpret or (_use_pallas(cfg)
+                         and pallas_spmm.pallas_eligible(S, pm)):
             run = pallas_spmm.make_spmm(S, pm, out_pshape, d_spec,
                                         out_sharding, cfg, interpret=interpret)
         else:

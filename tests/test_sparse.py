@@ -353,3 +353,23 @@ class TestShardedSpMM:
         D = BlockMatrix.from_numpy(d, mesh=mesh8)
         out = S.shard().multiply(D).to_numpy()
         np.testing.assert_allclose(out, a @ d, rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_eligibility_gate():
+    # bs=4 blocks violate Mosaic's (8, 128) block-shape rule on real TPU
+    # (caught by the on-chip soak, seed 10026); such stacks must take the
+    # XLA path. bs=512 at bench shapes stays eligible.
+    from matrel_tpu.ops.pallas_spmm import pallas_eligible
+
+    class FakeS:
+        def __init__(self, bs, gr):
+            self.block_size = bs
+            self._gr = gr
+        @property
+        def grid(self):
+            return (self._gr, self._gr)
+
+    assert not pallas_eligible(FakeS(4, 3), 8)     # the soak failure shape
+    assert pallas_eligible(FakeS(4, 1), 8)         # single row-block: equal dims
+    assert pallas_eligible(FakeS(512, 196), 512)   # bench row 4 shape
+    assert pallas_eligible(FakeS(8, 4), 16)        # small but 8-aligned
