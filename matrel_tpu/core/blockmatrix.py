@@ -319,8 +319,8 @@ class BlockMatrix:
     def inverse(self):
         return self.expr().inverse()
 
-    def solve(self, b):
-        return self.expr().solve(b)
+    def solve(self, b, assume: str = "general"):
+        return self.expr().solve(b, assume=assume)
 
     def vec(self):
         return self.expr().vec()

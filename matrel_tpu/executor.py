@@ -163,7 +163,9 @@ class Lowerer:
         raise NotImplementedError(f"lowering for node kind {k!r}")
 
     def _solve(self, node: MatExpr, ev) -> Array:
-        """X = A⁻¹·B as a dense LU solve on the LOGICAL shapes.
+        """X = A⁻¹·B as a dense solve on the LOGICAL shapes — LU by
+        default, Cholesky when attrs["assume"] == "pos" (caller asserts
+        SPD; a non-SPD lhs under "pos" yields NaNs, not the LU answer).
 
         Padded rows/cols must be sliced off first — a zero-padded square
         matrix is singular. Like the reference's normal-equations
@@ -176,8 +178,13 @@ class Lowerer:
         m = r.shape[1]
         a = ev(l)[:n, :n]
         b = ev(r)[:n, :m]
-        out = jnp.linalg.solve(a.astype(jnp.float32),
-                               b.astype(jnp.float32))
+        if node.attrs.get("assume") == "pos":
+            c, low = jax.scipy.linalg.cho_factor(a.astype(jnp.float32))
+            out = jax.scipy.linalg.cho_solve((c, low),
+                                             b.astype(jnp.float32))
+        else:
+            out = jnp.linalg.solve(a.astype(jnp.float32),
+                                   b.astype(jnp.float32))
         if self.config.keep_input_dtype and a.dtype == b.dtype:
             out = out.astype(a.dtype)
         return self._pad_to_node(out, node)

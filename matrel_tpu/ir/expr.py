@@ -152,8 +152,8 @@ class MatExpr:
     def inverse(self) -> "MatExpr":
         return inverse(self)
 
-    def solve(self, b) -> "MatExpr":
-        return solve(self, as_expr(b))
+    def solve(self, b, assume: str = "general") -> "MatExpr":
+        return solve(self, as_expr(b), assume=assume)
 
     def vec(self) -> "MatExpr":
         return vec(self)
@@ -327,15 +327,22 @@ def inverse(a: MatExpr) -> MatExpr:
     return MatExpr("inverse", (a,), a.shape, None)
 
 
-def solve(a: MatExpr, b: MatExpr) -> MatExpr:
-    """X = A⁻¹·B (solve A·X = B) for square A. ``assume`` can be set via
-    attrs later; lowering uses a dense LU solve on the logical shapes."""
+def solve(a: MatExpr, b: MatExpr, assume: str = "general") -> MatExpr:
+    """X = A⁻¹·B (solve A·X = B) for square A, on the logical shapes.
+
+    ``assume="pos"`` takes a Cholesky factorisation instead of LU —
+    right for the normal-equations Gram matrix (SPD), ~2× cheaper and
+    numerically tighter. ``"general"`` (default) is LU.
+    """
+    if assume not in ("general", "pos"):
+        raise ValueError(f"solve assume must be 'general' or 'pos', "
+                         f"got {assume!r}")
     n, m = a.shape
     if n != m:
         raise ValueError(f"solve needs a square lhs, got {a.shape}")
     if b.shape[0] != n:
         raise ValueError(f"solve shape mismatch: {a.shape} x {b.shape}")
-    return MatExpr("solve", (a, b), b.shape, None)
+    return MatExpr("solve", (a, b), b.shape, None, {"assume": assume})
 
 
 def select_value(a: MatExpr, predicate: Callable, fill: float = 0.0) -> MatExpr:

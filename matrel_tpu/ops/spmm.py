@@ -55,11 +55,13 @@ def _cached_runner(S, pm, out_pshape, d_spec, out_sharding, cfg, interpret):
            cfg.matmul_precision, interpret)
     run = _RUNNER_CACHE.get(key)
     if run is None:
-        from matrel_tpu.ops import pallas_spmm
-        # interpret mode skips the eligibility gate on purpose: it has
-        # no Mosaic tiling constraints and the tests drive tiny blocks
-        if interpret or (_use_pallas(cfg)
-                         and pallas_spmm.pallas_eligible(S, pm)):
+        use_pallas = interpret or _use_pallas(cfg)
+        if use_pallas:
+            from matrel_tpu.ops import pallas_spmm
+            # interpret mode skips the eligibility gate on purpose: it
+            # has no Mosaic tiling constraints and tests drive tiny blocks
+            use_pallas = interpret or pallas_spmm.pallas_eligible(S, pm)
+        if use_pallas:
             run = pallas_spmm.make_spmm(S, pm, out_pshape, d_spec,
                                         out_sharding, cfg, interpret=interpret)
         else:

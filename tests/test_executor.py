@@ -328,3 +328,16 @@ class TestLargeConstHoisting:
         A = bm(rng.standard_normal((16, 16)), mesh8)
         plan = compile_expr(A.expr().row_sum(), mesh8, MatrelConfig())
         assert plan.extra_args == []            # nothing above 1 MB
+
+def test_cholesky_solve_option(mesh8, rng):
+    m = rng.standard_normal((12, 12)).astype(np.float32)
+    a = m @ m.T + 12 * np.eye(12, dtype=np.float32)
+    b = rng.standard_normal((12, 5)).astype(np.float32)
+    out = bm(a, mesh8).solve(bm(b, mesh8), assume="pos"
+                             ).compute().to_numpy()
+    np.testing.assert_allclose(out, np.linalg.solve(a, b), rtol=1e-3,
+                               atol=1e-4)
+    import matrel_tpu.ir.expr as E
+    with pytest.raises(ValueError, match="assume"):
+        E.solve(bm(a, mesh8).expr(), bm(b, mesh8).expr(),
+                assume="banded")
