@@ -1,0 +1,76 @@
+"""Distributed sparse matrices: sharded tile stacks and sharded SpMV.
+
+The single-chip sparse paths replicate the sparse operand; at pod scale
+the operand itself must shard. This demo runs both scale-out plans on a
+CPU-simulated 8-device mesh (the same code drives a real slice):
+
+  1. BlockSparseMatrix.shard()      — tile stack cut into per-device
+     output row ranges, one all_gather of the product rows (RMM-shaped)
+  2. spmv.shard_plan + spmv_sharded — one-hot SpMV plan tables
+     row-decomposed over the mesh (the PageRank shape)
+
+Run:  python examples/distributed_sparse_demo.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import numpy as np                                    # noqa: E402
+import jax                                            # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp                               # noqa: E402
+from matrel_tpu.core import mesh as mesh_lib          # noqa: E402
+from matrel_tpu.core.blockmatrix import BlockMatrix   # noqa: E402
+from matrel_tpu.core.sparse import BlockSparseMatrix  # noqa: E402
+from matrel_tpu.ops import spmv as spmv_lib           # noqa: E402
+
+
+def main():
+    mesh = mesh_lib.make_mesh()
+    print(f"mesh: {dict(mesh.shape)} over {mesh.size} devices\n")
+    rng = np.random.default_rng(0)
+
+    # -- 1. sharded tile-stack SpMM -------------------------------------
+    n, bs = 4096, 128
+    a = np.zeros((n, n), np.float32)
+    g = n // bs
+    for f in rng.choice(g * g, size=g * g // 10, replace=False):
+        bi, bj = divmod(int(f), g)
+        a[bi*bs:(bi+1)*bs, bj*bs:(bj+1)*bs] = rng.standard_normal((bs, bs))
+    d = rng.standard_normal((n, 64)).astype(np.float32)
+
+    S = BlockSparseMatrix.from_numpy(a, block_size=bs, mesh=mesh)
+    Ssh = S.shard()
+    print(f"tile stack: {S.nnzb} tiles -> {Ssh.cap}/device "
+          f"(padding {Ssh.padding_ratio:.2f}x)")
+    out = Ssh.multiply(BlockMatrix.from_numpy(d, mesh=mesh)).to_numpy()
+    err = np.abs(out - a @ d).max()
+    print(f"sharded SpMM max err vs numpy: {err:.2e}\n")
+
+    # -- 2. sharded one-hot SpMV (the PageRank shape) -------------------
+    n_nodes, n_edges = 50_000, 400_000
+    src = rng.integers(0, n_nodes, n_edges)
+    dst = rng.integers(0, n_nodes, n_edges)
+    w = rng.random(n_edges).astype(np.float32)
+    plan = spmv_lib.build_spmv_plan(dst, src, w, n_nodes, n_nodes)
+    plan_s = spmv_lib.shard_plan(plan, mesh)
+    x = rng.standard_normal(n_nodes).astype(np.float32)
+    y = np.asarray(spmv_lib.spmv_sharded(plan_s, jnp.asarray(x), mesh))
+    oracle = np.zeros(n_nodes)
+    np.add.at(oracle, dst, w * x[src])
+    print(f"sharded SpMV ({n_edges} edges over {mesh.size} devices) "
+          f"max err: {np.abs(y - oracle).max():.2e}")
+    print("per-device table shard rows:",
+          {s.data.shape[0] for s in plan_s.src8.addressable_shards})
+
+
+if __name__ == "__main__":
+    main()

@@ -72,8 +72,9 @@ def pallas_eligible(S, pm: int) -> bool:
     """Mosaic requires each block's last two dims to be MULTIPLES of
     (8, 128) respectively, or equal the array's dims. The out block is
     (bs, tm) on (gr·bs, pm); tiny or odd block sizes (the fuzzer's bs=4
-    caught this on real TPU) must fall back to the XLA path. bf16 payloads at bs=8/16/24 were probed
-    on-chip (2026-07-30) and compile fine, so the 8-sublane rule is not
+    caught this on real TPU) must fall back to the XLA path. bf16
+    payloads at bs=8/16/24 were probed on-chip (2026-07-30) and compile
+    fine, so the 8-sublane rule is not
     dtype-widened here. The tm conjunct is currently always true by
     _pick_tm's contract (pm itself or a multiple of 128) — kept as a
     guard should that policy change."""
