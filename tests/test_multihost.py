@@ -1,0 +1,30 @@
+"""Multi-process (multi-host analogue) validation: collectives over a
+real process boundary via jax.distributed + Gloo — the DCN shape of a
+TPU pod (SURVEY.md §5 "Distributed comm backend"). Heavier than the
+in-process mesh tests; one spawn of tools/multihost_check.py."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_collectives():
+    # ephemeral coordinator port; the tool's own --timeout (120s) fires
+    # before this test's cap, and it kills its worker process group, so
+    # a hang cannot orphan coordinator-holding workers on the machine
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "multihost_check.py"),
+         "--nproc", "2", "--timeout", "120"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd="/tmp", start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        import signal
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        out, _ = proc.communicate()
+        raise AssertionError(f"multihost check hung:\n{out}")
+    assert proc.returncode == 0, out
+    assert "MULTIHOST CHECK: OK" in out

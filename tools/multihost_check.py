@@ -1,0 +1,147 @@
+"""Multi-HOST validation: the framework's collectives over a real
+process boundary.
+
+The CPU-mesh tests and ``dryrun_multichip`` exercise multi-device
+sharding inside ONE process. This tool goes one step further and runs
+the same code over MULTIPLE PROCESSES — jax.distributed + a Gloo/TCP
+coordinator, each process owning 4 virtual CPU devices — which is the
+same control/data plane shape as hosts in a TPU pod connected over DCN
+(SURVEY.md §5 "Distributed comm backend"). It validates:
+
+  1. mesh bring-up across processes (`core.mesh.init_distributed` — the
+     executor-registration analogue),
+  2. a CPMM (reduce-scatter) matmul whose collective crosses the
+     process boundary,
+  3. an RMM (all-gather) matmul likewise,
+  4. global-array construction from per-host numpy + result agreement
+     on every process via process_allgather.
+
+Run:  python tools/multihost_check.py [--nproc 2]
+Exit code 0 on success; worker logs live in a fresh temp dir (path
+printed on failure). The coordinator port is ephemeral by default.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import os, sys
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+from matrel_tpu.core import mesh as mesh_lib
+mesh_lib.init_distributed(f"127.0.0.1:{port}", nproc, pid)
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from matrel_tpu.config import MatrelConfig
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.parallel import strategies
+
+n_dev = len(jax.devices())
+assert n_dev == 4 * nproc, (n_dev, nproc)
+mesh = mesh_lib.make_mesh()
+print(f"[p{pid}] mesh {dict(mesh.shape)} over {n_dev} devices "
+      f"({len(jax.local_devices())} local)", flush=True)
+
+rng = np.random.default_rng(0)          # same data on every process
+a = rng.standard_normal((32, 32)).astype(np.float32)
+b = rng.standard_normal((32, 32)).astype(np.float32)
+A = BlockMatrix.from_numpy(a, mesh=mesh)
+B = BlockMatrix.from_numpy(b, mesh=mesh)
+cfg = MatrelConfig()
+oracle = a @ b
+
+for strat in ("cpmm", "rmm", "xla"):
+    f = jax.jit(lambda x, y, s=strat: strategies.run_matmul(
+        s, x, y, mesh, cfg))
+    out = f(A.data, B.data)
+    # every process receives the full value; collectives crossed the
+    # process boundary to produce it
+    full = np.asarray(multihost_utils.process_allgather(
+        out, tiled=True))[:32, :32]
+    np.testing.assert_allclose(full, oracle, rtol=1e-3, atol=1e-3)
+    print(f"[p{pid}] {strat} matches oracle", flush=True)
+
+multihost_utils.sync_global_devices("matrel-mh-done")
+print(f"[p{pid}] DONE", flush=True)
+"""
+
+
+def _free_port() -> str:
+    """Ask the kernel for an ephemeral port (fixed ports collide with
+    concurrent runs or orphans from earlier failures)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return str(s.getsockname()[1])
+
+
+def main() -> int:
+    import tempfile
+    import time
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nproc", type=int, default=2)
+    ap.add_argument("--port", default=None,
+                    help="coordinator port (default: ephemeral)")
+    ap.add_argument("--timeout", type=float, default=240.0)
+    args = ap.parse_args()
+    port = args.port or _free_port()
+
+    tmpdir = tempfile.mkdtemp(prefix="matrel_mh_")
+    worker_path = os.path.join(tmpdir, "worker.py")
+    with open(worker_path, "w") as f:
+        f.write(_WORKER % {"repo": REPO})
+
+    procs, logs = [], []
+    log_paths = []
+    env = dict(os.environ)
+    rcs = [None] * args.nproc
+    try:
+        for pid in range(args.nproc):
+            lp = os.path.join(tmpdir, f"p{pid}.log")
+            log_paths.append(lp)
+            log = open(lp, "w")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, worker_path, str(pid), str(args.nproc),
+                 port],
+                stdout=log, stderr=subprocess.STDOUT, env=env,
+                start_new_session=True))
+        deadline = time.monotonic() + args.timeout
+        for i, p in enumerate(procs):
+            rcs[i] = p.wait(timeout=max(1.0, deadline - time.monotonic()))
+    except subprocess.TimeoutExpired:
+        pass
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for log in logs:
+            log.close()
+    ok = all(rc == 0 for rc in rcs)
+    for pid, lp in enumerate(log_paths):
+        with open(lp) as f:
+            for ln in f.read().splitlines():
+                if ln.startswith(f"[p{pid}]"):
+                    print(ln)
+    print("MULTIHOST CHECK:", "OK" if ok else f"FAILED (rcs={rcs})",
+          f"(logs under {tmpdir})" if not ok else "")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
