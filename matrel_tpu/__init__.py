@@ -11,6 +11,7 @@ See SURVEY.md for the reference layer map this package mirrors.
 from matrel_tpu.config import MatrelConfig, default_config, set_default_config
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.core.coo import COOMatrix
+from matrel_tpu.core.sparse import BlockSparseMatrix
 from matrel_tpu.core.mesh import make_mesh
 from matrel_tpu.executor import CompiledPlan, compile_expr, execute
 from matrel_tpu.ir.expr import MatExpr, as_expr, leaf
@@ -20,7 +21,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "MatrelConfig", "default_config", "set_default_config",
-    "BlockMatrix", "COOMatrix", "make_mesh",
+    "BlockMatrix", "BlockSparseMatrix", "COOMatrix", "make_mesh",
     "CompiledPlan", "compile_expr", "execute",
     "MatExpr", "as_expr", "leaf",
     "MatrelSession", "get_or_create_session", "reset_session",

@@ -14,7 +14,10 @@ same control/data plane shape as hosts in a TPU pod connected over DCN
      process boundary,
   3. an RMM (all-gather) matmul likewise,
   4. global-array construction from per-host numpy + result agreement
-     on every process via process_allgather.
+     on every process via process_allgather,
+  5. the sharded one-hot SpMV (plan tables row-decomposed over the
+     global mesh),
+  6. the sharded tile-stack SpMM (BlockSparseMatrix.shard()).
 
 Run:  python tools/multihost_check.py [--nproc 2]
 Exit code 0 on success; worker logs live in a fresh temp dir (path
