@@ -223,6 +223,150 @@ class COOMatrix:
         return BlockMatrix.from_numpy(self.to_dense(), mesh=mesh,
                                       config=config, nnz=self.nnz)
 
+    # ------------------------------------------------- relational (σ/γ/⋈)
+    # Eager, edge-list-native forms of the relational operators — the
+    # scale path: a 1M×1M graph cannot take the executor's densifying
+    # lowering, but filtering/aggregating its edge list is O(nnz) host
+    # work. Semantics match the dense masked model exactly (0 = missing;
+    # SURVEY.md §7.6), so results agree with the IR lowerings wherever
+    # both are feasible.
+
+    def coalesce(self) -> "COOMatrix":
+        """Collapse duplicate coordinates additively (entry-level view).
+        Relational σ/γ operate on ENTRIES, not raw edges, so they
+        coalesce first; matvec/plans are additive and never need to."""
+        m = self.shape[1]
+        keys, vals = _sum_dups(self.rows * m + self.cols, self.vals)
+        return COOMatrix.from_edges(keys // m, keys % m, vals,
+                                    shape=self.shape)
+
+    def select_value(self, predicate, fill: float = 0.0) -> "COOMatrix":
+        """σ on ENTRY values (duplicates coalesced first — an entry's
+        value is the sum of its edges, exactly the dense semantics).
+        Only fill=0 keeps the result sparse; other fills would densify —
+        use the dense IR path for those."""
+        if fill != 0.0:
+            raise ValueError("COOMatrix.select_value supports fill=0 "
+                             "only (a nonzero fill densifies; use "
+                             "to_block(...).select_value)")
+        A = self.coalesce()
+        keep = np.asarray(predicate(A.vals), bool)
+        return COOMatrix.from_edges(A.rows[keep], A.cols[keep],
+                                    A.vals[keep], shape=self.shape)
+
+    def select_index(self, *, rows=None, cols=None) -> "COOMatrix":
+        """σ on indices: keep edges whose row/col satisfy the
+        predicates (vectorised callables over index arrays)."""
+        keep = np.ones(self.rows.shape, bool)
+        if rows is not None:
+            keep &= np.asarray(rows(self.rows), bool)
+        if cols is not None:
+            keep &= np.asarray(cols(self.cols), bool)
+        return COOMatrix.from_edges(self.rows[keep], self.cols[keep],
+                                    self.vals[keep], shape=self.shape)
+
+    def _axis_agg(self, axis: str, kind: str) -> np.ndarray:
+        # count/avg/max/min are entry-level (γ over nonzero TUPLES):
+        # duplicates must coalesce first; plain sums are additive anyway
+        A = self if kind == "sum" else self.coalesce()
+        ids = A.rows if axis == "row" else A.cols
+        n = self.shape[0] if axis == "row" else self.shape[1]
+        vals = A.vals
+        nz = vals != 0
+        if kind == "sum":
+            out = np.bincount(ids, weights=vals,
+                              minlength=n).astype(np.float32)
+        elif kind == "count":
+            out = np.bincount(ids[nz], minlength=n).astype(np.float32)
+        elif kind == "avg":
+            sv = np.bincount(ids, weights=vals, minlength=n)
+            c = np.bincount(ids[nz], minlength=n)
+            out = np.where(c > 0, sv / np.maximum(c, 1), 0.0)
+        elif kind in ("max", "min"):
+            fill = -np.inf if kind == "max" else np.inf
+            out = np.full(n, fill, np.float64)
+            op = np.maximum if kind == "max" else np.minimum
+            op.at(out, ids[nz], vals[nz].astype(np.float64))
+            out = np.where(np.isfinite(out), out, 0.0)
+            # dense-lowering parity: a row/col with any MISSING entry
+            # includes implicit zeros in its max/min (executor._agg runs
+            # over the full logical region), so clamp toward 0 wherever
+            # the axis isn't fully populated by nonzeros
+            width = self.shape[1] if axis == "row" else self.shape[0]
+            cnt = np.bincount(ids[nz], minlength=n)
+            partial = cnt < width
+            out = np.where(partial, op(out, 0.0), out)
+        else:
+            raise ValueError(f"unknown aggregate {kind!r}")
+        return out.astype(np.float32)
+
+    def row_sum(self) -> np.ndarray:
+        """γ: per-row sums as (n, 1) — O(nnz), never densifies."""
+        return self._axis_agg("row", "sum")[:, None]
+
+    def col_sum(self) -> np.ndarray:
+        return self._axis_agg("col", "sum")[None, :]
+
+    def row_count(self) -> np.ndarray:
+        return self._axis_agg("row", "count")[:, None]
+
+    def col_count(self) -> np.ndarray:
+        return self._axis_agg("col", "count")[None, :]
+
+    def row_avg(self) -> np.ndarray:
+        return self._axis_agg("row", "avg")[:, None]
+
+    def col_avg(self) -> np.ndarray:
+        return self._axis_agg("col", "avg")[None, :]
+
+    def row_max(self) -> np.ndarray:
+        return self._axis_agg("row", "max")[:, None]
+
+    def row_min(self) -> np.ndarray:
+        return self._axis_agg("row", "min")[:, None]
+
+    def col_max(self) -> np.ndarray:
+        return self._axis_agg("col", "max")[None, :]
+
+    def col_min(self) -> np.ndarray:
+        return self._axis_agg("col", "min")[None, :]
+
+    def sum(self) -> float:
+        return float(self.vals.sum())
+
+    def trace(self) -> float:
+        d = self.rows == self.cols
+        return float(self.vals[d].sum())
+
+    def join_on_index(self, other: "COOMatrix", merge) -> "COOMatrix":
+        """⋈ on index equality: C[i,j] = merge(A[i,j], B[i,j]) over the
+        UNION of both coordinate sets (absent entries read 0, the masked
+        semantics). merge must be a vectorised callable; exact zeros in
+        the merged result are dropped from the edge list."""
+        if tuple(self.shape) != tuple(other.shape):
+            raise ValueError(f"join_on_index shape mismatch: "
+                             f"{self.shape} vs {other.shape}")
+        if float(merge(np.float32(0.0), np.float32(0.0))) != 0.0:
+            raise ValueError(
+                "merge(0, 0) != 0: the result is dense (every absent "
+                "coordinate becomes nonzero) — use the dense IR "
+                "join_on_index for such merges")
+        m = self.shape[1]
+        ka = self.rows * m + self.cols
+        kb = other.rows * m + other.cols
+        # duplicate coordinates are additive (from_edges semantics)
+        ka_u, va = _sum_dups(ka, self.vals)
+        kb_u, vb = _sum_dups(kb, other.vals)
+        union = np.union1d(ka_u, kb_u)
+        a_full = np.zeros(union.shape, np.float32)
+        b_full = np.zeros(union.shape, np.float32)
+        a_full[np.searchsorted(union, ka_u)] = va
+        b_full[np.searchsorted(union, kb_u)] = vb
+        merged = np.asarray(merge(a_full, b_full), np.float32)
+        nz = merged != 0
+        return COOMatrix.from_edges(union[nz] // m, union[nz] % m,
+                                    merged[nz], shape=self.shape)
+
     # ------------------------------------------------------------ DSL
     def expr(self):
         """Enter the lazy IR as an element-sparse leaf: matmuls against
@@ -236,3 +380,13 @@ class COOMatrix:
     def multiply(self, other):
         from matrel_tpu.ir import expr as E
         return E.matmul(self.expr(), E.as_expr(other))
+
+
+def _sum_dups(keys: np.ndarray, vals: np.ndarray):
+    """Collapse duplicate coordinates additively: unique keys + summed
+    values (host, O(nnz log nnz))."""
+    if keys.size == 0:
+        return keys, vals.astype(np.float32)
+    uniq, inv = np.unique(keys, return_inverse=True)
+    return uniq, np.bincount(inv, weights=vals,
+                             minlength=uniq.size).astype(np.float32)

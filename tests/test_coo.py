@@ -218,3 +218,101 @@ class TestDSLIntegration:
         want = (S.to_dense() @ x).sum(axis=1, keepdims=True)
         np.testing.assert_allclose(out.to_numpy(), want, rtol=3e-4,
                                    atol=3e-4)
+
+
+class TestCOORelational:
+    """Edge-list-native σ/γ/⋈ — results must match the dense masked
+    semantics (and hence the IR lowerings) exactly."""
+
+    def _mat(self, rng, n=40, m=30, nnz=200):
+        from matrel_tpu.core.coo import COOMatrix
+        r = rng.integers(0, n, nnz)
+        c = rng.integers(0, m, nnz)
+        v = rng.standard_normal(nnz).astype(np.float32)
+        return COOMatrix.from_edges(r, c, v, shape=(n, m))
+
+    def test_select_value(self, rng):
+        A = self._mat(rng)
+        d = A.to_dense()
+        got = A.select_value(lambda v: v > 0.3).to_dense()
+        np.testing.assert_allclose(got, np.where(d > 0.3, d, 0.0),
+                                   rtol=1e-6)
+        with pytest.raises(ValueError, match="fill"):
+            A.select_value(lambda v: v > 0, fill=1.0)
+
+    def test_select_index(self, rng):
+        A = self._mat(rng)
+        d = A.to_dense()
+        got = A.select_index(rows=lambda i: i % 3 == 0,
+                             cols=lambda j: j < 10).to_dense()
+        want = d.copy()
+        want[np.arange(40) % 3 != 0, :] = 0
+        want[:, 10:] = 0
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_axis_aggregates(self, rng):
+        A = self._mat(rng)
+        d = A.to_dense().astype(np.float64)
+        np.testing.assert_allclose(A.row_sum()[:, 0], d.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(A.col_sum()[0], d.sum(0), rtol=1e-5)
+        nz = d != 0
+        np.testing.assert_allclose(A.row_count()[:, 0], nz.sum(1))
+        np.testing.assert_allclose(A.col_count()[0], nz.sum(0))
+        # avg/max/min over NONZERO entries (relational γ semantics)
+        cnt = np.maximum(nz.sum(1), 1)
+        np.testing.assert_allclose(A.row_avg()[:, 0],
+                                   np.where(nz.any(1), d.sum(1) / cnt, 0),
+                                   rtol=1e-5)
+        # dense-lowering parity: implicit zeros participate in max/min
+        np.testing.assert_allclose(A.row_max()[:, 0], d.max(1), rtol=1e-5)
+        assert A.sum() == pytest.approx(d.sum(), rel=1e-5)
+
+    def test_trace(self, rng):
+        from matrel_tpu.core.coo import COOMatrix
+        A = COOMatrix.from_edges([0, 1, 2, 1], [0, 1, 0, 1],
+                                 [1.0, 2.0, 3.0, 4.0], shape=(3, 3))
+        assert A.trace() == pytest.approx(7.0)   # dups additive on diag
+
+    def test_join_on_index_union_semantics(self, rng):
+        from matrel_tpu.core.coo import COOMatrix
+        A = self._mat(rng, nnz=100)
+        B = self._mat(rng, nnz=120)
+        da, db = A.to_dense(), B.to_dense()
+        # merge where absence reads 0 — union coordinates matter
+        got = A.join_on_index(B, lambda x, y: x * y + x).to_dense()
+        np.testing.assert_allclose(got, da * db + da, rtol=1e-5,
+                                   atol=1e-6)
+        with pytest.raises(ValueError, match="mismatch"):
+            A.join_on_index(self._mat(rng, n=10, m=10), lambda x, y: x)
+        # densifying merges must be rejected, not silently wrong
+        with pytest.raises(ValueError, match="dense"):
+            A.join_on_index(B, lambda x, y: x + y + 1.0)
+
+    def test_all_negative_row_max_matches_dense(self, rng):
+        from matrel_tpu.core.coo import COOMatrix
+        A = COOMatrix.from_edges([0, 0], [1, 2], [-3.0, -5.0],
+                                 shape=(2, 4))
+        d = A.to_dense()
+        np.testing.assert_allclose(A.row_max()[:, 0], d.max(1))   # [0, 0]
+        np.testing.assert_allclose(A.row_min()[:, 0], d.min(1))   # [-5, 0]
+        # a FULLY populated row keeps its true (negative) max
+        B = COOMatrix.from_edges([0, 0], [0, 1], [-3.0, -5.0],
+                                 shape=(1, 2))
+        np.testing.assert_allclose(B.row_max()[:, 0], [-3.0])
+
+    def test_scale_smoke_no_densify(self, rng):
+        # 200k x 200k with 50k edges: any densify would be 160 GB
+        from matrel_tpu.core.coo import COOMatrix
+        n, nnz = 200_000, 50_000
+        r = rng.integers(0, n, nnz); c = rng.integers(0, n, nnz)
+        v = rng.standard_normal(nnz).astype(np.float32)
+        A = COOMatrix.from_edges(r, c, v, shape=(n, n))
+        pos = A.select_value(lambda x: x > 0)
+        assert 0 < pos.nnz < nnz
+        rs = A.row_sum()
+        want = np.zeros(n); np.add.at(want, r, v)
+        np.testing.assert_allclose(rs[:, 0], want, rtol=1e-4, atol=1e-5)
+        j = A.join_on_index(pos, lambda x, y: x - y)   # A - positives
+        neg = A.select_value(lambda x: x < 0)
+        np.testing.assert_allclose(np.sort(j.vals), np.sort(neg.vals),
+                                   rtol=1e-6)
