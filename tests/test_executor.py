@@ -341,3 +341,30 @@ def test_cholesky_solve_option(mesh8, rng):
     with pytest.raises(ValueError, match="assume"):
         E.solve(bm(a, mesh8).expr(), bm(b, mesh8).expr(),
                 assume="banded")
+
+
+def test_multiplan_hoists_and_appends_extras(mesh8, rng):
+    # compile_exprs (multi-output) shares the hoisting path: sparse
+    # payloads ride as args there too
+    from matrel_tpu.core.sparse import BlockSparseMatrix
+    from matrel_tpu.executor import compile_exprs
+    from matrel_tpu.config import MatrelConfig
+    n = 1024
+    a = np.zeros((n, n), np.float32)
+    for bi in range(16):                 # 80 tiles of 64^2 f32 = 1.25 MB
+        for bj in range(5):
+            a[bi*64:(bi+1)*64, bj*64:(bj+1)*64] = \
+            rng.standard_normal((64, 64))
+    d = rng.standard_normal((n, 8)).astype(np.float32)
+    S = BlockSparseMatrix.from_numpy(a, block_size=64, mesh=mesh8)
+    D = bm(d, mesh8)
+    e1 = S.multiply(D)
+    e2 = e1.row_sum()
+    plan = compile_exprs([e1, e2], mesh8, MatrelConfig())
+    o1, o2 = plan.run()
+    np.testing.assert_allclose(o1.to_numpy(), a @ d, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(o2.to_numpy(), (a @ d).sum(1, keepdims=True),
+                               rtol=1e-4, atol=1e-4)
+    if sum(c.nbytes for c in plan.extra_args) == 0:
+        # tile stack below threshold would make this vacuous
+        raise AssertionError("expected hoisted sparse payload")
