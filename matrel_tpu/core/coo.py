@@ -52,6 +52,9 @@ class COOMatrix:
     _mesh: Optional[object] = dataclasses.field(default=None, repr=False)
     _plan_sharded: Optional[spmv_lib.EdgeSpMVPlan] = dataclasses.field(
         default=None, repr=False)
+    # True when coordinates are known-unique (outputs of coalesce/
+    # select_value/join): lets chained relational ops skip the re-sort
+    _coalesced: bool = dataclasses.field(default=False, repr=False)
 
     # ---------------------------------------------------------- build
     @classmethod
@@ -97,7 +100,8 @@ class COOMatrix:
                          _plan=self._plan_t, _plan_t=self._plan,
                          _plan_tried=self._plan_t_tried,
                          _plan_t_tried=self._plan_tried,
-                         _seg_fwd=self._seg_bwd, _seg_bwd=self._seg_fwd)
+                         _seg_fwd=self._seg_bwd, _seg_bwd=self._seg_fwd,
+                         _coalesced=self._coalesced)
 
     # ----------------------------------------------------------- plans
     def _get_plan(self) -> Optional[spmv_lib.EdgeSpMVPlan]:
@@ -144,7 +148,8 @@ class COOMatrix:
                 "plan; sharded matvec unavailable for this graph")
         return COOMatrix(rows=self.rows, cols=self.cols, vals=self.vals,
                          shape=self.shape, _mesh=mesh,
-                         _plan_sharded=spmv_lib.shard_plan(plan, mesh))
+                         _plan_sharded=spmv_lib.shard_plan(plan, mesh),
+                         _coalesced=self._coalesced)
 
     # ------------------------------------------------------------ ops
     def matvec(self, x) -> jax.Array:
@@ -234,11 +239,16 @@ class COOMatrix:
     def coalesce(self) -> "COOMatrix":
         """Collapse duplicate coordinates additively (entry-level view).
         Relational σ/γ operate on ENTRIES, not raw edges, so they
-        coalesce first; matvec/plans are additive and never need to."""
+        coalesce first; matvec/plans are additive and never need to.
+        No-op (returns self) when coordinates are known-unique."""
+        if self._coalesced:
+            return self
         m = self.shape[1]
         keys, vals = _sum_dups(self.rows * m + self.cols, self.vals)
-        return COOMatrix.from_edges(keys // m, keys % m, vals,
-                                    shape=self.shape)
+        out = COOMatrix.from_edges(keys // m, keys % m, vals,
+                                   shape=self.shape)
+        out._coalesced = True
+        return out
 
     def select_value(self, predicate, fill: float = 0.0) -> "COOMatrix":
         """σ on ENTRY values (duplicates coalesced first — an entry's
@@ -251,8 +261,10 @@ class COOMatrix:
                              "to_block(...).select_value)")
         A = self.coalesce()
         keep = np.asarray(predicate(A.vals), bool)
-        return COOMatrix.from_edges(A.rows[keep], A.cols[keep],
-                                    A.vals[keep], shape=self.shape)
+        out = COOMatrix.from_edges(A.rows[keep], A.cols[keep],
+                                   A.vals[keep], shape=self.shape)
+        out._coalesced = True
+        return out
 
     def select_index(self, *, rows=None, cols=None) -> "COOMatrix":
         """σ on indices: keep edges whose row/col satisfy the
@@ -262,8 +274,10 @@ class COOMatrix:
             keep &= np.asarray(rows(self.rows), bool)
         if cols is not None:
             keep &= np.asarray(cols(self.cols), bool)
-        return COOMatrix.from_edges(self.rows[keep], self.cols[keep],
-                                    self.vals[keep], shape=self.shape)
+        out = COOMatrix.from_edges(self.rows[keep], self.cols[keep],
+                                   self.vals[keep], shape=self.shape)
+        out._coalesced = self._coalesced   # subsets stay unique
+        return out
 
     def _axis_agg(self, axis: str, kind: str) -> np.ndarray:
         # count/avg/max/min are entry-level (γ over nonzero TUPLES):
@@ -364,8 +378,10 @@ class COOMatrix:
         b_full[np.searchsorted(union, kb_u)] = vb
         merged = np.asarray(merge(a_full, b_full), np.float32)
         nz = merged != 0
-        return COOMatrix.from_edges(union[nz] // m, union[nz] % m,
-                                    merged[nz], shape=self.shape)
+        out = COOMatrix.from_edges(union[nz] // m, union[nz] % m,
+                                   merged[nz], shape=self.shape)
+        out._coalesced = True
+        return out
 
     # ------------------------------------------------------------ DSL
     def expr(self):

@@ -136,7 +136,6 @@ def _sharded_spmm_runner(mesh, bs: int, gc: int, rows_per_dev: int,
     from jax import shard_map
 
     axes = tuple(mesh.axis_names)
-    p = mesh.size
 
     def kernel(blocks, brow_loc, bcols, dd):
         # per-device shards: blocks (cap, bs, bs), indices (cap,), dd
