@@ -1,0 +1,150 @@
+"""Pallas compact-table SpMV: the one-hot scatter without stored one-hots.
+
+The expanded EdgeSpMVPlan tables (ops/spmv.py) cost ~224 B per padded
+edge slot in HBM — sel (32 B) + oh_hi (128 B) + oh_lo (64 B) — which is
+~2.4 GB for a 10M-edge graph and the reason the PageRank plan cache is
+byte-capped. The one-hots only exist because XLA's ``dot_general`` needs
+materialised operands; inside a Pallas kernel they can be GENERATED in
+VMEM from the compact layout the plan build already produces
+(src8/lane/off/val, ~13 B/slot) and never touch HBM.
+
+Pipeline per matvec (``spmv_compact``):
+
+  1. XLA: width-8 row gather + fused lane-select
+     ``w[b,c] = x_ext[src8[b,c], lane[b,c]] · val[b,c]`` — the compare
+     mask fuses into the multiply-reduce, nothing extra materialises.
+  2. Pallas, grid over blocks: generate ``oh_hi`` (C, HI') bf16 and the
+     w-carrying rhs (C, LO·passes) in VMEM (w carved into bf16 residual
+     parts by mantissa masking — f32-faithful at passes=3, see
+     ops/spmv_routed.py for why masking, not casts), one MXU contraction
+     ``oh_hiᵀ @ rhs`` per block, write the (HI', LO) output tile.
+  3. XLA: overflow-COO accumulation (unchanged contract).
+
+This is an OPT-IN alternate executor for an EdgeSpMVPlan: it reads the
+plan's compact host tables (kept on device via a small memo) and leaves
+the expanded-table path — default, battle-tested, shardable — untouched.
+Measured trade (BASELINE row 5 graph): ~17× smaller device tables
+(13 B/slot vs ~224).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from matrel_tpu.ops import spmv as spmv_lib
+from matrel_tpu.ops.spmv_routed import _bf16_split
+
+LANE = 128
+
+
+def _make_scatter_kernel(hi_n: int, lo: int, passes: int):
+    def kernel(off_ref, w_ref, y_ref):
+        # slots ride the MINOR (128-lane) axis throughout: masks with a
+        # <128 minor dim lane-pad 4-8x on the VPU and cost more than the
+        # stored tables they replace (measured 45 ms vs 29 at BASELINE
+        # row-5 scale before this layout)
+        off = off_ref[0]                                 # (cr, 128)
+        w = w_ref[0]
+        cr = off.shape[0]
+        ids_hi = jax.lax.broadcasted_iota(
+            jnp.int32, (cr, hi_n, LANE), 1)
+        oh_hi = ((off // lo)[:, None, :] == ids_hi).astype(jnp.bfloat16)
+        ids_lo = jax.lax.broadcasted_iota(
+            jnp.int32, (cr, lo, LANE), 1)
+        mask = (off % lo)[:, None, :] == ids_lo
+        rhs = jnp.concatenate(
+            [jnp.where(mask, wp[:, None, :], 0.0)
+             for wp in _bf16_split(w, passes)],
+            axis=1).astype(jnp.bfloat16)                 # (cr,lo·p,128)
+        t = jax.lax.dot_general(
+            oh_hi, rhs,
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)          # (cr,hi_n,lo·p)
+        ts = jnp.sum(t, axis=0)                          # (hi_n, lo·p)
+        th = ts[:, :lo]
+        for p in range(1, passes):
+            th = th + ts[:, p * lo:(p + 1) * lo]
+        y_ref[0] = th
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=32)
+def _compact_runner(nb: int, cap: int, block: int, lo: int, passes: int,
+                    interpret: bool):
+    hi_n = block // lo
+    cr = cap // LANE
+    scatter = pl.pallas_call(
+        _make_scatter_kernel(hi_n, lo, passes),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1, cr, LANE), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, cr, LANE), lambda b: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hi_n, lo), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb, hi_n, lo), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )
+    return scatter
+
+
+def compact_tables(plan: spmv_lib.EdgeSpMVPlan):
+    """Device copies of the plan's compact layout, memoised on the plan
+    (the plan keeps its compact host tables even after expanded-path
+    use, so path order never matters)."""
+    dev = getattr(plan, "_compact_dev", None)
+    if dev is None:
+        nb, cap = np.asarray(plan.src8).shape
+        if cap % LANE:
+            raise ValueError(f"capacity {cap} not a multiple of {LANE}")
+        cr = cap // LANE
+        shp = (nb, cr, LANE)
+        # lane stays int8 on device (the kernel compares it against an
+        # iota of its own dtype): 13 B/slot total, as advertised
+        dev = (jnp.asarray(np.asarray(plan.src8).reshape(shp)),
+               jnp.asarray(np.asarray(plan.lane).reshape(shp)),
+               jnp.asarray(np.asarray(plan.off).reshape(shp)),
+               jnp.asarray(np.asarray(plan.val).reshape(shp)))
+        plan._compact_dev = dev
+    return dev
+
+
+def compact_apply(plan_static, tables, ov, x: jax.Array,
+                  passes: int = 3, interpret: bool = False) -> jax.Array:
+    """Traceable body: y = A·x from compact tables. ``plan_static`` is
+    (n_rows, n_cols, block, lo); ``tables`` from compact_tables(); ``ov``
+    the overflow COO tuple (possibly empty)."""
+    n_rows, n_cols, block, lo = plan_static
+    src8, lane, off, val = tables
+    nb, cr, _ = src8.shape
+    x_ext = spmv_lib._ext_table(x.astype(jnp.float32))
+    g = jnp.take(x_ext, src8, axis=0)                    # (nb,cr,128,W)
+    sel = lane[..., None] == jnp.arange(spmv_lib.WIDTH, dtype=lane.dtype)
+    w = jnp.sum(g * sel, axis=-1) * val                  # fused select
+    scatter = _compact_runner(nb, cr * LANE, block, lo, passes,
+                              interpret)
+    y = scatter(off, w).reshape(-1)[:n_rows]
+    if ov:
+        y = spmv_lib._overflow_add(y, ov, x, n_rows)
+    return y
+
+
+_compact_jitted = jax.jit(compact_apply, static_argnums=(0, 4, 5))
+
+
+def spmv_compact(plan: spmv_lib.EdgeSpMVPlan, x: jax.Array,
+                 passes: int = 3, interpret: bool = False) -> jax.Array:
+    """y = A·x via the compact-table Pallas scatter (opt-in; see module
+    docstring). Numerically ~f32 at passes=3."""
+    tables = compact_tables(plan)
+    static = (plan.n_rows, plan.n_cols, plan.block, spmv_lib.LO)
+    return _compact_jitted(static, tables, plan.overflow, x, passes,
+                           interpret)

@@ -114,6 +114,12 @@ class EdgeSpMVPlan:
     _spmm_tables: Optional[tuple] = dataclasses.field(default=None,
                                                       repr=False)
 
+    @property
+    def overflow(self):
+        """Overflow COO triple (cols, rows, vals), or () when none."""
+        return (() if self.ov_cols is None
+                else (self.ov_cols, self.ov_rows, self.ov_vals))
+
     def arrays(self):
         """Flat device-array tuple for passing through jit boundaries.
         First call expands the one-hot tables on device (one fused jitted
@@ -134,9 +140,9 @@ class EdgeSpMVPlan:
                 return (src8, sel, oh_hi, oh_lo) + ov
             self.src8 = src8
             self._tables = (src8, sel, oh_hi, oh_lo)
-            # the compact arrays are never read again once expanded —
-            # drop them so ~9 B/slot isn't pinned by the plan
-            self.lane = self.off = self.val = None
+            # compact host tables are KEPT (~9 B/slot of host RAM): the
+            # compact-table Pallas path (ops/pallas_spmv.py) reads them,
+            # and dropping them made path order matter
         return self._tables + ov
 
     def spmm_extra(self, arrays=None):
@@ -302,8 +308,9 @@ def _onehot_contrib(src8, sel, oh_hi, oh_lo, x_ext) -> jax.Array:
     return contrib.reshape(-1)
 
 
-def _overflow_add(y, arrays, x, n_rows):
-    ov_c, ov_r, ov_v = arrays[4:]
+def _overflow_add(y, ov, x, n_rows):
+    """Accumulate the overflow COO triple (cols, rows, vals)."""
+    ov_c, ov_r, ov_v = ov
     w_ov = gather_1d(x.astype(jnp.float32), ov_c) * ov_v
     return y + jax.ops.segment_sum(w_ov, ov_r, num_segments=n_rows,
                                    indices_are_sorted=True)
@@ -318,7 +325,7 @@ def spmv_apply(plan_static, arrays, x: jax.Array) -> jax.Array:
     y = _onehot_contrib(src8, sel, oh_hi, oh_lo,
                         _ext_table(x.astype(jnp.float32)))[:n_rows]
     if len(arrays) > 4:
-        y = _overflow_add(y, arrays, x, n_rows)
+        y = _overflow_add(y, arrays[4:], x, n_rows)
     return y
 
 
@@ -416,7 +423,7 @@ def spmv_sharded_apply(plan_static, arrays, x: jax.Array,
                             _ext_table(x.astype(jnp.float32)))
     y = jax.lax.all_gather(y_loc, axes, axis=0, tiled=True)[:n_rows]
     if len(arrays) > 4:
-        y = _overflow_add(y, arrays, x, n_rows)
+        y = _overflow_add(y, arrays[4:], x, n_rows)
     return y
 
 

@@ -181,6 +181,30 @@ def run_pagerank_onehot(prepared, rounds: int = 30,
     return run(plan.arrays(), dangling)
 
 
+def run_pagerank_compact(prepared, rounds: int = 30, alpha: float = 0.85,
+                         passes: int = 2,
+                         interpret: bool = False) -> jax.Array:
+    """PageRank rounds over the compact-table Pallas SpMV
+    (ops/pallas_spmv.py): ~14× smaller device tables than the expanded
+    plan and faster on real TPU (measured 18.8 ms vs 29.4 per matvec at
+    BASELINE row-5 scale). ``passes`` trades round fidelity for speed:
+    2 → ~2^-16 relative error per matvec (ranking-grade), 3 → ~f32."""
+    if prepared is None:
+        raise ValueError(
+            "prepare_pagerank_onehot returned None for this graph; "
+            "use the segment-sum path instead")
+    from matrel_tpu.ops import pallas_spmv as pc
+    from matrel_tpu.ops import spmv as spmv_lib
+    plan, dangling = prepared
+    tables = pc.compact_tables(plan)
+    ov = plan.overflow
+    run = _compact_runner_loop(plan.n_rows, int(rounds), float(alpha),
+                               (plan.n_rows, plan.n_cols, plan.block,
+                                spmv_lib.LO),
+                               len(ov), int(passes), bool(interpret))
+    return run(tables, ov, dangling)
+
+
 # Prepared-plan cache for the auto path: repeated pagerank_edges calls on
 # the same graph (alpha/round sweeps) must not repay the host sort + table
 # transfer. Keyed by a FULL content hash (blake2b runs ~1 GB/s, so a 10M-
@@ -345,6 +369,22 @@ def _onehot_runner(n: int, rounds: int, alpha: float, plan_static,
     def run(arrays, dangling):
         body = _power_body(
             lambda r: spmv_lib.spmv_apply(plan_static, arrays, r),
+            n, alpha, dangling)
+        return jax.lax.fori_loop(0, rounds, body, _r0(n))
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _compact_runner_loop(n: int, rounds: int, alpha: float, plan_static,
+                         n_ov: int, passes: int, interpret: bool):
+    from matrel_tpu.ops import pallas_spmv as pc
+
+    @jax.jit
+    def run(tables, ov, dangling):
+        body = _power_body(
+            lambda r: pc.compact_apply(plan_static, tables, ov, r,
+                                       passes, interpret),
             n, alpha, dangling)
         return jax.lax.fori_loop(0, rounds, body, _r0(n))
 

@@ -488,3 +488,77 @@ class TestRoutedSpMV:
         rows, cols, vals = random_coo(rng, n, n, m)
         assert rt.build_routed_plan(rows, cols, vals, n, n,
                                     max_padding=100.0) is None
+
+
+class TestCompactSpMV:
+    """ops/pallas_spmv.py — the compact-table Pallas scatter (interpret
+    mode on CPU; on-chip numbers in BASELINE.md row 5)."""
+
+    def test_matches_oracle(self, rng):
+        from matrel_tpu.ops import pallas_spmv as pc
+        n_r, n_c, m = 3000, 2500, 30_000
+        rows, cols, vals = random_coo(rng, n_r, n_c, m)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=n_r, n_cols=n_c)
+        x = rng.standard_normal(n_c).astype(np.float32)
+        y = np.asarray(pc.spmv_compact(plan, jnp.asarray(x),
+                                       interpret=True))
+        want = coo_oracle(rows, cols, vals, x, n_r)
+        scale = np.abs(want).max()
+        assert np.abs(y - want).max() / scale < 1e-6   # passes=3
+
+    def test_two_pass_split(self, rng):
+        from matrel_tpu.ops import pallas_spmv as pc
+        n, m = 2000, 20_000
+        rows, cols, vals = random_coo(rng, n, n, m)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals, n_rows=n,
+                                        n_cols=n)
+        x = rng.standard_normal(n).astype(np.float32)
+        y = np.asarray(pc.spmv_compact(plan, jnp.asarray(x), passes=2,
+                                       interpret=True))
+        want = coo_oracle(rows, cols, vals, x, n)
+        assert np.abs(y - want).max() / np.abs(want).max() < 1e-4
+
+    def test_overflow_coo_included(self, rng):
+        from matrel_tpu.ops import pallas_spmv as pc
+        # hub row forces quantile-capacity overflow
+        m = 20_000
+        rows = np.where(rng.random(m) < 0.3, 7,
+                        rng.integers(0, 4096, m)).astype(np.int64)
+        cols = rng.integers(0, 512, m).astype(np.int64)
+        vals = rng.standard_normal(m).astype(np.float32)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=4096, n_cols=512)
+        assert plan.ov_rows is not None
+        x = rng.standard_normal(512).astype(np.float32)
+        y = np.asarray(pc.spmv_compact(plan, jnp.asarray(x),
+                                       interpret=True))
+        want = coo_oracle(rows, cols, vals, x, 4096)
+        scale = np.abs(want).max()
+        assert np.abs(y - want).max() / scale < 1e-5
+
+    def test_works_after_expanded_path(self, rng):
+        # compact hosts are kept past expansion, so the two executors
+        # can be mixed on one plan in any order
+        from matrel_tpu.ops import pallas_spmv as pc
+        rows, cols, vals = random_coo(rng, 1000, 1000, 5_000)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=1000, n_cols=1000)
+        x = rng.standard_normal(1000).astype(np.float32)
+        y1 = np.asarray(spmv_lib.spmv(plan, jnp.asarray(x)))  # expands
+        y2 = np.asarray(pc.spmv_compact(plan, jnp.asarray(x),
+                                        interpret=True))
+        np.testing.assert_allclose(y2, y1, rtol=1e-5, atol=1e-6)
+
+    def test_pagerank_compact_matches_onehot(self, rng):
+        from matrel_tpu.workloads import pagerank as pr
+        n, m = 3000, 30_000
+        src = rng.integers(0, n, m)
+        dst = rng.integers(0, n, m)
+        r1 = np.asarray(pr.run_pagerank_compact(
+            pr.prepare_pagerank_onehot(src, dst, n), rounds=10,
+            interpret=True))
+        r2 = np.asarray(pr.run_pagerank_onehot(
+            pr.prepare_pagerank_onehot(src, dst, n), rounds=10))
+        assert np.abs(r1 - r2).max() / np.abs(r2).max() < 5e-4
+        assert abs(r1.sum() - 1.0) < 1e-3
