@@ -118,6 +118,14 @@ def soak_spmv(n_trials: int, base: int, tol: float):
             got2 = np.asarray(spmv_lib.spmm(plan, jnp.asarray(X)))
             np.testing.assert_allclose(got2 / scale, (S @ X) / scale,
                                        rtol=tol, atol=tol)
+            # compact-table Pallas scatter (interpret off-TPU)
+            from matrel_tpu.ops import pallas_spmv as pc
+            import jax as _jax
+            interp = _jax.default_backend() in ("cpu",)
+            got3 = np.asarray(pc.spmv_compact(plan, jnp.asarray(x),
+                                              interpret=interp))
+            np.testing.assert_allclose(got3 / scale, want / scale,
+                                       rtol=tol, atol=tol)
         except Exception as ex:  # noqa: BLE001
             fails.append((trial, style, n_r, n_c, m,
                           type(ex).__name__, str(ex)[:150]))
