@@ -152,6 +152,14 @@ class COOMatrix:
                          _coalesced=self._coalesced)
 
     # ------------------------------------------------------------ ops
+    @staticmethod
+    def _compact_mode() -> bool:
+        """On real TPU the compact-table Pallas executor wins on both
+        time and (17×) memory — the expanded one-hot tables are never
+        built. CPU keeps the expanded XLA path (pallas interpret is a
+        debugging mode, not a fast path)."""
+        return jax.default_backend() in ("tpu", "axon")
+
     def matvec(self, x) -> jax.Array:
         """y = A·x, shape (n_rows,)."""
         x = jnp.asarray(x, jnp.float32).ravel()
@@ -163,6 +171,9 @@ class COOMatrix:
                                          self._mesh)
         plan = self._get_plan()
         if plan is not None:
+            if self._compact_mode():
+                from matrel_tpu.ops import pallas_spmv as pc
+                return pc.spmv_compact(plan, x)
             return spmv_lib.spmv(plan, x)
         if self._seg_fwd is None:
             self._seg_fwd = self._seg_arrays(self.rows, self.cols)
@@ -198,6 +209,9 @@ class COOMatrix:
                                          self._mesh)
         plan = self._get_plan()
         if plan is not None:
+            if self._compact_mode():
+                from matrel_tpu.ops import pallas_spmv as pc
+                return pc.spmm_compact(plan, X)
             return spmv_lib.spmm(plan, X)
         cols = [self.matvec(X[:, j]) for j in range(X.shape[1])]
         return jnp.stack(cols, axis=1)

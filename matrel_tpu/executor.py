@@ -224,14 +224,29 @@ class Lowerer:
         return jnp.pad(out, ((0, pshape[0] - out.shape[0]),
                              (0, pshape[1] - out.shape[1])))
 
-    @staticmethod
-    def _coo_spmv_stack(plan, vectors) -> Array:
+    def _coo_spmv_stack(self, plan, vectors) -> Array:
         """SpMV results for a sequence of input vectors (columns of the
-        dense operand) as a (n_rows, k) array; plan arrays ride the trace
-        as constants, like the sparse tile stacks. Single vectors take
-        the matvec kernel; wider stacks the k-wide SpMM (one shared
-        gather for all columns)."""
+        dense operand) as a (n_rows, k) array; plan tables ride the
+        trace as constants (hoisted into call-time args by
+        _hoist_large_consts). On real TPU the compact-table Pallas
+        executor runs — faster, and the expanded one-hot tables are
+        never built (17× less HBM); CPU keeps the expanded XLA path.
+        Single vectors take the matvec kernel; wider stacks the k-wide
+        SpMM (one shared gather for all columns)."""
         from matrel_tpu.ops import spmv as spmv_lib
+        if (jax.default_backend() in ("tpu", "axon")
+                and self.mesh.size == 1):
+            # single-device only: pallas_call has no SPMD partitioning
+            # rule, so a multi-device GSPMD program keeps the XLA path
+            from matrel_tpu.ops import pallas_spmv as pc
+            tables = pc.compact_tables(plan)
+            static = (plan.n_rows, plan.n_cols, plan.block, spmv_lib.LO)
+            if len(vectors) == 1:
+                return pc.compact_apply(static, tables, plan.overflow,
+                                        vectors[0])[:, None]
+            return pc.compact_matmat_apply(
+                static, tables, plan.overflow,
+                jnp.stack(vectors, axis=1))
         static = (plan.n_rows, plan.n_cols, plan.block)
         arrays = plan.arrays()
         if len(vectors) == 1:

@@ -374,15 +374,16 @@ def spmm_apply(plan_static, arrays, extra, X: jax.Array) -> jax.Array:
     out = jax.lax.map(chunk, (hh, ll, ww))             # (nch, ch, H, LO·k)
     y = out.reshape(nch * ch, -1, LO, k).reshape(-1, k)[:n_rows]
     if len(arrays) > 4:
-        y = _overflow_add_wide(y, arrays, X, n_rows)
+        y = _overflow_add_wide(y, arrays[4:], X, n_rows)
     return y
 
 
-def _overflow_add_wide(y, arrays, X, n_rows):
-    """k-wide overflow COO accumulation. Overflow indices are always real
-    columns (< n_cols — sentinels never overflow), so gather straight
-    from X, no padded copy."""
-    ov_c, ov_r, ov_v = arrays[4:]
+def _overflow_add_wide(y, ov, X, n_rows):
+    """k-wide overflow COO accumulation of the (cols, rows, vals)
+    triple. Overflow indices are always real columns (< n_cols —
+    sentinels never overflow), so gather straight from X, no padded
+    copy."""
+    ov_c, ov_r, ov_v = ov
     w_ov = jnp.take(X.astype(jnp.float32), ov_c, axis=0) * ov_v[:, None]
     return y + jax.ops.segment_sum(w_ov, ov_r, num_segments=n_rows,
                                    indices_are_sorted=True)
@@ -439,7 +440,7 @@ def spmm_sharded_apply(plan_static, arrays, extra, X: jax.Array,
                        arrays[:4], extra, X)
     y = jax.lax.all_gather(y_loc, axes, axis=0, tiled=True)[:n_rows]
     if len(arrays) > 4:
-        y = _overflow_add_wide(y, arrays, X, n_rows)
+        y = _overflow_add_wide(y, arrays[4:], X, n_rows)
     return y
 
 

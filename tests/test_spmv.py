@@ -562,3 +562,40 @@ class TestCompactSpMV:
             pr.prepare_pagerank_onehot(src, dst, n), rounds=10))
         assert np.abs(r1 - r2).max() / np.abs(r2).max() < 5e-4
         assert abs(r1.sum() - 1.0) < 1e-3
+
+    def test_spmm_compact_matches_oracle(self, rng):
+        from matrel_tpu.ops import pallas_spmv as pc
+        for n_r, n_c, m, k in [(3000, 2500, 25_000, 16),
+                               (1000, 1500, 8_000, 5)]:
+            rows, cols, vals = random_coo(rng, n_r, n_c, m)
+            plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                            n_rows=n_r, n_cols=n_c)
+            X = rng.standard_normal((n_c, k)).astype(np.float32)
+            Y = np.asarray(pc.spmm_compact(plan, jnp.asarray(X),
+                                           interpret=True))
+            want = np.zeros((n_r, k))
+            np.add.at(want, rows, vals[:, None] * X[cols])
+            scale = np.abs(want).max()
+            assert np.abs(Y - want).max() / scale < 1e-4
+
+    def test_spmm_compact_overflow_and_single_col(self, rng):
+        from matrel_tpu.ops import pallas_spmv as pc
+        m = 20_000
+        rows = np.where(rng.random(m) < 0.3, 7,
+                        rng.integers(0, 4096, m)).astype(np.int64)
+        cols = rng.integers(0, 512, m).astype(np.int64)
+        vals = rng.standard_normal(m).astype(np.float32)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=4096, n_cols=512)
+        assert plan.ov_rows is not None
+        X = rng.standard_normal((512, 3)).astype(np.float32)
+        Y = np.asarray(pc.spmm_compact(plan, jnp.asarray(X),
+                                       interpret=True))
+        want = np.zeros((4096, 3))
+        np.add.at(want, rows, vals[:, None] * X[cols])
+        scale = np.abs(want).max()
+        assert np.abs(Y - want).max() / scale < 1e-4
+        # k == 1 takes the matvec kernel
+        y1 = np.asarray(pc.spmm_compact(plan, jnp.asarray(X[:, :1]),
+                                        interpret=True))
+        assert np.abs(y1[:, 0] - want[:, 0]).max() / scale < 1e-5
