@@ -140,6 +140,88 @@ def compact_apply(plan_static, tables, ov, x: jax.Array,
 _compact_jitted = jax.jit(compact_apply, static_argnums=(0, 4, 5))
 
 
+# -- mesh-sharded ------------------------------------------------------------
+# Unlike the executor's GSPMD programs (where pallas_call has no SPMD
+# partitioning rule), shard_map hands the kernel per-device shapes, so
+# the compact scatter runs unchanged on each device's slice of blocks:
+# ~13 B/slot / P per device, one tiled all_gather of the result.
+
+
+def shard_compact_tables(plan: spmv_lib.EdgeSpMVPlan, mesh):
+    """Row-decompose the compact tables over every device of ``mesh``
+    (block axis padded to the device count with sentinel slots).
+    Memoised per (plan, mesh) — by mesh EQUALITY, matching the runner
+    cache, so rebuilding an equal Mesh per call reuses the transfer."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    memo = getattr(plan, "_compact_sharded", None)
+    if memo is None:
+        memo = {}
+        plan._compact_sharded = memo
+    dev = memo.get(mesh)
+    if dev is not None:
+        return dev
+    nb, cap = np.asarray(plan.src8).shape
+    if cap % LANE:
+        raise ValueError(f"capacity {cap} not a multiple of {LANE}")
+    p = mesh.size
+    nb_pad = -(-nb // p) * p
+    pad = nb_pad - nb
+    fills = spmv_lib.compact_pad_fills(plan.n_cols)
+
+    def padded(a, fill, dtype):
+        a = np.asarray(a)
+        if pad:
+            a = np.concatenate(
+                [a, np.full((pad, cap), fill, a.dtype)])
+        return a.reshape(nb_pad, cap // LANE, LANE).astype(dtype)
+
+    sh = NamedSharding(mesh, P(tuple(mesh.axis_names), None, None))
+    dev = (jax.device_put(padded(plan.src8, fills["src8"], np.int32), sh),
+           jax.device_put(padded(plan.lane, fills["lane"], np.int8), sh),
+           jax.device_put(padded(plan.off, fills["off"], np.int32), sh),
+           jax.device_put(padded(plan.val, fills["val"], np.float32), sh))
+    memo[mesh] = dev
+    return dev
+
+
+@functools.lru_cache(maxsize=32)
+def _compact_sharded_runner(plan_static, mesh, passes: int, n_ov: int,
+                            interpret: bool):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_rows, n_cols, block, lo = plan_static
+    axes = tuple(mesh.axis_names)
+    spec3 = P(axes, None, None)
+
+    def kernel(src8, lane, off, val, x, *ov):
+        # per-device block slice; x replicated
+        y_loc = compact_apply(
+            (src8.shape[0] * block, n_cols, block, lo),
+            (src8, lane, off, val), (), x, passes, interpret)
+        y = jax.lax.all_gather(y_loc, axes, axis=0, tiled=True)[:n_rows]
+        if ov:
+            y = spmv_lib._overflow_add(y, ov, x, n_rows)
+        return y
+
+    in_specs = (spec3,) * 4 + (P(),) + (P(),) * n_ov
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                             out_specs=P(), check_vma=False))
+
+
+def spmv_compact_sharded(plan: spmv_lib.EdgeSpMVPlan, x: jax.Array,
+                         mesh, passes: int = 3,
+                         interpret: bool = False) -> jax.Array:
+    """y = A·x with compact tables sharded over ``mesh``."""
+    tables = shard_compact_tables(plan, mesh)
+    ov = plan.overflow
+    run = _compact_sharded_runner(
+        (plan.n_rows, plan.n_cols, plan.block, spmv_lib.LO), mesh,
+        passes, len(ov), interpret)
+    return run(*tables, jnp.asarray(x, jnp.float32), *ov)
+
+
 # -- k-wide (SpMM) -----------------------------------------------------------
 
 _COL_CHUNK = 8          # lo·passes·chunk = 256 lanes in the rhs concat

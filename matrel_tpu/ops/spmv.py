@@ -486,6 +486,14 @@ def spmm_sharded(plan: EdgeSpMVPlan, X: jax.Array, mesh,
     return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
 
 
+def compact_pad_fills(n_cols: int) -> dict:
+    """Sentinel fill values for padded slots/blocks of the compact
+    layout, shared by every sharding path: src8 rows point at the
+    zero sentinel row of _ext_table, val 0 kills any contribution."""
+    return {"src8": n_cols // WIDTH, "lane": n_cols % WIDTH,
+            "off": 0, "val": 0.0}
+
+
 def shard_plan(plan: EdgeSpMVPlan, mesh) -> EdgeSpMVPlan:
     """Row-decompose a plan over all devices of ``mesh``: the block axis
     pads to the device count and the compact tables are placed with
@@ -511,14 +519,14 @@ def shard_plan(plan: EdgeSpMVPlan, mesh) -> EdgeSpMVPlan:
             [np.asarray(a),
              np.full((pad, *a.shape[1:]), fill, np.asarray(a).dtype)])
 
-    sentinel8 = plan.n_cols // WIDTH
+    fills = compact_pad_fills(plan.n_cols)
     sh2 = NamedSharding(mesh, P(axes, None))
     return dataclasses.replace(
         plan,
-        src8=jax.device_put(padded(plan.src8, sentinel8), sh2),
-        lane=jax.device_put(padded(plan.lane, plan.n_cols % WIDTH), sh2),
-        off=jax.device_put(padded(plan.off, 0), sh2),
-        val=jax.device_put(padded(plan.val, 0.0), sh2))
+        src8=jax.device_put(padded(plan.src8, fills["src8"]), sh2),
+        lane=jax.device_put(padded(plan.lane, fills["lane"]), sh2),
+        off=jax.device_put(padded(plan.off, fills["off"]), sh2),
+        val=jax.device_put(padded(plan.val, fills["val"]), sh2))
 
 
 _spmv_jitted = jax.jit(spmv_apply, static_argnums=0)
