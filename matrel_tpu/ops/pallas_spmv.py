@@ -20,11 +20,13 @@ Pipeline per matvec (``spmv_compact``):
      ``oh_hiᵀ @ rhs`` per block, write the (HI', LO) output tile.
   3. XLA: overflow-COO accumulation (unchanged contract).
 
-This is an OPT-IN alternate executor for an EdgeSpMVPlan: it reads the
-plan's compact host tables (kept on device via a small memo) and leaves
-the expanded-table path — default, battle-tested, shardable — untouched.
-Measured trade (BASELINE row 5 graph): ~17× smaller device tables
-(13 B/slot vs ~224).
+This executor reads an EdgeSpMVPlan's compact host tables (kept on
+device via a small memo). It is the DEFAULT on real TPU backends for
+COOMatrix matvec/matmat, the DSL's single-device COO matmuls, and
+pagerank_edges; CPU and GSPMD multi-device executor programs keep the
+expanded XLA path (pallas_call has no SPMD partitioning rule — the
+shard_map variants below are the multi-device form). Measured trade
+(BASELINE row 5 graph): ~17× smaller device tables (13 B/slot vs ~224).
 """
 
 from __future__ import annotations
