@@ -187,28 +187,46 @@ def shard_compact_tables(plan: spmv_lib.EdgeSpMVPlan, mesh):
     return dev
 
 
+def compact_sharded_apply(plan_static, tables, ov, x, axes,
+                          passes: int = 3,
+                          interpret: bool = False) -> jax.Array:
+    """Per-device sharded compact matvec — call INSIDE a shard_map over
+    ``axes``: ``tables`` arrive as this device's block slice, x
+    replicated; one tiled all_gather assembles the result; overflow COO
+    is replicated and added after the gather. Shared by the standalone
+    runner here and pagerank's power-iteration loop."""
+    n_rows, n_cols, block, lo = plan_static
+    src8 = tables[0]
+    y_loc = compact_apply(
+        (src8.shape[0] * block, n_cols, block, lo), tables, (), x,
+        passes, interpret)
+    y = jax.lax.all_gather(y_loc, axes, axis=0, tiled=True)[:n_rows]
+    if ov:
+        y = spmv_lib._overflow_add(y, ov, x, n_rows)
+    return y
+
+
+def compact_sharded_specs(axes, n_ov: int):
+    """shard_map in_specs for (tables..., x, overflow...)."""
+    from jax.sharding import PartitionSpec as P
+    return (P(axes, None, None),) * 4 + (P(),) + (P(),) * n_ov
+
+
 @functools.lru_cache(maxsize=32)
 def _compact_sharded_runner(plan_static, mesh, passes: int, n_ov: int,
                             interpret: bool):
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    n_rows, n_cols, block, lo = plan_static
     axes = tuple(mesh.axis_names)
-    spec3 = P(axes, None, None)
 
     def kernel(src8, lane, off, val, x, *ov):
-        # per-device block slice; x replicated
-        y_loc = compact_apply(
-            (src8.shape[0] * block, n_cols, block, lo),
-            (src8, lane, off, val), (), x, passes, interpret)
-        y = jax.lax.all_gather(y_loc, axes, axis=0, tiled=True)[:n_rows]
-        if ov:
-            y = spmv_lib._overflow_add(y, ov, x, n_rows)
-        return y
+        return compact_sharded_apply(plan_static,
+                                     (src8, lane, off, val), ov, x,
+                                     axes, passes, interpret)
 
-    in_specs = (spec3,) * 4 + (P(),) + (P(),) * n_ov
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=in_specs,
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=compact_sharded_specs(axes, n_ov),
                              out_specs=P(), check_vma=False))
 
 

@@ -87,9 +87,15 @@ def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
                 "sharded across non-addressable devices need "
                 "impl='segment'")
         if mesh is not None:
-            out = _pagerank_onehot_sharded(src, dst, n, rounds, alpha,
-                                           mesh, max_slots=None,
-                                           weights=weights)
+            if jax.default_backend() in ("tpu", "axon"):
+                out = _pagerank_compact_sharded(
+                    src, dst, n, rounds, alpha, mesh, max_slots=None,
+                    weights=weights, passes=passes)
+            else:
+                out = _pagerank_onehot_sharded(src, dst, n, rounds,
+                                               alpha, mesh,
+                                               max_slots=None,
+                                               weights=weights)
         else:
             out = _pagerank_onehot(src, dst, n, rounds, alpha,
                                    weights=weights, passes=passes)
@@ -112,10 +118,10 @@ def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
         on_tpu = jax.default_backend() in ("tpu", "axon")
         if on_tpu and _host_fetchable(src) and _host_fetchable(dst):
             if mesh is not None:
-                out = _pagerank_onehot_sharded(
+                out = _pagerank_compact_sharded(
                     src, dst, n, rounds, alpha, mesh,
-                    max_slots=_PLAN_CACHE_MAX_SLOTS * mesh.size,
-                    weights=weights)
+                    max_slots=_auto_max_slots() * mesh.size,
+                    weights=weights, passes=passes)
             else:
                 out = _pagerank_onehot(src, dst, n, rounds, alpha,
                                        max_slots=_auto_max_slots(),
@@ -296,6 +302,69 @@ def _pagerank_onehot(src, dst, n: int, rounds: int, alpha: float,
         return run_pagerank_compact(prepared, rounds, alpha,
                                     passes=passes)
     return run_pagerank_onehot(prepared, rounds, alpha)
+
+
+def _pagerank_compact_sharded(src, dst, n: int, rounds: int, alpha: float,
+                              mesh, max_slots: int = None, weights=None,
+                              passes: int = 3, interpret: bool = False):
+    """Multi-chip PageRank over mesh-sharded COMPACT tables: each device
+    holds ~13 B/slot / P and generates its scatter one-hots in VMEM
+    (ops/pallas_spmv.py); the whole power iteration is one shard_map'd
+    program with a tiled all_gather of r per round."""
+    from matrel_tpu.ops import pallas_spmv as pc
+    from matrel_tpu.ops import spmv as spmv_lib
+
+    key = _graph_fingerprint(src, dst, n, weights) + (mesh, "compact")
+
+    def build():
+        prepared = prepare_pagerank_onehot(src, dst, n,
+                                           max_slots=max_slots,
+                                           weights=weights)
+        if prepared is None:
+            return None
+        pc.shard_compact_tables(prepared[0], mesh)   # place now
+        return prepared
+
+    prepared = _cache_get_or_insert(
+        key, build, lambda pr_: -(-_plan_slots(pr_) // (16 * mesh.size)))
+    if prepared is None:
+        return None
+    plan, dangling = prepared
+    tables = pc.shard_compact_tables(plan, mesh)
+    ov = plan.overflow
+    run = _compact_sharded_loop(
+        int(n), int(rounds), float(alpha),
+        (plan.n_rows, plan.n_cols, plan.block, spmv_lib.LO),
+        len(ov), int(passes), bool(interpret), mesh)
+    return run(*tables, jnp.asarray(dangling), *ov)
+
+
+@functools.lru_cache(maxsize=32)
+def _compact_sharded_loop(n: int, rounds: int, alpha: float, plan_static,
+                          n_ov: int, passes: int, interpret: bool, mesh):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from matrel_tpu.ops import pallas_spmv as pc
+    from matrel_tpu.ops import spmv as spmv_lib
+
+    axes = tuple(mesh.axis_names)
+    in_specs = pc.compact_sharded_specs(axes, n_ov)
+
+    def kernel(src8, lane, off, val, dangling, *ov):
+        def matvec(r):
+            return pc.compact_sharded_apply(
+                plan_static, (src8, lane, off, val), ov, r, axes,
+                passes, interpret)
+
+        body = _power_body(matvec, n, alpha, dangling)
+        r0 = _r0(n)
+        pcast = getattr(jax.lax, "pcast", None)
+        r0 = (pcast(r0, axes, to="varying") if pcast is not None
+              else jax.lax.pvary(r0, axes))
+        return jax.lax.fori_loop(0, rounds, body, r0)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                             out_specs=P(), check_vma=False))
 
 
 def _pagerank_onehot_sharded(src, dst, n: int, rounds: int, alpha: float,

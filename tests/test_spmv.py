@@ -633,3 +633,15 @@ class TestCompactSpMV:
                                                interpret=True))
         want = coo_oracle(rows, cols, vals, x, 4096)
         assert np.abs(y - want).max() / np.abs(want).max() < 1e-5
+
+    def test_pagerank_compact_sharded_matches_segment(self, mesh8, rng):
+        from matrel_tpu.workloads import pagerank as pr
+        n, m = 3000, 30_000
+        src = rng.integers(0, n, m)
+        dst = rng.integers(0, n, m)
+        r1 = np.asarray(pr._pagerank_compact_sharded(
+            src, dst, n, 8, 0.85, mesh8, interpret=True))
+        r2 = np.asarray(pr.pagerank_edges(src, dst, n, rounds=8,
+                                          impl="segment"))
+        assert np.abs(r1 - r2).max() / np.abs(r2).max() < 5e-4
+        assert abs(r1.sum() - 1.0) < 1e-3
