@@ -645,3 +645,27 @@ class TestCompactSpMV:
                                           impl="segment"))
         assert np.abs(r1 - r2).max() / np.abs(r2).max() < 5e-4
         assert abs(r1.sum() - 1.0) < 1e-3
+
+    def test_compact_edge_cases(self, mesh8, rng):
+        # empty plans, single partial block, fewer blocks than devices,
+        # zero-column X — none may crash or densify
+        from matrel_tpu.ops import pallas_spmv as pc
+        empty = spmv_lib.build_spmv_plan(np.zeros(0), np.zeros(0),
+                                         n_rows=100, n_cols=100)
+        y = np.asarray(pc.spmv_compact(empty, jnp.ones(100, jnp.float32),
+                                       interpret=True))
+        assert (y == 0).all()
+        rows, cols, vals = random_coo(rng, 100, 80, 500)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=100, n_cols=80)
+        x = rng.standard_normal(80).astype(np.float32)
+        want = coo_oracle(rows, cols, vals, x, 100)
+        y = np.asarray(pc.spmv_compact(plan, jnp.asarray(x),
+                                       interpret=True))
+        assert np.abs(y - want).max() / np.abs(want).max() < 1e-6
+        # one block over eight devices: sentinel-padded to the mesh
+        y = np.asarray(pc.spmv_compact_sharded(plan, x, mesh8,
+                                               interpret=True))
+        assert np.abs(y - want).max() / np.abs(want).max() < 1e-6
+        assert pc.spmm_compact(plan, jnp.zeros((80, 0), jnp.float32),
+                               interpret=True).shape == (100, 0)
