@@ -106,3 +106,13 @@ def default_config() -> MatrelConfig:
 def set_default_config(cfg: MatrelConfig) -> None:
     global _default_config
     _default_config = cfg
+
+
+def pallas_enabled(config: "MatrelConfig" = None) -> bool:
+    """True when hand-written Pallas kernels should run: the config
+    toggle is on AND the backend is a real TPU (CPU keeps the XLA
+    paths; pallas interpret is a debugging mode, not a fast path).
+    The single gate shared by every compact-executor call site."""
+    import jax
+    cfg = config or default_config()
+    return cfg.use_pallas and jax.default_backend() in ("tpu", "axon")

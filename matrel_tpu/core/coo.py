@@ -156,9 +156,9 @@ class COOMatrix:
     def _compact_mode() -> bool:
         """On real TPU the compact-table Pallas executor wins on both
         time and (17×) memory — the expanded one-hot tables are never
-        built. CPU keeps the expanded XLA path (pallas interpret is a
-        debugging mode, not a fast path)."""
-        return jax.default_backend() in ("tpu", "axon")
+        built (config.pallas_enabled is the single shared gate)."""
+        from matrel_tpu.config import pallas_enabled
+        return pallas_enabled()
 
     def matvec(self, x) -> jax.Array:
         """y = A·x, shape (n_rows,)."""

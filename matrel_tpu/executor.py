@@ -233,9 +233,9 @@ class Lowerer:
         never built (17× less HBM); CPU keeps the expanded XLA path.
         Single vectors take the matvec kernel; wider stacks the k-wide
         SpMM (one shared gather for all columns)."""
+        from matrel_tpu.config import pallas_enabled
         from matrel_tpu.ops import spmv as spmv_lib
-        if (jax.default_backend() in ("tpu", "axon")
-                and self.mesh.size == 1):
+        if pallas_enabled(self.config) and self.mesh.size == 1:
             # single-device only: pallas_call has no SPMD partitioning
             # rule, so a multi-device GSPMD program keeps the XLA path
             from matrel_tpu.ops import pallas_spmv as pc

@@ -87,7 +87,8 @@ def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
                 "sharded across non-addressable devices need "
                 "impl='segment'")
         if mesh is not None:
-            if jax.default_backend() in ("tpu", "axon"):
+            from matrel_tpu.config import pallas_enabled
+            if pallas_enabled():
                 out = _pagerank_compact_sharded(
                     src, dst, n, rounds, alpha, mesh, max_slots=None,
                     weights=weights, passes=passes)
@@ -118,10 +119,17 @@ def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
         on_tpu = jax.default_backend() in ("tpu", "axon")
         if on_tpu and _host_fetchable(src) and _host_fetchable(dst):
             if mesh is not None:
-                out = _pagerank_compact_sharded(
-                    src, dst, n, rounds, alpha, mesh,
-                    max_slots=_auto_max_slots() * mesh.size,
-                    weights=weights, passes=passes)
+                from matrel_tpu.config import pallas_enabled
+                if pallas_enabled():
+                    out = _pagerank_compact_sharded(
+                        src, dst, n, rounds, alpha, mesh,
+                        max_slots=_auto_max_slots() * mesh.size,
+                        weights=weights, passes=passes)
+                else:
+                    out = _pagerank_onehot_sharded(
+                        src, dst, n, rounds, alpha, mesh,
+                        max_slots=_PLAN_CACHE_MAX_SLOTS * mesh.size,
+                        weights=weights)
             else:
                 out = _pagerank_onehot(src, dst, n, rounds, alpha,
                                        max_slots=_auto_max_slots(),
@@ -276,10 +284,13 @@ def _plan_slots(prepared) -> int:
 
 
 def _auto_max_slots() -> int:
-    """Plan-size gate for the auto path: on TPU the compact executor
-    runs at ~13 B/slot device-side, so the budget is ~17× the expanded
-    path's (whose ~224 B/slot sized _PLAN_CACHE_MAX_SLOTS)."""
-    if jax.default_backend() in ("tpu", "axon"):
+    """Plan-size gate for the auto path: when the compact executor will
+    run (~13 B/slot device-side) the budget is 8× the expanded path's
+    (whose ~224 B/slot sized _PLAN_CACHE_MAX_SLOTS). Must consult the
+    SAME gate as the executor choice — with use_pallas=False the
+    expanded tables run, and an 8× budget would admit ~43 GB plans."""
+    from matrel_tpu.config import pallas_enabled
+    if pallas_enabled():
         return _PLAN_CACHE_MAX_SLOTS * 8     # ~3 GB compact + host copy
     return _PLAN_CACHE_MAX_SLOTS
 
@@ -294,7 +305,8 @@ def _pagerank_onehot(src, dst, n: int, rounds: int, alpha: float,
         _plan_slots)
     if prepared is None:
         return None
-    if jax.default_backend() in ("tpu", "axon"):
+    from matrel_tpu.config import pallas_enabled
+    if pallas_enabled():
         # compact-table Pallas executor: faster and ~17× less HBM than
         # the expanded tables (BASELINE row 5). passes=3 (default) is
         # f32-faithful like the expanded path; callers may pass 2 for
