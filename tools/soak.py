@@ -71,6 +71,37 @@ def soak_fuzz(n_seeds: int, base: int, tol: float):
     return fails
 
 
+def soak_deep(n_seeds: int, base: int, tol: float):
+    """Deep expression trees (depth 5-7): heavier rewrite/CSE/planner
+    pressure than the default battery's depth 2-4."""
+    import importlib.util
+    import numpy as np
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.executor import compile_expr
+
+    spec = importlib.util.spec_from_file_location(
+        "fuzzmod", os.path.join(REPO, "tests", "test_fuzz.py"))
+    fuzz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fuzz)
+    mesh = mesh_lib.make_mesh()
+    fails = []
+    for seed in range(base, base + n_seeds):
+        rng = np.random.default_rng(seed)
+        env = {}
+        try:
+            e = fuzz.gen_expr(rng, env, mesh,
+                              depth=int(rng.integers(5, 8)),
+                              leaf_kinds=("dense", "dense", "sparse",
+                                          "coo"))
+            oracle = fuzz.np_eval(e, env)
+            got = compile_expr(e, mesh, MatrelConfig()).run().to_numpy()
+            np.testing.assert_allclose(got, oracle, rtol=tol, atol=tol)
+        except Exception as ex:  # noqa: BLE001
+            fails.append(("deep", seed, type(ex).__name__, str(ex)[:200]))
+    return fails
+
+
 def soak_spmv(n_trials: int, base: int, tol: float):
     import numpy as np
     import scipy.sparse as sp
@@ -231,7 +262,8 @@ def soak_routed(n_trials: int, base: int, tol: float):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("battery",
-                   choices=["fuzz", "spmv", "sharded", "routed", "all"])
+                   choices=["fuzz", "deep", "spmv", "sharded", "routed",
+                            "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -242,6 +274,9 @@ def main():
     fails = []
     if args.battery in ("fuzz", "all"):
         fails += soak_fuzz(args.seeds, args.base, tol)
+    if args.battery in ("deep", "all"):
+        # deeper trees accumulate more bf16 matmul error; widen slightly
+        fails += soak_deep(max(args.seeds // 4, 5), args.base, 2 * tol)
     if args.battery in ("spmv", "all"):
         fails += soak_spmv(args.seeds, args.base,
                            1e-3 if args.tpu else 2e-4)
