@@ -316,6 +316,9 @@ class BlockMatrix:
     def trace(self):
         return self.expr().trace()
 
+    def norm(self, kind: str = "fro"):
+        return self.expr().norm(kind)
+
     def inverse(self):
         return self.expr().inverse()
 

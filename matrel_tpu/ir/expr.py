@@ -149,6 +149,24 @@ class MatExpr:
     def col_avg(self) -> "MatExpr":
         return agg(self, "avg", "col")
 
+    def norm(self, kind: str = "fro") -> "MatExpr":
+        """Matrix norm as a (1,1) expression — pure sugar over existing
+        nodes (so every rewrite applies): "fro" = sqrt(Σ a²), "l1" =
+        Σ|a| (entrywise), "max" = max|a|."""
+        if kind == "fro":
+            return scalar_op("pow", agg(elemwise("mul", self, self),
+                                        "sum", "all"), 0.5)
+        # |a| = max(a, -a): exact, no under/overflow from squaring, and
+        # sparsity-preserving (max(0, 0) = 0)
+        if kind == "l1":
+            return agg(elemwise("max", self, self.multiply_scalar(-1.0)),
+                       "sum", "all")
+        if kind == "max":
+            return agg(elemwise("max", self, self.multiply_scalar(-1.0)),
+                       "max", "all")
+        raise ValueError(f"unknown norm kind {kind!r} "
+                         "(expected 'fro', 'l1', or 'max')")
+
     def inverse(self) -> "MatExpr":
         return inverse(self)
 

@@ -17,7 +17,7 @@ Grammar (Python-expression syntax, parsed via ``ast`` — no eval):
         transpose(A) | t(A)
         rowsum(e) colsum(e) sum(e) trace(e) vec(e)
         rowmax/rowmin/colmax/colmin/rowcount/rowavg/colcount/colavg(e)
-        power(e, p)
+        power(e, p)  norm(e [, "fro"|"l1"|"max"])
         select(e, "v > 0" [, fill])     σ on entry values
         selectrows(e, "i % 2 == 0")     σ on row index
         selectcols(e, "j < 4")          σ on col index
@@ -192,6 +192,9 @@ class _Compiler(ast.NodeVisitor):
             return self._expr(args[0]).power(self._lit(args[1]))
         if name == "vec":
             return self._expr(args[0]).vec()
+        if name == "norm":
+            kind = (self._str(args[1]) if len(args) > 1 else "fro")
+            return self._expr(args[0]).norm(kind)
         if name in ("inverse", "inv"):
             return self._expr(args[0]).inverse()
         if name == "solve":

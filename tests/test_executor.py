@@ -368,3 +368,20 @@ def test_multiplan_hoists_and_appends_extras(mesh8, rng):
     if sum(c.nbytes for c in plan.extra_args) == 0:
         # tile stack below threshold would make this vacuous
         raise AssertionError("expected hoisted sparse payload")
+
+
+def test_norms(mesh8, rng):
+    a = rng.standard_normal((9, 13)).astype(np.float32)
+    A = bm(a, mesh8)
+    assert A.norm().compute().to_numpy()[0, 0] == pytest.approx(
+        np.linalg.norm(a), rel=1e-4)
+    assert A.norm("l1").compute().to_numpy()[0, 0] == pytest.approx(
+        np.abs(a).sum(), rel=1e-4)
+    assert A.norm("max").compute().to_numpy()[0, 0] == pytest.approx(
+        np.abs(a).max(), rel=1e-4)
+    with pytest.raises(ValueError, match="norm kind"):
+        A.norm("spectral")
+    # |a| via max(a, -a): tiny magnitudes must not underflow to 0
+    tiny = bm(np.full((4, 4), -1e-30, np.float32), mesh8)
+    assert tiny.norm("max").compute().to_numpy()[0, 0] == pytest.approx(
+        1e-30, rel=1e-4)

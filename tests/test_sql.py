@@ -92,3 +92,11 @@ def test_solve_and_inverse(sess):
         s.sql("inverse(multiply(transpose(A), A))")).to_numpy()
     np.testing.assert_allclose(gram_inv, np.linalg.inv(a.T @ a),
                                rtol=1e-2, atol=1e-3)
+
+
+def test_norm_function(sess):
+    s, a, b = sess
+    out = s.compute(s.sql('norm(A)')).to_numpy()
+    np.testing.assert_allclose(out[0, 0], np.linalg.norm(a), rtol=1e-4)
+    out = s.compute(s.sql('norm(A, "l1")')).to_numpy()
+    np.testing.assert_allclose(out[0, 0], np.abs(a).sum(), rtol=1e-4)
