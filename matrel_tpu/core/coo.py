@@ -362,6 +362,19 @@ class COOMatrix:
     def sum(self) -> float:
         return float(self.vals.sum())
 
+    def norm(self, kind: str = "fro") -> float:
+        """Matrix norm over ENTRIES (duplicates coalesced first —
+        absent entries are 0 and contribute nothing to any of these)."""
+        v = self.coalesce().vals.astype(np.float64)
+        if kind == "fro":
+            return float(np.sqrt((v * v).sum()))
+        if kind == "l1":
+            return float(np.abs(v).sum())
+        if kind == "max":
+            return float(np.abs(v).max()) if v.size else 0.0
+        raise ValueError(f"unknown norm kind {kind!r} "
+                         "(expected 'fro', 'l1', or 'max')")
+
     def trace(self) -> float:
         d = self.rows == self.cols
         return float(self.vals[d].sum())

@@ -237,6 +237,21 @@ class BlockSparseMatrix:
             shape=(self.shape[1], self.shape[0]),
             block_size=self.block_size, mesh=self.mesh)
 
+    def norm(self, kind: str = "fro") -> float:
+        """Matrix norm from the tile stack (tiles are unique by
+        construction; zeros outside kept tiles contribute nothing)."""
+        # float64 like the COO sibling: f32 squaring overflows at
+        # ~1.8e19 magnitudes and f32 sums drift on large stacks
+        b = np.asarray(self.blocks, np.float64)
+        if kind == "fro":
+            return float(np.sqrt((b * b).sum()))
+        if kind == "l1":
+            return float(np.abs(b).sum())
+        if kind == "max":
+            return float(np.abs(b).max()) if self.nnzb else 0.0
+        raise ValueError(f"unknown norm kind {kind!r} "
+                         "(expected 'fro', 'l1', or 'max')")
+
     def shard(self, mesh: Optional[Mesh] = None):
         """Distribute the tile stack over a mesh (each device holds
         ~nnzb/P tiles in its output row range) — the scale-out SpMM

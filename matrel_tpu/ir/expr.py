@@ -158,12 +158,9 @@ class MatExpr:
                                         "sum", "all"), 0.5)
         # |a| = max(a, -a): exact, no under/overflow from squaring, and
         # sparsity-preserving (max(0, 0) = 0)
-        if kind == "l1":
-            return agg(elemwise("max", self, self.multiply_scalar(-1.0)),
-                       "sum", "all")
-        if kind == "max":
-            return agg(elemwise("max", self, self.multiply_scalar(-1.0)),
-                       "max", "all")
+        if kind in ("l1", "max"):
+            absa = elemwise("max", self, self.multiply_scalar(-1.0))
+            return agg(absa, "sum" if kind == "l1" else "max", "all")
         raise ValueError(f"unknown norm kind {kind!r} "
                          "(expected 'fro', 'l1', or 'max')")
 

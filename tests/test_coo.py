@@ -316,3 +316,12 @@ class TestCOORelational:
         neg = A.select_value(lambda x: x < 0)
         np.testing.assert_allclose(np.sort(j.vals), np.sort(neg.vals),
                                    rtol=1e-6)
+
+    def test_norms(self, rng):
+        from matrel_tpu.core.coo import COOMatrix
+        A = COOMatrix.from_edges([0, 0, 1], [1, 1, 2],
+                                 [3.0, -1.0, -4.0], shape=(3, 3))
+        d = A.to_dense()          # dup at (0,1) sums to 2.0
+        assert A.norm() == pytest.approx(np.linalg.norm(d))
+        assert A.norm("l1") == pytest.approx(np.abs(d).sum())
+        assert A.norm("max") == pytest.approx(np.abs(d).max())

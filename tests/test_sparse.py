@@ -373,3 +373,13 @@ def test_pallas_eligibility_gate():
     assert pallas_eligible(FakeS(4, 1), 8)         # single row-block: equal dims
     assert pallas_eligible(FakeS(512, 196), 512)   # bench row 4 shape
     assert pallas_eligible(FakeS(8, 4), 16)        # small but 8-aligned
+
+
+def test_block_sparse_norms(mesh8, rng):
+    a = random_block_sparse_np(rng, 24, 24, 8, 0.4)
+    S = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+    assert S.norm() == pytest.approx(np.linalg.norm(a), rel=1e-5)
+    assert S.norm("l1") == pytest.approx(np.abs(a).sum(), rel=1e-5)
+    assert S.norm("max") == pytest.approx(np.abs(a).max(), rel=1e-5)
+    with pytest.raises(ValueError, match="norm kind"):
+        S.norm("nuclear")
