@@ -113,7 +113,8 @@ def pagerank_edges(src: jax.Array, dst: jax.Array, n: int,
         # edge arrays sharded across non-addressable (multi-host) devices
         # stay on the segment path. Falls back when the degree
         # distribution is too heavy-tailed to pad, or when the expanded
-        # tables would exceed the per-device HBM budget (~224 B/slot;
+        # tables would exceed the per-device HBM budget (~224 B/slot
+        # expanded, ~30 B/slot compact — _auto_max_slots picks;
         # the cap keeps auto from OOMing on huge graphs that the
         # 8 B/edge segment path handles fine).
         on_tpu = jax.default_backend() in ("tpu", "axon")
@@ -227,7 +228,9 @@ def run_pagerank_compact(prepared, rounds: int = 30, alpha: float = 0.85,
 # Callers holding device-resident edge arrays should use
 # prepare_pagerank_onehot/run_pagerank_onehot directly: a cache probe
 # pulls the arrays to host. Eviction is byte-aware in PER-DEVICE slots
-# (expanded one-hot tables are ~224 B per padded slot; sharded plans
+# (expanded one-hot tables are ~224 B per padded slot — the compact
+# executor's ~30 B/slot plans cost far less, so this budget is the
+# conservative worst case across both executors; sharded plans
 # spread theirs over mesh.size devices): pinning several multi-GB plans
 # would OOM a 16 GB chip, and plans above the budget run uncached.
 _PLAN_CACHE: dict = {}
