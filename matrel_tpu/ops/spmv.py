@@ -585,11 +585,10 @@ def spmv_sharded(plan: EdgeSpMVPlan, x: jax.Array, mesh) -> jax.Array:
 
 def save_plan(path: str, plan: EdgeSpMVPlan) -> None:
     """Persist a plan's compact layout (one .npz). The expensive build
-    (host sort/fill) is skipped on load; one-hot expansion still happens
-    on the loading process's device. Plans must be saved before table
-    expansion (save the freshly built plan, or rebuild)."""
-    if plan._tables is not None:
-        raise ValueError("plan already expanded; save it before first use")
+    (host sort/fill) is skipped on load; table expansion (or the compact
+    executor's device copy) happens on the loading process's device.
+    Plans keep their compact tables for life, so saving works before OR
+    after any executor has used the plan."""
     payload = dict(
         # trailing fields: format version + the WIDTH/LO constants baked
         # into src8/lane/off at build time — loading under different

@@ -315,13 +315,18 @@ class TestPlanPersistence:
             np.asarray(spmv_lib.spmv(loaded, jnp.asarray(x))),
             np.asarray(spmv_lib.spmv(plan, jnp.asarray(x))))
 
-    def test_save_after_expansion_raises(self, tmp_path):
+    def test_save_after_expansion_works(self, tmp_path):
+        # behavior change (2026-07-30): compact tables are kept for the
+        # plan's life, so saving after expanded-path use round-trips
         import jax.numpy as jnp
         plan = spmv_lib.build_spmv_plan(np.array([1, 2]), np.array([0, 1]),
                                         n_rows=8, n_cols=4)
-        spmv_lib.spmv(plan, jnp.ones(4, jnp.float32))   # expands
-        with pytest.raises(ValueError, match="expanded"):
-            spmv_lib.save_plan(str(tmp_path / "x.npz"), plan)
+        x = jnp.ones(4, jnp.float32)
+        y1 = np.asarray(spmv_lib.spmv(plan, x))   # expands
+        spmv_lib.save_plan(str(tmp_path / "x.npz"), plan)
+        plan2 = spmv_lib.load_plan(str(tmp_path / "x.npz"))
+        np.testing.assert_allclose(np.asarray(spmv_lib.spmv(plan2, x)),
+                                   y1, rtol=1e-6)
 
 
 class TestPageRankOneHot:
@@ -669,3 +674,21 @@ class TestCompactSpMV:
         assert np.abs(y - want).max() / np.abs(want).max() < 1e-6
         assert pc.spmm_compact(plan, jnp.zeros((80, 0), jnp.float32),
                                interpret=True).shape == (100, 0)
+
+    def test_save_after_use_roundtrip(self, tmp_path, rng):
+        # compact tables survive expanded-path use, so persistence works
+        # at any point in a plan's life
+        rows, cols, vals = random_coo(rng, 2000, 1500, 20_000)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=2000, n_cols=1500)
+        x = rng.standard_normal(1500).astype(np.float32)
+        y1 = np.asarray(spmv_lib.spmv(plan, jnp.asarray(x)))  # expands
+        path = str(tmp_path / "plan.npz")
+        spmv_lib.save_plan(path, plan)                        # after use
+        plan2 = spmv_lib.load_plan(path)
+        y2 = np.asarray(spmv_lib.spmv(plan2, jnp.asarray(x)))
+        np.testing.assert_allclose(y2, y1, rtol=1e-6, atol=1e-7)
+        from matrel_tpu.ops import pallas_spmv as pc
+        y3 = np.asarray(pc.spmv_compact(plan2, jnp.asarray(x),
+                                        interpret=True))
+        np.testing.assert_allclose(y3, y1, rtol=1e-5, atol=1e-6)
