@@ -7,8 +7,10 @@ matrices whose nonzeros cluster into dense tiles; uniform/graph-shaped
 sparsity (1e-5-class densities) would touch every tile. `COOMatrix` covers
 that regime: a fixed edge list compiled once into a blocked one-hot SpMV
 plan (`ops/spmv.py` — width-row gather + hi/lo one-hot MXU scatter, no
-XLA scatter anywhere), with transpose plans built lazily and a plain
-segment-sum fallback for degree distributions the planner refuses.
+XLA scatter anywhere; on real TPU the compact-table Pallas executor of
+`ops/pallas_spmv.py` runs it at 13 B/slot), with transpose plans built
+lazily and a plain segment-sum fallback for degree distributions the
+planner refuses.
 
 Matvec is the hot op (PageRank-class workloads). `matmat` handles narrow
 dense right-hand sides by reusing the row gather once and cycling the
