@@ -29,13 +29,6 @@ import time
 
 import numpy as np
 
-N = int(os.environ.get("MATREL_BENCH_N", 4096))
-DTYPE = "bfloat16"
-REPEATS = int(os.environ.get("MATREL_BENCH_REPEATS", 40))
-_HERE = os.path.dirname(os.path.abspath(__file__))
-CPU_CACHE = os.path.join(_HERE, "cpu_baseline.json")
-LAST_GOOD = os.path.join(_HERE, "bench_last_good.json")
-
 def _env_int(name: str, default: int) -> int:
     try:
         return int(os.environ.get(name, default))
@@ -43,12 +36,23 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+N = _env_int("MATREL_BENCH_N", 4096)
+DTYPE = "bfloat16"
+REPEATS = _env_int("MATREL_BENCH_REPEATS", 40)
+_HERE = os.path.dirname(os.path.abspath(__file__))
+CPU_CACHE = os.path.join(_HERE, "cpu_baseline.json")
+LAST_GOOD = os.path.join(_HERE, "bench_last_good.json")
+
 PROBE_TIMEOUT_S = _env_int("MATREL_BENCH_PROBE_TIMEOUT", 180)
 MEASURE_TIMEOUT_S = _env_int("MATREL_BENCH_MEASURE_TIMEOUT", 900)
 # sleeps between the 4 attempts; relay wedges clear on their own eventually
-BACKOFFS_S = tuple(
-    int(x) for x in os.environ.get("MATREL_BENCH_BACKOFFS", "60,120,240").split(",")
-    if x.strip())
+try:
+    BACKOFFS_S = tuple(
+        int(x) for x in
+        os.environ.get("MATREL_BENCH_BACKOFFS", "60,120,240").split(",")
+        if x.strip())
+except ValueError:
+    BACKOFFS_S = (60, 120, 240)
 
 
 def flops(n: int) -> float:
@@ -67,12 +71,21 @@ def measure_cpu_baseline() -> float:
 
 
 def cpu_baseline() -> float:
-    if os.path.exists(CPU_CACHE):
+    try:
         with open(CPU_CACHE) as f:
-            return json.load(f)["tflops"]
+            cached = json.load(f)
+        if cached.get("n") == N:
+            return float(cached["tflops"])
+    except (OSError, ValueError, KeyError, TypeError):
+        pass  # missing/corrupt/mismatched cache → re-measure
     v = measure_cpu_baseline()
-    with open(CPU_CACHE, "w") as f:
-        json.dump({"tflops": v, "n": N, "dtype": "float32"}, f)
+    try:
+        tmp = CPU_CACHE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"tflops": v, "n": N, "dtype": "float32"}, f)
+        os.replace(tmp, CPU_CACHE)
+    except OSError:
+        pass
     return v
 
 
@@ -80,10 +93,10 @@ def probe_tpu() -> None:
     """Tiny matmul proving the backend is alive. Raises on failure."""
     import jax
     import jax.numpy as jnp
+    del jax  # imported for backend registration side effect
     x = jnp.ones((256, 256), dtype=jnp.bfloat16)
     val = float(jnp.sum((x @ x).astype(jnp.float32)))
     assert abs(val - 256.0 ** 3) < 1e-3 * 256.0 ** 3, val
-    del jax
 
 
 def measure_tpu() -> float:
@@ -155,19 +168,41 @@ def _run_child(mode: str, timeout_s: int) -> tuple[bool, object]:
 
     payload = parsed JSON from the child's last stdout line on success,
     else a short error string.
+
+    Output goes to temp FILES (not pipes) and the child runs in its own
+    session killed via killpg on timeout: a hung relay helper process
+    that inherited a stdout pipe would otherwise keep communicate()
+    blocked forever after the direct child dies, re-creating the very
+    hang this harness exists to bound.
     """
-    try:
-        proc = subprocess.run(
+    import signal
+    import tempfile
+    with tempfile.TemporaryFile(mode="w+") as out, \
+            tempfile.TemporaryFile(mode="w+") as err:
+        proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), f"--_{mode}"],
-            capture_output=True, text=True, timeout=timeout_s,
-            env=_child_env(), cwd=_HERE,
+            stdout=out, stderr=err, text=True,
+            env=_child_env(), cwd=_HERE, start_new_session=True,
         )
-    except subprocess.TimeoutExpired:
-        return False, f"{mode} timed out after {timeout_s}s (relay wedge?)"
-    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
-    if proc.returncode != 0 or not lines:
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-        return False, f"{mode} rc={proc.returncode}: " + " | ".join(tail[-3:])[:500]
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+            err.seek(0)
+            tail = " | ".join(err.read().strip().splitlines()[-3:])[:300]
+            return False, (f"{mode} timed out after {timeout_s}s (relay "
+                           f"wedge?)" + (f"; child stderr: {tail}" if tail else ""))
+        out.seek(0)
+        err.seek(0)
+        stdout, stderr = out.read(), err.read()
+    lines = [ln for ln in stdout.strip().splitlines() if ln.strip()]
+    if rc != 0 or not lines:
+        tail = (stderr or stdout or "").strip().splitlines()
+        return False, f"{mode} rc={rc}: " + " | ".join(tail[-3:])[:500]
     try:
         return True, json.loads(lines[-1])
     except json.JSONDecodeError:
@@ -184,9 +219,11 @@ def _load_last_good() -> dict | None:
 
 def _store_last_good(tflops: float) -> None:
     try:
-        with open(LAST_GOOD, "w") as f:
+        tmp = LAST_GOOD + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"tflops": round(tflops, 3), "n": N, "dtype": DTYPE,
                        "when": time.strftime("%Y-%m-%dT%H:%M:%S")}, f)
+        os.replace(tmp, LAST_GOOD)
     except OSError:
         pass
 
@@ -209,8 +246,13 @@ def main() -> None:
         if not ok:
             errors.append(str(payload))
             continue
-        tpu = float(payload["tflops"])
-        break
+        try:
+            tpu = float(payload["tflops"])
+            break
+        except (KeyError, TypeError, ValueError):
+            errors.append(f"measure returned unexpected payload: "
+                          f"{str(payload)[:200]}")
+            continue
 
     if tpu is not None:
         _store_last_good(tpu)
