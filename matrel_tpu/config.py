@@ -41,6 +41,9 @@ class MatrelConfig:
         write bandwidth of bf16 pipelines; XLA fuses the cast into the
         matmul epilogue).
       use_pallas: enable hand-written Pallas kernels where available.
+      pallas_interpret: ALSO run the Pallas paths on non-TPU backends in
+        interpret mode. Testing/debug only — interpret is slow and
+        elides bf16 rounding on casts; never a fast path.
       chain_opt: enable the matrix-chain DP reorder.
       rewrite_rules: enable the algebraic rewrite pass.
       donate_intermediates: donate chain intermediates to XLA where legal.
@@ -56,6 +59,7 @@ class MatrelConfig:
     matmul_precision: str = "highest"
     keep_input_dtype: bool = True
     use_pallas: bool = True
+    pallas_interpret: bool = False
     chain_opt: bool = True
     rewrite_rules: bool = True
     donate_intermediates: bool = True
@@ -111,8 +115,21 @@ def set_default_config(cfg: MatrelConfig) -> None:
 def pallas_enabled(config: "MatrelConfig" = None) -> bool:
     """True when hand-written Pallas kernels should run: the config
     toggle is on AND the backend is a real TPU (CPU keeps the XLA
-    paths; pallas interpret is a debugging mode, not a fast path).
-    The single gate shared by every compact-executor call site."""
+    paths), OR pallas_interpret forces them in interpret mode for
+    testing. The single gate shared by every compact-executor call
+    site; pair with ``pallas_interpret_mode`` for the interpret flag."""
     import jax
     cfg = config or default_config()
-    return cfg.use_pallas and jax.default_backend() in ("tpu", "axon")
+    if not cfg.use_pallas:
+        return False
+    return (jax.default_backend() in ("tpu", "axon")
+            or cfg.pallas_interpret)
+
+
+def pallas_interpret_mode(config: "MatrelConfig" = None) -> bool:
+    """interpret= flag for pallas_call at the shared call sites: True
+    only when the compact paths were forced onto a non-TPU backend."""
+    import jax
+    cfg = config or default_config()
+    return cfg.pallas_interpret and jax.default_backend() not in (
+        "tpu", "axon")

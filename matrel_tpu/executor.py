@@ -233,20 +233,28 @@ class Lowerer:
         never built (17× less HBM); CPU keeps the expanded XLA path.
         Single vectors take the matvec kernel; wider stacks the k-wide
         SpMM (one shared gather for all columns)."""
-        from matrel_tpu.config import pallas_enabled
+        from matrel_tpu.config import pallas_enabled, pallas_interpret_mode
         from matrel_tpu.ops import spmv as spmv_lib
-        if pallas_enabled(self.config) and self.mesh.size == 1:
-            # single-device only: pallas_call has no SPMD partitioning
-            # rule, so a multi-device GSPMD program keeps the XLA path
+        if pallas_enabled(self.config):
             from matrel_tpu.ops import pallas_spmv as pc
-            tables = pc.compact_tables(plan)
+            interp = pallas_interpret_mode(self.config)
             static = (plan.n_rows, plan.n_cols, plan.block, spmv_lib.LO)
-            if len(vectors) == 1:
-                return pc.compact_apply(static, tables, plan.overflow,
-                                        vectors[0])[:, None]
-            return pc.compact_matmat_apply(
-                static, tables, plan.overflow,
-                jnp.stack(vectors, axis=1))
+            if self.mesh.size == 1:
+                tables = pc.compact_tables(plan)
+                if len(vectors) == 1:
+                    return pc.compact_apply(static, tables, plan.overflow,
+                                            vectors[0],
+                                            interpret=interp)[:, None]
+                return pc.compact_matmat_apply(
+                    static, tables, plan.overflow,
+                    jnp.stack(vectors, axis=1), interpret=interp)
+            # multi-device: pallas_call has no SPMD partitioning rule,
+            # but shard_map hands it per-device shapes — row-decompose
+            # the compact tables over the mesh and run the scatter on
+            # each device's block slice (13 B/slot everywhere; the
+            # expanded ~224 B/slot XLA tables are never built).
+            return self._coo_compact_sharded(pc, plan, static, vectors,
+                                             interp)
         static = (plan.n_rows, plan.n_cols, plan.block)
         arrays = plan.arrays()
         if len(vectors) == 1:
@@ -260,6 +268,36 @@ class Lowerer:
                  for j in range(0, X.shape[1], 64)]
         return parts[0] if len(parts) == 1 else jnp.concatenate(parts,
                                                                 axis=1)
+
+    def _coo_compact_sharded(self, pc, plan, static, vectors,
+                             interp: bool) -> Array:
+        """Compact-table SpMV/SpMM inside the executor's traced program
+        on a multi-device mesh: shard_map over the mesh with the tables
+        row-decomposed per device (shard_compact_tables), dense operand
+        replicated, one tiled all_gather of the result. The sharded
+        tables ride the trace as committed device arrays and are hoisted
+        into call-time args by _hoist_large_consts like any other
+        payload constant."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        tables = pc.shard_compact_tables(plan, self.mesh)
+        axes = tuple(self.mesh.axis_names)
+        ov = plan.overflow
+        wide = len(vectors) > 1
+        x = (jnp.stack(vectors, axis=1) if wide else vectors[0]).astype(
+            jnp.float32)
+
+        def kern(src8, lane, off, val, xx, *ovv):
+            apply = (pc.compact_sharded_matmat_apply if wide
+                     else pc.compact_sharded_apply)
+            return apply(static, (src8, lane, off, val), ovv, xx, axes,
+                         interpret=interp)
+
+        sm = shard_map(kern, mesh=self.mesh,
+                       in_specs=pc.compact_sharded_specs(axes, len(ov)),
+                       out_specs=P(), check_vma=False)
+        out = sm(*tables, x, *ov)
+        return out if wide else out[:, None]
 
     def _matmul(self, node: MatExpr, ev) -> Array:
         l, r = node.children

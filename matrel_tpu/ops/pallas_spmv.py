@@ -179,12 +179,37 @@ def shard_compact_tables(plan: spmv_lib.EdgeSpMVPlan, mesh):
         return a.reshape(nb_pad, cap // LANE, LANE).astype(dtype)
 
     sh = NamedSharding(mesh, P(tuple(mesh.axis_names), None, None))
-    dev = (jax.device_put(padded(plan.src8, fills["src8"], np.int32), sh),
-           jax.device_put(padded(plan.lane, fills["lane"], np.int8), sh),
-           jax.device_put(padded(plan.off, fills["off"], np.int32), sh),
-           jax.device_put(padded(plan.val, fills["val"], np.float32), sh))
+    # eager even when called from inside a trace (the executor's
+    # Lowerer): the memo must hold COMMITTED arrays, not tracers — a
+    # cached tracer would escape its trace and poison every later
+    # compile that reuses this plan on the same mesh
+    with jax.ensure_compile_time_eval():
+        dev = (jax.device_put(padded(plan.src8, fills["src8"], np.int32),
+                              sh),
+               jax.device_put(padded(plan.lane, fills["lane"], np.int8),
+                              sh),
+               jax.device_put(padded(plan.off, fills["off"], np.int32),
+                              sh),
+               jax.device_put(padded(plan.val, fills["val"], np.float32),
+                              sh))
     memo[mesh] = dev
     return dev
+
+
+def _compact_sharded_body(apply_fn, overflow_fn, plan_static, tables,
+                          ov, x, axes, passes, interpret) -> jax.Array:
+    """Shared shard-local sequence: per-device compact apply on this
+    device's block-row slice → tiled all_gather → slice padding →
+    replicated-overflow add."""
+    n_rows, n_cols, block, lo = plan_static
+    src8 = tables[0]
+    y_loc = apply_fn(
+        (src8.shape[0] * block, n_cols, block, lo), tables, (), x,
+        passes, interpret)
+    y = jax.lax.all_gather(y_loc, axes, axis=0, tiled=True)[:n_rows]
+    if ov:
+        y = overflow_fn(y, ov, x, n_rows)
+    return y
 
 
 def compact_sharded_apply(plan_static, tables, ov, x, axes,
@@ -195,15 +220,21 @@ def compact_sharded_apply(plan_static, tables, ov, x, axes,
     replicated; one tiled all_gather assembles the result; overflow COO
     is replicated and added after the gather. Shared by the standalone
     runner here and pagerank's power-iteration loop."""
-    n_rows, n_cols, block, lo = plan_static
-    src8 = tables[0]
-    y_loc = compact_apply(
-        (src8.shape[0] * block, n_cols, block, lo), tables, (), x,
-        passes, interpret)
-    y = jax.lax.all_gather(y_loc, axes, axis=0, tiled=True)[:n_rows]
-    if ov:
-        y = spmv_lib._overflow_add(y, ov, x, n_rows)
-    return y
+    return _compact_sharded_body(compact_apply, spmv_lib._overflow_add,
+                                 plan_static, tables, ov, x, axes,
+                                 passes, interpret)
+
+
+def compact_sharded_matmat_apply(plan_static, tables, ov, X, axes,
+                                 passes: int = 3,
+                                 interpret: bool = False) -> jax.Array:
+    """The k-wide sibling of compact_sharded_apply (Y = A·X inside a
+    shard_map). Lets the executor keep the 13 B/slot tables on every
+    mesh size instead of falling back to the expanded XLA tables."""
+    return _compact_sharded_body(compact_matmat_apply,
+                                 spmv_lib._overflow_add_wide,
+                                 plan_static, tables, ov, X, axes,
+                                 passes, interpret)
 
 
 def compact_sharded_specs(axes, n_ov: int):
@@ -230,10 +261,20 @@ def _compact_sharded_runner(plan_static, mesh, passes: int, n_ov: int,
                              out_specs=P(), check_vma=False))
 
 
+def _resolve_interpret(interpret) -> bool:
+    """None → config: pallas_interpret forces interpret mode on non-TPU
+    backends so CI can drive the compact paths on the CPU mesh."""
+    if interpret is not None:
+        return interpret
+    from matrel_tpu.config import pallas_interpret_mode
+    return pallas_interpret_mode()
+
+
 def spmv_compact_sharded(plan: spmv_lib.EdgeSpMVPlan, x: jax.Array,
                          mesh, passes: int = 3,
-                         interpret: bool = False) -> jax.Array:
+                         interpret=None) -> jax.Array:
     """y = A·x with compact tables sharded over ``mesh``."""
+    interpret = _resolve_interpret(interpret)
     tables = shard_compact_tables(plan, mesh)
     ov = plan.overflow
     run = _compact_sharded_runner(
@@ -340,11 +381,12 @@ _compact_matmat_jitted = jax.jit(compact_matmat_apply,
 
 
 def spmm_compact(plan: spmv_lib.EdgeSpMVPlan, X: jax.Array,
-                 passes: int = 3, interpret: bool = False) -> jax.Array:
+                 passes: int = 3, interpret=None) -> jax.Array:
     """Y = A·X via compact tables (see spmv_compact). k == 1 takes the
     matvec kernel (its width-8 gather beats the full-index one).
     passes=3 is f32-faithful — the same fidelity as the expanded path it
     replaces; pass 2 only where ranking-grade error is acceptable."""
+    interpret = _resolve_interpret(interpret)
     X = jnp.asarray(X, jnp.float32)
     if X.shape[1] == 0:
         return jnp.zeros((plan.n_rows, 0), jnp.float32)
@@ -358,9 +400,10 @@ def spmm_compact(plan: spmv_lib.EdgeSpMVPlan, X: jax.Array,
 
 
 def spmv_compact(plan: spmv_lib.EdgeSpMVPlan, x: jax.Array,
-                 passes: int = 3, interpret: bool = False) -> jax.Array:
+                 passes: int = 3, interpret=None) -> jax.Array:
     """y = A·x via the compact-table Pallas scatter (opt-in; see module
     docstring). Numerically ~f32 at passes=3."""
+    interpret = _resolve_interpret(interpret)
     tables = compact_tables(plan)
     static = (plan.n_rows, plan.n_cols, plan.block, spmv_lib.LO)
     return _compact_jitted(static, tables, plan.overflow, x, passes,

@@ -198,7 +198,7 @@ def run_pagerank_onehot(prepared, rounds: int = 30,
 
 def run_pagerank_compact(prepared, rounds: int = 30, alpha: float = 0.85,
                          passes: int = 2,
-                         interpret: bool = False) -> jax.Array:
+                         interpret=None) -> jax.Array:
     """PageRank rounds over the compact-table Pallas SpMV
     (ops/pallas_spmv.py): ~14× smaller device tables than the expanded
     plan and faster on real TPU (measured 18.8 ms vs 29.4 per matvec at
@@ -211,6 +211,7 @@ def run_pagerank_compact(prepared, rounds: int = 30, alpha: float = 0.85,
     from matrel_tpu.ops import pallas_spmv as pc
     from matrel_tpu.ops import spmv as spmv_lib
     plan, dangling = prepared
+    interpret = pc._resolve_interpret(interpret)
     tables = pc.compact_tables(plan)
     ov = plan.overflow
     run = _compact_runner_loop(plan.n_rows, int(rounds), float(alpha),
@@ -321,7 +322,7 @@ def _pagerank_onehot(src, dst, n: int, rounds: int, alpha: float,
 
 def _pagerank_compact_sharded(src, dst, n: int, rounds: int, alpha: float,
                               mesh, max_slots: int = None, weights=None,
-                              passes: int = 3, interpret: bool = False):
+                              passes: int = 3, interpret=None):
     """Multi-chip PageRank over mesh-sharded COMPACT tables: each device
     holds ~13 B/slot / P and generates its scatter one-hots in VMEM
     (ops/pallas_spmv.py); the whole power iteration is one shard_map'd
@@ -345,6 +346,7 @@ def _pagerank_compact_sharded(src, dst, n: int, rounds: int, alpha: float,
     if prepared is None:
         return None
     plan, dangling = prepared
+    interpret = pc._resolve_interpret(interpret)
     tables = pc.shard_compact_tables(plan, mesh)
     ov = plan.overflow
     run = _compact_sharded_loop(
