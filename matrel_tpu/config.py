@@ -45,6 +45,18 @@ class MatrelConfig:
         interpret mode. Testing/debug only — interpret is slow and
         elides bf16 rounding on casts; never a fast path.
       chain_opt: enable the matrix-chain DP reorder.
+      join_pair_cap_entries: refuse to MATERIALISE a join result larger
+        than this many entries (the pair matrix of join_on_value; the
+        merged output of join_on_rows / join_on_cols). Only aggregated
+        VALUE-joins stream and are exempt — index joins always
+        materialise their output and hit the cap even under an
+        aggregate.
+      join_bruteforce_max_pairs: cap on na*nb for aggregated value-joins
+        with BLACK-BOX (callable) merge/predicate, which must enumerate
+        pairs chunkwise. Structured predicates ("eq","lt",...) use the
+        O(n log n) sort path and are exempt.
+      join_chunk_entries: per-chunk entry budget for the black-box
+        streaming enumeration (bounds the live tile).
       rewrite_rules: enable the algebraic rewrite pass.
       donate_intermediates: donate chain intermediates to XLA where legal.
     """
@@ -63,6 +75,9 @@ class MatrelConfig:
     chain_opt: bool = True
     rewrite_rules: bool = True
     donate_intermediates: bool = True
+    join_pair_cap_entries: int = 1 << 26
+    join_bruteforce_max_pairs: int = 1 << 28
+    join_chunk_entries: int = 1 << 22
 
     def replace(self, **kw: Any) -> "MatrelConfig":
         return dataclasses.replace(self, **kw)

@@ -26,7 +26,7 @@ from jax.sharding import Mesh
 from matrel_tpu.config import MatrelConfig, default_config
 from matrel_tpu.core import mesh as mesh_lib, padding
 from matrel_tpu.core.blockmatrix import BlockMatrix
-from matrel_tpu.ir import rules
+from matrel_tpu.ir import expr as expr_mod, rules
 from matrel_tpu.ir.expr import MatExpr, leaves as expr_leaves
 from matrel_tpu.parallel import planner, strategies
 
@@ -48,6 +48,25 @@ def _mask_to_logical(x: Array, shape: Tuple[int, int]) -> Array:
     if (pn, pm) == (n, m):
         return x
     return jnp.where(_row_mask(n, pn) & _col_mask(m, pm), x, jnp.zeros((), x.dtype))
+
+
+def _diag_reduce(d: Array, kind: str) -> Array:
+    """sum/count/avg/max/min of a 1-D entry vector — the single
+    diagonal-aggregate dispatch shared by the dense diag branch and the
+    value-join diag branch (count counts nonzero entries; avg divides
+    by that count)."""
+    if kind == "sum":
+        return jnp.sum(d)
+    if kind == "count":
+        return jnp.sum(d != 0).astype(d.dtype)
+    if kind == "avg":
+        c = jnp.sum(d != 0)
+        return jnp.where(c > 0, jnp.sum(d) / c, 0.0).astype(d.dtype)
+    if kind == "max":
+        return jnp.max(d)
+    if kind == "min":
+        return jnp.min(d)
+    raise NotImplementedError(kind)
 
 
 class Lowerer:
@@ -202,10 +221,29 @@ class Lowerer:
 
     def _join_axis(self, node: MatExpr, ev) -> Array:
         """Row/col-index joins: statically-shaped pairwise merge along the
-        non-join axis (the replication-scheme joins of the reference)."""
+        non-join axis (the replication-scheme joins of the reference).
+        The planner's attrs['replicate'] (choose_join_scheme) picks the
+        operand to replicate across the mesh; the other keeps its
+        sharding."""
+        out_entries = node.shape[0] * node.shape[1]
+        cap = self.config.join_pair_cap_entries
+        if out_entries > cap:
+            raise ValueError(
+                f"row/col join output has {node.shape[0]}x"
+                f"{node.shape[1]} = {out_entries} entries (> "
+                f"join_pair_cap_entries = {cap}); select/aggregate the "
+                f"operands first or raise the cap in MatrelConfig.")
         l, r = node.children
         a = ev(l)[: l.shape[0], : l.shape[1]]
         b = ev(r)[: r.shape[0], : r.shape[1]]
+        rep = node.attrs.get("replicate")
+        if rep is not None and self.mesh.size > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            repl = NamedSharding(self.mesh, P(None, None))
+            if rep == "left":
+                a = jax.lax.with_sharding_constraint(a, repl)
+            else:
+                b = jax.lax.with_sharding_constraint(b, repl)
         merge = node.attrs["merge"]
         if node.kind == "join_rows":
             out = merge(a[:, :, None], b[:, None, :])       # (n, ma, mb)
@@ -408,23 +446,17 @@ class Lowerer:
 
     def _agg(self, node: MatExpr, ev) -> Array:
         (child,) = node.children
+        if child.kind == "join_value":
+            # never materialise the pair matrix under an aggregate —
+            # stream it (sort-based or chunked; value_join.py)
+            return self._agg_join_value(node, child, ev)
         x = ev(child)
         kind, axis = node.attrs["agg"], node.attrs["axis"]
         n, m = child.shape
         pn, pm = x.shape
         if axis == "diag":
             d = jnp.diagonal(x)[:n]
-            if kind == "sum":
-                return jnp.sum(d).reshape(1, 1)
-            if kind == "count":
-                return jnp.sum(d != 0).reshape(1, 1).astype(x.dtype)
-            if kind == "avg":
-                c = jnp.sum(d != 0)
-                return jnp.where(c > 0, jnp.sum(d) / c, 0.0).reshape(1, 1).astype(x.dtype)
-            if kind == "max":
-                return jnp.max(d).reshape(1, 1)
-            if kind == "min":
-                return jnp.min(d).reshape(1, 1)
+            return _diag_reduce(d, kind).reshape(1, 1).astype(x.dtype)
         ax = {"row": 1, "col": 0, "all": None}[axis]
 
         def finish(res: Array) -> Array:
@@ -475,11 +507,78 @@ class Lowerer:
             keep = keep & cols(jnp.arange(pm))[None, :]
         return jnp.where(keep, x, jnp.zeros((), x.dtype))
 
+    def _entry_vectors(self, node: MatExpr, ev):
+        """Column-major logical-entry vectors (va, vb) of a join_value
+        node's operands — the pair matrix's row/col coordinates."""
+        l, r = node.children
+        a, b = ev(l), ev(r)
+        va = a[: l.shape[0], : l.shape[1]].T.reshape(-1)
+        vb = b[: r.shape[0], : r.shape[1]].T.reshape(-1)
+        return va.astype(jnp.float32), vb.astype(jnp.float32)
+
+    def _agg_join_value(self, node: MatExpr, jnode: MatExpr, ev) -> Array:
+        """agg(join_on_value(A, B)) without materialising the (na, nb)
+        pair matrix: sort-based O((na+nb)·log nb) for structured
+        predicate+merge, bounded chunkwise enumeration for black-box
+        callables (capped), elementwise for the diagonal."""
+        from matrel_tpu.relational import value_join as vj
+        kind, axis = node.attrs["agg"], node.attrs["axis"]
+        merge_fn = jnode.attrs["merge"]
+        pred_fn = jnode.attrs["predicate"]
+        pred_kind = jnode.attrs.get("pred_kind")
+        merge_kind = jnode.attrs.get("merge_kind")
+        na, nb = jnode.shape
+        va, vb = self._entry_vectors(jnode, ev)
+        if axis == "diag":
+            L = min(na, nb)
+            d = merge_fn(va[:L], vb[:L])
+            if pred_fn is not None:
+                d = jnp.where(pred_fn(va[:L], vb[:L]), d, 0.0)
+            out = _diag_reduce(d, kind)
+            return self._pad_to_node(out.reshape(1, 1), node)
+        structured = (merge_kind is not None
+                      and (pred_kind is not None or pred_fn is None)
+                      and kind in vj.AGG_KINDS)
+        if structured:
+            out = vj.axis_agg_sorted(va, vb, pred_kind or "always",
+                                     merge_kind, kind, axis)
+        else:
+            cap = self.config.join_bruteforce_max_pairs
+            if na * nb > cap:
+                raise ValueError(
+                    f"aggregated value-join with callable merge/"
+                    f"predicate must enumerate {na}x{nb} = {na * nb} "
+                    f"pairs (> join_bruteforce_max_pairs = {cap}). Use "
+                    f"structured forms (predicate in "
+                    f"{expr_mod.JOIN_PREDS}, merge in "
+                    f"{expr_mod.JOIN_MERGES}) for the O(n log n) sort "
+                    f"path, or raise the cap.")
+            out = vj.axis_agg_chunked(va, vb, merge_fn, pred_fn, kind,
+                                      axis,
+                                      self.config.join_chunk_entries)
+        if axis == "row":
+            out = out.reshape(-1, 1)
+        elif axis == "col":
+            out = out.reshape(1, -1)
+        else:
+            out = out.reshape(1, 1)
+        return self._pad_to_node(out, node)
+
     def _join_value(self, node: MatExpr, ev) -> Array:
         """Value-join: all pairs (a_entry, b_entry) with predicate; output is
         the (|A|, |B|) pair matrix (entries merge(va, vb) where predicate
-        holds, else 0). Blockwise outer construction; sizes are the caller's
-        responsibility (SURVEY.md §7.6 static-shape semantics)."""
+        holds, else 0). Blockwise outer construction. MATERIALISING the
+        pair matrix is capped (config.join_pair_cap_entries) — aggregate
+        the join for the streaming path (_agg_join_value)."""
+        na, nb = node.shape
+        cap = self.config.join_pair_cap_entries
+        if na * nb > cap:
+            raise ValueError(
+                f"materialising a {na}x{nb} value-join pair matrix "
+                f"({na * nb} entries) exceeds join_pair_cap_entries = "
+                f"{cap}. Aggregate the join (e.g. agg(join, 'sum', "
+                f"'row')) to stream it without materialisation, or "
+                f"raise the cap in MatrelConfig.")
         l, r = node.children
         a, b = ev(l), ev(r)
         va = a[: l.shape[0], : l.shape[1]].T.reshape(-1)  # column-major entries

@@ -392,21 +392,68 @@ def join_on_index(a: MatExpr, b: MatExpr, merge: Callable) -> MatExpr:
     return MatExpr("join_index", (a, b), a.shape, None, {"merge": merge})
 
 
-def join_on_value(a: MatExpr, b: MatExpr, merge: Callable,
-                  predicate: Optional[Callable] = None) -> MatExpr:
+JOIN_PREDS = ("eq", "lt", "le", "gt", "ge")
+JOIN_MERGES = ("left", "right", "add", "mul")
+
+
+def resolve_join_pred(pred):
+    """(pred_kind, callable) for a structured-or-callable predicate.
+    Structured kinds compare va ? vb: "lt" means va < vb."""
+    if pred is None or callable(pred):
+        return None, pred
+    if pred not in JOIN_PREDS:
+        raise ValueError(f"unknown join predicate {pred!r}; expected a "
+                         f"callable or one of {JOIN_PREDS}")
+    import operator
+    fn = {"eq": operator.eq, "lt": operator.lt, "le": operator.le,
+          "gt": operator.gt, "ge": operator.ge}[pred]
+    return pred, fn
+
+
+def resolve_join_merge(merge):
+    """(merge_kind, callable) for a structured-or-callable merge."""
+    if callable(merge):
+        return None, merge
+    if merge not in JOIN_MERGES:
+        raise ValueError(f"unknown join merge {merge!r}; expected a "
+                         f"callable or one of {JOIN_MERGES}")
+    def _take_left(a, b):
+        import jax.numpy as jnp
+        # broadcast WITHOUT arithmetic on b: a + 0*b turns a non-finite
+        # discarded operand into NaN (inf·0)
+        return a + jnp.zeros_like(b)
+
+    fn = {"left": _take_left,
+          "right": lambda a, b: _take_left(b, a),
+          "add": lambda a, b: a + b,
+          "mul": lambda a, b: a * b}[merge]
+    return merge, fn
+
+
+def join_on_value(a: MatExpr, b: MatExpr, merge,
+                  predicate=None) -> MatExpr:
     """⋈ on values: pairs (A[i,j], B[k,l]) where predicate(va, vb).
 
     Full value-join output is |A|x|B| pairs — unrepresentable statically.
     Faithful static-shape semantics: the result is the (n*m_A) x (n*m_B)
-    PAIR MATRIX restricted to merge values where the predicate holds, as a
-    lazy node; the executor materialises it blockwise. For the common case
-    (both operands same shape, predicate on aligned entries) use
-    join_on_index. See relational.py for the blockwise implementation.
+    PAIR MATRIX restricted to merge values where the predicate holds, as
+    a lazy node. Materialising it is capped by
+    config.join_pair_cap_entries; AGGREGATED value-joins
+    (agg(join_on_value(...), ...)) never materialise the pair matrix —
+    with STRUCTURED predicate/merge (predicate in "eq"/"lt"/"le"/"gt"/
+    "ge" on va ? vb, merge in "left"/"right"/"add"/"mul") they stream in
+    O((na+nb)·log nb) via the executor's sort-based path (the
+    reference's scalable value-join; SURVEY.md §2 relational execs),
+    and with callables they fall back to capped chunkwise enumeration.
+    For aligned-entry joins use join_on_index.
     """
+    pred_kind, pred_fn = resolve_join_pred(predicate)
+    merge_kind, merge_fn = resolve_join_merge(merge)
     na = a.shape[0] * a.shape[1]
     nb = b.shape[0] * b.shape[1]
     return MatExpr("join_value", (a, b), (na, nb), None,
-                   {"merge": merge, "predicate": predicate})
+                   {"merge": merge_fn, "predicate": pred_fn,
+                    "merge_kind": merge_kind, "pred_kind": pred_kind})
 
 
 # -- utilities --------------------------------------------------------------

@@ -160,13 +160,31 @@ def choose_strategy(node: MatExpr, mesh: Mesh,
     return min(cands, key=cands.get)
 
 
+def choose_join_scheme(node: MatExpr, mesh: Mesh,
+                       config: Optional[MatrelConfig] = None) -> str:
+    """Replication-scheme selection for row/col index joins — the
+    reference's cost-based choice of which operand to replicate
+    (SURVEY.md §2 "Physical: relational execs": "join-scheme selection
+    to minimize replication"). Replicating side s all-gathers
+    bytes(s)·(p-1)/p per device; the cheaper side to move is the
+    smaller one (density-credited), so the LARGER operand keeps its
+    sharding. Returns "left"|"right" — the side to replicate."""
+    a, b = node.children
+    a_bytes = _bytes(a.shape, a.density if a.density is not None else 1.0)
+    b_bytes = _bytes(b.shape, b.density if b.density is not None else 1.0)
+    return "left" if a_bytes <= b_bytes else "right"
+
+
 def annotate_strategies(e: MatExpr, mesh: Mesh,
                         config: Optional[MatrelConfig] = None) -> MatExpr:
-    """Bottom-up pass stamping attrs['strategy'] on every matmul node."""
+    """Bottom-up pass stamping attrs['strategy'] on every matmul node
+    and attrs['replicate'] on every row/col index join."""
     new_children = tuple(annotate_strategies(c, mesh, config)
                          for c in e.children)
     if any(nc is not oc for nc, oc in zip(new_children, e.children)):
         e = e.with_children(new_children)
     if e.kind == "matmul" and "strategy" not in e.attrs:
         e = e.with_attrs(strategy=choose_strategy(e, mesh, config))
+    if e.kind in ("join_rows", "join_cols") and "replicate" not in e.attrs:
+        e = e.with_attrs(replicate=choose_join_scheme(e, mesh, config))
     return e

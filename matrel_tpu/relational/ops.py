@@ -95,8 +95,11 @@ def join_on_cols(a: MatLike, b: MatLike, merge: Callable) -> E.MatExpr:
     return E.MatExpr("join_cols", (ae, be), shape, None, {"merge": merge})
 
 
-def join_on_values(a: MatLike, b: MatLike, merge: Callable,
-                   predicate: Optional[Callable] = None) -> E.MatExpr:
+def join_on_values(a: MatLike, b: MatLike, merge,
+                   predicate=None) -> E.MatExpr:
     """⋈ on value predicate over all entry pairs; see ir.expr.join_on_value
-    for the static pair-matrix semantics."""
+    for the static pair-matrix semantics. ``merge``/``predicate`` may be
+    callables OR structured strings (merge in "left"/"right"/"add"/
+    "mul", predicate in "eq"/"lt"/"le"/"gt"/"ge") — structured forms let
+    aggregated joins stream in O(n log n) without materialising pairs."""
     return E.as_expr(a).join_on_value(E.as_expr(b), merge, predicate)

@@ -1,0 +1,248 @@
+"""Scalable value-join evaluation — the streaming/sort kernels behind
+``agg(join_on_value(A, B, ...), kind, axis)``.
+
+The reference joins matrices on value predicates with join-scheme
+selection so the pair relation never fully materialises (SURVEY.md §2
+"Physical: relational execs"). The TPU-native equivalent here: the pair
+matrix is an IR node that only EXISTS logically; when its consumer is an
+aggregate, the executor calls into this module instead of materialising
+(na, nb) entries:
+
+- STRUCTURED predicate ("eq"/"lt"/"le"/"gt"/"ge" on ``va ? vb``) and
+  merge ("left"/"right"/"add"/"mul"): sort B's entries once, then every
+  per-A-entry aggregate over its matched set is a contiguous range of
+  the sorted array — counts/sums/extrema come from prefix tables and
+  ``searchsorted`` in O((na+nb)·log nb) with O(na+nb) memory. A 4k×4k ⋈
+  4k×4k (16.7M × 16.7M pairs) aggregates without any pair allocation.
+- CALLABLE merge/predicate (black boxes): chunked enumeration with a
+  bounded live tile (config.join_chunk_entries), refused above
+  config.join_bruteforce_max_pairs with a pointer at the structured
+  forms.
+
+Semantics match the dense lowering exactly (executor._join_value +
+_agg): the pair matrix holds merge(va, vb) where the predicate holds
+and 0 elsewhere, over ALL logical entries (zeros of A/B included);
+"count" counts nonzero MERGED values; max/min see the implicit zeros of
+unmatched pairs; avg = sum/count.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_PRED_SWAP = {"eq": "eq", "lt": "gt", "le": "ge", "gt": "lt",
+              "ge": "le", "always": "always"}
+_MERGE_SWAP = {"left": "right", "right": "left", "add": "add",
+               "mul": "mul"}
+
+AGG_KINDS = ("sum", "count", "avg", "max", "min")
+
+
+def _match_range(sv, x, pred: str):
+    """[lo, hi) into ascending-sorted ``sv`` of the entries matching
+    predicate(x, vb) — every structured predicate selects a contiguous
+    run. x: (q,) query values → (lo, hi): (q,) int32."""
+    nb = sv.shape[0]
+    if pred == "always":      # predicate omitted: every pair matches
+        z = jnp.zeros(x.shape, jnp.int32)
+        return z, jnp.full_like(z, nb)
+    left = jnp.searchsorted(sv, x, side="left").astype(jnp.int32)
+    right = jnp.searchsorted(sv, x, side="right").astype(jnp.int32)
+    if pred == "eq":
+        return left, right
+    if pred == "lt":          # vb > x
+        return right, jnp.full_like(right, nb)
+    if pred == "le":          # vb >= x
+        return left, jnp.full_like(left, nb)
+    if pred == "gt":          # vb < x
+        return jnp.zeros_like(left), left
+    if pred == "ge":          # vb <= x
+        return jnp.zeros_like(right), right
+    raise ValueError(f"unknown structured predicate {pred!r}")
+
+
+def _range_eq_count(sv, v, lo, hi):
+    """#entries equal to v INSIDE [lo, hi) of sorted sv (int32-exact)."""
+    zl = jnp.searchsorted(sv, v, side="left").astype(jnp.int32)
+    zr = jnp.searchsorted(sv, v, side="right").astype(jnp.int32)
+    return jnp.maximum(jnp.minimum(zr, hi) - jnp.maximum(zl, lo), 0)
+
+
+def entry_stats(va, vb, pred: str, merge: str):
+    """Per-A-entry aggregates of merge(va, ·) over the matched B set.
+
+    Returns dict with float32 arrays shaped like ``va``:
+      cnt      — matched-pair count
+      nnz      — matched pairs whose MERGED value is nonzero
+      sum      — Σ merge over matches
+      mx / mn  — max / min of the PAIR-MATRIX ROW (merge over matches,
+                 0 for every unmatched pair, 0 when the row is empty) —
+                 i.e. exactly what the dense lowering's masked row
+                 reduction sees.
+    """
+    va = jnp.asarray(va, jnp.float32)
+    vb = jnp.asarray(vb, jnp.float32)
+    nb = vb.shape[0]
+    sv = jnp.sort(vb)
+    # prefix sums over CENTERED values: a raw f32 cumsum of ~2^24
+    # same-sign entries reaches ~n·|mean| and the range sum
+    # ps[hi]-ps[lo] cancels catastrophically (observed: a 1-pair match
+    # off by 20% at 16.7M entries); centering keeps the cumsum at
+    # random-walk magnitude and restores the mean term exactly as
+    # cnt·mean (cnt is integer-exact below 2^24 per range)
+    mean = jnp.mean(sv)
+    ps = jnp.concatenate([jnp.zeros(1, jnp.float32),
+                          jnp.cumsum(sv - mean, dtype=jnp.float32)])
+    lo, hi = _match_range(sv, va, pred)
+    # counts stay int32 through the arithmetic — float32 rounds above
+    # 2^24; the final f32 CAST of the result rounds exactly like the
+    # dense f32 lowering's own count output would
+    cnt_i = hi - lo
+    cnt = cnt_i.astype(jnp.float32)
+    some = cnt_i > 0
+    sum_vb = (ps[hi] - ps[lo]) + cnt * mean
+    # extrema of the matched vb range (safe-read 0 when empty)
+    mn_vb = jnp.where(some, sv[jnp.clip(lo, 0, nb - 1)], 0.0)
+    mx_vb = jnp.where(some, sv[jnp.clip(hi - 1, 0, nb - 1)], 0.0)
+    zeros_i = jnp.zeros_like(cnt_i)
+
+    if merge == "left":
+        m_sum = cnt * va
+        m_nnz = jnp.where(va != 0, cnt_i, zeros_i)
+        m_mx = m_mn = va
+    elif merge == "right":
+        m_sum = sum_vb
+        m_nnz = cnt_i - _range_eq_count(sv, jnp.zeros_like(va), lo, hi)
+        m_mx, m_mn = mx_vb, mn_vb
+    elif merge == "add":
+        m_sum = cnt * va + sum_vb
+        m_nnz = cnt_i - _range_eq_count(sv, -va, lo, hi)
+        m_mx, m_mn = va + mx_vb, va + mn_vb
+    elif merge == "mul":
+        m_sum = va * sum_vb
+        m_nnz = jnp.where(
+            va != 0,
+            cnt_i - _range_eq_count(sv, jnp.zeros_like(va), lo, hi),
+            zeros_i)
+        pos = va >= 0
+        m_mx = va * jnp.where(pos, mx_vb, mn_vb)
+        m_mn = va * jnp.where(pos, mn_vb, mx_vb)
+    else:
+        raise ValueError(f"unknown structured merge {merge!r}")
+
+    # fold the implicit zeros of unmatched pairs into the row extrema
+    full = cnt_i >= nb
+    mx = jnp.where(some, jnp.where(full, m_mx, jnp.maximum(m_mx, 0.0)),
+                   0.0)
+    mn = jnp.where(some, jnp.where(full, m_mn, jnp.minimum(m_mn, 0.0)),
+                   0.0)
+    zero = jnp.zeros_like(va)
+    return {"cnt": cnt,
+            "nnz": jnp.where(some, m_nnz, zeros_i).astype(jnp.float32),
+            "sum": jnp.where(some, m_sum, zero),
+            "mx": mx, "mn": mn}
+
+
+def axis_agg_sorted(va, vb, pred: str, merge: str, kind: str,
+                    axis: str) -> jax.Array:
+    """Aggregate the (na, nb) pair matrix without building it.
+
+    axis "row" → (na,) per-A-entry results; "col" → (nb,) per-B-entry
+    (computed by swapping roles and mirroring predicate/merge);
+    "all" → scalar ().
+    """
+    if kind not in AGG_KINDS:
+        raise ValueError(f"unknown aggregate {kind!r}")
+    if axis == "col":
+        return axis_agg_sorted(vb, va, _PRED_SWAP[pred],
+                               _MERGE_SWAP[merge], kind, "row")
+    st = entry_stats(va, vb, pred, merge)
+    if axis == "row":
+        if kind == "sum":
+            return st["sum"]
+        if kind == "count":
+            return st["nnz"]
+        if kind == "avg":
+            return jnp.where(st["nnz"] > 0, st["sum"] / st["nnz"], 0.0)
+        return st["mx"] if kind == "max" else st["mn"]
+    if axis == "all":
+        if kind == "sum":
+            return jnp.sum(st["sum"])
+        if kind == "count":
+            return jnp.sum(st["nnz"])
+        if kind == "avg":
+            c = jnp.sum(st["nnz"])
+            return jnp.where(c > 0, jnp.sum(st["sum"]) / c, 0.0)
+        # row extrema already include unmatched zeros / empty-row zeros
+        return (jnp.max(st["mx"]) if kind == "max"
+                else jnp.min(st["mn"]))
+    raise ValueError(f"unknown axis {axis!r} for a value-join "
+                     "aggregate (diag is handled elementwise upstream)")
+
+
+def axis_agg_chunked(va, vb, merge_fn, pred_fn, kind: str, axis: str,
+                     chunk_entries: int) -> jax.Array:
+    """Black-box fallback: enumerate pair blocks (na, cb) chunkwise over
+    B with a bounded live tile; callers gate total pairs with
+    config.join_bruteforce_max_pairs. axis "col" swaps the roles (the
+    merge/predicate argument order is preserved via wrappers); "all"
+    reduces the row results."""
+    if kind not in AGG_KINDS:
+        raise ValueError(f"unknown aggregate {kind!r}")
+    if axis == "col":
+        return axis_agg_chunked(
+            vb, va, lambda b, a: merge_fn(a, b),
+            None if pred_fn is None else (lambda b, a: pred_fn(a, b)),
+            kind, "row", chunk_entries)
+    va = jnp.asarray(va, jnp.float32)
+    vb = jnp.asarray(vb, jnp.float32)
+    na, nb = va.shape[0], vb.shape[0]
+    cb = max(1, min(nb, chunk_entries // max(na, 1)))
+    n_chunks = -(-nb // cb)
+    pad = n_chunks * cb - nb
+    vb_pad = jnp.pad(vb, (0, pad))
+    valid_tail = jnp.arange(n_chunks * cb) < nb
+
+    def body(carry, j):
+        s, c, mx, mn = carry
+        b = jax.lax.dynamic_slice(vb_pad, (j * cb,), (cb,))
+        vmask = jax.lax.dynamic_slice(valid_tail, (j * cb,), (cb,))
+        pairs = merge_fn(va[:, None], b[None, :])
+        if pred_fn is not None:
+            pairs = jnp.where(pred_fn(va[:, None], b[None, :]), pairs,
+                              0.0)
+        pairs = jnp.where(vmask[None, :], pairs, 0.0)
+        s = s + jnp.sum(pairs, axis=1)
+        c = c + jnp.sum((pairs != 0), axis=1).astype(jnp.float32)
+        # PADDED slots must not leak their exact 0 into the extrema (a
+        # row whose true pairs are all negative has a negative max) —
+        # mask them to ∓inf; real unmatched pairs keep their 0, exactly
+        # as the dense lowering's masked rows see them
+        mx = jnp.maximum(mx, jnp.max(
+            jnp.where(vmask[None, :], pairs, -jnp.inf), axis=1))
+        mn = jnp.minimum(mn, jnp.min(
+            jnp.where(vmask[None, :], pairs, jnp.inf), axis=1))
+        return (s, c, mx, mn), None
+
+    init = (jnp.zeros(na, jnp.float32), jnp.zeros(na, jnp.float32),
+            jnp.full(na, -jnp.inf), jnp.full(na, jnp.inf))
+    (s, c, mx, mn), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    mn = jnp.where(jnp.isfinite(mn), mn, 0.0)
+    if axis == "all":
+        if kind == "sum":
+            return jnp.sum(s)
+        if kind == "count":
+            return jnp.sum(c)
+        if kind == "avg":
+            ct = jnp.sum(c)
+            return jnp.where(ct > 0, jnp.sum(s) / ct, 0.0)
+        return jnp.max(mx) if kind == "max" else jnp.min(mn)
+    if kind == "sum":
+        return s
+    if kind == "count":
+        return c
+    if kind == "avg":
+        return jnp.where(c > 0, s / c, 0.0)
+    return mx if kind == "max" else mn

@@ -130,3 +130,203 @@ class TestJoins:
         e = R.join_on_index(bm(a, mesh8), bm(b, mesh8), jnp.maximum)
         s = R.aggregate(e, "sum", "all").compute().to_numpy()[0, 0]
         assert s == pytest.approx(np.maximum(a, b).sum(), rel=1e-4)
+
+
+def _pair_oracle(a, b, merge, pred, kind, axis):
+    """Dense numpy oracle: build the full pair matrix, aggregate it with
+    the dense lowering's rules (count = nonzero entries; max/min over
+    merged-or-zero; avg = sum/count)."""
+    va = np.asarray(a, np.float32).T.reshape(-1)
+    vb = np.asarray(b, np.float32).T.reshape(-1)
+    P = merge(va[:, None], vb[None, :]).astype(np.float64)
+    if pred is not None:
+        P = np.where(pred(va[:, None], vb[None, :]), P, 0.0)
+    ax = {"row": 1, "col": 0, "all": None}[axis]
+    if kind == "sum":
+        return P.sum(axis=ax)
+    if kind == "count":
+        return (P != 0).sum(axis=ax).astype(np.float64)
+    if kind == "avg":
+        s = P.sum(axis=ax)
+        c = (P != 0).sum(axis=ax)
+        return np.where(c > 0, s / np.maximum(c, 1), 0.0)
+    red = np.max if kind == "max" else np.min
+    return red(P, axis=ax)
+
+
+_NP_PREDS = {"eq": np.equal, "lt": np.less, "le": np.less_equal,
+             "gt": np.greater, "ge": np.greater_equal}
+_NP_MERGES = {"left": lambda x, y: x + 0 * y,
+              "right": lambda x, y: y + 0 * x,
+              "add": np.add, "mul": np.multiply}
+
+
+class TestValueJoinStreaming:
+    """agg(join_on_value) must stream — sort-based for structured
+    forms, capped chunk enumeration for callables — and match the
+    dense pair-matrix oracle bit-for-rule."""
+
+    @pytest.mark.parametrize("pred", ["eq", "lt", "le", "gt", "ge"])
+    @pytest.mark.parametrize("merge", ["left", "right", "add", "mul"])
+    def test_sorted_grid_row(self, mesh8, rng, pred, merge):
+        # duplicate values + zeros + sign mix stress every range rule
+        pool = np.array([-2.0, -1.0, 0.0, 0.0, 1.0, 1.0, 2.0, 3.0],
+                        np.float32)
+        a = rng.choice(pool, size=(4, 3)).astype(np.float32)
+        b = rng.choice(pool, size=(3, 4)).astype(np.float32)
+        j = R.join_on_values(bm(a, mesh8), bm(b, mesh8), merge=merge,
+                             predicate=pred)
+        for kind in ("sum", "count", "avg", "max", "min"):
+            got = R.aggregate(j, kind, "row").compute().to_numpy()[:, 0]
+            want = _pair_oracle(a, b, _NP_MERGES[merge],
+                                _NP_PREDS[pred], kind, "row")
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"{pred}/{merge}/{kind}")
+
+    @pytest.mark.parametrize("axis", ["col", "all"])
+    def test_sorted_other_axes(self, mesh8, rng, axis):
+        pool = np.array([-1.0, 0.0, 0.5, 1.0, 1.0], np.float32)
+        a = rng.choice(pool, size=(3, 4)).astype(np.float32)
+        b = rng.choice(pool, size=(5, 2)).astype(np.float32)
+        for pred in ("eq", "gt"):
+            for merge in ("add", "mul"):
+                j = R.join_on_values(bm(a, mesh8), bm(b, mesh8),
+                                     merge=merge, predicate=pred)
+                for kind in ("sum", "count", "max", "min"):
+                    out = R.aggregate(j, kind, axis).compute().to_numpy()
+                    got = out[0] if axis == "col" else out[0, 0]
+                    want = _pair_oracle(a, b, _NP_MERGES[merge],
+                                        _NP_PREDS[pred], kind, axis)
+                    np.testing.assert_allclose(
+                        got, want, rtol=1e-5, atol=1e-5,
+                        err_msg=f"{axis}/{pred}/{merge}/{kind}")
+
+    def test_no_predicate_streams(self, mesh8, rng):
+        a = rng.standard_normal((4, 4)).astype(np.float32)
+        b = rng.standard_normal((4, 4)).astype(np.float32)
+        j = R.join_on_values(bm(a, mesh8), bm(b, mesh8), merge="add")
+        got = R.aggregate(j, "sum", "row").compute().to_numpy()[:, 0]
+        want = _pair_oracle(a, b, np.add, None, "sum", "row")
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+    def test_callable_chunked_matches_oracle(self, mesh8, rng):
+        a = rng.standard_normal((5, 3)).astype(np.float32)
+        b = rng.standard_normal((4, 4)).astype(np.float32)
+        merge = lambda x, y: x * x + y          # not a structured form
+        pred = lambda x, y: x + y > 0.3
+        j = R.join_on_values(bm(a, mesh8), bm(b, mesh8), merge=merge,
+                             predicate=pred)
+        for kind, axis in (("sum", "row"), ("count", "col"),
+                           ("max", "all"), ("min", "row"),
+                           ("avg", "col")):
+            out = R.aggregate(j, kind, axis).compute().to_numpy()
+            got = {"row": out[:, 0], "col": out[0],
+                   "all": out[0, 0]}[axis]
+            want = _pair_oracle(a, b, merge, pred, kind, axis)
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"{kind}/{axis}")
+
+    def test_diag_agg_elementwise(self, mesh8, rng):
+        a = rng.standard_normal((3, 3)).astype(np.float32)
+        b = rng.standard_normal((3, 3)).astype(np.float32)
+        j = R.join_on_values(bm(a, mesh8), bm(b, mesh8), merge="mul",
+                             predicate="gt")
+        out = R.aggregate(j, "sum", "diag").compute().to_numpy()[0, 0]
+        va = a.T.reshape(-1)
+        vb = b.T.reshape(-1)
+        want = np.where(va > vb, va * vb, 0.0).sum()
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+    def test_4k_by_4k_streams_without_pair_alloc(self, mesh8, rng):
+        # 4096² entries each side → 16.7M × 16.7M pairs (~1.1 PB f32 if
+        # materialised). The sort path must aggregate it in O(n log n);
+        # finishing at all IS the no-allocation proof. Constructed
+        # values give a closed-form oracle.
+        n = 4096
+        a = np.zeros((n, n), np.float32)
+        a[0, 0] = 3.0            # one positive entry; rest zeros
+        b = np.full((n, n), 2.0, np.float32)
+        b[0, 0] = 5.0
+        j = R.join_on_values(bm(a, mesh8), bm(b, mesh8), merge="mul",
+                             predicate="lt")      # va < vb
+        nb = n * n
+        # row entry 0 (va=3): matches only vb=5 → sum 15. Zero entries
+        # of A match every vb>0 (all of them) but merge mul → 0.
+        s = R.aggregate(j, "sum", "all").compute().to_numpy()[0, 0]
+        np.testing.assert_allclose(s, 15.0, rtol=1e-6)
+        c = R.aggregate(j, "count", "all").compute().to_numpy()[0, 0]
+        np.testing.assert_allclose(c, 1.0)
+        # per-row: row 0 sums 15, every other row 0
+        rs = R.aggregate(j, "sum", "row").compute().to_numpy()
+        assert rs.shape == (n * n, 1)
+        np.testing.assert_allclose(rs[0, 0], 15.0, rtol=1e-6)
+        assert float(np.abs(rs[1:]).max()) == 0.0
+
+    def test_materialising_large_join_refused(self, mesh8, rng):
+        n = 128   # 16384 entries/side → 2.7e8 pairs > default cap 6.7e7
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        j = R.join_on_values(bm(a, mesh8), bm(a, mesh8), merge="add",
+                             predicate="eq")
+        from matrel_tpu.executor import execute
+        with pytest.raises(ValueError, match="join_pair_cap_entries"):
+            execute(j, mesh8)
+
+    def test_blackbox_over_cap_refused(self, mesh8, rng):
+        n = 192   # 36864 entries/side → 1.36e9 pairs > brute cap 2.7e8
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        j = R.join_on_values(bm(a, mesh8), bm(a, mesh8),
+                             merge=lambda x, y: x - y,
+                             predicate=lambda x, y: x > y)
+        from matrel_tpu.executor import execute
+        with pytest.raises(ValueError,
+                           match="join_bruteforce_max_pairs"):
+            execute(R.aggregate(j, "sum", "row"), mesh8)
+
+    def test_row_col_join_size_guard(self, mesh8, rng):
+        n = 1 << 14
+        a = bm(np.zeros((2, 8), np.float32), mesh8)
+        # fabricate a huge logical join via expr shapes: (2, 8) rows ⋈
+        # (2, m) rows gives (2, 8*m) — pick m so entries exceed the cap
+        big = bm(np.zeros((2, 8), np.float32), mesh8)
+        from matrel_tpu.ir import expr as E
+        node = E.MatExpr("join_rows",
+                         (a.expr(), big.expr()),
+                         (1 << 13, 1 << 14), None,
+                         {"merge": lambda x, y: x + y})
+        from matrel_tpu.executor import execute
+        with pytest.raises(ValueError, match="join_pair_cap_entries"):
+            execute(node, mesh8)
+
+
+class TestJoinSchemeSelection:
+    """The planner must pick the SMALLER operand to replicate, and the
+    choice must flip when the operand sizes flip (SURVEY.md §2
+    relational execs: join-scheme selection to minimize replication)."""
+
+    def _scheme(self, a, b, mesh, joiner):
+        from matrel_tpu.parallel import planner as pl
+        e = joiner(a, b, lambda x, y: x + y)
+        ann = pl.annotate_strategies(e, mesh)
+        return ann.attrs["replicate"]
+
+    def test_row_join_replicates_smaller_and_flips(self, mesh8, rng):
+        small = bm(rng.standard_normal((8, 4)), mesh8)
+        big = bm(rng.standard_normal((8, 64)), mesh8)
+        assert self._scheme(small, big, mesh8, R.join_on_rows) == "left"
+        assert self._scheme(big, small, mesh8, R.join_on_rows) == "right"
+
+    def test_col_join_replicates_smaller_and_flips(self, mesh8, rng):
+        small = bm(rng.standard_normal((4, 8)), mesh8)
+        big = bm(rng.standard_normal((64, 8)), mesh8)
+        assert self._scheme(small, big, mesh8, R.join_on_cols) == "left"
+        assert self._scheme(big, small, mesh8, R.join_on_cols) == "right"
+
+    def test_scheme_annotation_runs_through_executor(self, mesh8, rng):
+        # the annotated plan must still produce oracle results
+        a = rng.standard_normal((6, 3)).astype(np.float32)
+        b = rng.standard_normal((6, 5)).astype(np.float32)
+        e = R.join_on_rows(bm(a, mesh8), bm(b, mesh8),
+                           lambda x, y: x * y)
+        got = e.compute().to_numpy()
+        want = (a[:, :, None] * b[:, None, :]).reshape(6, 15)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
