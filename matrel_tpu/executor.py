@@ -509,12 +509,15 @@ class Lowerer:
 
     def _entry_vectors(self, node: MatExpr, ev):
         """Column-major logical-entry vectors (va, vb) of a join_value
-        node's operands — the pair matrix's row/col coordinates."""
+        node's operands — the pair matrix's row/col coordinates — plus
+        the dtype the DENSE lowering would produce (operand promotion),
+        so the streaming result is cast to match it."""
         l, r = node.children
         a, b = ev(l), ev(r)
         va = a[: l.shape[0], : l.shape[1]].T.reshape(-1)
         vb = b[: r.shape[0], : r.shape[1]].T.reshape(-1)
-        return va.astype(jnp.float32), vb.astype(jnp.float32)
+        out_dtype = jnp.result_type(a.dtype, b.dtype)
+        return va.astype(jnp.float32), vb.astype(jnp.float32), out_dtype
 
     def _agg_join_value(self, node: MatExpr, jnode: MatExpr, ev) -> Array:
         """agg(join_on_value(A, B)) without materialising the (na, nb)
@@ -528,31 +531,35 @@ class Lowerer:
         pred_kind = jnode.attrs.get("pred_kind")
         merge_kind = jnode.attrs.get("merge_kind")
         na, nb = jnode.shape
-        va, vb = self._entry_vectors(jnode, ev)
+        structured = (merge_kind is not None
+                      and (pred_kind is not None or pred_fn is None)
+                      and kind in vj.AGG_KINDS)
+        if (axis != "diag" and not structured
+                and na * nb > self.config.join_bruteforce_max_pairs):
+            # guard BEFORE evaluating the operands — same guard-first
+            # pattern as _join_value; shapes are static
+            raise ValueError(
+                f"aggregated value-join with callable merge/"
+                f"predicate must enumerate {na}x{nb} = {na * nb} "
+                f"pairs (> join_bruteforce_max_pairs = "
+                f"{self.config.join_bruteforce_max_pairs}). Use "
+                f"structured forms (predicate in "
+                f"{expr_mod.JOIN_PREDS}, merge in "
+                f"{expr_mod.JOIN_MERGES}) for the O(n log n) sort "
+                f"path, or raise the cap.")
+        va, vb, out_dtype = self._entry_vectors(jnode, ev)
         if axis == "diag":
             L = min(na, nb)
             d = merge_fn(va[:L], vb[:L])
             if pred_fn is not None:
                 d = jnp.where(pred_fn(va[:L], vb[:L]), d, 0.0)
             out = _diag_reduce(d, kind)
-            return self._pad_to_node(out.reshape(1, 1), node)
-        structured = (merge_kind is not None
-                      and (pred_kind is not None or pred_fn is None)
-                      and kind in vj.AGG_KINDS)
+            return self._pad_to_node(
+                out.reshape(1, 1).astype(out_dtype), node)
         if structured:
             out = vj.axis_agg_sorted(va, vb, pred_kind or "always",
                                      merge_kind, kind, axis)
         else:
-            cap = self.config.join_bruteforce_max_pairs
-            if na * nb > cap:
-                raise ValueError(
-                    f"aggregated value-join with callable merge/"
-                    f"predicate must enumerate {na}x{nb} = {na * nb} "
-                    f"pairs (> join_bruteforce_max_pairs = {cap}). Use "
-                    f"structured forms (predicate in "
-                    f"{expr_mod.JOIN_PREDS}, merge in "
-                    f"{expr_mod.JOIN_MERGES}) for the O(n log n) sort "
-                    f"path, or raise the cap.")
             out = vj.axis_agg_chunked(va, vb, merge_fn, pred_fn, kind,
                                       axis,
                                       self.config.join_chunk_entries)
@@ -562,7 +569,7 @@ class Lowerer:
             out = out.reshape(1, -1)
         else:
             out = out.reshape(1, 1)
-        return self._pad_to_node(out, node)
+        return self._pad_to_node(out.astype(out_dtype), node)
 
     def _join_value(self, node: MatExpr, ev) -> Array:
         """Value-join: all pairs (a_entry, b_entry) with predicate; output is

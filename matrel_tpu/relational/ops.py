@@ -51,7 +51,12 @@ def select_blocks(m: MatLike, predicate: Callable,
     (i // bs, j // bs) satisfies ``predicate(bi, bj)`` — the reference's
     block-granular selection, expressed through index predicates."""
     e = E.as_expr(m)
-    bs = block_size or getattr(m, "block_size", 512)
+    if block_size is None:
+        from matrel_tpu.config import default_config
+        block_size = getattr(m, "block_size", None)
+        if block_size is None:
+            block_size = default_config().block_size
+    bs = block_size
     return E.MatExpr("select_block", (e,), e.shape, e.nnz,
                      {"predicate": predicate, "block_size": bs})
 

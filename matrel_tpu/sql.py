@@ -8,11 +8,13 @@ catalog, compiled to the same MatExpr IR as the DSL, hence optimized and
 executed identically.
 
 Grammar (Python-expression syntax, parsed via ``ast`` — no eval):
-    SELECT <expr> [FROM t1, t2, ...]     -- FROM optional; names resolve
-                                            against the session catalog
+    SELECT <expr>
+        [FROM t1, t2, ...]        -- restricts AND validates the visible
+                                     tables against the session catalog
+        [WHERE <pred over v>]     -- sugar for select(<expr>, "<pred>")
     <expr> :=
         A * B            matrix multiply        A + B | A - B  elementwise
-        A .* B  → elemmul(A, B)                 A / B          elementwise
+        A .* B | A % B   element multiply       A / B          elementwise
         2 * A | A * 2    scalar multiply        A + 2          scalar add
         transpose(A) | t(A)
         rowsum(e) colsum(e) sum(e) trace(e) vec(e)
@@ -21,16 +23,24 @@ Grammar (Python-expression syntax, parsed via ``ast`` — no eval):
         select(e, "v > 0" [, fill])     σ on entry values
         selectrows(e, "i % 2 == 0")     σ on row index
         selectcols(e, "j < 4")          σ on col index
+        selectblocks(e, "bi == bj", block_size)   σ on block index
         joinindex(a, b, "x * y")        ⋈ on index with merge expr
+        joinrows(a, b, "x + y")         ⋈ on row index (pairwise cols)
+        joincols(a, b, "x - y")         ⋈ on col index (pairwise rows)
+        joinvalue(a, b, <merge>, <pred>)   ⋈ on values; merge/pred are
+            either structured keywords ("left"/"right"/"add"/"mul" and
+            "eq"/"lt"/"le"/"gt"/"ge" — these stream under aggregates)
+            or expression strings over (x, y)
 
-Predicate / merge strings are tiny lambdas over (v) / (i) / (j) / (x, y),
-parsed with the same restricted-ast machinery.
+Predicate / merge strings are tiny lambdas over (v) / (i) / (j) /
+(bi, bj) / (x, y), parsed with the same restricted-ast machinery.
+``A .* B`` is lexed (quote-aware) to ``A % B`` before parsing.
+Malformed input of any kind raises SqlError.
 """
 
 from __future__ import annotations
 
 import ast
-import re
 from typing import Any, Callable, Dict
 
 import jax.numpy as jnp
@@ -55,11 +65,19 @@ class SqlError(ValueError):
     pass
 
 
+def _parse_eval(src: str, what: str) -> ast.Expression:
+    """ast.parse(mode='eval') with SyntaxError mapped into SqlError."""
+    try:
+        return ast.parse(src, mode="eval")
+    except SyntaxError as e:
+        raise SqlError(f"malformed {what}: {src!r} ({e.msg})") from e
+
+
 def _compile_lambda(src: str, argnames: tuple) -> Callable:
     """Compile a restricted arithmetic/comparison expression into a fn over
     jnp arrays. Only names in ``argnames``, literals, arithmetic,
     comparisons, and boolean ops are allowed."""
-    tree = ast.parse(src, mode="eval")
+    tree = _parse_eval(src, "predicate/merge expression")
 
     allowed = (ast.Expression, ast.BinOp, ast.UnaryOp, ast.Compare,
                ast.BoolOp, ast.Name, ast.Constant, ast.Load,
@@ -130,7 +148,7 @@ class _Compiler(ast.NodeVisitor):
         self.catalog = catalog
 
     def compile(self, src: str) -> E.MatExpr:
-        tree = ast.parse(src, mode="eval")
+        tree = _parse_eval(src, "query expression")
         return self._expr(tree.body)
 
     def _expr(self, n: ast.AST):
@@ -164,6 +182,12 @@ class _Compiler(ast.NodeVisitor):
             return l.multiply(r)          # '*' between matrices = matmul
         if isinstance(n.op, ast.MatMult):
             return l.multiply(r)
+        if isinstance(n.op, ast.Mod):
+            # 'A .* B' lexes to 'A % B': element-wise multiply
+            if scalar_l or scalar_r:
+                raise SqlError(".* / % is matrix element-multiply; use "
+                               "* for scalar multiply")
+            return l.elem_multiply(r)
         if type(n.op) in _BINOPS:
             op = _BINOPS[type(n.op)]
             if scalar_r and op == "add":
@@ -215,7 +239,33 @@ class _Compiler(ast.NodeVisitor):
         if name == "joinindex":
             merge = _compile_lambda(self._str(args[2]), ("x", "y"))
             return self._expr(args[0]).join_on_index(self._expr(args[1]), merge)
+        if name in ("joinrows", "joincols"):
+            from matrel_tpu.relational import ops as R
+            merge = _compile_lambda(self._str(args[2]), ("x", "y"))
+            join = (R.join_on_rows if name == "joinrows"
+                    else R.join_on_cols)
+            return join(self._expr(args[0]), self._expr(args[1]), merge)
+        if name == "joinvalue":
+            merge = self._merge_or_pred(args[2], E.JOIN_MERGES)
+            pred = (self._merge_or_pred(args[3], E.JOIN_PREDS)
+                    if len(args) > 3 else None)
+            return self._expr(args[0]).join_on_value(
+                self._expr(args[1]), merge, pred)
+        if name == "selectblocks":
+            from matrel_tpu.relational import ops as R
+            pred = _compile_lambda(self._str(args[1]), ("bi", "bj"))
+            bs = int(self._lit(args[2])) if len(args) > 2 else None
+            return R.select_blocks(self._expr(args[0]), pred,
+                                   block_size=bs)
         raise SqlError(f"unknown function {name!r}")
+
+    def _merge_or_pred(self, node, keywords):
+        """joinvalue argument: a structured keyword string (streams
+        under aggregates) or an (x, y) expression string."""
+        s = self._str(node)
+        if s in keywords:
+            return s
+        return _compile_lambda(s, ("x", "y"))
 
     @staticmethod
     def _str(node) -> str:
@@ -233,12 +283,93 @@ class _Compiler(ast.NodeVisitor):
         raise SqlError("expected a numeric literal")
 
 
-_SELECT_RE = re.compile(r"^\s*select\s+(.*?)(\s+from\s+[\w\s,]+)?\s*;?\s*$",
-                        re.IGNORECASE | re.DOTALL)
+def _lex_elemmul(q: str) -> str:
+    """Replace the documented ``.*`` element-multiply token with ``%``
+    outside string literals (quote-aware; string predicates keep their
+    characters untouched)."""
+    out = []
+    quote = None
+    i = 0
+    while i < len(q):
+        ch = q[i]
+        if quote:
+            out.append(ch)
+            if ch == quote:
+                quote = None
+        elif ch in "'\"":
+            quote = ch
+            out.append(ch)
+        elif (ch == "." and i + 1 < len(q) and q[i + 1] == "*"
+                and not (i > 0 and q[i - 1].isdigit())):
+            # digit-adjacent dots are float literals: '2.*A' is
+            # 2.0 * A (scalar multiply), not an elemmul token
+            out.append(" % ")
+            i += 1
+        else:
+            out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def _find_keyword(q: str, kw: str) -> int:
+    """Start index of a word-boundary keyword OUTSIDE string literals,
+    or -1. Quoted predicates containing the word are skipped."""
+    quote = None
+    n, k = len(q), len(kw)
+    for i, ch in enumerate(q):
+        if quote:
+            if ch == quote:
+                quote = None
+            continue
+        if ch in "'\"":
+            quote = ch
+            continue
+        if (q[i:i + k].lower() == kw
+                and (i == 0 or not (q[i - 1].isalnum()
+                                    or q[i - 1] == "_"))
+                and (i + k >= n or not (q[i + k].isalnum()
+                                        or q[i + k] == "_"))):
+            return i
+    return -1
 
 
 def parse_sql(query: str, session) -> E.MatExpr:
-    """Compile a SQL-ish query against the session catalog into a MatExpr."""
-    m = _SELECT_RE.match(query)
-    body = m.group(1) if m else query
-    return _Compiler(session.catalog).compile(body.strip())
+    """Compile a SQL-ish query against the session catalog into a
+    MatExpr. FROM names are validated against the catalog AND restrict
+    the tables visible to the body; WHERE is sugar for a value
+    selection on the result."""
+    q = query.strip()
+    while q.endswith(";"):
+        q = q[:-1].rstrip()
+    # the SELECT keyword needs trailing whitespace — 'select(...)' (no
+    # space) is the σ FUNCTION, not the keyword
+    if q[:6].lower() == "select" and len(q) > 6 and q[6].isspace():
+        q = q[6:].strip()
+    q = _lex_elemmul(q)
+    where_src = None
+    wi = _find_keyword(q, "where")
+    if wi >= 0:
+        where_src = q[wi + 5:].strip()
+        if not where_src:
+            raise SqlError("WHERE requires a predicate over v")
+        q = q[:wi]
+    fi = _find_keyword(q, "from")
+    catalog = dict(session.catalog)
+    if fi >= 0:
+        names = [t.strip() for t in q[fi + 4:].split(",") if t.strip()]
+        q = q[:fi]
+        if not names:
+            raise SqlError("FROM requires at least one table name")
+        for t in names:
+            if not t.isidentifier():
+                raise SqlError(f"bad table name in FROM: {t!r}")
+        unknown = sorted(t for t in names if t not in catalog)
+        if unknown:
+            raise SqlError(
+                f"unknown table(s) in FROM: {unknown}; the session "
+                f"catalog has {sorted(catalog)}")
+        catalog = {t: catalog[t] for t in names}
+    expr = _Compiler(catalog).compile(q.strip())
+    if where_src is not None:
+        expr = expr.select_value(_compile_lambda(where_src, ("v",)))
+    return expr

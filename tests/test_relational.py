@@ -283,7 +283,6 @@ class TestValueJoinStreaming:
             execute(R.aggregate(j, "sum", "row"), mesh8)
 
     def test_row_col_join_size_guard(self, mesh8, rng):
-        n = 1 << 14
         a = bm(np.zeros((2, 8), np.float32), mesh8)
         # fabricate a huge logical join via expr shapes: (2, 8) rows ⋈
         # (2, m) rows gives (2, 8*m) — pick m so entries exceed the cap

@@ -100,3 +100,145 @@ def test_norm_function(sess):
     np.testing.assert_allclose(out[0, 0], np.linalg.norm(a), rtol=1e-4)
     out = s.compute(s.sql('norm(A, "l1")')).to_numpy()
     np.testing.assert_allclose(out[0, 0], np.abs(a).sum(), rtol=1e-4)
+
+
+# -- round-2 grammar completion: every docstring grammar line tested ---------
+
+
+def test_elemmul_dotstar_and_percent(sess):
+    s, a, b = sess
+    out = s.compute(s.sql("A .* A")).to_numpy()
+    np.testing.assert_allclose(out, a * a, rtol=1e-5)
+    out2 = s.compute(s.sql("A % A")).to_numpy()
+    np.testing.assert_allclose(out2, a * a, rtol=1e-5)
+    # .* inside a quoted predicate is NOT lexed: the string reaches the
+    # predicate compiler untouched and is rejected there, not mangled
+    with pytest.raises(SqlError):
+        s.sql("select(A, 'v .* v')")
+    with pytest.raises(SqlError, match="element-multiply"):
+        s.sql("2 % A")
+
+
+def test_elemwise_add_sub_div(sess):
+    s, a, b = sess
+    np.testing.assert_allclose(s.compute(s.sql("A + A")).to_numpy(),
+                               a + a, rtol=1e-5)
+    np.testing.assert_allclose(s.compute(s.sql("A - A")).to_numpy(),
+                               np.zeros_like(a), atol=1e-6)
+    d = s.compute(s.sql("A / (A + 10)")).to_numpy()
+    np.testing.assert_allclose(d, a / (a + 10), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(s.compute(s.sql("A + 2")).to_numpy(),
+                               a + 2, rtol=1e-5)
+
+
+def test_from_validates_and_restricts(sess):
+    s, a, b = sess
+    # unknown table in FROM → clear error naming the catalog
+    with pytest.raises(SqlError, match="unknown table.*FROM"):
+        s.sql("SELECT A * B FROM A, C")
+    # FROM restricts scope: B not listed → body may not use it
+    with pytest.raises(SqlError, match="unknown table"):
+        s.sql("SELECT A * B FROM A")
+    # malformed name
+    with pytest.raises(SqlError, match="bad table name"):
+        s.sql("SELECT A FROM A B")
+    # FROM with nothing after it
+    with pytest.raises(SqlError, match="at least one table"):
+        s.sql("SELECT A FROM ")
+
+
+def test_where_clause(sess):
+    s, a, b = sess
+    out = s.compute(s.sql("SELECT A + 0 WHERE v > 0.5")).to_numpy()
+    want = np.where(a + 0 > 0.5, a, 0)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    out2 = s.compute(
+        s.sql("SELECT A * B FROM A, B WHERE v < 0")).to_numpy()
+    ab = a @ b
+    np.testing.assert_allclose(out2, np.where(ab < 0, ab, 0), rtol=1e-4,
+                               atol=1e-4)
+    with pytest.raises(SqlError, match="WHERE requires"):
+        s.sql("SELECT A WHERE ")
+
+
+def test_selectcols_and_selectblocks(sess):
+    s, a, b = sess
+    out = s.compute(s.sql("selectcols(A, 'j < 3')")).to_numpy()
+    want = a.copy()
+    want[:, 3:] = 0
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+    blk = s.compute(s.sql("selectblocks(A, 'bi == bj', 4)")).to_numpy()
+    bi = np.arange(8)[:, None] // 4
+    bj = np.arange(6)[None, :] // 4
+    np.testing.assert_allclose(blk, np.where(bi == bj, a, 0), rtol=1e-5)
+
+
+def test_joinrows_and_joincols(sess):
+    s, a, b = sess
+    out = s.compute(s.sql("joinrows(A, A, 'x + y')")).to_numpy()
+    want = (a[:, :, None] + a[:, None, :]).reshape(8, 36)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+    out2 = s.compute(s.sql("joincols(A, A, 'x - y')")).to_numpy()
+    want2 = (a[:, None, :] - a[None, :, :]).reshape(64, 6)
+    np.testing.assert_allclose(out2, want2, rtol=1e-5, atol=1e-6)
+
+
+def test_joinvalue_structured_streams(sess):
+    s, a, b = sess
+    got = s.compute(
+        s.sql("rowsum(joinvalue(A, B, 'mul', 'lt'))")).to_numpy()[:, 0]
+    va = a.T.reshape(-1)
+    vb = b.T.reshape(-1)
+    want = np.where(va[:, None] < vb[None, :],
+                    va[:, None] * vb[None, :], 0.0).sum(axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_joinvalue_expression_strings(sess):
+    s, a, b = sess
+    got = s.compute(
+        s.sql("joinvalue(A, B, 'x + 2 * y', 'x > y and y > 0')")
+    ).to_numpy()
+    va = a.T.reshape(-1)
+    vb = b.T.reshape(-1)
+    want = np.where((va[:, None] > vb[None, :]) & (vb[None, :] > 0),
+                    va[:, None] + 2 * vb[None, :], 0.0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_power_vec_and_remaining_aggs(sess):
+    s, a, b = sess
+    np.testing.assert_allclose(s.compute(s.sql("power(A, 2)")).to_numpy(),
+                               a ** 2, rtol=1e-4)
+    v = s.compute(s.sql("vec(A)")).to_numpy()
+    np.testing.assert_allclose(v, a.T.reshape(-1, 1), rtol=1e-6)
+    checks = {
+        "rowmax(A)": a.max(1, keepdims=True),
+        "rowmin(A)": a.min(1, keepdims=True),
+        "colmax(A)": a.max(0, keepdims=True),
+        "colmin(A)": a.min(0, keepdims=True),
+        "rowcount(A)": (a != 0).sum(1, keepdims=True).astype(np.float32),
+        "colcount(A)": (a != 0).sum(0, keepdims=True).astype(np.float32),
+        "rowavg(A)": a.mean(1, keepdims=True),
+        "colavg(A)": a.mean(0, keepdims=True),
+        "colsum(A)": a.sum(0, keepdims=True),
+        "sum(A)": a.sum().reshape(1, 1),
+    }
+    for q, want in checks.items():
+        got = s.compute(s.sql(q)).to_numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
+                                   err_msg=q)
+
+
+def test_syntax_errors_become_sql_errors(sess):
+    s, a, b = sess
+    for bad in ("A **", "A .* ", "((A)", "select(A, 'v >')",
+                "joinvalue(A, B, 'x +', 'lt')", "A @", "FROM A"):
+        with pytest.raises(SqlError):
+            s.sql(bad)
+
+
+def test_trailing_semicolons_and_case(sess):
+    s, a, b = sess
+    out = s.compute(s.sql("SeLeCt rowsum(A) FROM A;;")).to_numpy()
+    np.testing.assert_allclose(out, a.sum(1, keepdims=True), rtol=1e-4)
