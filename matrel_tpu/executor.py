@@ -832,8 +832,9 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
                 else mesh_lib.make_mesh(cfg.mesh_shape, cfg.mesh_axis_names))
     for e in exprs:
         _check_one_mesh(e, mesh)
-    opts = tuple(planner.annotate_strategies(rules.optimize(e, cfg), mesh, cfg)
-                 for e in exprs)
+    grid = mesh_lib.mesh_grid_shape(mesh)
+    opts = tuple(planner.annotate_strategies(
+        rules.optimize(e, cfg, grid=grid), mesh, cfg) for e in exprs)
     leaf_order = []
     seen = set()
     for o in opts:
@@ -873,7 +874,8 @@ def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
         mesh = lvs[0].attrs["matrix"].mesh if lvs else mesh_lib.make_mesh(
             cfg.mesh_shape, cfg.mesh_axis_names)
     _check_one_mesh(expr, mesh)
-    opt = rules.optimize(expr, cfg)
+    opt = rules.optimize(expr, cfg,
+                         grid=mesh_lib.mesh_grid_shape(mesh))
     opt = planner.annotate_strategies(opt, mesh, cfg)
     leaf_order = expr_leaves(opt)
     fn = Lowerer(mesh, cfg).lower(opt, leaf_order)

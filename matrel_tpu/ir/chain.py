@@ -23,27 +23,35 @@ def collect_chain(e: MatExpr) -> List[MatExpr]:
     return collect_chain(e.children[0]) + collect_chain(e.children[1])
 
 
-def optimal_order(operands: List[MatExpr]) -> Tuple[MatExpr, float]:
+def optimal_order(operands: List[MatExpr],
+                  grid: Tuple[int, int] = (1, 1)
+                  ) -> Tuple[MatExpr, float]:
     """Interval DP over the operand list; returns (rebuilt expr, est. cost).
 
     cost[i][j] = min over split s of cost[i][s] + cost[s+1][j]
-                 + multiplyCost(dims, densities)
-    Densities of intermediates are re-estimated per split via the same
-    propagation the stats module uses, so sparse chains order correctly.
+                 + stepCost(dims, densities, grid)
+    stepCost (stats.chain_step_cost) = sparsity-aware FLOPs + the
+    collective bill of the cheapest MM strategy on the grid in
+    FLOP-equivalents — two parenthesisations with equal FLOPs but
+    different comm bills no longer tie arbitrarily. grid == (1, 1)
+    reduces to pure FLOPs. Densities of intermediates are re-estimated
+    per split via the same propagation the stats module uses, so sparse
+    chains order correctly.
 
     For chains of ≥3 operands the O(n³) loop runs in the native optimizer
-    core (native/chain_dp.cc, same cost semantics) when built; the pure-
-    Python DP below is the always-available fallback and the reference
-    implementation for equivalence tests.
+    core (native/chain_dp.cc, same cost semantics incl. the comm term);
+    the pure-Python DP below is the always-available fallback and the
+    reference implementation for equivalence tests.
     """
     n = len(operands)
+    gx, gy = grid
     if n == 1:
         return operands[0], 0.0
     if n >= 3:
         from matrel_tpu.utils import native
         dims = [op.shape[0] for op in operands] + [operands[-1].shape[1]]
         dens = [op.density for op in operands]
-        res = native.chain_dp(dims, dens)
+        res = native.chain_dp(dims, dens, grid=grid)
         if res is not None:
             splits, cost = res
 
@@ -67,9 +75,9 @@ def optimal_order(operands: List[MatExpr]) -> Tuple[MatExpr, float]:
             for s in range(i, j):
                 cl, el = best[i][s]
                 cr, er = best[s + 1][j]
-                step = stats.matmul_cost(
+                step = stats.chain_step_cost(
                     el.shape[0], el.shape[1], er.shape[1],
-                    el.density, er.density,
+                    el.density, er.density, gx, gy,
                 )
                 total = cl + cr + step
                 if cand is None or total < cand[0]:
@@ -79,14 +87,17 @@ def optimal_order(operands: List[MatExpr]) -> Tuple[MatExpr, float]:
     return e, cost
 
 
-def reorder_chains(e: MatExpr) -> MatExpr:
-    """Recursively find maximal matmul chains and DP-reorder each."""
+def reorder_chains(e: MatExpr,
+                   grid: Tuple[int, int] = (1, 1)) -> MatExpr:
+    """Recursively find maximal matmul chains and DP-reorder each.
+    ``grid`` is the mesh grid shape feeding the comm-aware step cost."""
     if e.kind == "matmul":
         ops = collect_chain(e)
         # optimize below each chain operand first, then the chain itself
-        ops = [reorder_chains(o) if o.kind != "leaf" else o for o in ops]
+        ops = [reorder_chains(o, grid) if o.kind != "leaf" else o
+               for o in ops]
         if len(ops) > 2:
-            new, _ = optimal_order(ops)
+            new, _ = optimal_order(ops, grid)
             return new
         if len(ops) == 2:
             return matmul(ops[0], ops[1])
@@ -94,21 +105,23 @@ def reorder_chains(e: MatExpr) -> MatExpr:
     if not e.children:
         return e
     new_children = tuple(
-        reorder_chains(c) for c in e.children
+        reorder_chains(c, grid) for c in e.children
     )
     if all(nc is oc for nc, oc in zip(new_children, e.children)):
         return e
     return e.with_children(new_children)
 
 
-def chain_cost(e: MatExpr) -> float:
-    """Total estimated matmul FLOP cost of a (sub)tree, for plan assertions."""
+def chain_cost(e: MatExpr, grid: Tuple[int, int] = (1, 1)) -> float:
+    """Total estimated matmul cost of a (sub)tree, for plan assertions.
+    Pure FLOPs at the default grid; comm-aware otherwise."""
     total = 0.0
     if e.kind == "matmul":
         l, r = e.children
-        total += stats.matmul_cost(
-            l.shape[0], l.shape[1], r.shape[1], l.density, r.density
+        total += stats.chain_step_cost(
+            l.shape[0], l.shape[1], r.shape[1], l.density, r.density,
+            grid[0], grid[1],
         )
     for c in e.children:
-        total += chain_cost(c)
+        total += chain_cost(c, grid)
     return total

@@ -20,6 +20,9 @@ Rules, mirroring the reference's Catalyst batch:
   R7 solve fusion: A⁻¹·B → solve(A,B) ; A·B⁻¹ → solve(Bᵀ,Aᵀ)ᵀ ;
      (A⁻¹)⁻¹ → A — the normal-equations pattern (XᵀX)⁻¹·Xᵀy never
      materialises an inverse.
+  R8 rank-1 multiply push-through: (A + u·vᵀ)·B → A·B + u·(vᵀ·B) and
+     B·(A + u·vᵀ) → B·A + (B·u)·vᵀ — the outer product is never
+     materialised inside a multiply chain (MatFast's rank-1 family).
 
 Each rule is a bottom-up tree transform; the batch runs to fixpoint with a
 bound, Catalyst-style.
@@ -182,6 +185,30 @@ def selection_pushdown(e: MatExpr) -> Optional[MatExpr]:
     return None
 
 
+# -- R8: rank-1 multiply push-through ----------------------------------------
+
+
+def rank1_pushdown(e: MatExpr) -> Optional[MatExpr]:
+    """(A + u·vᵀ)·B → A·B + u·(vᵀ·B) ; B·(A + u·vᵀ) → B·A + (B·u)·vᵀ.
+
+    MatFast's rank-1 family: never materialise the n×m outer product
+    inside a multiply chain — the rewritten form costs two thin
+    matmuls and an add, and exposes A·B to the chain DP. Always a win
+    for genuine rank-1 updates (u: n×1, v: m×1)."""
+    if e.kind != "matmul":
+        return None
+    a, b = e.children
+    if a.kind == "rank1":
+        base, u, v = a.children
+        return elemwise("add", matmul(base, b),
+                        matmul(u, matmul(transpose(v), b)))
+    if b.kind == "rank1":
+        base, u, v = b.children
+        return elemwise("add", matmul(a, base),
+                        matmul(matmul(a, u), transpose(v)))
+    return None
+
+
 # -- R7: solve fusion --------------------------------------------------------
 
 
@@ -210,6 +237,7 @@ _RULES: List[Rule] = [
     scalar_folding,
     selection_pushdown,
     solve_fusion,
+    rank1_pushdown,
 ]
 
 _MAX_ITERS = 10
@@ -231,11 +259,18 @@ def _same_structure(a: MatExpr, b: MatExpr) -> bool:
         return True
     if a.kind != b.kind or a.shape != b.shape or len(a.children) != len(b.children):
         return False
-    keys = ("op", "value", "agg", "axis")
-    if any(a.attrs.get(k) != b.attrs.get(k) for k in keys):
-        return False
-    if a.kind == "leaf":
-        return a.attrs["matrix"] is b.attrs["matrix"]
+    # compare ALL attrs (not a fixed whitelist — a rule rewriting an
+    # attr outside a whitelist would fool fixpoint detection into an
+    # early exit); callables and other unhashables compare by identity
+    keys = set(a.attrs) | set(b.attrs)
+    for k in keys:
+        va, vb = a.attrs.get(k), b.attrs.get(k)
+        if isinstance(va, (int, float, str, bool, type(None))) \
+                and isinstance(vb, (int, float, str, bool, type(None))):
+            if va != vb:
+                return False
+        elif va is not vb:
+            return False
     return all(_same_structure(x, y) for x, y in zip(a.children, b.children))
 
 
@@ -271,13 +306,17 @@ def common_subexpressions(e: MatExpr) -> MatExpr:
     return walk(e)[1]
 
 
-def optimize(e: MatExpr, config: Optional[MatrelConfig] = None) -> MatExpr:
-    """Full logical optimization: rewrites, chain-DP reorder, CSE."""
+def optimize(e: MatExpr, config: Optional[MatrelConfig] = None,
+             grid: tuple = (1, 1)) -> MatExpr:
+    """Full logical optimization: rewrites, chain-DP reorder, CSE.
+    ``grid`` is the mesh grid shape — the chain DP's step cost then
+    includes each candidate multiply's collective bill (comm-aware
+    reorder); (1, 1) keeps the pure-FLOPs DP."""
     cfg = config or default_config()
     if cfg.rewrite_rules:
         e = apply_rewrites(e)
     if cfg.chain_opt:
-        e = chain_lib.reorder_chains(e)
+        e = chain_lib.reorder_chains(e, grid)
         if cfg.rewrite_rules:
             e = apply_rewrites(e)  # reorder can expose new folds
     if cfg.rewrite_rules:

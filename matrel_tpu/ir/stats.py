@@ -60,6 +60,49 @@ def matmul_cost(
     return 2.0 * n * k * m * da * db
 
 
+COMM_FLOPS_PER_BYTE = 1000.0
+"""Blend factor converting ICI bytes into FLOP-equivalents for the
+chain DP's step cost: a v5e chip retires ~200e12 bf16 FLOP/s against
+~200 GB/s of per-link ICI, so ~1000 MXU FLOPs buy the time of one
+ICI byte. Order-of-magnitude is what matters — the term breaks
+FLOP-ties toward the cheaper collective bill."""
+
+
+def comm_proxy(n: int, k: int, m: int, da: float, db: float,
+               gx: int, gy: int, itemsize: int = 4) -> float:
+    """Simplified per-device ICI bytes of the cheapest MM strategy for
+    an (n×k)·(k×m) multiply on a gx×gy mesh — the chain DP's comm term.
+
+    Mirrors planner.comm_cost's closed forms WITHOUT layout credits or
+    admissibility gates (physical layouts aren't known while the DP
+    reorders the logical chain); the planner still picks the real
+    strategy per multiply afterwards. Must stay in sync with
+    native/chain_dp.cc's comm_proxy."""
+    p = gx * gy
+    if p <= 1:
+        return 0.0
+    a_b = n * k * itemsize * da
+    b_b = k * m * itemsize * db
+    c_b = n * m * itemsize
+    # planner.comm_cost's forms at the canonical "2d" layout (the bmm
+    # reshard terms are unconditional there, only their layout CREDITS
+    # are dropped)
+    bmm_r = b_b * (p - 1) / p + (a_b / p) * (1 - 1 / gy)
+    bmm_l = a_b * (p - 1) / p + (b_b / p) * (1 - 1 / gx)
+    cpmm = (b_b / gy) * (gx - 1) / gx + (c_b / gx) * (gy - 1) / gy
+    rmm = (a_b / gx) * (gy - 1) / gy + (b_b / gy) * (gx - 1) / gx
+    return min(bmm_r, bmm_l, cpmm, rmm)
+
+
+def chain_step_cost(n: int, k: int, m: int, da: float, db: float,
+                    gx: int = 1, gy: int = 1) -> float:
+    """DP step cost: sparsity-aware FLOPs + the collective bill in
+    FLOP-equivalents. With gx·gy == 1 this is exactly matmul_cost, so
+    single-device plans are unchanged."""
+    return (matmul_cost(n, k, m, da, db)
+            + COMM_FLOPS_PER_BYTE * comm_proxy(n, k, m, da, db, gx, gy))
+
+
 def matmul_out_nnz(
     n: int, k: int, m: int, nnz_a: Optional[int], nnz_b: Optional[int]
 ) -> Optional[int]:

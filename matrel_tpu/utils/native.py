@@ -87,6 +87,25 @@ def load() -> Optional[ctypes.CDLL]:
             log.debug("native chain-dp symbols unavailable: %s", e)
             _has_dp = False
         lib._matrel_has_dp = _has_dp
+        try:
+            # comm-aware DP binds separately so a stale prebuilt lib
+            # still serves the FLOPs-only DP
+            lib.matrel_chain_dp_comm.restype = ctypes.c_int
+            lib.matrel_chain_dp_comm.argtypes = [
+                ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.c_double,
+                ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.POINTER(ctypes.c_double),
+            ]
+            lib._matrel_has_dp_comm = True
+        except AttributeError as e:
+            log.debug("native comm-aware chain-dp unavailable: %s", e)
+            lib._matrel_has_dp_comm = False
         _lib = lib
         try:
             # Ingestion symbols bind separately so a stale prebuilt lib
@@ -141,11 +160,15 @@ def load() -> Optional[ctypes.CDLL]:
         return _lib
 
 
-def chain_dp(dims: Sequence[int], densities: Sequence[float]
-             ) -> Optional[Tuple[np.ndarray, float]]:
+def chain_dp(dims: Sequence[int], densities: Sequence[float],
+             grid: Tuple[int, int] = (1, 1),
+             comm_weight: Optional[float] = None,
+             itemsize: int = 4) -> Optional[Tuple[np.ndarray, float]]:
     """Run the native interval DP. dims has n+1 entries; densities n.
-    Returns (split table [n,n] int32, total cost) or None if the native
-    path is unavailable."""
+    With grid != (1,1) the step cost adds the comm term (ir/stats.py::
+    chain_step_cost semantics). Returns (split table [n,n] int32, total
+    cost) or None if the native path is unavailable — including a stale
+    prebuilt lib lacking the comm symbol when comm is requested."""
     lib = load()
     if lib is None or not getattr(lib, "_matrel_has_dp", False):
         return None
@@ -156,8 +179,20 @@ def chain_dp(dims: Sequence[int], densities: Sequence[float]
     dens_arr = np.ascontiguousarray(densities, dtype=np.float64)
     splits = np.zeros((n, n), dtype=np.int32)
     cost = ctypes.c_double(0.0)
-    rc = lib.matrel_chain_dp(n, dims_arr, dens_arr, splits.reshape(-1),
-                             ctypes.byref(cost))
+    gx, gy = grid
+    if gx * gy > 1:
+        if not getattr(lib, "_matrel_has_dp_comm", False):
+            return None
+        if comm_weight is None:
+            from matrel_tpu.ir.stats import COMM_FLOPS_PER_BYTE
+            comm_weight = COMM_FLOPS_PER_BYTE
+        rc = lib.matrel_chain_dp_comm(
+            n, dims_arr, dens_arr, int(gx), int(gy),
+            float(comm_weight), int(itemsize), splits.reshape(-1),
+            ctypes.byref(cost))
+    else:
+        rc = lib.matrel_chain_dp(n, dims_arr, dens_arr,
+                                 splits.reshape(-1), ctypes.byref(cost))
     if rc != 0:
         return None
     return splits, float(cost.value)

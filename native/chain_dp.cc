@@ -29,18 +29,31 @@ double matmul_density(double da, double db, double k) {
   return -std::expm1(k * std::log1p(-p));
 }
 
-}  // namespace
+// Per-device ICI bytes of the cheapest MM strategy for (n×k)·(k×m) on a
+// gx×gy mesh. MUST mirror ir/stats.py::comm_proxy (planner.comm_cost at
+// the canonical 2d layout: no layout credits, no admissibility gates) —
+// the equivalence is asserted by tests/test_native.py::
+// test_comm_dp_native_matches_python.
+double comm_proxy(double n, double k, double m, double da, double db,
+                  double gx, double gy, double itemsize) {
+  double p = gx * gy;
+  if (p <= 1.0) return 0.0;
+  double a_b = n * k * itemsize * da;
+  double b_b = k * m * itemsize * db;
+  double c_b = n * m * itemsize;
+  double bmm_r = b_b * (p - 1.0) / p + (a_b / p) * (1.0 - 1.0 / gy);
+  double bmm_l = a_b * (p - 1.0) / p + (b_b / p) * (1.0 - 1.0 / gx);
+  double cpmm = (b_b / gy) * (gx - 1.0) / gx + (c_b / gx) * (gy - 1.0) / gy;
+  double rmm = (a_b / gx) * (gy - 1.0) / gy + (b_b / gy) * (gx - 1.0) / gx;
+  double best = bmm_r < bmm_l ? bmm_r : bmm_l;
+  if (cpmm < best) best = cpmm;
+  if (rmm < best) best = rmm;
+  return best;
+}
 
-extern "C" {
-
-// dims: n+1 entries — operand i is dims[i] x dims[i+1]
-// dens: n entries   — density of operand i (1.0 = dense)
-// split_out: n*n row-major; split_out[i*n+j] = optimal split s for the
-//            inclusive interval [i, j] (undefined for i >= j)
-// cost_out:  total optimal FLOP cost of [0, n-1]
-// returns 0 on success, nonzero on bad input
-int matrel_chain_dp(int32_t n, const int64_t* dims, const double* dens,
-                    int32_t* split_out, double* cost_out) {
+int chain_dp_impl(int32_t n, const int64_t* dims, const double* dens,
+                  double gx, double gy, double comm_weight, double itemsize,
+                  int32_t* split_out, double* cost_out) {
   if (n <= 0 || dims == nullptr || dens == nullptr || split_out == nullptr ||
       cost_out == nullptr)
     return 1;
@@ -65,6 +78,9 @@ int matrel_chain_dp(int32_t n, const int64_t* dims, const double* dens,
         double mid = static_cast<double>(dims[s + 1]);
         double colsj = static_cast<double>(dims[j + 1]);
         double step = 2.0 * rows * mid * colsj * dl * dr;
+        if (comm_weight > 0.0)
+          step += comm_weight *
+                  comm_proxy(rows, mid, colsj, dl, dr, gx, gy, itemsize);
         double total = cost[i * n + s] + cost[(s + 1) * n + j] + step;
         if (best < 0.0 || total < best) {
           best = total;
@@ -79,6 +95,35 @@ int matrel_chain_dp(int32_t n, const int64_t* dims, const double* dens,
   }
   *cost_out = cost[0 * n + (n - 1)];
   return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// dims: n+1 entries — operand i is dims[i] x dims[i+1]
+// dens: n entries   — density of operand i (1.0 = dense)
+// split_out: n*n row-major; split_out[i*n+j] = optimal split s for the
+//            inclusive interval [i, j] (undefined for i >= j)
+// cost_out:  total optimal FLOP cost of [0, n-1]
+// returns 0 on success, nonzero on bad input
+int matrel_chain_dp(int32_t n, const int64_t* dims, const double* dens,
+                    int32_t* split_out, double* cost_out) {
+  return chain_dp_impl(n, dims, dens, 1.0, 1.0, 0.0, 4.0, split_out,
+                       cost_out);
+}
+
+// Comm-aware variant: step cost additionally pays
+// comm_weight * comm_proxy(dims, densities, gx, gy, itemsize) —
+// FLOP-equivalents of the cheapest collective bill on the gx×gy mesh.
+int matrel_chain_dp_comm(int32_t n, const int64_t* dims, const double* dens,
+                         int32_t gx, int32_t gy, double comm_weight,
+                         int32_t itemsize, int32_t* split_out,
+                         double* cost_out) {
+  if (gx <= 0 || gy <= 0 || itemsize <= 0) return 1;
+  return chain_dp_impl(n, dims, dens, static_cast<double>(gx),
+                       static_cast<double>(gy), comm_weight,
+                       static_cast<double>(itemsize), split_out, cost_out);
 }
 
 }  // extern "C"

@@ -81,6 +81,29 @@ def test_native_matches_python_sparse(lib, mesh8):
         assert cost == pytest.approx(pcost, rel=0.05)
 
 
+def test_comm_dp_native_matches_python(lib, mesh8, monkeypatch):
+    """The comm term's C++ implementation must track ir/stats.py exactly
+    (the C++ comment in native/chain_dp.cc points here): fuzz random
+    chains/grids through native chain_dp vs the forced-Python DP."""
+    rng = np.random.default_rng(9)
+    for _ in range(10):
+        n = int(rng.integers(3, 7))
+        dims = [int(rng.integers(2, 600)) for _ in range(n + 1)]
+        dens = [float(rng.choice([1.0, 1.0, 0.2, 0.02]))
+                for _ in range(n)]
+        grid = tuple(rng.choice([(1, 2), (2, 2), (2, 4), (4, 2)]))
+        ops = _mk_ops(mesh8, dims, dens)
+        e_nat, c_nat = chain_lib.optimal_order(ops, grid=grid)
+        with monkeypatch.context() as mp:
+            mp.setattr(native, "chain_dp", lambda *a, **k: None)
+            e_py, c_py = chain_lib.optimal_order(ops, grid=grid)
+        # density propagation rounds differently (nnz ints in expr
+        # nodes vs float densities in C++) — same tolerance as
+        # test_native_matches_python_sparse; equal-cost ties may pick
+        # different structures
+        assert c_nat == pytest.approx(c_py, rel=0.05), (dims, dens, grid)
+
+
 def test_native_raw_api(lib):
     splits, cost = native.chain_dp([10, 1000, 10, 1000], [1.0, 1.0, 1.0])
     # (A·B)·C: split after operand 1 for the full interval [0,2]
@@ -196,8 +219,10 @@ class TestNativeMtxReader:
                 f.write(f"{k},0,{v:.17g}\n")
         _, _, got = native.coo_csv_read(p)
         want = np.array([float(f"{v:.17g}") for v in vals])
-        np.testing.assert_array_equal(got.astype(np.float32),
-                                      want.astype(np.float32))
+        with np.errstate(over="ignore"):   # 1e300 → inf is the point
+            got32 = got.astype(np.float32)
+            want32 = want.astype(np.float32)
+        np.testing.assert_array_equal(got32, want32)
 
     def test_io_load_mtx_uses_native(self, lib, tmp_path, mesh8):
         import scipy.sparse as sps

@@ -262,3 +262,120 @@ class TestSolveFusion:
         A, _ = self._exprs(mesh8, rng)
         e = rules.apply_rewrites(E.inverse(E.inverse(A)))
         assert e is A
+
+
+class TestRank1Pushdown:
+    """R8: (A + u·vᵀ)·B → A·B + u·(vᵀ·B), both sides — the outer product
+    is never materialised inside a multiply chain."""
+
+    def test_left_rank1_multiply(self, mesh8):
+        a, u, v = L(6, 6, mesh8), L(6, 1, mesh8), L(6, 1, mesh8)
+        b = L(6, 4, mesh8)
+        e = apply_rewrites(matmul(a.rank_one_update(u, v), b))
+        assert e.kind == "elemwise" and e.attrs["op"] == "add"
+        lhs, rhs = e.children
+        assert lhs.kind == "matmul"
+        assert lhs.children[0] is a and lhs.children[1] is b
+        # rhs = u·(vᵀ·B): no rank1 node anywhere
+        def no_rank1(n):
+            assert n.kind != "rank1"
+            for c in n.children:
+                no_rank1(c)
+        no_rank1(e)
+        assert rhs.shape == (6, 4)
+
+    def test_right_rank1_multiply(self, mesh8):
+        a = L(4, 6, mesh8)
+        base, u, v = L(6, 6, mesh8), L(6, 1, mesh8), L(6, 1, mesh8)
+        e = apply_rewrites(matmul(a, base.rank_one_update(u, v)))
+        assert e.kind == "elemwise" and e.attrs["op"] == "add"
+        lhs, rhs = e.children
+        assert lhs.children[0] is a and lhs.children[1] is base
+        assert rhs.shape == (4, 6)
+
+    def test_rank1_numeric_equivalence(self, mesh8, rng=None):
+        # full pipeline: optimized vs unoptimized vs numpy oracle
+        from matrel_tpu.executor import execute
+        from matrel_tpu.config import MatrelConfig
+        rng = np.random.default_rng(5)
+        a = rng.standard_normal((6, 6)).astype(np.float32)
+        u = rng.standard_normal((6, 1)).astype(np.float32)
+        v = rng.standard_normal((6, 1)).astype(np.float32)
+        b = rng.standard_normal((6, 4)).astype(np.float32)
+        A = leaf(BlockMatrix.from_numpy(a, mesh=mesh8))
+        U = leaf(BlockMatrix.from_numpy(u, mesh=mesh8))
+        V = leaf(BlockMatrix.from_numpy(v, mesh=mesh8))
+        B = leaf(BlockMatrix.from_numpy(b, mesh=mesh8))
+        expr = matmul(A.rank_one_update(U, V), B)
+        want = (a + u @ v.T) @ b
+        got_opt = execute(expr, mesh8).to_numpy()
+        got_raw = execute(expr, mesh8,
+                          MatrelConfig(rewrite_rules=False)).to_numpy()
+        np.testing.assert_allclose(got_opt, want, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(got_raw, want, rtol=1e-4, atol=1e-4)
+
+
+class TestCommAwareChainDP:
+    """The DP's step cost includes the collective bill: two
+    parenthesisations with equal FLOPs but different comm bills must no
+    longer tie arbitrarily (comm-aware reorder)."""
+
+    def test_flop_tie_broken_by_comm(self, mesh8):
+        # dims (16,512)(512,512)(512,16): both orders cost the same
+        # FLOPs — an exact tie — but the comm proxy differs (the right
+        # order's big middle operand rides a cheaper collective mix on
+        # the 2x4 grid)
+        n, k = 16, 512
+        a, b, c = L(n, k, mesh8), L(k, k, mesh8), L(k, n, mesh8)
+        ops = [a, b, c]
+        flops_left = (stats.matmul_cost(n, k, k, 1, 1)
+                      + stats.matmul_cost(n, k, n, 1, 1))
+        flops_right = (stats.matmul_cost(k, k, n, 1, 1)
+                       + stats.matmul_cost(n, k, n, 1, 1))
+        assert flops_left == flops_right            # genuine FLOP tie
+        opt_comm, cost_comm = chain.optimal_order(ops, grid=(2, 4))
+        # the comm-aware plan must be at least as cheap (comm-inclusive)
+        # as BOTH fixed parenthesisations, and strictly cheaper than one
+        left = matmul(matmul(a, b), c)
+        right = matmul(a, matmul(b, c))
+        cl = chain.chain_cost(left, grid=(2, 4))
+        cr = chain.chain_cost(right, grid=(2, 4))
+        assert cl != cr                             # comm breaks the tie
+        assert cost_comm == pytest.approx(min(cl, cr))
+
+    def test_python_and_native_dp_agree_with_comm(self, mesh8,
+                                                  monkeypatch):
+        # run BOTH implementations on the same chain: the native comm
+        # DP, and the pure-Python fallback (forced by disabling the
+        # native path) — plans and costs must agree exactly
+        from matrel_tpu.utils import native
+        dims = [(64, 512), (512, 32), (32, 256), (256, 16)]
+        ops = [L(n, m, mesh8) for n, m in dims]
+        res = native.chain_dp(
+            [d[0] for d in dims] + [dims[-1][1]],
+            [1.0] * 4, grid=(2, 4))
+        if res is None:
+            pytest.skip("native comm DP unavailable")
+        e_nat, c_nat = chain.optimal_order(ops, grid=(2, 4))
+        assert c_nat == pytest.approx(res[1])
+        monkeypatch.setattr(native, "chain_dp", lambda *a, **k: None)
+        e_py, c_py = chain.optimal_order(ops, grid=(2, 4))
+        assert c_py == pytest.approx(c_nat)
+        from matrel_tpu.workloads.chain_bench import parenthesisation
+        assert parenthesisation(e_py) == parenthesisation(e_nat)
+        assert chain.chain_cost(e_py, grid=(2, 4)) == pytest.approx(c_py)
+
+    def test_single_device_grid_unchanged(self, mesh8):
+        # grid (1,1): step cost reduces exactly to FLOPs
+        assert stats.chain_step_cost(50, 60, 70, 1.0, 1.0, 1, 1) == \
+            stats.matmul_cost(50, 60, 70, 1.0, 1.0)
+        assert stats.comm_proxy(50, 60, 70, 1.0, 1.0, 1, 1) == 0.0
+
+    def test_comm_proxy_matches_planner_forms(self):
+        # spot-check the proxy against planner.comm_cost with 2d layouts
+        from matrel_tpu.parallel.planner import comm_cost
+        n, k, m, gx, gy = 256, 512, 128, 2, 4
+        want = min(comm_cost(s, n, k, m, 1.0, 1.0, gx, gy)
+                   for s in ("bmm_right", "bmm_left", "cpmm", "rmm"))
+        assert stats.comm_proxy(n, k, m, 1.0, 1.0, gx, gy) == \
+            pytest.approx(want)
