@@ -57,6 +57,11 @@ class MatrelConfig:
         O(n log n) sort path and are exempt.
       join_chunk_entries: per-chunk entry budget for the black-box
         streaming enumeration (bounds the live tile).
+      plan_cache_max_plans / plan_cache_max_bytes: LRU bounds on the
+        session's compiled-plan cache. Each cached plan pins its
+        hoisted sparse payloads (extra_args) in device memory; the
+        byte budget counts those, the plan bound the rest. Least-
+        recently-used plans evict first.
       rewrite_rules: enable the algebraic rewrite pass.
       donate_intermediates: donate chain intermediates to XLA where legal.
     """
@@ -78,6 +83,8 @@ class MatrelConfig:
     join_pair_cap_entries: int = 1 << 26
     join_bruteforce_max_pairs: int = 1 << 28
     join_chunk_entries: int = 1 << 22
+    plan_cache_max_plans: int = 64
+    plan_cache_max_bytes: int = 4 << 30
 
     def replace(self, **kw: Any) -> "MatrelConfig":
         return dataclasses.replace(self, **kw)

@@ -13,7 +13,8 @@ analogue).
 from __future__ import annotations
 
 import logging
-from typing import Dict, Optional, Tuple
+from collections import OrderedDict
+from typing import Optional, Tuple
 
 import numpy as np
 from jax.sharding import Mesh
@@ -37,8 +38,15 @@ class MatrelSession:
         self.config = config or default_config()
         self.mesh = mesh or mesh_lib.make_mesh(
             self.config.mesh_shape, self.config.mesh_axis_names)
-        self.catalog: Dict[str, BlockMatrix] = {}
-        self._plan_cache: Dict[str, executor_lib.CompiledPlan] = {}
+        self.catalog: dict[str, BlockMatrix] = {}
+        # LRU plan cache: every cached plan pins its hoisted sparse
+        # payloads (extra_args) in device HBM and its leaf matrices via
+        # leaf_order — unbounded growth OOMs long-lived sessions, so
+        # least-recently-used plans evict at the config's plan-count /
+        # hoisted-byte bounds
+        self._plan_cache: "OrderedDict[str, executor_lib.CompiledPlan]" \
+            = OrderedDict()
+        self._plan_cache_bytes = 0
 
     # -- builder (MatfastSession.builder().getOrCreate() analogue) ---------
 
@@ -46,9 +54,11 @@ class MatrelSession:
         def __init__(self):
             self._cfg = default_config()
             self._mesh = None
+            self._explicit_cfg = False
 
         def config(self, **kw) -> "MatrelSession.Builder":
             self._cfg = self._cfg.replace(**kw)
+            self._explicit_cfg = True
             return self
 
         def mesh(self, mesh: Mesh) -> "MatrelSession.Builder":
@@ -59,6 +69,21 @@ class MatrelSession:
             global _active
             if _active is None:
                 _active = MatrelSession(self._mesh, self._cfg)
+                return _active
+            # a live session wins — but silently ignoring an
+            # explicitly-requested different config/mesh hands the
+            # caller settings they did not ask for
+            if self._explicit_cfg and self._cfg != _active.config:
+                log.warning(
+                    "MatrelSession.builder(): a session already exists; "
+                    "ignoring the requested config (differs from the "
+                    "live session's — call reset_session() first to "
+                    "rebuild with new settings)")
+            if self._mesh is not None and self._mesh != _active.mesh:
+                log.warning(
+                    "MatrelSession.builder(): a session already exists; "
+                    "ignoring the requested mesh (differs from the live "
+                    "session's — call reset_session() first)")
             return _active
 
     @staticmethod
@@ -92,10 +117,35 @@ class MatrelSession:
     def compile(self, expr: MatExpr) -> executor_lib.CompiledPlan:
         key = _plan_key(as_expr(expr))
         plan = self._plan_cache.get(key)
-        if plan is None:
-            plan = executor_lib.compile_expr(as_expr(expr), self.mesh, self.config)
-            self._plan_cache[key] = plan
+        if plan is not None:
+            self._plan_cache.move_to_end(key)
+            return plan
+        plan = executor_lib.compile_expr(as_expr(expr), self.mesh,
+                                         self.config)
+        self._plan_cache[key] = plan
+        self._plan_cache_bytes += _plan_bytes(plan)
+        self._evict_plans()
         return plan
+
+    def _evict_plans(self) -> None:
+        """Drop least-recently-used plans past the config bounds. The
+        byte budget counts hoisted payloads (extra_args) — the device
+        memory a cached plan pins beyond its leaves."""
+        cfg = self.config
+        while self._plan_cache and (
+                len(self._plan_cache) > cfg.plan_cache_max_plans
+                or self._plan_cache_bytes > cfg.plan_cache_max_bytes):
+            if len(self._plan_cache) == 1 and \
+                    len(self._plan_cache) <= cfg.plan_cache_max_plans:
+                break    # never evict the sole (just-inserted) plan
+            _, old = self._plan_cache.popitem(last=False)
+            self._plan_cache_bytes -= _plan_bytes(old)
+        self._plan_cache_bytes = max(self._plan_cache_bytes, 0)
+
+    def plan_cache_info(self) -> dict:
+        """Cache observability: entry count + pinned hoisted bytes."""
+        return {"plans": len(self._plan_cache),
+                "hoisted_bytes": self._plan_cache_bytes}
 
     def compute(self, expr: MatExpr) -> BlockMatrix:
         return self.compile(expr).run()
@@ -108,6 +158,19 @@ class MatrelSession:
         SQL surface, SURVEY.md §2 'SQL entry point'). See sql.py."""
         from matrel_tpu.sql import parse_sql
         return parse_sql(query, self)
+
+
+def _plan_bytes(plan: executor_lib.CompiledPlan) -> int:
+    """Device bytes a cached plan pins beyond its leaf matrices: the
+    hoisted constant payloads shipped as call-time args. Computed from
+    shape/dtype — jax 0.9 TypedNdArray consts lack .nbytes."""
+    total = 0
+    for a in plan.extra_args:
+        try:
+            total += int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+        except (AttributeError, TypeError):
+            pass
+    return total
 
 
 def _plan_key(e: MatExpr) -> str:

@@ -1,0 +1,124 @@
+"""Session hygiene: plan-cache LRU eviction bounds and builder
+config-conflict warnings (long-lived sessions must not grow HBM pins
+without bound, and a second builder must not silently lose its
+settings)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from matrel_tpu.config import MatrelConfig
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.session import MatrelSession, reset_session
+
+
+class TestPlanCacheEviction:
+    def test_count_bound_evicts_lru(self, mesh8, rng):
+        sess = MatrelSession(
+            mesh=mesh8, config=MatrelConfig(plan_cache_max_plans=3))
+        mats = [BlockMatrix.from_numpy(
+            rng.standard_normal((8, 8)).astype(np.float32), mesh=mesh8)
+            for _ in range(5)]
+        for m in mats:
+            sess.compute(m.expr().t())
+        assert sess.plan_cache_info()["plans"] == 3
+        keys_before = list(sess._plan_cache)
+        # the OLDEST (mats[0]) was evicted: recomputing it recompiles,
+        # inserting a fresh entry and evicting the current LRU
+        sess.compute(mats[0].expr().t())
+        keys_after = list(sess._plan_cache)
+        assert keys_after[-1] not in keys_before   # new entry appended
+        assert keys_before[0] not in keys_after    # LRU evicted
+        assert sess.plan_cache_info()["plans"] == 3
+
+    def test_lru_order_on_hit(self, mesh8, rng):
+        sess = MatrelSession(
+            mesh=mesh8, config=MatrelConfig(plan_cache_max_plans=2))
+        a = BlockMatrix.from_numpy(
+            rng.standard_normal((8, 8)).astype(np.float32), mesh=mesh8)
+        b = BlockMatrix.from_numpy(
+            rng.standard_normal((8, 8)).astype(np.float32), mesh=mesh8)
+        c = BlockMatrix.from_numpy(
+            rng.standard_normal((8, 8)).astype(np.float32), mesh=mesh8)
+        pa = sess.compile(a.expr().t())
+        sess.compile(b.expr().t())
+        assert sess.compile(a.expr().t()) is pa    # hit refreshes a
+        sess.compile(c.expr().t())                 # evicts b (LRU)
+        assert sess.compile(a.expr().t()) is pa    # a survived
+        assert sess.plan_cache_info()["plans"] == 2
+
+    def test_byte_budget_evicts_hoisted_payloads(self, mesh8, rng):
+        # COO plans hoist their table payloads into extra_args; a tiny
+        # byte budget must evict old plans once exceeded
+        from matrel_tpu.core.coo import COOMatrix
+        sess = MatrelSession(
+            mesh=mesh8, config=MatrelConfig(plan_cache_max_bytes=1,
+                                            plan_cache_max_plans=64))
+        x = BlockMatrix.from_numpy(
+            rng.standard_normal((2000, 2)).astype(np.float32),
+            mesh=mesh8)
+        plans = []
+        for seed in range(3):
+            # ≥1 MB of plan tables so the payloads actually hoist
+            m = 400_000
+            r = rng.integers(0, 2000, m)
+            c = rng.integers(0, 2000, m)
+            v = rng.standard_normal(m).astype(np.float32)
+            A = COOMatrix.from_edges(r, c, v, shape=(2000, 2000))
+            plans.append(sess.compile(A.multiply(x.expr())))
+        assert any(p.extra_args for p in plans), \
+            "fixture too small: nothing hoisted"
+        info = sess.plan_cache_info()
+        # with a 1-byte budget only the newest plan may stay
+        assert info["plans"] == 1
+        # sole-plan exception: the just-inserted plan is never evicted
+        assert list(sess._plan_cache.values())[0] is plans[-1]
+
+    def test_sole_plan_never_evicted(self, mesh8, rng):
+        from matrel_tpu.core.coo import COOMatrix
+        sess = MatrelSession(
+            mesh=mesh8, config=MatrelConfig(plan_cache_max_bytes=1))
+        r = rng.integers(0, 500, 20_000)
+        c = rng.integers(0, 500, 20_000)
+        A = COOMatrix.from_edges(r, c, shape=(500, 500))
+        x = BlockMatrix.from_numpy(
+            rng.standard_normal((500, 2)).astype(np.float32), mesh=mesh8)
+        p = sess.compile(A.multiply(x.expr()))
+        assert sess.compile(A.multiply(x.expr())) is p
+
+
+class TestBuilderConflicts:
+    def test_explicit_config_conflict_warns(self, caplog):
+        reset_session()
+        s1 = MatrelSession.builder().config(use_pallas=True).get_or_create()
+        with caplog.at_level(logging.WARNING, logger="matrel_tpu"):
+            s2 = MatrelSession.builder().config(
+                use_pallas=False).get_or_create()
+        assert s2 is s1
+        assert any("ignoring the requested config" in r.message
+                   for r in caplog.records)
+
+    def test_default_builder_does_not_warn(self, caplog):
+        reset_session()
+        MatrelSession.builder().config(block_size=256).get_or_create()
+        with caplog.at_level(logging.WARNING, logger="matrel_tpu"):
+            MatrelSession.builder().get_or_create()
+        assert not [r for r in caplog.records
+                    if "ignoring the requested" in r.message]
+
+    def test_mesh_conflict_warns(self, mesh8, mesh4x2, caplog):
+        reset_session()
+        MatrelSession.builder().mesh(mesh8).get_or_create()
+        with caplog.at_level(logging.WARNING, logger="matrel_tpu"):
+            MatrelSession.builder().mesh(mesh4x2).get_or_create()
+        assert any("ignoring the requested mesh" in r.message
+                   for r in caplog.records)
+
+    def test_same_mesh_no_warning(self, mesh8, caplog):
+        reset_session()
+        MatrelSession.builder().mesh(mesh8).get_or_create()
+        with caplog.at_level(logging.WARNING, logger="matrel_tpu"):
+            MatrelSession.builder().mesh(mesh8).get_or_create()
+        assert not [r for r in caplog.records
+                    if "ignoring the requested" in r.message]
