@@ -1,0 +1,34 @@
+# matrel_tpu developer entry points.
+#
+# test       — full CPU suite on the simulated 8-device mesh
+# soak       — oracle fuzz batteries on CPU (fast sanity)
+# soak-tpu   — on-chip soak with relay-wedge-safe probe/timeouts;
+#              result appended to PROGRESS.jsonl (tools/soak_guard.py).
+#              The real-chip run is the only place Mosaic bf16 behavior
+#              is exercised — run it after any kernel change.
+# multihost  — 2- and 4-process Gloo collectives (DCN shape)
+# native     — build the C++ optimizer/ingestion core
+# bench      — the driver's headline metric (TPU; wedge-safe)
+
+PY ?= python
+SEEDS ?= 10
+
+.PHONY: test soak soak-tpu multihost native bench
+
+test:
+	$(PY) -m pytest tests/ -q
+
+soak:
+	$(PY) tools/soak.py all --seeds 25
+
+soak-tpu:
+	$(PY) tools/soak_guard.py --seeds $(SEEDS)
+
+multihost:
+	$(PY) -m pytest tests/test_multihost.py -q
+
+native:
+	$(MAKE) -C native
+
+bench:
+	$(PY) bench.py
