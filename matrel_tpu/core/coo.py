@@ -412,6 +412,68 @@ class COOMatrix:
         out._coalesced = True
         return out
 
+    def join_on_value(self, other: "COOMatrix", merge="mul",
+                      predicate="eq", max_pairs: int = 1 << 22):
+        """⋈ on values over NONZERO entry tuples — the edge-list-native
+        value join (the dense IR's pair matrix ranges over ALL logical
+        entries; here only stored nonzeros join, the relational
+        entry-tuple semantics of the reference's sparse value joins).
+
+        predicate: "eq"/"lt"/"le"/"gt"/"ge" (sort-based matching,
+        O((na+nb)·log nb) before materialising pairs) or a vectorised
+        callable over (va, vb) (brute-force, capped). merge: one of
+        "left"/"right"/"add"/"mul" or a vectorised callable.
+
+        Returns matched pairs as a tuple of numpy arrays
+        ``(ia, ja, ib, jb, value)`` — A-coordinates, B-coordinates,
+        merged value per pair. Refuses to materialise more than
+        ``max_pairs`` pairs with a clear error.
+        """
+        A = self.coalesce()
+        B = other.coalesce()
+        # zero-valued entries (duplicate cancellation) are ABSENT under
+        # the masked entry semantics — they never join
+        nza = A.vals != 0
+        nzb = B.vals != 0
+        a_rows, a_cols = A.rows[nza], A.cols[nza]
+        b_rows, b_cols = B.rows[nzb], B.cols[nzb]
+        va = A.vals[nza].astype(np.float32)
+        vb = B.vals[nzb].astype(np.float32)
+        merge_np = {"left": lambda x, y: x, "right": lambda x, y: y,
+                    "add": np.add, "mul": np.multiply}.get(merge, merge)
+        if not callable(merge_np):
+            raise ValueError(f"unknown merge {merge!r}")
+        if callable(predicate):
+            if va.size * vb.size > max_pairs:
+                raise ValueError(
+                    f"callable-predicate value join must enumerate "
+                    f"{va.size}x{vb.size} pairs (> max_pairs = "
+                    f"{max_pairs}); use a structured predicate "
+                    f"('eq'/'lt'/'le'/'gt'/'ge') or raise max_pairs")
+            mask = np.asarray(predicate(va[:, None], vb[None, :]), bool)
+            pa, pb = np.nonzero(mask)
+        else:
+            # shared predicate→range semantics (incl. IEEE NaN
+            # handling) with the streaming executor path
+            from matrel_tpu.relational.value_join import match_range
+            order = np.argsort(vb, kind="stable")   # NaNs sort last
+            sv = vb[order]
+            lo, hi = match_range(sv, va, predicate, xp=np)
+            cnt = hi - lo
+            total = int(cnt.sum())
+            if total > max_pairs:
+                raise ValueError(
+                    f"value join matches {total} pairs (> max_pairs = "
+                    f"{max_pairs}); tighten the predicate or raise "
+                    f"max_pairs")
+            pa = np.repeat(np.arange(va.size), cnt)
+            # pair k of entry i maps to sorted-B slot lo[i] + offset
+            offs = np.arange(total) - np.repeat(
+                np.cumsum(cnt) - cnt, cnt)
+            pb = order[np.repeat(lo, cnt) + offs]
+        vals = np.asarray(merge_np(va[pa], vb[pb]), np.float32)
+        return (a_rows[pa], a_cols[pa], b_rows[pb], b_cols[pb], vals)
+
     # ------------------------------------------------------------ DSL
     def expr(self):
         """Enter the lazy IR as an element-sparse leaf: matmuls against

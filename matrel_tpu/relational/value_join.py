@@ -39,27 +39,47 @@ _MERGE_SWAP = {"left": "right", "right": "left", "add": "add",
 AGG_KINDS = ("sum", "count", "avg", "max", "min")
 
 
-def _match_range(sv, x, pred: str):
-    """[lo, hi) into ascending-sorted ``sv`` of the entries matching
-    predicate(x, vb) — every structured predicate selects a contiguous
-    run. x: (q,) query values → (lo, hi): (q,) int32."""
+def match_range(sv, x, pred: str, xp=jnp):
+    """[lo, hi) into ascending-sorted ``sv`` (NaNs sorted last) of the
+    entries matching predicate(x, vb) — every structured predicate
+    selects a contiguous run. x: (q,) query values → (lo, hi): (q,)
+    int32. The SINGLE implementation of the predicate→range semantics,
+    shared by the streaming executor path (xp=jnp) and
+    COOMatrix.join_on_value (xp=np).
+
+    IEEE comparison semantics: NaN on either side matches NOTHING for
+    the five comparison predicates (sort puts B's NaNs last; ranges
+    clamp to the non-NaN prefix, NaN queries get empty ranges) —
+    matching the dense masked lowering, where pred(NaN, ·) is False.
+    "always" (predicate omitted) keeps every pair incl. NaNs, again
+    like the dense path."""
     nb = sv.shape[0]
+    i32 = (lambda a: a.astype(xp.int32))
     if pred == "always":      # predicate omitted: every pair matches
-        z = jnp.zeros(x.shape, jnp.int32)
-        return z, jnp.full_like(z, nb)
-    left = jnp.searchsorted(sv, x, side="left").astype(jnp.int32)
-    right = jnp.searchsorted(sv, x, side="right").astype(jnp.int32)
+        z = i32(xp.zeros(x.shape))
+        return z, xp.full_like(z, nb)
+    n_valid = i32(nb - xp.isnan(sv).sum())
+    left = i32(xp.searchsorted(sv, x, side="left"))
+    right = i32(xp.searchsorted(sv, x, side="right"))
     if pred == "eq":
-        return left, right
-    if pred == "lt":          # vb > x
-        return right, jnp.full_like(right, nb)
-    if pred == "le":          # vb >= x
-        return left, jnp.full_like(left, nb)
-    if pred == "gt":          # vb < x
-        return jnp.zeros_like(left), left
-    if pred == "ge":          # vb <= x
-        return jnp.zeros_like(right), right
-    raise ValueError(f"unknown structured predicate {pred!r}")
+        lo, hi = left, right
+    elif pred == "lt":        # vb > x
+        lo, hi = right, xp.full_like(right, nb)
+    elif pred == "le":        # vb >= x
+        lo, hi = left, xp.full_like(left, nb)
+    elif pred == "gt":        # vb < x
+        lo, hi = xp.zeros_like(left), left
+    elif pred == "ge":        # vb <= x
+        lo, hi = xp.zeros_like(right), right
+    else:
+        raise ValueError(f"unknown structured predicate {pred!r}")
+    lo = xp.minimum(lo, n_valid)
+    hi = xp.minimum(hi, n_valid)
+    hi = xp.where(xp.isnan(x), lo, hi)    # NaN query: empty range
+    return lo, hi
+
+
+_match_range = match_range
 
 
 def _range_eq_count(sv, v, lo, hi):
@@ -91,7 +111,10 @@ def entry_stats(va, vb, pred: str, merge: str):
     # off by 20% at 16.7M entries); centering keeps the cumsum at
     # random-walk magnitude and restores the mean term exactly as
     # cnt·mean (cnt is integer-exact below 2^24 per range)
-    mean = jnp.mean(sv)
+    # nanmean + NaNs-last sorting: the comparison predicates clamp
+    # their ranges to the non-NaN prefix, so the poisoned cumsum tail
+    # is never read (and "always" keeps dense NaN propagation)
+    mean = jnp.nanmean(sv)
     ps = jnp.concatenate([jnp.zeros(1, jnp.float32),
                           jnp.cumsum(sv - mean, dtype=jnp.float32)])
     lo, hi = _match_range(sv, va, pred)

@@ -20,6 +20,7 @@ Grammar (Python-expression syntax, parsed via ``ast`` — no eval):
         rowsum(e) colsum(e) sum(e) trace(e) vec(e)
         rowmax/rowmin/colmax/colmin/rowcount/rowavg/colcount/colavg(e)
         power(e, p)  norm(e [, "fro"|"l1"|"max"])
+        rankone(a, u, v)   A + u·vᵀ (optimizer pushes through multiplies)
         select(e, "v > 0" [, fill])     σ on entry values
         selectrows(e, "i % 2 == 0")     σ on row index
         selectcols(e, "j < 4")          σ on col index
@@ -221,6 +222,9 @@ class _Compiler(ast.NodeVisitor):
             return self._expr(args[0]).norm(kind)
         if name in ("inverse", "inv"):
             return self._expr(args[0]).inverse()
+        if name in ("rankone", "rankoneupdate"):
+            return self._expr(args[0]).rank_one_update(
+                self._expr(args[1]), self._expr(args[2]))
         if name == "solve":
             return self._expr(args[0]).solve(self._expr(args[1]))
         if name in _AGG_FNS:

@@ -407,3 +407,102 @@ class TestCOORelational:
         assert A.norm() == pytest.approx(np.linalg.norm(d))
         assert A.norm("l1") == pytest.approx(np.abs(d).sum())
         assert A.norm("max") == pytest.approx(np.abs(d).max())
+
+
+class TestCOOValueJoin:
+    """Edge-list-native ⋈ on values: nonzero entry tuples matched by
+    structured (sorted) or callable (capped brute) predicates."""
+
+    def _oracle(self, A, B, merge_np, pred_np):
+        sa = A.to_dense()
+        sb = B.to_dense()
+        ia, ja = np.nonzero(sa)
+        ib, jb = np.nonzero(sb)
+        pairs = []
+        for x, (i, j) in zip(sa[ia, ja], zip(ia, ja)):
+            for y, (k, l) in zip(sb[ib, jb], zip(ib, jb)):
+                if pred_np(x, y):
+                    pairs.append((i, j, k, l, merge_np(x, y)))
+        return sorted(pairs)
+
+    def _got(self, res):
+        return sorted(zip(*(a.tolist() for a in res[:4]),
+                          res[4].tolist()))
+
+    @pytest.mark.parametrize("pred", ["eq", "lt", "le", "gt", "ge"])
+    def test_structured_matches_bruteforce(self, rng, pred):
+        import operator
+        pool = np.array([-2.0, -1.0, 1.0, 1.0, 2.0], np.float32)
+        r, c = rng.integers(0, 20, 60), rng.integers(0, 15, 60)
+        A = COOMatrix.from_edges(r, c, rng.choice(pool, 60),
+                                 shape=(20, 15))
+        r2, c2 = rng.integers(0, 10, 40), rng.integers(0, 12, 40)
+        B = COOMatrix.from_edges(r2, c2, rng.choice(pool, 40),
+                                 shape=(10, 12))
+        ops = {"eq": operator.eq, "lt": operator.lt, "le": operator.le,
+               "gt": operator.gt, "ge": operator.ge}
+        got = self._got(A.join_on_value(B, merge="mul", predicate=pred))
+        want = self._oracle(A, B, operator.mul, ops[pred])
+        assert [g[:4] for g in got] == [w[:4] for w in want]
+        np.testing.assert_allclose([g[4] for g in got],
+                                   [w[4] for w in want], rtol=1e-6)
+
+    def test_callable_pred_and_merges(self, rng):
+        r, c = rng.integers(0, 8, 20), rng.integers(0, 8, 20)
+        A = COOMatrix.from_edges(r, c, rng.standard_normal(20),
+                                 shape=(8, 8))
+        B = COOMatrix.from_edges(c, r, rng.standard_normal(20),
+                                 shape=(8, 8))
+        got = self._got(A.join_on_value(
+            B, merge=lambda x, y: x - y,
+            predicate=lambda x, y: x + y > 0.5))
+        want = self._oracle(A, B, lambda x, y: x - y,
+                            lambda x, y: x + y > 0.5)
+        assert [g[:4] for g in got] == [w[:4] for w in want]
+        # structured merges
+        ia, ja, ib, jb, v = A.join_on_value(B, merge="left",
+                                            predicate="ge")
+        dense_a = A.to_dense()
+        np.testing.assert_allclose(v, dense_a[ia, ja], rtol=1e-6)
+
+    def test_pair_cap_refusal(self, rng):
+        r = rng.integers(0, 100, 3000)
+        c = rng.integers(0, 100, 3000)
+        A = COOMatrix.from_edges(r, c, np.ones(3000), shape=(100, 100))
+        with pytest.raises(ValueError, match="max_pairs"):
+            A.join_on_value(A, merge="mul", predicate="eq",
+                            max_pairs=10)
+        with pytest.raises(ValueError, match="max_pairs"):
+            A.join_on_value(A, merge="mul",
+                            predicate=lambda x, y: x == y,
+                            max_pairs=10)
+
+    def test_zero_entries_never_join(self):
+        # duplicate cancellation produces an explicit zero entry; it
+        # must be absent from the join
+        A = COOMatrix.from_edges([0, 0, 1], [0, 0, 1], [1.0, -1.0, 2.0],
+                                 shape=(2, 2))
+        B = COOMatrix.from_edges([0], [0], [0.5], shape=(1, 1))
+        ia, ja, ib, jb, v = A.join_on_value(B, merge="mul",
+                                            predicate="gt")
+        assert list(zip(ia, ja)) == [(1, 1)]
+        np.testing.assert_allclose(v, [1.0])
+
+    def test_nan_entries_match_nothing_structured(self):
+        # IEEE: NaN compares False — structured and callable paths agree
+        A = COOMatrix.from_edges([0, 1], [0, 1], [1.0, np.nan],
+                                 shape=(2, 2))
+        B = COOMatrix.from_edges([0, 1], [0, 1], [np.nan, 2.0],
+                                 shape=(2, 2))
+        for pred_s, pred_f in [("lt", lambda x, y: x < y),
+                               ("eq", lambda x, y: x == y),
+                               ("ge", lambda x, y: x >= y)]:
+            got_s = A.join_on_value(B, merge="left", predicate=pred_s)
+            got_f = A.join_on_value(B, merge="left", predicate=pred_f)
+            assert got_s[0].tolist() == got_f[0].tolist(), pred_s
+            assert got_s[3].tolist() == got_f[3].tolist(), pred_s
+        # only the (1.0, 2.0) pair can ever match 'lt'
+        ia, ja, ib, jb, v = A.join_on_value(B, merge="right",
+                                            predicate="lt")
+        assert list(zip(ia, ja, ib, jb)) == [(0, 0, 1, 1)]
+        np.testing.assert_allclose(v, [2.0])

@@ -329,3 +329,22 @@ class TestJoinSchemeSelection:
         got = e.compute().to_numpy()
         want = (a[:, :, None] * b[:, None, :]).reshape(6, 15)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_streaming_join_nan_semantics(mesh8):
+    # dense lowering: pred(NaN, .) is False -> NaN rows/cols contribute
+    # nothing under comparison predicates; the sorted streaming path
+    # must agree (NaNs clamp out of every range)
+    a = np.array([[1.0, np.nan], [0.5, 2.0]], np.float32)
+    b = np.array([[np.nan, 1.5]], np.float32)
+    j = R.join_on_values(bm(a, mesh8), bm(b, mesh8), merge="left",
+                         predicate="lt")
+    got = R.aggregate(j, "count", "row").compute().to_numpy()[:, 0]
+    va = a.T.reshape(-1)
+    vb = b.T.reshape(-1)
+    with np.errstate(invalid="ignore"):
+        P = np.where(va[:, None] < vb[None, :], va[:, None], 0.0)
+    want = (np.nan_to_num(P) != 0).sum(axis=1)
+    np.testing.assert_allclose(got, want)
+    s = R.aggregate(j, "sum", "all").compute().to_numpy()[0, 0]
+    np.testing.assert_allclose(s, np.nan_to_num(P).sum(), rtol=1e-6)

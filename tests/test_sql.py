@@ -242,3 +242,17 @@ def test_trailing_semicolons_and_case(sess):
     s, a, b = sess
     out = s.compute(s.sql("SeLeCt rowsum(A) FROM A;;")).to_numpy()
     np.testing.assert_allclose(out, a.sum(1, keepdims=True), rtol=1e-4)
+
+
+def test_rankone(sess):
+    s, a, b = sess
+    u = np.random.default_rng(1).standard_normal((8, 1)).astype(np.float32)
+    v = np.random.default_rng(2).standard_normal((6, 1)).astype(np.float32)
+    s.register("U", s.from_numpy(u))
+    s.register("V", s.from_numpy(v))
+    out = s.compute(s.sql("rankone(A, U, V)")).to_numpy()
+    np.testing.assert_allclose(out, a + u @ v.T, rtol=1e-5, atol=1e-5)
+    # pushed through a multiply: still correct end-to-end
+    out2 = s.compute(s.sql("rankone(A, U, V) * B")).to_numpy()
+    np.testing.assert_allclose(out2, (a + u @ v.T) @ b, rtol=1e-4,
+                               atol=1e-4)
