@@ -226,3 +226,57 @@ def test_fuzz_on_square_mesh(seed, mesh_square):
     oracle = np_eval(e, env)
     got = compile_expr(e, mesh_square, MatrelConfig()).run().to_numpy()
     np.testing.assert_allclose(got, oracle, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("seed", range(60, 75))
+def test_fuzz_value_join_streaming_vs_pair_matrix(seed, mesh8):
+    """The streaming agg(join_on_value) lowerings (sort-based and
+    chunked) must equal the materialised pair matrix aggregated with
+    the dense rules, across random shapes, predicates, merges, zero/
+    duplicate-heavy values, aggregate kinds and axes."""
+    rng = np.random.default_rng(seed)
+    pool = np.array([-2.0, -1.0, -1.0, 0.0, 0.0, 0.5, 1.0, 1.0, 3.0],
+                    np.float32)
+    sa = (int(rng.integers(2, 7)), int(rng.integers(2, 7)))
+    sb = (int(rng.integers(2, 7)), int(rng.integers(2, 7)))
+    a = rng.choice(pool, sa).astype(np.float32)
+    b = rng.choice(pool, sb).astype(np.float32)
+    A = E.leaf(BlockMatrix.from_numpy(a, mesh=mesh8))
+    B = E.leaf(BlockMatrix.from_numpy(b, mesh=mesh8))
+
+    structured = bool(rng.random() < 0.7)
+    if structured:
+        pred = str(rng.choice(["eq", "lt", "le", "gt", "ge"]))
+        merge = str(rng.choice(["left", "right", "add", "mul"]))
+        pred_np = {"eq": np.equal, "lt": np.less, "le": np.less_equal,
+                   "gt": np.greater, "ge": np.greater_equal}[pred]
+        merge_np = {"left": lambda x, y: x + 0 * y,
+                    "right": lambda x, y: y + 0 * x,
+                    "add": np.add, "mul": np.multiply}[merge]
+    else:
+        pred = pred_np = lambda x, y: x + y > 0.25
+        merge = merge_np = lambda x, y: x * y - x
+    kind = str(rng.choice(["sum", "count", "avg", "max", "min"]))
+    axis = str(rng.choice(["row", "col", "all"]))
+
+    va, vb = a.T.reshape(-1), b.T.reshape(-1)
+    P = merge_np(va[:, None].astype(np.float64), vb[None, :])
+    P = np.where(pred_np(va[:, None], vb[None, :]), P, 0.0)
+    ax = {"row": 1, "col": 0, "all": None}[axis]
+    if kind == "sum":
+        want = P.sum(axis=ax)
+    elif kind == "count":
+        want = (P != 0).sum(axis=ax).astype(np.float64)
+    elif kind == "avg":
+        s, c = P.sum(axis=ax), (P != 0).sum(axis=ax)
+        want = np.where(c > 0, s / np.maximum(c, 1), 0.0)
+    else:
+        want = (np.max if kind == "max" else np.min)(P, axis=ax)
+
+    expr = E.agg(E.join_on_value(A, B, merge, pred), kind, axis)
+    out = compile_expr(expr, mesh8, MatrelConfig()).run().to_numpy()
+    got = {"row": out[:, 0], "col": out[0], "all": out[0, 0]}[axis]
+    np.testing.assert_allclose(
+        got, want, rtol=1e-4, atol=1e-4,
+        err_msg=f"seed {seed}: {pred}/{merge}/{kind}/{axis} "
+                f"structured={structured}")
