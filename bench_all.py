@@ -15,6 +15,7 @@ counts (see bench.py). Run on the real chip: `python bench_all.py`.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -177,6 +178,31 @@ def bench_north_star(mesh, cfg):
 
 
 def main():
+    # probe the backend FIRST (subprocess + hard timeout, with
+    # bench.py's retry/backoff schedule) — while the axon relay is
+    # wedged, backend init HANGS rather than erroring, and this process
+    # would block before printing anything (docs/INTERNALS.md "relay
+    # can wedge"). NOTE this bounds the wedged-at-start case only: a
+    # wedge striking MID-run still hangs the current benchmark — this
+    # is an operator-attended tool; the driver's unattended capture
+    # path (bench.py) isolates every TPU stage in its own timed
+    # subprocess instead.
+    import bench
+    errors = []
+    for attempt in range(1 + len(bench.BACKOFFS_S)):
+        if attempt > 0:
+            delay = bench.BACKOFFS_S[attempt - 1]
+            print(f"# probe failed ({errors[-1]}); retrying in {delay}s",
+                  file=sys.stderr, flush=True)
+            time.sleep(delay)
+        ok, payload = bench._run_child("probe", bench.PROBE_TIMEOUT_S)
+        if ok:
+            break
+        errors.append(str(payload))
+    else:
+        print(json.dumps({"metric": "bench_all",
+                          "error": "; ".join(errors)[-800:]}), flush=True)
+        sys.exit(2)
     from matrel_tpu.config import MatrelConfig, set_default_config
     from matrel_tpu.core import mesh as mesh_lib
     cfg = MatrelConfig()
