@@ -548,6 +548,19 @@ class Lowerer:
                 f"{expr_mod.JOIN_MERGES}) for the O(n log n) sort "
                 f"path, or raise the cap.")
         va, vb, out_dtype = self._entry_vectors(jnode, ev)
+        if structured and axis != "diag" and self.mesh.size > 1:
+            # the sort path is embarrassingly parallel over the
+            # query side after the sort: shard the query entries
+            # across every device (sorted operand replicated), so
+            # searchsorted/prefix-gathers run on na/P entries per chip
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            axes = tuple(self.mesh.axis_names)
+            flat = NamedSharding(self.mesh, P(axes))
+            repl = NamedSharding(self.mesh, P())
+            sa, sb = ((flat, repl) if axis in ("row", "all")
+                      else (repl, flat))            # col: roles swap
+            va = jax.lax.with_sharding_constraint(va, sa)
+            vb = jax.lax.with_sharding_constraint(vb, sb)
         if axis == "diag":
             L = min(na, nb)
             d = merge_fn(va[:L], vb[:L])
