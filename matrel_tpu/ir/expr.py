@@ -484,5 +484,12 @@ def pretty(e: MatExpr, indent: int = 0) -> str:
         extra = f" {e.attrs['agg']}/{e.attrs['axis']}"
     elif e.kind == "matmul" and "strategy" in e.attrs:
         extra = f" strategy={e.attrs['strategy']}"
+    elif e.kind in ("join_rows", "join_cols") and "replicate" in e.attrs:
+        extra = f" replicate={e.attrs['replicate']}"
+    elif e.kind == "join_value":
+        mk = e.attrs.get("merge_kind") or "<callable>"
+        pk = e.attrs.get("pred_kind") or (
+            "<callable>" if e.attrs.get("predicate") else "always")
+        extra = f" merge={mk} pred={pk}"
     line = f"{pad}{e.kind}{extra} shape={e.shape} nnz={e.nnz}\n"
     return line + "".join(pretty(c, indent + 1) for c in e.children)
