@@ -110,11 +110,16 @@ def compact_tables(plan: spmv_lib.EdgeSpMVPlan):
         cr = cap // LANE
         shp = (nb, cr, LANE)
         # lane stays int8 on device (the kernel compares it against an
-        # iota of its own dtype): 13 B/slot total, as advertised
-        dev = (jnp.asarray(np.asarray(plan.src8).reshape(shp)),
-               jnp.asarray(np.asarray(plan.lane).reshape(shp)),
-               jnp.asarray(np.asarray(plan.off).reshape(shp)),
-               jnp.asarray(np.asarray(plan.val).reshape(shp)))
+        # iota of its own dtype): 13 B/slot total, as advertised.
+        # Eager even when first called from inside an executor trace —
+        # the memo must hold COMMITTED arrays, not tracers (a cached
+        # tracer escapes its trace and poisons every later use of this
+        # plan; found by the single-device interpret CI test)
+        with jax.ensure_compile_time_eval():
+            dev = (jnp.asarray(np.asarray(plan.src8).reshape(shp)),
+                   jnp.asarray(np.asarray(plan.lane).reshape(shp)),
+                   jnp.asarray(np.asarray(plan.off).reshape(shp)),
+                   jnp.asarray(np.asarray(plan.val).reshape(shp)))
         plan._compact_dev = dev
     return dev
 

@@ -221,13 +221,49 @@ class TestDSLIntegration:
 
 
 class TestCompactShardedExecutor:
-    """DSL coo_leaf matmuls on a multi-device mesh must run the
-    compact-table Pallas path (13 B/slot, row-decomposed per device) —
-    the expanded ~224 B/slot XLA tables must never be built."""
+    """DSL coo_leaf matmuls must run the compact-table Pallas path
+    (13 B/slot; row-decomposed per device on a mesh) — the expanded
+    ~224 B/slot XLA tables must never be built. Single-device compact
+    branches are covered here too (interpret mode in CI)."""
 
     def _cfg(self):
         from matrel_tpu.config import MatrelConfig
         return MatrelConfig(pallas_interpret=True)
+
+    @staticmethod
+    def _forbid_expanded(plan):
+        """Spy: the expanded-table path goes through plan.arrays()."""
+        def _boom(*a, **k):
+            raise AssertionError("expanded tables built")
+        object.__setattr__(plan, "arrays", _boom)
+
+    def test_single_device_compact_interpret(self, rng):
+        # mesh.size == 1 takes the UNSHARDED compact branch
+        # (compact_apply / compact_matmat_apply); regression cover for
+        # the cached-tracer bug (compact_tables memoised tracers when
+        # first called inside an executor trace)
+        import jax
+        from matrel_tpu import execute
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.core import mesh as mesh_lib
+        mesh1 = mesh_lib.make_mesh((1, 1), devices=jax.devices()[:1])
+        r, c, v = random_coo(rng, 600, 500, 5000)
+        A = COOMatrix.from_edges(r, c, v, shape=(600, 500))
+        x = rng.standard_normal((500, 3)).astype(np.float32)
+        self._forbid_expanded(A._get_plan())
+        out = execute(A.multiply(BlockMatrix.from_numpy(
+            x, mesh=mesh1).expr()), mesh=mesh1, config=self._cfg())
+        np.testing.assert_allclose(out.to_numpy(), A.to_dense() @ x,
+                                   rtol=3e-4, atol=3e-4)
+        # memo must hold committed arrays, not trace leftovers
+        assert not isinstance(A._plan._compact_dev[0], jax.core.Tracer)
+        # single vector → matvec kernel branch; plan reused across
+        # compiles (the sequence the cached-tracer bug broke)
+        x1 = rng.standard_normal((500, 1)).astype(np.float32)
+        out1 = execute(A.multiply(BlockMatrix.from_numpy(
+            x1, mesh=mesh1).expr()), mesh=mesh1, config=self._cfg())
+        np.testing.assert_allclose(out1.to_numpy(), A.to_dense() @ x1,
+                                   rtol=3e-4, atol=3e-4)
 
     def test_left_multiply_compact_on_mesh(self, mesh8, rng):
         from matrel_tpu import execute
@@ -241,9 +277,7 @@ class TestCompactShardedExecutor:
         # uncached tracers, so _tables stays None on BOTH paths — state
         # alone can't discriminate)
         plan = A._get_plan()
-        def _boom(*a, **k):
-            raise AssertionError("expanded tables built on a mesh")
-        object.__setattr__(plan, "arrays", _boom)
+        self._forbid_expanded(plan)
         out = execute(A.multiply(X.expr()), mesh=mesh8,
                       config=self._cfg())
         np.testing.assert_allclose(out.to_numpy(), A.to_dense() @ x,
