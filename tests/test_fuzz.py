@@ -72,6 +72,16 @@ def np_eval(e, env):
     if k == "join_index":
         a, b = (np_eval(c, env) for c in e.children)
         return np.asarray(e.attrs["merge"](a, b), dtype=np.float32)
+    if k == "join_value":
+        a, b = (np_eval(c, env) for c in e.children)
+        va = a.T.reshape(-1)
+        vb = b.T.reshape(-1)
+        P = np.asarray(e.attrs["merge"](va[:, None], vb[None, :]))
+        if e.attrs["predicate"] is not None:
+            mask = np.asarray(e.attrs["predicate"](va[:, None],
+                                                   vb[None, :]))
+            P = np.where(mask, P, 0.0)
+        return P.astype(np.float32)
     if k == "select_index":
         x = np_eval(e.children[0], env).copy()
         rows, cols = e.attrs["rows"], e.attrs["cols"]
@@ -115,8 +125,8 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",)):
         return leaf_of(shape)
     choice = rng.choice(
         ["matmul", "elemwise", "scalar", "transpose", "agg_chain",
-         "select", "select_value", "join_index", "rank1", "solve",
-         "leaf"])
+         "select", "select_value", "join_index", "join_value", "rank1",
+         "solve", "leaf"])
     if choice == "matmul":
         k = int(rng.choice(dims[1:]))
         a = gen_expr(rng, env, mesh, depth - 1, (shape[0], k), leaf_kinds)
@@ -158,6 +168,17 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",)):
         a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
         b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
         return E.join_on_index(a, b, lambda x, y: x * y + x)
+    if choice == "join_value":
+        # pair matrix shaped (s0, s1) from column-vector operands; a
+        # parent agg triggers the streaming lowering, otherwise the
+        # capped materialisation runs — both fuzzed here
+        a = gen_expr(rng, env, mesh, depth - 1, (shape[0], 1),
+                     leaf_kinds)
+        b = gen_expr(rng, env, mesh, depth - 1, (shape[1], 1),
+                     leaf_kinds)
+        merge = str(rng.choice(["left", "right", "add", "mul"]))
+        pred = str(rng.choice(["eq", "lt", "le", "gt", "ge"]))
+        return E.join_on_value(a, b, merge, pred)
     if choice == "solve":
         # well-conditioned lhs: a random leaf shifted to diagonal
         # dominance, so the numpy oracle and the LU solve both stay
