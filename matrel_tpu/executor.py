@@ -548,7 +548,12 @@ class Lowerer:
                 f"{expr_mod.JOIN_MERGES}) for the O(n log n) sort "
                 f"path, or raise the cap.")
         va, vb, out_dtype = self._entry_vectors(jnode, ev)
-        if structured and axis != "diag" and self.mesh.size > 1:
+        # a tiny QUERY side isn't worth resharding (GSPMD falls back to
+        # full rematerialisation moving small leaf shardings around);
+        # the query side is va for row/all aggregates, vb for col
+        query_n = na if axis in ("row", "all") else nb
+        if (structured and axis != "diag" and self.mesh.size > 1
+                and query_n >= 128 * self.mesh.size):
             # the sort path is embarrassingly parallel over the
             # query side after the sort: shard the query entries
             # across every device (sorted operand replicated), so
