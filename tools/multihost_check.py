@@ -17,7 +17,9 @@ same control/data plane shape as hosts in a TPU pod connected over DCN
      on every process via process_allgather,
   5. the sharded one-hot SpMV (plan tables row-decomposed over the
      global mesh),
-  6. the sharded tile-stack SpMM (BlockSparseMatrix.shard()).
+  6. the sharded COMPACT-table SpMV (the TPU-default executor path,
+     pallas interpret per device),
+  7. the sharded tile-stack SpMM (BlockSparseMatrix.shard()).
 
 Run:  python tools/multihost_check.py [--nproc 2]
 Exit code 0 on success; worker logs live in a fresh temp dir (path
@@ -81,9 +83,8 @@ from matrel_tpu.ops import spmv as spmv_lib
 n_r, n_c, m = 4096, 2048, 40_000
 rows = rng.integers(0, n_r, m); cols = rng.integers(0, n_c, m)
 vals = rng.standard_normal(m).astype(np.float32)
-plan_s = spmv_lib.shard_plan(
-    spmv_lib.build_spmv_plan(rows, cols, vals, n_rows=n_r, n_cols=n_c),
-    mesh)
+plan = spmv_lib.build_spmv_plan(rows, cols, vals, n_rows=n_r, n_cols=n_c)
+plan_s = spmv_lib.shard_plan(plan, mesh)
 x = rng.standard_normal(n_c).astype(np.float32)
 y = spmv_lib.spmv_sharded(plan_s, jnp.asarray(x), mesh)
 got = np.asarray(multihost_utils.process_allgather(
@@ -92,6 +93,17 @@ want = np.zeros(n_r); np.add.at(want, rows, vals * x[cols])
 np.testing.assert_allclose(got, want, rtol=1e-4,
                            atol=1e-4 * max(abs(want).max(), 1.0))
 print(f"[p{pid}] sharded one-hot SpMV matches oracle", flush=True)
+
+# sharded COMPACT-table SpMV (the TPU-default executor path): tables
+# row-decomposed over the GLOBAL mesh, pallas interpret per device,
+# tiled all_gather crossing the process boundary
+from matrel_tpu.ops import pallas_spmv as pc
+y_c = pc.spmv_compact_sharded(plan, x, mesh, interpret=True)
+got_c = np.asarray(multihost_utils.process_allgather(
+    y_c, tiled=True)).reshape(-1)[:n_r]
+np.testing.assert_allclose(got_c, want, rtol=1e-4,
+                           atol=1e-4 * max(abs(want).max(), 1.0))
+print(f"[p{pid}] sharded compact-table SpMV matches oracle", flush=True)
 
 # sharded tile-stack SpMM
 from matrel_tpu.core.sparse import BlockSparseMatrix
