@@ -155,3 +155,13 @@ def pallas_interpret_mode(config: "MatrelConfig" = None) -> bool:
     cfg = config or default_config()
     return cfg.pallas_interpret and jax.default_backend() not in (
         "tpu", "axon")
+
+
+def resolve_interpret(interpret, config: "MatrelConfig" = None) -> bool:
+    """The single None→config resolver for per-call ``interpret``
+    parameters across every Pallas call site (ops/pallas_spmv.py,
+    ops/spmm.py, workloads/pagerank.py): an explicit True/False wins;
+    None defers to pallas_interpret_mode."""
+    if interpret is not None:
+        return bool(interpret)
+    return pallas_interpret_mode(config)

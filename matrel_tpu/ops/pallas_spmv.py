@@ -267,12 +267,9 @@ def _compact_sharded_runner(plan_static, mesh, passes: int, n_ov: int,
 
 
 def _resolve_interpret(interpret) -> bool:
-    """None → config: pallas_interpret forces interpret mode on non-TPU
-    backends so CI can drive the compact paths on the CPU mesh."""
-    if interpret is not None:
-        return interpret
-    from matrel_tpu.config import pallas_interpret_mode
-    return pallas_interpret_mode()
+    """None → config (the shared resolver in config.py)."""
+    from matrel_tpu.config import resolve_interpret
+    return resolve_interpret(interpret)
 
 
 def spmv_compact_sharded(plan: spmv_lib.EdgeSpMVPlan, x: jax.Array,

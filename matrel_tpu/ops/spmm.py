@@ -29,8 +29,10 @@ from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.core.sparse import BlockSparseMatrix
 
 
-def _use_pallas(cfg: MatrelConfig) -> bool:
-    return cfg.use_pallas and jax.default_backend() not in ("cpu",)
+def _resolve_interpret(interpret, cfg) -> bool:
+    """None → config (the shared resolver in config.py)."""
+    from matrel_tpu.config import resolve_interpret
+    return resolve_interpret(interpret, cfg)
 
 
 # Runner cache: make_spmm/_xla_spmm build a fresh jitted closure per call,
@@ -55,7 +57,13 @@ def _cached_runner(S, pm, out_pshape, d_spec, out_sharding, cfg, interpret):
            cfg.matmul_precision, interpret)
     run = _RUNNER_CACHE.get(key)
     if run is None:
-        use_pallas = interpret or _use_pallas(cfg)
+        # compiled (non-interpret) Pallas only on a real TPU backend:
+        # the resolved ``interpret`` flag already carries the
+        # pallas_interpret forcing, and an explicit interpret=False on
+        # CPU must fall through to XLA, never lower Mosaic on CPU
+        use_pallas = interpret or (
+            cfg.use_pallas
+            and jax.default_backend() in ("tpu", "axon"))
         if use_pallas:
             from matrel_tpu.ops import pallas_spmm
             # interpret mode skips the eligibility gate on purpose: it
@@ -84,7 +92,7 @@ def _dense_spec(pm: int, mesh) -> P:
 def apply(S: BlockSparseMatrix, dd: jax.Array,
           d_shape: Tuple[int, int],
           config: Optional[MatrelConfig] = None,
-          interpret: bool = False) -> jax.Array:
+          interpret=None) -> jax.Array:
     """Trace-compatible SpMM: S (static metadata) × dense padded array
     ``dd`` of logical shape ``d_shape``. Returns the padded product with
     canonical output sharding."""
@@ -93,6 +101,7 @@ def apply(S: BlockSparseMatrix, dd: jax.Array,
     k2, m = d_shape
     if k != k2:
         raise ValueError(f"spmm shape mismatch: {S.shape} x {d_shape}")
+    interpret = _resolve_interpret(interpret, cfg)
     mesh = S.mesh
     out_pshape = padding.padded_shape((n, m), mesh)
     out_sharding = padding.canonical_sharding(out_pshape, mesh)
@@ -105,7 +114,7 @@ def apply(S: BlockSparseMatrix, dd: jax.Array,
 
 def spmm(S: BlockSparseMatrix, D: BlockMatrix,
          config: Optional[MatrelConfig] = None,
-         interpret: bool = False) -> BlockMatrix:
+         interpret=None) -> BlockMatrix:
     """C = S @ D with S block-sparse (n×k), D dense (k×m)."""
     cfg = config or default_config()
     n, _ = S.shape

@@ -383,3 +383,29 @@ def test_block_sparse_norms(mesh8, rng):
     assert S.norm("max") == pytest.approx(np.abs(a).max(), rel=1e-5)
     with pytest.raises(ValueError, match="norm kind"):
         S.norm("nuclear")
+
+
+def test_pallas_interpret_config_routes_spmm(mesh8, rng, monkeypatch):
+    """MatrelConfig(pallas_interpret=True) must route block-sparse SpMM
+    through the Pallas kernel (interpret mode) on the CPU mesh — the
+    same shared gate the compact SpMV paths use."""
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.ops import pallas_spmm, spmm as spmm_lib
+    calls = []
+    real = pallas_spmm.make_spmm
+    monkeypatch.setattr(pallas_spmm, "make_spmm",
+                        lambda *a, **k: calls.append(k) or real(*a, **k))
+    sp = random_block_sparse_np(rng, 32, 24, 8, 0.5)
+    d = rng.standard_normal((24, 8)).astype(np.float32)
+    S = BlockSparseMatrix.from_numpy(sp, block_size=8, mesh=mesh8)
+    D = BlockMatrix.from_numpy(d, mesh=mesh8)
+    cfg = MatrelConfig(pallas_interpret=True)
+    out = spmm_lib.spmm(S, D, cfg).to_numpy()
+    np.testing.assert_allclose(out, sp @ d, rtol=1e-4, atol=1e-4)
+    assert calls and calls[0].get("interpret") is True
+    # default config on CPU keeps the XLA path (no new pallas runner)
+    S2 = BlockSparseMatrix.from_numpy(sp, block_size=8, mesh=mesh8)
+    n_before = len(calls)
+    out2 = spmm_lib.spmm(S2, D, MatrelConfig()).to_numpy()
+    np.testing.assert_allclose(out2, sp @ d, rtol=1e-4, atol=1e-4)
+    assert len(calls) == n_before
