@@ -211,7 +211,8 @@ def run_pagerank_compact(prepared, rounds: int = 30, alpha: float = 0.85,
     from matrel_tpu.ops import pallas_spmv as pc
     from matrel_tpu.ops import spmv as spmv_lib
     plan, dangling = prepared
-    interpret = pc._resolve_interpret(interpret)
+    from matrel_tpu.config import resolve_interpret
+    interpret = resolve_interpret(interpret)
     tables = pc.compact_tables(plan)
     ov = plan.overflow
     run = _compact_runner_loop(plan.n_rows, int(rounds), float(alpha),
@@ -346,7 +347,8 @@ def _pagerank_compact_sharded(src, dst, n: int, rounds: int, alpha: float,
     if prepared is None:
         return None
     plan, dangling = prepared
-    interpret = pc._resolve_interpret(interpret)
+    from matrel_tpu.config import resolve_interpret
+    interpret = resolve_interpret(interpret)
     tables = pc.shard_compact_tables(plan, mesh)
     ov = plan.overflow
     run = _compact_sharded_loop(
