@@ -348,3 +348,28 @@ def test_streaming_join_nan_semantics(mesh8):
     np.testing.assert_allclose(got, want)
     s = R.aggregate(j, "sum", "all").compute().to_numpy()[0, 0]
     np.testing.assert_allclose(s, np.nan_to_num(P).sum(), rtol=1e-6)
+
+
+class TestValueJoinEdgeCases:
+    @pytest.mark.parametrize("case", ["ones_1x1", "zeros", "identical",
+                                      "extreme"])
+    def test_degenerate_inputs(self, mesh8, case):
+        a, b = {
+            "ones_1x1": (np.ones((1, 1)), np.ones((1, 1))),
+            "zeros": (np.zeros((3, 3)), np.zeros((2, 2))),
+            "identical": (np.full((4, 4), 2.5), np.full((3, 3), 2.5)),
+            "extreme": (np.array([[1e30, -1e30], [1e-30, 1.0]]),
+                        np.array([[1e30], [-1e-30]])),
+        }[case]
+        a = a.astype(np.float32)
+        b = b.astype(np.float32)
+        for pred in ("eq", "le"):
+            for kind in ("sum", "count", "max", "min"):
+                j = R.join_on_values(bm(a, mesh8), bm(b, mesh8),
+                                     merge="add", predicate=pred)
+                got = R.aggregate(j, kind, "row").compute().to_numpy()
+                want = _pair_oracle(a, b, np.add, _NP_PREDS[pred],
+                                    kind, "row")
+                np.testing.assert_allclose(
+                    got[:, 0], want, rtol=1e-4, atol=1e-6,
+                    err_msg=f"{case}/{pred}/{kind}")
