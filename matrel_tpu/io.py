@@ -22,7 +22,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from matrel_tpu.config import MatrelConfig, default_config
+from matrel_tpu.config import MatrelConfig
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.core.sparse import BlockSparseMatrix
 from matrel_tpu.utils import native

@@ -21,7 +21,7 @@ chain evaluation may be needed").
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from matrel_tpu.config import MatrelConfig, default_config
 from matrel_tpu.core.blockmatrix import BlockMatrix
-from matrel_tpu.executor import compile_expr, compile_exprs
+from matrel_tpu.executor import compile_exprs
 from matrel_tpu.ir.expr import matmul, transpose
 
 

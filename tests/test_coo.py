@@ -4,7 +4,6 @@ import numpy as np
 import pytest
 import scipy.sparse as sp
 
-import jax.numpy as jnp
 
 from matrel_tpu import COOMatrix
 

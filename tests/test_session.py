@@ -6,7 +6,6 @@ settings)."""
 import logging
 
 import numpy as np
-import pytest
 
 from matrel_tpu.config import MatrelConfig
 from matrel_tpu.core.blockmatrix import BlockMatrix
