@@ -45,6 +45,10 @@ LAST_GOOD = os.path.join(_HERE, "bench_last_good.json")
 
 PROBE_TIMEOUT_S = _env_int("MATREL_BENCH_PROBE_TIMEOUT", 180)
 MEASURE_TIMEOUT_S = _env_int("MATREL_BENCH_MEASURE_TIMEOUT", 900)
+# total wall-clock budget for the retry ladder: the structured error
+# JSON must reach stdout BEFORE any outer (driver) timeout kills us —
+# a full 4-attempt ladder with backoffs would otherwise take ~19 min
+DEADLINE_S = _env_int("MATREL_BENCH_DEADLINE", 540)
 # sleeps between the 4 attempts; relay wedges clear on their own eventually
 try:
     BACKOFFS_S = tuple(
@@ -230,11 +234,19 @@ def _store_last_good(tflops: float) -> None:
 
 def main() -> None:
     base = cpu_baseline()
+    t_start = time.monotonic()
     errors: list[str] = []
     tpu: float | None = None
     for attempt in range(1 + len(BACKOFFS_S)):
         if attempt > 0:
             delay = BACKOFFS_S[attempt - 1]
+            remaining = DEADLINE_S - (time.monotonic() - t_start)
+            # a retry needs its backoff + at least one probe window
+            if delay + PROBE_TIMEOUT_S > remaining:
+                errors.append(
+                    f"deadline ({DEADLINE_S}s) reached after "
+                    f"{attempt} attempt(s)")
+                break
             print(f"# attempt {attempt} failed ({errors[-1]}); "
                   f"retrying in {delay}s", file=sys.stderr)
             time.sleep(delay)
@@ -242,7 +254,14 @@ def main() -> None:
         if not ok:
             errors.append(str(payload))
             continue
-        ok, payload = _run_child("measure", MEASURE_TIMEOUT_S)
+        # clamp the measure window to the remaining budget (120 s
+        # floor: a healthy measure runs ~60-90 s incl. compile) so a
+        # mid-run wedge still reports near the deadline instead of
+        # holding the JSON for the full MEASURE_TIMEOUT_S
+        remaining = DEADLINE_S - (time.monotonic() - t_start)
+        measure_timeout = min(MEASURE_TIMEOUT_S,
+                              max(120, int(remaining)))
+        ok, payload = _run_child("measure", measure_timeout)
         if not ok:
             errors.append(str(payload))
             continue
