@@ -159,6 +159,11 @@ class MatrelSession:
         from matrel_tpu.sql import parse_sql
         return parse_sql(query, self)
 
+    def explain_sql(self, query: str) -> str:
+        """Optimized-plan text for a SQL query — the EXPLAIN analogue
+        (strategies, join schemes and value-join kinds included)."""
+        return self.explain(self.sql(query))
+
 
 def _plan_bytes(plan: executor_lib.CompiledPlan) -> int:
     """Device bytes a cached plan pins beyond its leaf matrices: the

@@ -256,3 +256,19 @@ def test_rankone(sess):
     out2 = s.compute(s.sql("rankone(A, U, V) * B")).to_numpy()
     np.testing.assert_allclose(out2, (a + u @ v.T) @ b, rtol=1e-4,
                                atol=1e-4)
+
+
+def test_explain_sql(sess):
+    s, a, b = sess
+    txt = s.explain_sql("SELECT rowsum(A * B) FROM A, B")
+    # aggregation pushdown: in the OPTIMIZED section the plan ROOT is
+    # the matmul with the rowSum pushed beneath it (rowSum(A·B) →
+    # A·rowSum(B)); the logical section above still shows agg-on-top
+    opt = txt.split("== Optimized plan ==")[1]
+    first, second = [ln for ln in opt.splitlines() if ln.strip()][:2]
+    assert first.startswith("matmul")
+    assert "agg sum/row" in opt and not second.startswith("agg")
+    txt2 = s.explain_sql("rowsum(joinvalue(A, B, 'mul', 'lt'))")
+    assert "join_value merge=mul pred=lt" in txt2
+    txt3 = s.explain_sql("joinrows(A, A, 'x + y')")
+    assert "join_rows" in txt3
