@@ -1,0 +1,75 @@
+"""Config system: MATREL_* env overrides, dict overrides, and the
+shared Pallas gates (SURVEY.md §5 "Config / flag system")."""
+
+import numpy as np
+import pytest
+
+from matrel_tpu.config import (MatrelConfig, pallas_enabled,
+                               pallas_interpret_mode, resolve_interpret)
+
+
+class TestFromEnv:
+    def test_typed_overrides(self, monkeypatch):
+        monkeypatch.setenv("MATREL_BLOCK_SIZE", "128")
+        monkeypatch.setenv("MATREL_SPARSITY_THRESHOLD", "0.25")
+        monkeypatch.setenv("MATREL_USE_PALLAS", "false")
+        monkeypatch.setenv("MATREL_STRATEGY_OVERRIDE", "cpmm")
+        monkeypatch.setenv("MATREL_MESH_SHAPE", "2x4")
+        cfg = MatrelConfig.from_env()
+        assert cfg.block_size == 128
+        assert cfg.sparsity_threshold == 0.25
+        assert cfg.use_pallas is False
+        assert cfg.strategy_override == "cpmm"
+        assert cfg.mesh_shape == (2, 4)
+
+    def test_bool_spellings(self, monkeypatch):
+        for raw, want in [("1", True), ("true", True), ("YES", True),
+                          ("on", True), ("0", False), ("off", False),
+                          ("no", False)]:
+            monkeypatch.setenv("MATREL_CHAIN_OPT", raw)
+            assert MatrelConfig.from_env().chain_opt is want, raw
+
+    def test_mesh_shape_comma_form(self, monkeypatch):
+        monkeypatch.setenv("MATREL_MESH_SHAPE", "4,2")
+        assert MatrelConfig.from_env().mesh_shape == (4, 2)
+
+    def test_unset_env_keeps_base(self, monkeypatch):
+        base = MatrelConfig(block_size=64)
+        assert MatrelConfig.from_env(base).block_size == 64
+
+    def test_round2_knobs_via_env(self, monkeypatch):
+        monkeypatch.setenv("MATREL_PALLAS_INTERPRET", "1")
+        monkeypatch.setenv("MATREL_JOIN_PAIR_CAP_ENTRIES", "1024")
+        monkeypatch.setenv("MATREL_PLAN_CACHE_MAX_PLANS", "7")
+        cfg = MatrelConfig.from_env()
+        assert cfg.pallas_interpret is True
+        assert cfg.join_pair_cap_entries == 1024
+        assert cfg.plan_cache_max_plans == 7
+
+
+class TestFromDict:
+    def test_valid_and_unknown_keys(self):
+        cfg = MatrelConfig.from_dict({"block_size": 256,
+                                      "use_pallas": False})
+        assert cfg.block_size == 256 and cfg.use_pallas is False
+        with pytest.raises(KeyError, match="unknown MatrelConfig keys"):
+            MatrelConfig.from_dict({"blok_size": 1})
+
+
+class TestPallasGates:
+    # conftest pins the cpu backend, so the gates' backend term is False
+    def test_gates_on_cpu(self):
+        assert pallas_enabled(MatrelConfig()) is False
+        assert pallas_enabled(MatrelConfig(pallas_interpret=True)) is True
+        assert pallas_enabled(MatrelConfig(use_pallas=False,
+                                           pallas_interpret=True)) is False
+        assert pallas_interpret_mode(
+            MatrelConfig(pallas_interpret=True)) is True
+        assert pallas_interpret_mode(MatrelConfig()) is False
+
+    def test_resolve_interpret_precedence(self):
+        cfg_on = MatrelConfig(pallas_interpret=True)
+        assert resolve_interpret(None, cfg_on) is True
+        assert resolve_interpret(None, MatrelConfig()) is False
+        assert resolve_interpret(False, cfg_on) is False   # explicit wins
+        assert resolve_interpret(True, MatrelConfig()) is True
