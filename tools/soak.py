@@ -60,7 +60,11 @@ def soak_fuzz(n_seeds: int, base: int, tol: float):
                               leaf_kinds=("dense", "dense", "sparse",
                                           "coo"))
             oracle = fuzz.np_eval(e, env)
-            got = compile_expr(e, mesh, MatrelConfig()).run().to_numpy()
+            # half the seeds force the Pallas paths (interpret mode off
+            # TPU): the compact COO executor dispatch and Pallas SpMM
+            # get soaked alongside the XLA lowerings
+            cfg = MatrelConfig(pallas_interpret=(seed % 2 == 0))
+            got = compile_expr(e, mesh, cfg).run().to_numpy()
             np.testing.assert_allclose(got, oracle, rtol=tol, atol=tol)
         except Exception as ex:  # noqa: BLE001 — soak collects everything
             fails.append((seed, type(ex).__name__, str(ex)[:200]))
