@@ -51,6 +51,9 @@ def cmd_sql(args):
     for spec in args.table or []:
         name, path = spec.split("=", 1)
         sess.register(name, sess.from_numpy(np.load(path)))
+    if getattr(args, "explain", False):
+        print(sess.explain_sql(args.query))
+        return
     out = sess.compute(sess.sql(args.query))
     np.set_printoptions(precision=5, suppress=True, threshold=200)
     print(out.to_numpy())
@@ -108,6 +111,9 @@ def main(argv=None):
     sq = sub.add_parser("sql")
     sq.add_argument("query")
     sq.add_argument("--table", action="append")
+    sq.add_argument("--explain", action="store_true",
+                    help="print the logical + optimized plan instead "
+                         "of executing")
     sq.set_defaults(fn=cmd_sql)
     sa = sub.add_parser("autotune")
     sa.add_argument("n", type=int)

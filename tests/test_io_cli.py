@@ -121,3 +121,16 @@ class TestCLI:
         r = self._run("sql", "trace(X)", "--table", f"X={p}")
         assert r.returncode == 0, r.stderr
         assert "6." in r.stdout
+
+
+def test_sql_explain_flag(tmp_path, capsys):
+    import numpy as np
+    from matrel_tpu.__main__ import main as cli_main
+    from matrel_tpu.session import reset_session
+    reset_session()
+    a = np.eye(6, dtype=np.float32)
+    p = str(tmp_path / "a.npy")
+    np.save(p, a)
+    cli_main(["sql", "rowsum(A * A)", "--table", f"A={p}", "--explain"])
+    out = capsys.readouterr().out
+    assert "== Optimized plan ==" in out and "matmul" in out
