@@ -1,0 +1,82 @@
+"""bench.py harness CI: the driver's capture path must emit ONE
+parseable JSON line on both a healthy and a dead backend, within
+bounded wall-clock, with no leaked processes (round-1 VERDICT #1)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _preserve_bench_caches():
+    """bench.py caches (cpu_baseline.json, bench_last_good.json) live
+    at the repo root and would be overwritten by the N=256 runs —
+    snapshot and restore them."""
+    paths = [os.path.join(REPO, f) for f in ("cpu_baseline.json",
+                                             "bench_last_good.json")]
+    saved = {p: (open(p).read() if os.path.exists(p) else None)
+             for p in paths}
+    try:
+        yield
+    finally:
+        for p, content in saved.items():
+            if content is None:
+                if os.path.exists(p):
+                    os.remove(p)
+            else:
+                with open(p, "w") as f:
+                    f.write(content)
+
+
+def _run_bench(env_extra, timeout):
+    env = dict(os.environ)
+    # children must NOT inherit the axon sitecustomize (hangs while the
+    # relay is wedged); force the CPU backend end-to-end
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.update(env_extra)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO)
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    return proc.returncode, lines, time.monotonic() - t0
+
+
+def test_success_path_emits_metric_json(tmp_path):
+    rc, lines, _ = _run_bench({
+        "MATREL_BENCH_N": "256", "MATREL_BENCH_REPEATS": "3",
+        "MATREL_BENCH_BACKOFFS": "1",
+    }, timeout=240)
+    assert rc == 0, lines
+    out = json.loads(lines[-1])
+    assert out["metric"] == "dense_blockmatmul_tflops_per_chip"
+    assert out["value"] is not None and out["value"] > 0
+    assert out["unit"] == "TFLOPS" and out["vs_baseline"] is not None
+
+
+def test_dead_backend_emits_error_json_within_deadline():
+    rc, lines, dt = _run_bench({
+        # unloadable platform in the CHILDREN: probe fails; tiny
+        # timeouts/backoffs keep the ladder fast
+        "JAX_PLATFORMS": "nosuchplatform",
+        "MATREL_BENCH_PROBE_TIMEOUT": "15",
+        "MATREL_BENCH_BACKOFFS": "1,1,1",
+        "MATREL_BENCH_DEADLINE": "60",
+    }, timeout=180)
+    assert rc == 0, lines                      # structured, not a crash
+    out = json.loads(lines[-1])
+    assert out["value"] is None
+    assert out["vs_baseline"] is None
+    assert out["error"]
+    assert out["last_known_good"] is not None  # seeded in the repo
+    assert dt < 150
