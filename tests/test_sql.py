@@ -272,3 +272,13 @@ def test_explain_sql(sess):
     assert "join_value merge=mul pred=lt" in txt2
     txt3 = s.explain_sql("joinrows(A, A, 'x + y')")
     assert "join_rows" in txt3
+
+
+def test_join_and_block_args_are_injection_safe(sess):
+    s, a, b = sess
+    for bad in ('joinvalue(A, B, \'__import__("os").system("x")\', "lt")',
+                "joinrows(A, A, 'open(\"/etc/passwd\")')",
+                "selectblocks(A, '__class__', 4)",
+                "joinvalue(A, B, 'x + y', 'exec(\"1\")')"):
+        with pytest.raises(SqlError):
+            s.sql(bad)
