@@ -179,7 +179,11 @@ def make_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg: MatrelConfig,
         want_rows = gc * bs
         if dd.shape[0] < want_rows:
             dd = jnp.pad(dd, ((0, want_rows - dd.shape[0]), (0, 0)))
-        dblocks = dd.reshape(gc, bs, pm)
+        # mesh padding can exceed the tile grid's extent (small k on a
+        # big mesh — soak seed 50114); the excess rows are exact zeros
+        # by the padding invariant — same unconditional slice as
+        # _xla_spmm and the sharded runner
+        dblocks = dd[:want_rows].reshape(gc, bs, pm)
         out = kernel(rows, cols, payload, dblocks)
         out = out[: out_pshape[0], : out_pshape[1]]
         if out.shape != out_pshape:

@@ -52,9 +52,10 @@ def _purge_runners(sid: int) -> None:
         del _RUNNER_CACHE[k]
 
 
-def _cached_runner(S, pm, out_pshape, d_spec, out_sharding, cfg, interpret):
+def _cached_runner(S, pm, out_pshape, d_spec, out_sharding, cfg, interpret,
+                   explicit_interpret):
     key = (id(S), pm, out_pshape, str(d_spec), cfg.use_pallas,
-           cfg.matmul_precision, interpret)
+           cfg.matmul_precision, interpret, explicit_interpret)
     run = _RUNNER_CACHE.get(key)
     if run is None:
         # compiled (non-interpret) Pallas only on a real TPU backend:
@@ -66,9 +67,13 @@ def _cached_runner(S, pm, out_pshape, d_spec, out_sharding, cfg, interpret):
             and jax.default_backend() in ("tpu", "axon"))
         if use_pallas:
             from matrel_tpu.ops import pallas_spmm
-            # interpret mode skips the eligibility gate on purpose: it
-            # has no Mosaic tiling constraints and tests drive tiny blocks
-            use_pallas = interpret or pallas_spmm.pallas_eligible(S, pm)
+            # ONLY an EXPLICIT interpret=True skips the eligibility
+            # gate (tests drive deliberately tiny blocks); config-driven
+            # interpret (pallas_interpret) must still respect it —
+            # ineligible stacks (e.g. bs=4) break the kernel's layout
+            # assumptions in ANY mode (found by soak seed 50114)
+            use_pallas = ((interpret and explicit_interpret)
+                          or pallas_spmm.pallas_eligible(S, pm))
         if use_pallas:
             run = pallas_spmm.make_spmm(S, pm, out_pshape, d_spec,
                                         out_sharding, cfg, interpret=interpret)
@@ -101,6 +106,7 @@ def apply(S: BlockSparseMatrix, dd: jax.Array,
     k2, m = d_shape
     if k != k2:
         raise ValueError(f"spmm shape mismatch: {S.shape} x {d_shape}")
+    explicit_interpret = interpret is not None
     interpret = _resolve_interpret(interpret, cfg)
     mesh = S.mesh
     out_pshape = padding.padded_shape((n, m), mesh)
@@ -108,7 +114,7 @@ def apply(S: BlockSparseMatrix, dd: jax.Array,
     pm = dd.shape[1]
     d_spec = _dense_spec(pm, mesh)
     run = _cached_runner(S, pm, out_pshape, d_spec, out_sharding, cfg,
-                         interpret)
+                         interpret, explicit_interpret)
     return run(S.blocks, S.block_rows, S.block_cols, dd)
 
 

@@ -409,3 +409,20 @@ def test_pallas_interpret_config_routes_spmm(mesh8, rng, monkeypatch):
     out2 = spmm_lib.spmm(S2, D, MatrelConfig()).to_numpy()
     np.testing.assert_allclose(out2, sp @ d, rtol=1e-4, atol=1e-4)
     assert len(calls) == n_before
+
+
+def test_pallas_spmm_mesh_padding_exceeds_tile_grid(mesh8, rng):
+    """Small-k sparse x dense on a big mesh: the dense operand's MESH
+    padding (k→8 rows here) exceeds the tile grid extent (gc*bs = 4);
+    the Pallas runner must slice the zero padding off, not crash
+    (soak seed 50114 regression)."""
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.ops import spmm as spmm_lib
+    sp = rng.standard_normal((4, 4)).astype(np.float32)
+    sp[rng.random((4, 4)) < 0.5] = 0.0
+    d = rng.standard_normal((4, 8)).astype(np.float32)
+    S = BlockSparseMatrix.from_numpy(sp, block_size=4, mesh=mesh8)
+    D = BlockMatrix.from_numpy(d, mesh=mesh8)
+    out = spmm_lib.spmm(S, D, MatrelConfig(pallas_interpret=True)
+                        ).to_numpy()
+    np.testing.assert_allclose(out, sp @ d, rtol=1e-4, atol=1e-5)
