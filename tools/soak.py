@@ -99,7 +99,8 @@ def soak_deep(n_seeds: int, base: int, tol: float):
                               leaf_kinds=("dense", "dense", "sparse",
                                           "coo"))
             oracle = fuzz.np_eval(e, env)
-            got = compile_expr(e, mesh, MatrelConfig()).run().to_numpy()
+            cfg = MatrelConfig(pallas_interpret=(seed % 2 == 0))
+            got = compile_expr(e, mesh, cfg).run().to_numpy()
             np.testing.assert_allclose(got, oracle, rtol=tol, atol=tol)
         except Exception as ex:  # noqa: BLE001
             fails.append(("deep", seed, type(ex).__name__, str(ex)[:200]))
