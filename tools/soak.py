@@ -264,11 +264,70 @@ def soak_routed(n_trials: int, base: int, tol: float):
     return fails
 
 
+def soak_checkpoint(n_trials: int, base: int, tol: float):
+    """Randomized checkpoint/restore: matrices with random specs, sparse
+    tile stacks, loop state — restored values AND shardings must match;
+    keep-k GC must hold."""
+    import shutil
+    import tempfile
+    import numpy as np
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.core.sparse import BlockSparseMatrix
+    from matrel_tpu.utils.checkpoint import CheckpointManager
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh_lib.make_mesh()
+    x, y = mesh.axis_names
+    specs = [P(x, y), P((x, y), None), P(None, (x, y)), P(None, None)]
+    fails = []
+    for trial in range(base, base + n_trials):
+        rng = np.random.default_rng(trial)
+        d = tempfile.mkdtemp(prefix="matrel_soak_ckpt_")
+        try:
+            mgr = CheckpointManager(d, keep=2)
+            n = int(rng.choice([8, 16, 24, 32]))
+            mats = {}
+            vals = {}
+            for i in range(int(rng.integers(1, 4))):
+                v = rng.standard_normal((n, n)).astype(np.float32)
+                spec = specs[int(rng.integers(0, len(specs)))]
+                mats[f"m{i}"] = BlockMatrix.from_numpy(v, mesh=mesh,
+                                                       spec=spec)
+                vals[f"m{i}"] = v
+            sp_np = rng.standard_normal((n, n)).astype(np.float32)
+            sp_np[rng.random((n, n)) < 0.6] = 0.0
+            sp = BlockSparseMatrix.from_numpy(sp_np, block_size=8,
+                                              mesh=mesh)
+            state = {"iter": int(rng.integers(0, 100))}
+            for step in range(int(rng.integers(1, 4))):
+                mgr.save(step, matrices=mats, sparse={"s": sp},
+                         state=state)
+            got = mgr.restore(mesh)
+            assert got is not None
+            _, rmats, _, rstate = got
+            assert rstate == state, (rstate, state)
+            for name, v in vals.items():
+                np.testing.assert_allclose(rmats[name].to_numpy(), v,
+                                           rtol=tol, atol=tol)
+                assert rmats[name].spec == mats[name].spec
+            rsp = mgr.restore_sparse(mesh)["s"]
+            np.testing.assert_allclose(rsp.to_numpy(), sp_np,
+                                       rtol=tol, atol=tol)
+            assert len(mgr._steps()) <= 2       # keep-k GC held
+        except Exception as ex:  # noqa: BLE001
+            fails.append(("ckpt", trial, type(ex).__name__,
+                          str(ex)[:200]))
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    return fails
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("battery",
                    choices=["fuzz", "deep", "spmv", "sharded", "routed",
-                            "all"])
+                            "ckpt", "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -285,6 +344,9 @@ def main():
     if args.battery in ("spmv", "all"):
         fails += soak_spmv(args.seeds, args.base,
                            1e-3 if args.tpu else 2e-4)
+    if args.battery in ("ckpt", "all"):
+        fails += soak_checkpoint(max(args.seeds // 5, 5), args.base,
+                                 1e-6)
     if args.battery in ("sharded", "all"):
         fails += soak_sharded(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("routed", "all"):
