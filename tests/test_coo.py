@@ -539,3 +539,26 @@ class TestCOOValueJoin:
                                             predicate="lt")
         assert list(zip(ia, ja, ib, jb)) == [(0, 0, 1, 1)]
         np.testing.assert_allclose(v, [2.0])
+
+    def test_coo_join_totals_match_dense_streaming(self, mesh8, rng):
+        # cross-surface metamorphic check: for merge='mul' (zero
+        # operands annihilate), the sum over COO matched PAIRS equals
+        # the dense pair-matrix aggregate of the same logical matrices
+        from matrel_tpu import execute
+        from matrel_tpu.relational import ops as R
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        r, c, v = random_coo(rng, 40, 30, 200)
+        r2, c2, v2 = random_coo(rng, 20, 25, 150)
+        A = COOMatrix.from_edges(r, c, v, shape=(40, 30))
+        B = COOMatrix.from_edges(r2, c2, v2, shape=(20, 25))
+        for pred in ("lt", "gt", "eq"):
+            pairs = A.join_on_value(B, merge="mul", predicate=pred)
+            coo_total = float(pairs[4].astype(np.float64).sum())
+            j = R.join_on_values(
+                BlockMatrix.from_numpy(A.to_dense(), mesh=mesh8),
+                BlockMatrix.from_numpy(B.to_dense(), mesh=mesh8),
+                merge="mul", predicate=pred)
+            dense_total = float(R.aggregate(j, "sum", "all")
+                                .compute().to_numpy()[0, 0])
+            assert abs(coo_total - dense_total) <= 1e-3 * max(
+                1.0, abs(dense_total)), (pred, coo_total, dense_total)
