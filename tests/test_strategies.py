@@ -152,3 +152,40 @@ def test_compiled_plan_collectives_summary(mesh8):
     cols = plan.collectives()
     assert cols.get("reduce-scatter", 0) >= 1
     assert "strategy=cpmm" in plan.explain()
+
+
+class TestBmmLeft:
+    def test_bmm_left_hlo_no_reduce_scatter(self, mesh8):
+        # near-symmetric pin to the bmm_right HLO test: with the LEFT
+        # operand replicated there is no contraction-time
+        # reduce-scatter. (B's 2d→col reshard MAY lower to a
+        # collective-permute — input movement, not execution comm — so
+        # only the reduce-scatter absence is pinned.)
+        import jax
+        rng = np.random.default_rng(3)
+        a = BlockMatrix.from_numpy(
+            rng.standard_normal((16, 16)).astype(np.float32), mesh=mesh8)
+        b = BlockMatrix.from_numpy(
+            rng.standard_normal((16, 16)).astype(np.float32), mesh=mesh8)
+        f = jax.jit(lambda x, y: strategies.run_matmul(
+            "bmm_left", x, y, mesh8, MatrelConfig()))
+        hlo = f.lower(a.data, b.data).compile().as_text()
+        assert "reduce-scatter" not in hlo
+        got = np.asarray(f(a.data, b.data))[:16, :16]
+        np.testing.assert_allclose(got, a.to_numpy() @ b.to_numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_small_lhs_broadcasts_left(self, mesh8):
+        # mirror of test_small_rhs_broadcasts: tiny LEFT operand against
+        # a big col-partitioned RHS → the planner must flip to bmm_left
+        import dataclasses
+        from jax.sharding import PartitionSpec as P
+        a_small = BlockMatrix.from_numpy(
+            np.zeros((8, 8), dtype=np.float32), mesh=mesh8)
+        b_small = BlockMatrix.from_numpy(
+            np.zeros((8, 8), dtype=np.float32), mesh=mesh8,
+            spec=P(None, ("x", "y")))
+        a = dataclasses.replace(a_small, shape=(64, 512))
+        b = dataclasses.replace(b_small, shape=(512, 100_000))
+        node = matmul(leaf(a), leaf(b))
+        assert planner.choose_strategy(node, mesh8) == "bmm_left"
