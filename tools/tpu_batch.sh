@@ -1,0 +1,19 @@
+#!/bin/sh
+# One-command TPU capture batch — run (or auto-triggered by a relay
+# watch) the moment the axon relay is alive. Every step is wedge-safe
+# (probe-first, hard timeouts), so a relay that dies mid-batch cannot
+# hang this script. Results: stdout JSON lines per tool + structured
+# entries in PROGRESS.jsonl (soak_guard, north_star_sweep).
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "$(date '+%H:%M:%S') $*"; }
+log "TPU batch start"
+log "--- bench.py (headline, BENCH row 1)"
+python bench.py
+log "--- soak_guard (on-chip oracle soak)"
+python tools/soak_guard.py --seeds 8
+log "--- bench_all.py (all BASELINE rows)"
+python bench_all.py
+log "--- north_star_sweep (VERDICT #10 residual)"
+python tools/north_star_sweep.py
+log "TPU batch done"
