@@ -13,7 +13,7 @@
 PY ?= python
 SEEDS ?= 10
 
-.PHONY: test soak soak-tpu multihost native bench
+.PHONY: test soak soak-tpu multihost native bench tpu-batch
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -32,3 +32,6 @@ native:
 
 bench:
 	$(PY) bench.py
+
+tpu-batch:
+	sh tools/tpu_batch.sh
