@@ -121,3 +121,26 @@ class TestBuilderConflicts:
             MatrelSession.builder().mesh(mesh8).get_or_create()
         assert not [r for r in caplog.records
                     if "ignoring the requested" in r.message]
+
+
+def test_iterative_queries_under_aggressive_eviction(mesh8, rng):
+    """An iterative workload whose per-step queries exceed the plan
+    cache: evicted plans recompile transparently and results stay
+    correct across many steps (long-lived-session shape)."""
+    sess = MatrelSession(
+        mesh=mesh8, config=MatrelConfig(plan_cache_max_plans=2))
+    mats = [sess.from_numpy(
+        rng.standard_normal((12, 12)).astype(np.float32))
+        for _ in range(4)]
+    oracles = [m.to_numpy() for m in mats]
+    state = np.eye(12, dtype=np.float32)
+    S = sess.from_numpy(state)
+    for step in range(8):
+        m = step % 4                      # cycles past the cache bound
+        out = sess.compute(S.expr().multiply(mats[m].expr()))
+        want = state @ oracles[m]
+        np.testing.assert_allclose(out.to_numpy(), want, rtol=2e-3,
+                                   atol=2e-3, err_msg=f"step {step}")
+        state = want
+        S = sess.from_numpy(state)
+    assert sess.plan_cache_info()["plans"] <= 2
