@@ -64,6 +64,17 @@ class MatrelConfig:
         recently-used plans evict first.
       rewrite_rules: enable the algebraic rewrite pass.
       donate_intermediates: donate chain intermediates to XLA where legal.
+      autotune: let MEASURED strategy timings override the cost model's
+        matmul pick (SURVEY.md §7 hard part: "detecting when XLA's
+        choice beats the explicit paths"). On first sight of a shape
+        class the admissible strategies are timed on-device once; the
+        winner is cached in-process AND persisted to autotune_table_path
+        so the measurement survives the session.
+      autotune_table_path: JSON file for the persisted measurement
+        table. Empty → ".matrel_autotune.json" in the working directory.
+      autotune_max_dim: shapes with max(n,k,m) above this are never
+        measured inline (measuring allocates two square operands of
+        that size); the cost model keeps those.
     """
 
     block_size: int = 512
@@ -85,6 +96,9 @@ class MatrelConfig:
     join_chunk_entries: int = 1 << 22
     plan_cache_max_plans: int = 64
     plan_cache_max_bytes: int = 4 << 30
+    autotune: bool = False
+    autotune_table_path: str = ""
+    autotune_max_dim: int = 8192
 
     def replace(self, **kw: Any) -> "MatrelConfig":
         return dataclasses.replace(self, **kw)

@@ -337,6 +337,16 @@ class Lowerer:
         out = sm(*tables, x, *ov)
         return out if wide else out[:, None]
 
+    @staticmethod
+    def _same_operand(u: MatExpr, v: MatExpr) -> bool:
+        """Do two expression nodes denote the SAME evaluated operand?
+        True for a shared DAG node, or for distinct leaf wrappers of
+        one matrix object (the DSL creates a fresh leaf per .expr())."""
+        if u is v or u.uid == v.uid:
+            return True
+        return (u.kind == "leaf" and v.kind == "leaf"
+                and u.attrs["matrix"] is v.attrs["matrix"])
+
     def _matmul(self, node: MatExpr, ev) -> Array:
         l, r = node.children
         # coo_leaf matmuls: per-column one-hot SpMV for narrow dense
@@ -386,6 +396,34 @@ class Lowerer:
             out = spmm_lib.apply(st, at, (l.shape[1], l.shape[0]),
                                  self.config)
             return out.T
+        gram = None
+        if l.kind == "transpose" and self._same_operand(l.children[0], r):
+            gram = ("AtA", r)
+        elif r.kind == "transpose" and self._same_operand(r.children[0], l):
+            gram = ("AAt", l)
+        if gram is not None and self.config.matmul_precision == "high":
+            side, base = gram
+            x = ev(base)
+            if x.dtype == jnp.float32:
+                # symmetric 2-pass bf16 split for AᵀA / AAᵀ under
+                # precision="high": of XLA's three bf16x3 products
+                # (hi·hi, hi·lo, lo·hi) the cross terms are transposes
+                # of each other in a Gram, so one MXU pass is a k×k
+                # transpose instead — 33% fewer matmul FLOPs at
+                # identical accuracy (same three products; round-3
+                # floor analysis, docs/ROUND3.md). XLA's generic dot
+                # cannot apply this: it does not know both operands
+                # are the same matrix. The transpose operand is never
+                # materialised either.
+                from matrel_tpu.ops.gram import symmetric_gram
+                strategy = node.attrs.get("strategy", "xla")
+                if side == "AtA":
+                    mm = lambda p, q: strategies.run_matmul(
+                        strategy, p.T, q, self.mesh, self.config)
+                else:                    # A·Aᵀ
+                    mm = lambda p, q: strategies.run_matmul(
+                        strategy, p, q.T, self.mesh, self.config)
+                return symmetric_gram(x, mm).astype(jnp.float32)
         a, b = ev(node.children[0]), ev(node.children[1])
         strategy = node.attrs.get("strategy", "xla")
         out = strategies.run_matmul(strategy, a, b, self.mesh, self.config)

@@ -5,12 +5,20 @@ detecting when not)".
 The cost model (planner.py) is an estimate; this module MEASURES. For a
 given (n, k, m, mesh) it times every admissible strategy on-device
 (marginal timing: chained dependent runs with a forced fetch, cancelling
-dispatch latency — see bench.py methodology) and caches the winner. Use
-``config.strategy_override`` per-session, or consult the returned table.
+dispatch latency — see bench.py methodology) and caches the winner.
+
+The loop is CLOSED via ``config.autotune``: with the flag on, the
+planner consults ``lookup_or_measure`` before trusting its byte model —
+a recurring shape class is measured once, the winner overrides the
+model's pick, and the table persists as JSON (config.autotune_table_path)
+so later sessions inherit the measurement. ``config.strategy_override``
+still wins over both.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from typing import Dict, Optional, Tuple
 
@@ -23,6 +31,65 @@ from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.parallel import planner, strategies
 
 _CACHE: Dict[tuple, Tuple[str, Dict[str, float]]] = {}
+
+_DEFAULT_TABLE = ".matrel_autotune.json"
+
+
+def _table_path(config: Optional[MatrelConfig] = None) -> str:
+    cfg = config or default_config()
+    return cfg.autotune_table_path or _DEFAULT_TABLE
+
+
+def _table_key(side: int, gx: int, gy: int, dtype: str) -> str:
+    return f"{side}|{gx}x{gy}|{dtype}"
+
+
+def load_table(path: str) -> Dict[str, dict]:
+    """Persisted {key: {"best": strategy, "times": {...}}} or {}.
+    A corrupt/absent file is an empty table, never an error."""
+    try:
+        with open(path) as f:
+            t = json.load(f)
+        return t if isinstance(t, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+_TABLE_CACHE: Dict[str, Tuple[float, Dict[str, dict]]] = {}
+
+
+def _load_table_cached(path: str) -> Dict[str, dict]:
+    """load_table memoised on (path, mtime): the planner consults the
+    table on EVERY matmul when config.autotune is on, and un-measured
+    shapes (including everything above autotune_max_dim) would
+    otherwise re-open and re-parse the JSON on each compile."""
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        mtime = -1.0
+    hit = _TABLE_CACHE.get(path)
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
+    table = load_table(path)
+    _TABLE_CACHE[path] = (mtime, table)
+    return table
+
+
+def _persist(path: str, key: str, best: str,
+             times: Dict[str, float]) -> None:
+    """Merge one measurement into the JSON table (atomic rename)."""
+    table = load_table(path)
+    table[key] = {"best": best, "times": times}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(table, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:      # read-only FS etc.: in-process cache still holds it
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def measure_strategy(strategy: str, A: BlockMatrix, B: BlockMatrix,
@@ -82,4 +149,32 @@ def autotune_matmul(n: int, k: int, m: int,
             continue       # on this backend just drops out of the table
     best = min(results, key=results.get)
     _CACHE[key] = (best, results)
+    _persist(_table_path(cfg), _table_key(side, gx, gy, str(dtype)),
+             best, results)
     return best, results
+
+
+def lookup_or_measure(n: int, k: int, m: int, mesh,
+                      dtype: str = "float32",
+                      config: Optional[MatrelConfig] = None
+                      ) -> Optional[str]:
+    """The planner's entry point (config.autotune=True): the measured
+    winner for this shape class, or None when the cost model should
+    decide. Order: in-process cache → persisted table → measure once
+    (small shapes only — measuring allocates two side² operands, so
+    shapes above config.autotune_max_dim are never measured inline)."""
+    cfg = config or default_config()
+    side = max(n, k, m)
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    key = (side, gx, gy, str(dtype))
+    if key in _CACHE:
+        return _CACHE[key][0]
+    entry = _load_table_cached(_table_path(cfg)).get(
+        _table_key(side, gx, gy, str(dtype)))
+    if entry and isinstance(entry.get("best"), str):
+        _CACHE[key] = (entry["best"], dict(entry.get("times", {})))
+        return entry["best"]
+    if side > cfg.autotune_max_dim:
+        return None
+    best, _ = autotune_matmul(n, k, m, mesh=mesh, dtype=dtype, config=cfg)
+    return best

@@ -93,6 +93,18 @@ def _layout_of(node: MatExpr, mesh: Mesh) -> str:
     return "2d"
 
 
+def _operand_dtype(node: MatExpr):
+    """Statically-known dtype of a matmul operand: a leaf's matrix
+    dtype, looked up through dtype-preserving transposes; None for
+    intermediates (no dtype inference in the IR)."""
+    n = node
+    while n.kind == "transpose":
+        n = n.children[0]
+    if n.kind == "leaf":
+        return n.attrs["matrix"].dtype
+    return None
+
+
 def admissible(strategy: str, pn: int, pk: int, pm: int,
                gx: int, gy: int) -> bool:
     """Can this strategy's shard_map specs divide the padded dims evenly?
@@ -131,6 +143,22 @@ def choose_strategy(node: MatExpr, mesh: Mesh,
     from matrel_tpu.core import padding
     pn, pk = padding.padded_shape((n, k), mesh)
     _, pm = padding.padded_shape((k, m), mesh)
+    if cfg.autotune:
+        # MEASURED winner beats the byte model (closed autotune loop);
+        # admissibility is re-checked against THESE dims — the table
+        # keys by shape class, the divisibility constraint is exact.
+        # Only consulted when BOTH operand dtypes are statically known
+        # (leaves, possibly through transposes) and equal: keying a
+        # bf16 multiply into the f32 table row — or measuring f32
+        # operands for a bf16 chain step — would violate the
+        # measured-beats-model premise.
+        dta, dtb = _operand_dtype(a), _operand_dtype(b)
+        if dta is not None and dta == dtb:
+            from matrel_tpu.parallel import autotune
+            best = autotune.lookup_or_measure(n, k, m, mesh, str(dta),
+                                              cfg)
+            if best is not None and admissible(best, pn, pk, pm, gx, gy):
+                return best
     da, db = a.density, b.density
     la, lb = _layout_of(a, mesh), _layout_of(b, mesh)
     cands = {}
@@ -166,13 +194,20 @@ def choose_join_scheme(node: MatExpr, mesh: Mesh,
     reference's cost-based choice of which operand to replicate
     (SURVEY.md §2 "Physical: relational execs": "join-scheme selection
     to minimize replication"). Replicating side s all-gathers
-    bytes(s)·(p-1)/p per device; the cheaper side to move is the
-    smaller one (density-credited), so the LARGER operand keeps its
-    sharding. Returns "left"|"right" — the side to replicate."""
+    bytes(s)·(p-1)/p per device — unless s is ALREADY replicated on the
+    mesh, in which case it moves nothing and is the free choice
+    regardless of size (the same input-layout credit the matmul planner
+    applies). Bytes are density-credited. Returns "left"|"right" — the
+    side to replicate."""
     a, b = node.children
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    p = max(gx * gy, 1)
+    la, lb = _layout_of(a, mesh), _layout_of(b, mesh)
     a_bytes = _bytes(a.shape, a.density if a.density is not None else 1.0)
     b_bytes = _bytes(b.shape, b.density if b.density is not None else 1.0)
-    return "left" if a_bytes <= b_bytes else "right"
+    cost_left = 0.0 if la == "rep" else a_bytes * (p - 1) / p
+    cost_right = 0.0 if lb == "rep" else b_bytes * (p - 1) / p
+    return "left" if cost_left <= cost_right else "right"
 
 
 def annotate_strategies(e: MatExpr, mesh: Mesh,

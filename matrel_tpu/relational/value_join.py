@@ -24,6 +24,15 @@ _agg): the pair matrix holds merge(va, vb) where the predicate holds
 and 0 elsewhere, over ALL logical entries (zeros of A/B included);
 "count" counts nonzero MERGED values; max/min see the implicit zeros of
 unmatched pairs; avg = sum/count.
+
+One DEFINED divergence: the streaming "count" decides nonzero-ness of a
+merged pair in EXACT arithmetic (range counts of vb == 0 / vb == -va on
+the sorted table), while the dense path tests the f32-ROUNDED merge —
+when add/mul underflows (tiny + (-tiny), tiny * tiny → f32 0) or
+overflows, the dense count drops/keeps pairs the exact count keeps. The
+exact-arithmetic answer is the semantics of the streaming path: it is
+scale-invariant and matches the relation's "merged value is zero"
+meaning rather than an artifact of f32 rounding at 16M+ pair scale.
 """
 
 from __future__ import annotations
@@ -221,6 +230,12 @@ def axis_agg_chunked(va, vb, merge_fn, pred_fn, kind: str, axis: str,
     va = jnp.asarray(va, jnp.float32)
     vb = jnp.asarray(vb, jnp.float32)
     na, nb = va.shape[0], vb.shape[0]
+    if nb == 0:
+        # degenerate empty-B join: every row of the pair matrix is
+        # empty; the scan below would leave the ∓inf extrema inits in
+        # place. All aggregates of an empty row are 0.
+        z = jnp.zeros(na, jnp.float32)
+        return jnp.asarray(0.0) if axis == "all" else z
     cb = max(1, min(nb, chunk_entries // max(na, 1)))
     n_chunks = -(-nb // cb)
     pad = n_chunks * cb - nb
@@ -251,8 +266,10 @@ def axis_agg_chunked(va, vb, merge_fn, pred_fn, kind: str, axis: str,
     init = (jnp.zeros(na, jnp.float32), jnp.zeros(na, jnp.float32),
             jnp.full(na, -jnp.inf), jnp.full(na, jnp.inf))
     (s, c, mx, mn), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
-    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
-    mn = jnp.where(jnp.isfinite(mn), mn, 0.0)
+    # no finiteness masking here: a legitimate ±inf/NaN extremum (an inf
+    # operand entry) must surface exactly as the dense lowering reports
+    # it; the ∓inf inits cannot survive because nb >= 1 guarantees every
+    # row sees at least one valid (non-padded) slot
     if axis == "all":
         if kind == "sum":
             return jnp.sum(s)

@@ -12,7 +12,9 @@ analogue).
 
 from __future__ import annotations
 
+import hashlib
 import logging
+import types
 from collections import OrderedDict
 from typing import Optional, Tuple
 
@@ -115,13 +117,21 @@ class MatrelSession:
     # -- actions ------------------------------------------------------------
 
     def compile(self, expr: MatExpr) -> executor_lib.CompiledPlan:
-        key = _plan_key(as_expr(expr))
+        e = as_expr(expr)
+        key, pins = _plan_key(e)
         plan = self._plan_cache.get(key)
         if plan is not None:
             self._plan_cache.move_to_end(key)
             return plan
-        plan = executor_lib.compile_expr(as_expr(expr), self.mesh,
-                                         self.config)
+        plan = executor_lib.compile_expr(e, self.mesh, self.config)
+        # pin every id()-keyed object on the cached plan: a garbage-
+        # collected object's address can be REUSED by CPython, and a
+        # later distinct object at the recycled address would falsely
+        # hit this entry. Pinning the expr alone is not enough — a
+        # REBOUND module global referenced by a predicate is no longer
+        # reachable from the expr, so its old value is pinned
+        # explicitly via the collected pins list.
+        plan._cache_pin = (e, pins)
         self._plan_cache[key] = plan
         self._plan_cache_bytes += _plan_bytes(plan)
         self._evict_plans()
@@ -178,12 +188,79 @@ def _plan_bytes(plan: executor_lib.CompiledPlan) -> int:
     return total
 
 
-def _plan_key(e: MatExpr) -> str:
+def _fn_token(fn, pins: list) -> str:
+    """Cache-key token for a callable attr. Distinct predicates/merges MUST
+    key differently — dropping them (pre-round-3 behaviour) made the second
+    of two same-shaped queries silently return the first's cached result.
+    Preference order: an attached source key (sql.py tags its compiled
+    lambdas, so identical query text still HITS the cache), then a
+    code+closure+globals+defaults fingerprint (stable across re-created
+    lambdas with the same behaviour), then id(). EVERY object keyed by
+    id() is appended to ``pins``, which the session attaches to the
+    cached plan: a pinned object's address cannot be garbage-collected
+    and reused, so an id-based token can never falsely hit."""
+    key = getattr(fn, "__matrel_key__", None)
+    if key is not None:
+        return f"fnkey:{key}"
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        pins.append(fn)
+        return f"fnid:{id(fn)}"
+    parts = [code.co_code.hex(), repr(code.co_consts), repr(code.co_names)]
+    for cell in (getattr(fn, "__closure__", None) or ()):
+        try:
+            parts.append(_attr_token(cell.cell_contents, pins))
+        except Exception:
+            pins.append(cell)
+            parts.append(f"cell:{id(cell)}")
+    # referenced globals are part of the behaviour: `thr = 0.5;
+    # lambda v: v > thr` re-created after `thr = -0.5` has identical
+    # code/consts/names and must NOT key identically. Scalars key by
+    # value; modules/builtins by name (stable); anything else by
+    # identity (pinned — a REBOUND global's old value would otherwise
+    # free and its address recycle into a false hit).
+    g = getattr(fn, "__globals__", None) or {}
+    for name in code.co_names:
+        if name in g:
+            v = g[name]
+            if v is None or isinstance(v, (bool, int, float, str)):
+                parts.append(f"{name}={v!r}")
+            elif isinstance(v, types.ModuleType):
+                parts.append(f"{name}=mod:{v.__name__}")
+            else:
+                pins.append(v)
+                parts.append(f"{name}=gid:{id(v)}")
+    parts.append(repr(getattr(fn, "__defaults__", None)))
+    digest = hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+    return f"fncode:{digest}"
+
+
+def _attr_token(v, pins: list) -> str:
+    """Encode ANY attr value into the plan key — nothing is dropped.
+    Unknown object types key by identity (and are pinned): conservative
+    (may miss the cache) but never shares a plan between distinct
+    semantics."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return repr(v)
+    if callable(v):
+        return _fn_token(v, pins)
+    if isinstance(v, (tuple, list)):
+        return "[" + ",".join(_attr_token(x, pins) for x in v) + "]"
+    pins.append(v)
+    return f"obj:{type(v).__name__}:{id(v)}"
+
+
+def _plan_key(e: MatExpr) -> Tuple[str, list]:
+    """(key, pins): pins is every object the key references by id() —
+    matrices, raw callables, their id-keyed globals/cells. The caller
+    must keep pins alive as long as the key maps to a cached plan."""
     parts = []
+    pins: list = []
 
     def walk(n: MatExpr):
         if n.kind == "leaf":
             m = n.attrs["matrix"]
+            pins.append(m)
             parts.append(f"leaf:{id(m)}:{m.shape}:{m.spec}")
             return
         if n.kind in ("sparse_leaf", "coo_leaf"):
@@ -191,17 +268,17 @@ def _plan_key(e: MatExpr) -> str:
             # program — the cache key must carry the matrix identity or two
             # same-shaped sparse matrices would share one plan
             m = n.attrs["matrix"]
+            pins.append(m)
             parts.append(f"{n.kind}:{id(m)}:{m.shape}")
             return
-        attrs = {k: v for k, v in sorted(n.attrs.items())
-                 if isinstance(v, (int, float, str, bool))}
+        attrs = {k: _attr_token(v, pins) for k, v in sorted(n.attrs.items())}
         parts.append(f"{n.kind}:{n.shape}:{attrs}(")
         for c in n.children:
             walk(c)
         parts.append(")")
 
     walk(e)
-    return "|".join(parts)
+    return "|".join(parts), pins
 
 
 def get_or_create_session() -> MatrelSession:

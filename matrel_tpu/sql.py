@@ -19,6 +19,8 @@ Grammar (Python-expression syntax, parsed via ``ast`` — no eval):
         transpose(A) | t(A)
         rowsum(e) colsum(e) sum(e) trace(e) vec(e)
         rowmax/rowmin/colmax/colmin/rowcount/rowavg/colcount/colavg(e)
+        max/min/count/avg(e)                       global aggregates
+        diagsum/diagmax/diagmin/diagcount/diagavg(e)   diagonal aggregates
         power(e, p)  norm(e [, "fro"|"l1"|"max"])
         rankone(a, u, v)   A + u·vᵀ (optimizer pushes through multiplies)
         select(e, "v > 0" [, fill])     σ on entry values
@@ -59,6 +61,14 @@ _AGG_FNS = {
     "colmax": ("max", "col"), "colmin": ("min", "col"),
     "rowcount": ("count", "row"), "colcount": ("count", "col"),
     "rowavg": ("avg", "row"), "colavg": ("avg", "col"),
+    # global + diagonal spellings — every executor kind×axis is reachable
+    # from SQL (reference γ surface: sum/count/avg/max/min over
+    # row/col/all/diag; SURVEY.md §2 "Physical: relational execs")
+    "max": ("max", "all"), "min": ("min", "all"),
+    "count": ("count", "all"), "avg": ("avg", "all"),
+    "diagsum": ("sum", "diag"),
+    "diagmax": ("max", "diag"), "diagmin": ("min", "diag"),
+    "diagcount": ("count", "diag"), "diagavg": ("avg", "diag"),
 }
 
 
@@ -141,6 +151,10 @@ def _compile_lambda(src: str, argnames: tuple) -> Callable:
 
         return ev(tree)
 
+    # the session plan cache keys callables by this tag: identical query
+    # text compiles to a fresh fn each parse, but must HIT the cache,
+    # while different predicate text must MISS it (ADVICE r2 high)
+    fn.__matrel_key__ = f"sql({','.join(argnames)}):{src}"
     return fn
 
 
@@ -287,6 +301,18 @@ class _Compiler(ast.NodeVisitor):
         raise SqlError("expected a numeric literal")
 
 
+def _float_dot(q: str, i: int) -> bool:
+    """Is the dot at q[i] part of a float literal (``2.*A`` = 2.0 * A)?
+    Only when the preceding digit run is a NUMBER, not the tail of an
+    identifier: ``t1.*t2`` is table t1 elem-multiplied by t2."""
+    j = i
+    while j > 0 and q[j - 1].isdigit():
+        j -= 1
+    if j == i:            # no digits before the dot
+        return False
+    return j == 0 or not (q[j - 1].isalpha() or q[j - 1] == "_")
+
+
 def _lex_elemmul(q: str) -> str:
     """Replace the documented ``.*`` element-multiply token with ``%``
     outside string literals (quote-aware; string predicates keep their
@@ -304,9 +330,7 @@ def _lex_elemmul(q: str) -> str:
             quote = ch
             out.append(ch)
         elif (ch == "." and i + 1 < len(q) and q[i + 1] == "*"
-                and not (i > 0 and q[i - 1].isdigit())):
-            # digit-adjacent dots are float literals: '2.*A' is
-            # 2.0 * A (scalar multiply), not an elemmul token
+                and not _float_dot(q, i)):
             out.append(" % ")
             i += 1
         else:

@@ -102,8 +102,14 @@ def fit_streaming(n_rows: int, k: int,
 
     ``precision``: MXU passes for the f32 Gram products — "highest"
     (6-pass bf16, ≈exact f32, the safe default: cond(XᵀX) = cond(X)²) or
-    "high" (3-pass, ~2× the throughput, f32-representation-level error;
-    fine for well-conditioned problems).
+    "high" (f32-representation-level error; fine for well-conditioned
+    problems). For f32 panels, "high" uses a SYMMETRIC 2-pass split
+    instead of XLA's generic bf16x3: the Gram's cross terms loᵀ·hi and
+    hiᵀ·lo are transposes of each other, so HiᵀHi + HiᵀLo + (HiᵀLo)ᵀ
+    reproduces the exact same three products with one MXU pass fewer —
+    a 33% FLOP cut XLA cannot apply because its dot lowering does not
+    know both operands are the same matrix (round-3 floor analysis,
+    docs/ROUND3.md).
     """
     import math as _math
     if precision.lower() not in ("default", "high", "highest"):
@@ -124,8 +130,18 @@ def fit_streaming(n_rows: int, k: int,
             def body(p, carry):
                 gram, rhs = carry
                 xp, yp = panel_fn(p)
-                gram = gram + jnp.einsum("nk,nj->kj", xp, xp, precision=prec,
-                                         preferred_element_type=jnp.float32)
+                if precision == "high" and xp.dtype == jnp.float32:
+                    # symmetric 2-pass bf16 split (see docstring);
+                    # shared identity lives in ops/gram.py
+                    from matrel_tpu.ops.gram import symmetric_gram
+                    gram = gram + symmetric_gram(
+                        xp, lambda p, q: jnp.einsum(
+                            "nk,nj->kj", p, q,
+                            preferred_element_type=jnp.float32))
+                else:
+                    gram = gram + jnp.einsum(
+                        "nk,nj->kj", xp, xp, precision=prec,
+                        preferred_element_type=jnp.float32)
                 rhs = rhs + jnp.einsum("nk,nj->kj", xp, yp, precision=prec,
                                        preferred_element_type=jnp.float32)
                 return gram, rhs

@@ -60,3 +60,14 @@ def _fresh_session():
     session.reset_session()
     yield
     session.reset_session()
+
+
+@pytest.fixture(autouse=True)
+def _autotune_table_tmp(tmp_path, monkeypatch):
+    """Keep the persisted autotune table out of the repo root and out of
+    cross-test state: each test gets a fresh table path + empty cache."""
+    from matrel_tpu.parallel import autotune
+    monkeypatch.setattr(autotune, "_DEFAULT_TABLE",
+                        str(tmp_path / "autotune.json"))
+    autotune._CACHE.clear()
+    yield

@@ -385,3 +385,76 @@ def test_norms(mesh8, rng):
     tiny = bm(np.full((4, 4), -1e-30, np.float32), mesh8)
     assert tiny.norm("max").compute().to_numpy()[0, 0] == pytest.approx(
         1e-30, rel=1e-4)
+
+
+class TestSymmetricGramLowering:
+    """matmul(Aᵀ, A) / matmul(A, Aᵀ) under precision="high" lowers to
+    the symmetric 2-pass bf16 split (round-3: 33% fewer MXU FLOPs at
+    bf16x3-identical accuracy; docs/ROUND3.md)."""
+
+    def _cfg(self):
+        from matrel_tpu.config import MatrelConfig
+        return MatrelConfig(matmul_precision="high")
+
+    def test_ata_matches_oracle(self, mesh8, rng):
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.executor import execute
+        a = rng.standard_normal((48, 24)).astype(np.float32)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        out = execute(A.expr().t().multiply(A.expr()), mesh8,
+                      self._cfg()).to_numpy()
+        np.testing.assert_allclose(out, a.T @ a, rtol=2e-3, atol=2e-3)
+
+    def test_aat_matches_oracle(self, mesh8, rng):
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.executor import execute
+        a = rng.standard_normal((24, 48)).astype(np.float32)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        out = execute(A.expr().multiply(A.expr().t()), mesh8,
+                      self._cfg()).to_numpy()
+        np.testing.assert_allclose(out, a @ a.T, rtol=2e-3, atol=2e-3)
+
+    def test_two_bf16_passes_not_one_f32(self, mesh8, rng, monkeypatch):
+        # spy: the gram path must call run_matmul TWICE with bf16
+        # operands (hi·hi, hi·lo) instead of once with f32
+        import jax.numpy as jnp
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.executor import execute
+        from matrel_tpu.parallel import strategies
+        calls = []
+        real = strategies.run_matmul
+
+        def spy(strategy, x, y, mesh, config=None, **kw):
+            calls.append((x.dtype, y.dtype))
+            return real(strategy, x, y, mesh, config, **kw)
+
+        monkeypatch.setattr(strategies, "run_matmul", spy)
+        a = rng.standard_normal((32, 16)).astype(np.float32)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        execute(A.expr().t().multiply(A.expr()), mesh8, self._cfg())
+        gram_calls = [c for c in calls if c == (jnp.bfloat16, jnp.bfloat16)]
+        assert len(gram_calls) == 2, calls
+
+    def test_highest_precision_keeps_generic_path(self, mesh8, rng):
+        # default "highest" must NOT take the 2-pass split (it would
+        # silently downgrade accuracy): result ≈ f32-exact
+        from matrel_tpu.config import MatrelConfig
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.executor import execute
+        a = rng.standard_normal((32, 16)).astype(np.float32)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        out = execute(A.expr().t().multiply(A.expr()), mesh8,
+                      MatrelConfig(matmul_precision="highest")).to_numpy()
+        np.testing.assert_allclose(out, a.T @ a, rtol=1e-5, atol=1e-5)
+
+    def test_distinct_matrices_not_treated_as_gram(self, mesh8, rng):
+        # Bᵀ·A with B ≠ A must stay on the generic path and be correct
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.executor import execute
+        a = rng.standard_normal((48, 24)).astype(np.float32)
+        b = rng.standard_normal((48, 24)).astype(np.float32)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        B = BlockMatrix.from_numpy(b, mesh=mesh8)
+        out = execute(B.expr().t().multiply(A.expr()), mesh8,
+                      self._cfg()).to_numpy()
+        np.testing.assert_allclose(out, b.T @ a, rtol=2e-3, atol=2e-3)

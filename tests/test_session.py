@@ -144,3 +144,119 @@ def test_iterative_queries_under_aggressive_eviction(mesh8, rng):
         state = want
         S = sess.from_numpy(state)
     assert sess.plan_cache_info()["plans"] <= 2
+
+
+class TestPlanCacheCallableKeys:
+    """The plan key must distinguish callable attrs (ADVICE r2 high):
+    pre-fix, two queries differing only in a predicate/merge callable
+    shared one cache entry and the second silently returned the first's
+    results."""
+
+    def test_where_predicates_key_separately(self, mesh8, rng):
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        sess.register("A", sess.from_numpy(a))
+        pos = sess.compute(sess.sql("SELECT A WHERE v > 0")).to_numpy()
+        neg = sess.compute(sess.sql("SELECT A WHERE v < 0")).to_numpy()
+        np.testing.assert_allclose(pos, np.where(a > 0, a, 0), rtol=1e-5)
+        np.testing.assert_allclose(neg, np.where(a < 0, a, 0), rtol=1e-5)
+
+    def test_joinvalue_merge_exprs_key_separately(self, mesh8, rng):
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((4, 4)).astype(np.float32)
+        sess.register("A", sess.from_numpy(a))
+        add = sess.compute(
+            sess.sql("rowsum(joinvalue(A, A, 'x + y'))")).to_numpy()
+        sub = sess.compute(
+            sess.sql("rowsum(joinvalue(A, A, 'x - y'))")).to_numpy()
+        assert not np.allclose(add, sub)
+
+    def test_raw_lambdas_key_separately(self, mesh8, rng):
+        sess = MatrelSession(mesh=mesh8)
+        m = sess.from_numpy(rng.standard_normal((8, 8)).astype(np.float32))
+        a = m.to_numpy()
+        hi = sess.compute(m.expr().select_value(lambda v: v > 0.5)).to_numpy()
+        lo = sess.compute(m.expr().select_value(lambda v: v < -0.5)).to_numpy()
+        np.testing.assert_allclose(hi, np.where(a > 0.5, a, 0), rtol=1e-5)
+        np.testing.assert_allclose(lo, np.where(a < -0.5, a, 0), rtol=1e-5)
+
+    def test_identical_sql_text_still_hits_cache(self, mesh8, rng):
+        # correctness must not cost the cache: re-parsing the same query
+        # makes a fresh callable, but the attached source key matches
+        sess = MatrelSession(mesh=mesh8)
+        sess.register("A", sess.from_numpy(
+            rng.standard_normal((8, 8)).astype(np.float32)))
+        p1 = sess.compile(sess.sql("SELECT A WHERE v > 0"))
+        p2 = sess.compile(sess.sql("SELECT A WHERE v > 0"))
+        assert p1 is p2
+        assert sess.plan_cache_info()["plans"] == 1
+
+    def test_selectblocks_predicates_key_separately(self, mesh8, rng):
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        sess.register("A", sess.from_numpy(a))
+        diag = sess.compute(
+            sess.sql("selectblocks(A, 'bi == bj', 4)")).to_numpy()
+        off = sess.compute(
+            sess.sql("selectblocks(A, 'bi != bj', 4)")).to_numpy()
+        np.testing.assert_allclose(diag + off, a, rtol=1e-5)
+        assert not np.allclose(diag, off)
+
+
+class TestPlanKeyGlobalsAndPinning:
+    """Code-review r3 findings: lambdas reading module globals must key
+    by the global's VALUE, and id-keyed objects must stay pinned while
+    their plan is cached (CPython address reuse)."""
+
+    def test_global_value_change_keys_differently(self, mesh8, rng):
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        m = sess.from_numpy(a)
+        g = {"thr": 0.5}
+        f1 = eval("lambda v: v > thr", g)          # noqa: S307 — test fixture
+        r1 = sess.compute(m.expr().select_value(f1)).to_numpy()
+        g["thr"] = -0.5
+        f2 = eval("lambda v: v > thr", g)          # noqa: S307
+        r2 = sess.compute(m.expr().select_value(f2)).to_numpy()
+        np.testing.assert_allclose(r1, np.where(a > 0.5, a, 0), rtol=1e-5)
+        np.testing.assert_allclose(r2, np.where(a > -0.5, a, 0), rtol=1e-5)
+
+    def test_cached_plan_pins_keyed_callable(self, mesh8, rng):
+        import gc
+        import weakref
+        sess = MatrelSession(mesh=mesh8)
+        m = sess.from_numpy(rng.standard_normal((8, 8)).astype(np.float32))
+
+        def pred(v):
+            return v > 0.25
+
+        wr = weakref.ref(pred)
+        sess.compile(m.expr().select_value(pred))
+        del pred
+        gc.collect()
+        # while the plan is cached, the callable's id must stay valid
+        assert wr() is not None
+        sess._plan_cache.clear()
+        gc.collect()
+        assert wr() is None
+
+    def test_rebound_array_global_keys_and_pins(self, mesh8, rng):
+        # review r3: a non-scalar global (numpy array) keys by id and
+        # its OLD value must stay pinned after rebinding — the recycled
+        # address can otherwise falsely hit the stale plan
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        m = sess.from_numpy(a)
+        g = {"thr": np.array(0.5, np.float32)}
+        f1 = eval("lambda v: v > thr", g)          # noqa: S307
+        r1 = sess.compute(m.expr().select_value(f1)).to_numpy()
+        old_thr = g["thr"]
+        g["thr"] = np.array(-0.5, np.float32)      # rebind the global
+        f2 = eval("lambda v: v > thr", g)          # noqa: S307
+        r2 = sess.compute(m.expr().select_value(f2)).to_numpy()
+        np.testing.assert_allclose(r1, np.where(a > 0.5, a, 0), rtol=1e-5)
+        np.testing.assert_allclose(r2, np.where(a > -0.5, a, 0), rtol=1e-5)
+        # the old value object is pinned by the cached first plan
+        pinned = [p for plan in sess._plan_cache.values()
+                  for p in plan._cache_pin[1]]
+        assert any(p is old_thr for p in pinned)

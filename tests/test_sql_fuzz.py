@@ -60,6 +60,11 @@ def _gen(rng, env, depth):
     raise AssertionError(op)
 
 
+def _nnz_avg(v):
+    n = np.count_nonzero(v)
+    return (v.sum() / max(n, 1)).reshape(1, 1)
+
+
 _TERMINALS = {
     "rowsum({q})": lambda v: v.sum(1, keepdims=True),
     "colsum({q})": lambda v: v.sum(0, keepdims=True),
@@ -67,6 +72,15 @@ _TERMINALS = {
     "trace({q})": lambda v: np.trace(v).reshape(1, 1),
     "rowmax({q})": lambda v: v.max(1, keepdims=True),
     "colmin({q})": lambda v: v.min(0, keepdims=True),
+    # round-3 grammar closure: global + diag aggregate spellings
+    "max({q})": lambda v: v.max().reshape(1, 1),
+    "min({q})": lambda v: v.min().reshape(1, 1),
+    "count({q})": lambda v: np.float64(np.count_nonzero(v)).reshape(1, 1),
+    "avg({q})": _nnz_avg,
+    "diagsum({q})": lambda v: np.trace(v).reshape(1, 1),
+    "diagmax({q})": lambda v: v.diagonal().max().reshape(1, 1),
+    "diagmin({q})": lambda v: v.diagonal().min().reshape(1, 1),
+    "diagavg({q})": lambda v: _nnz_avg(v.diagonal()),
     "{q}": lambda v: v,
 }
 

@@ -102,6 +102,50 @@ class TestStreamingLinreg:
         np.testing.assert_allclose(theta, np.asarray(theta_true),
                                    rtol=1e-3, atol=1e-3)
 
+    def test_streaming_high_symmetric_matches_oracle(self, mesh8):
+        # round-3: precision="high" on f32 panels takes the SYMMETRIC
+        # 2-pass bf16 split; theta must still recover to f32-level
+        # accuracy and agree with the "highest" path closely
+        import jax
+        import jax.numpy as jnp
+        from matrel_tpu.workloads.linreg import fit_streaming
+        k, n, panel = 16, 1024, 256
+        theta_true = jnp.linspace(-2.0, 2.0, k).reshape(k, 1)
+
+        def panel_fn(p):
+            key = jax.random.fold_in(jax.random.PRNGKey(3), p)
+            xp = jax.random.normal(key, (panel, k), jnp.float32)
+            yp = xp @ theta_true
+            return xp, yp
+
+        th_high = np.asarray(fit_streaming(n, k, panel_fn,
+                                           panel_rows=panel, mesh=mesh8,
+                                           precision="high"))
+        th_highest = np.asarray(fit_streaming(n, k, panel_fn,
+                                              panel_rows=panel,
+                                              mesh=mesh8,
+                                              precision="highest"))
+        np.testing.assert_allclose(th_high, np.asarray(theta_true),
+                                   rtol=5e-3, atol=5e-3)
+        np.testing.assert_allclose(th_high, th_highest, rtol=5e-3,
+                                   atol=5e-3)
+
+    def test_symmetric_gram_term_equivalence(self):
+        # the 2-pass identity itself: HiHi + HiLo + HiLo^T equals the
+        # generic 3-term split HiHi + HiLo + LoHi exactly
+        import jax.numpy as jnp
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.standard_normal((64, 8)), jnp.float32)
+        hi = x.astype(jnp.bfloat16)
+        lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        d = lambda a, b: jnp.einsum("nk,nj->kj", a, b,
+                                    preferred_element_type=jnp.float32)
+        sym = d(hi, hi) + d(hi, lo) + d(hi, lo).T
+        generic = d(hi, hi) + d(hi, lo) + d(lo, hi)
+        np.testing.assert_allclose(np.asarray(sym), np.asarray(generic),
+                                   rtol=0, atol=0)
+
+
 
 class TestEdgePageRank:
     def test_edges_matches_dense_oracle(self, mesh8, rng):

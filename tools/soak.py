@@ -334,6 +334,7 @@ def main():
                    help="run on the real chip (looser tolerance)")
     args = p.parse_args()
     _setup(args.tpu)
+    t_start = __import__("time").time()
     tol = 5e-3 if args.tpu else 3e-3
     fails = []
     if args.battery in ("fuzz", "all"):
@@ -362,7 +363,36 @@ def main():
     print(f"SOAK COMPLETE: {len(fails)} failures")
     for f in fails[:20]:
         print(" ", f)
+    _log_tally(args, len(fails), fails[:20], t_start)
     sys.exit(min(len(fails), 125))
+
+
+def _log_tally(args, n_fails, fail_heads, t_start):
+    """Append a machine-checkable tally line to SOAKLOG.jsonl — the
+    committed evidence trail for soak runs (round-2 VERDICT: tallies
+    lived only as prose in docs). Every run, CPU or TPU, logs here;
+    soak_guard additionally logs its wrapper event to PROGRESS.jsonl."""
+    import json
+    import time
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:
+        backend = os.environ.get("JAX_PLATFORMS", "(default)")
+    rec = {"ts": round(time.time(), 1),
+           "when": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "event": "soak", "battery": args.battery,
+           "seeds": args.seeds, "base": args.base,
+           "tpu": bool(args.tpu),
+           "backend": backend,
+           "failures": n_fails,
+           "fail_heads": [str(f) for f in fail_heads],
+           "wall_s": round(time.time() - t_start, 1)}
+    try:
+        with open(os.path.join(REPO, "SOAKLOG.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as e:
+        print(f"# could not append SOAKLOG.jsonl: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":
