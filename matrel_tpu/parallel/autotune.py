@@ -149,8 +149,13 @@ def autotune_matmul(n: int, k: int, m: int,
             continue       # on this backend just drops out of the table
     best = min(results, key=results.get)
     _CACHE[key] = (best, results)
-    _persist(_table_path(cfg), _table_key(side, gx, gy, str(dtype)),
-             best, results)
+    if cfg.autotune or cfg.autotune_table_path:
+        # persist only when the closed loop is on or the caller named a
+        # table explicitly — a one-off measurement call (the original
+        # API contract, also the CLI) must not drop a hidden JSON file
+        # into the working directory as a side effect
+        _persist(_table_path(cfg), _table_key(side, gx, gy, str(dtype)),
+                 best, results)
     return best, results
 
 

@@ -215,12 +215,15 @@ def _fn_token(fn, pins: list) -> str:
             parts.append(f"cell:{id(cell)}")
     # referenced globals are part of the behaviour: `thr = 0.5;
     # lambda v: v > thr` re-created after `thr = -0.5` has identical
-    # code/consts/names and must NOT key identically. Scalars key by
+    # code/consts/names and must NOT key identically. Names are
+    # collected TRANSITIVELY through nested code objects (an inner
+    # lambda/genexp reads the same __globals__ but its names live on
+    # its own code constant, not the outer co_names). Scalars key by
     # value; modules/builtins by name (stable); anything else by
     # identity (pinned — a REBOUND global's old value would otherwise
     # free and its address recycle into a false hit).
     g = getattr(fn, "__globals__", None) or {}
-    for name in code.co_names:
+    for name in sorted(_code_names(code)):
         if name in g:
             v = g[name]
             if v is None or isinstance(v, (bool, int, float, str)):
@@ -230,9 +233,22 @@ def _fn_token(fn, pins: list) -> str:
             else:
                 pins.append(v)
                 parts.append(f"{name}=gid:{id(v)}")
-    parts.append(repr(getattr(fn, "__defaults__", None)))
+    # defaults go through _attr_token, NOT bare repr: a default object
+    # with a state-independent custom __repr__ would otherwise collide
+    parts.append(_attr_token(tuple(getattr(fn, "__defaults__", None)
+                                   or ()), pins))
     digest = hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
     return f"fncode:{digest}"
+
+
+def _code_names(code) -> set:
+    """co_names of a code object UNION those of every nested code
+    object (inner lambdas, genexps, nested defs share __globals__)."""
+    names = set(code.co_names)
+    for c in code.co_consts:
+        if isinstance(c, types.CodeType):
+            names |= _code_names(c)
+    return names
 
 
 def _attr_token(v, pins: list) -> str:

@@ -1,0 +1,45 @@
+"""Cosine-similarity matrix — a row-similarity "matrix analytics" query
+(the all-pairs similarity workload of relational-matrix systems):
+
+    S = D⁻¹ · (X·Xᵀ) · D⁻¹,   D = diag(‖x_i‖₂)
+
+The X·Xᵀ core is a GRAM, so under ``matmul_precision="high"`` the
+executor's symmetric 2-pass bf16 split (ops/gram.py, round-3) applies
+automatically — this workload is the user-facing consumer of that
+lowering. The normalisation is rowwise masking-safe elementwise math on
+the framework surface (no host round-trips); thresholded similarity
+joins compose via select_value on the result.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import numpy as np
+
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.ir import expr as E
+
+
+def cosine_similarity_expr(X: Union[BlockMatrix, E.MatExpr]) -> E.MatExpr:
+    """Lazy S = normalize-rows(X) gram: S[i,j] = cos(x_i, x_j).
+
+    Expressed as G / (n·nᵀ) with G = X·Xᵀ and n = sqrt(rowSum(X∘X)):
+    one gram multiply (symmetric-split eligible), one rank-1-shaped
+    denominator via a row-norm outer product, one elementwise divide.
+    """
+    x = E.as_expr(X)
+    g = x.multiply(x.t())                        # X·Xᵀ — gram path
+    sq = E.agg(E.elemwise("mul", x, x), "sum", "row")   # (n, 1) ‖x‖²
+    norms = sq.power(0.5)
+    denom = norms.multiply(norms.t())            # ‖x_i‖·‖x_j‖ outer
+    return E.elemwise("div", g, denom)
+
+
+def cosine_similarity(X: Union[BlockMatrix, E.MatExpr]) -> np.ndarray:
+    return cosine_similarity_expr(X).compute().to_numpy()
+
+
+def cosine_similarity_numpy_oracle(x: np.ndarray) -> np.ndarray:
+    n = np.linalg.norm(x, axis=1, keepdims=True)
+    return (x @ x.T) / (n @ n.T)

@@ -1,0 +1,46 @@
+"""Triangle counting — the classic graph "matrix query" workload
+(SURVEY.md §1 L6 "graph/matrix queries"): the number of triangles in an
+undirected graph is trace(A³)/6.
+
+Built entirely through the framework's query surface, so it exercises
+the stack the way a MatRel user would write it:
+  - the IR multiply chain A·A·A goes through chain-DP (all dims equal,
+    so the DP is a tie — the comm term breaks it),
+  - trace(·) is the γ(sum, diag) aggregate, and R3 pushes the diagonal
+    aggregate INTO the final multiply where profitable,
+  - sparse adjacency enters as a BlockSparse or COO leaf and routes
+    through the corresponding kernels.
+
+Also exposed through SQL: ``trace(A * A * A)`` over a registered
+adjacency table computes the same plan.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import numpy as np
+
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.ir import expr as E
+
+
+def triangle_count_expr(A: Union[BlockMatrix, E.MatExpr]) -> E.MatExpr:
+    """trace(A·A·A) as a lazy expression; divide by 6 on the scalar
+    result for the triangle count of a simple undirected graph."""
+    a = E.as_expr(A)
+    if a.shape[0] != a.shape[1]:
+        raise ValueError(f"adjacency must be square, got {a.shape}")
+    return E.agg(a.multiply(a).multiply(a), "sum", "diag")
+
+
+def triangle_count(A: Union[BlockMatrix, E.MatExpr]) -> float:
+    """Number of triangles in the simple undirected graph with
+    0/1 symmetric adjacency ``A`` (zero diagonal)."""
+    out = triangle_count_expr(A).compute().to_numpy()
+    return float(out[0, 0]) / 6.0
+
+
+def triangles_numpy_oracle(a: np.ndarray) -> float:
+    """Dense numpy oracle for tests."""
+    return float(np.trace(a @ a @ a)) / 6.0

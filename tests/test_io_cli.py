@@ -207,3 +207,17 @@ def test_sql_explain_flag(tmp_path, capsys):
     cli_main(["sql", "rowsum(A * A)", "--table", f"A={p}", "--explain"])
     out = capsys.readouterr().out
     assert "== Optimized plan ==" in out and "matmul" in out
+
+
+def test_plain_autotune_call_leaves_no_table_file(mesh8, tmp_path,
+                                                  monkeypatch):
+    # review r3: a one-off measurement (autotune flag off, no explicit
+    # path) must not drop a hidden JSON into the working directory
+    import os
+    from matrel_tpu.parallel import autotune
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(autotune, "_DEFAULT_TABLE",
+                        str(tmp_path / ".matrel_autotune.json"))
+    autotune._CACHE.clear()
+    autotune.autotune_matmul(64, 64, 64, mesh=mesh8)
+    assert not os.path.exists(tmp_path / ".matrel_autotune.json")

@@ -260,3 +260,39 @@ class TestPlanKeyGlobalsAndPinning:
         pinned = [p for plan in sess._plan_cache.values()
                   for p in plan._cache_pin[1]]
         assert any(p is old_thr for p in pinned)
+
+    def test_nested_lambda_global_keys_differently(self, mesh8, rng):
+        # review r3 (confirmed repro): a global read only by a NESTED
+        # code object must still enter the fingerprint
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        m = sess.from_numpy(a)
+        g = {"thr": 0.5}
+        make = eval("lambda: (lambda v: (lambda w: w > thr)(v))", g)  # noqa: S307
+        r1 = sess.compute(m.expr().select_value(make())).to_numpy()
+        g["thr"] = -3.0
+        make2 = eval("lambda: (lambda v: (lambda w: w > thr)(v))", g)  # noqa: S307
+        r2 = sess.compute(m.expr().select_value(make2())).to_numpy()
+        np.testing.assert_allclose(r1, np.where(a > 0.5, a, 0), rtol=1e-5)
+        np.testing.assert_allclose(r2, np.where(a > -3.0, a, 0), rtol=1e-5)
+
+    def test_custom_repr_default_objects_key_differently(self, mesh8, rng):
+        # review r3: default objects with state-independent __repr__
+        # must key by identity, not repr
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        m = sess.from_numpy(a)
+
+        class Thr:
+            def __init__(self, t):
+                self.t = t
+
+            def __repr__(self):
+                return "<Thr>"
+
+        f1 = lambda v, thr=Thr(0.5): v > thr.t      # noqa: E731
+        f2 = lambda v, thr=Thr(-0.5): v > thr.t     # noqa: E731
+        r1 = sess.compute(m.expr().select_value(f1)).to_numpy()
+        r2 = sess.compute(m.expr().select_value(f2)).to_numpy()
+        np.testing.assert_allclose(r1, np.where(a > 0.5, a, 0), rtol=1e-5)
+        np.testing.assert_allclose(r2, np.where(a > -0.5, a, 0), rtol=1e-5)

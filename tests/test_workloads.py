@@ -285,3 +285,81 @@ class TestBlockSparsePageRank:
             S, rounds=20, config=MatrelConfig(use_pallas=False)))
         oracle = pagerank_numpy_oracle(a, rounds=20)
         np.testing.assert_allclose(r, oracle, rtol=1e-3, atol=1e-6)
+
+
+class TestTriangleCount:
+    def test_matches_numpy_oracle(self, mesh8, rng):
+        from matrel_tpu.workloads import triangles as T
+        n = 40
+        a = (rng.random((n, n)) < 0.2).astype(np.float32)
+        a = np.triu(a, 1)
+        a = a + a.T                       # symmetric, zero diagonal
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        got = T.triangle_count(A)
+        assert got == pytest.approx(T.triangles_numpy_oracle(a), rel=1e-4)
+
+    def test_known_small_graph(self, mesh8):
+        from matrel_tpu.workloads import triangles as T
+        # K4 has C(4,3) = 4 triangles
+        a = (np.ones((4, 4)) - np.eye(4)).astype(np.float32)
+        assert T.triangle_count(
+            BlockMatrix.from_numpy(a, mesh=mesh8)) == pytest.approx(4.0)
+
+    def test_via_sql(self, mesh8, rng):
+        from matrel_tpu.session import MatrelSession
+        from matrel_tpu.workloads import triangles as T
+        n = 24
+        a = (rng.random((n, n)) < 0.3).astype(np.float32)
+        a = np.triu(a, 1); a = a + a.T
+        s = MatrelSession(mesh=mesh8)
+        s.register("A", s.from_numpy(a))
+        got = s.compute(s.sql("trace(A * A * A)")).to_numpy()[0, 0] / 6.0
+        assert got == pytest.approx(T.triangles_numpy_oracle(a), rel=1e-4)
+
+    def test_rejects_nonsquare(self, mesh8, rng):
+        from matrel_tpu.workloads import triangles as T
+        A = BlockMatrix.from_numpy(
+            rng.standard_normal((4, 6)).astype(np.float32), mesh=mesh8)
+        with pytest.raises(ValueError):
+            T.triangle_count_expr(A)
+
+
+class TestCosineSimilarity:
+    def test_matches_numpy_oracle(self, mesh8, rng):
+        from matrel_tpu.workloads import similarity as S
+        x = rng.standard_normal((20, 12)).astype(np.float32) + 0.1
+        X = BlockMatrix.from_numpy(x, mesh=mesh8)
+        got = S.cosine_similarity(X)
+        np.testing.assert_allclose(
+            got, S.cosine_similarity_numpy_oracle(x), rtol=2e-3, atol=2e-3)
+
+    def test_diagonal_is_one(self, mesh8, rng):
+        from matrel_tpu.workloads import similarity as S
+        x = rng.standard_normal((16, 8)).astype(np.float32) + 0.2
+        got = S.cosine_similarity(BlockMatrix.from_numpy(x, mesh=mesh8))
+        np.testing.assert_allclose(np.diagonal(got), 1.0, atol=1e-3)
+
+    def test_gram_path_engaged_under_high_precision(self, mesh8, rng,
+                                                    monkeypatch):
+        # the X·Xᵀ core must route through the symmetric 2-pass split
+        import jax.numpy as jnp
+        from matrel_tpu.config import MatrelConfig
+        from matrel_tpu.executor import execute
+        from matrel_tpu.parallel import strategies
+        from matrel_tpu.workloads import similarity as S
+        calls = []
+        real = strategies.run_matmul
+
+        def spy(strategy, p, q, mesh, config=None, **kw):
+            calls.append((p.dtype, q.dtype))
+            return real(strategy, p, q, mesh, config, **kw)
+
+        monkeypatch.setattr(strategies, "run_matmul", spy)
+        x = rng.standard_normal((24, 12)).astype(np.float32) + 0.1
+        X = BlockMatrix.from_numpy(x, mesh=mesh8)
+        out = execute(S.cosine_similarity_expr(X), mesh8,
+                      MatrelConfig(matmul_precision="high")).to_numpy()
+        assert [c for c in calls
+                if c == (jnp.bfloat16, jnp.bfloat16)], calls
+        np.testing.assert_allclose(
+            out, S.cosine_similarity_numpy_oracle(x), rtol=5e-3, atol=5e-3)
