@@ -45,7 +45,9 @@ print(f"triangles via SQL: {tri_sql:.0f}")
 x = rng.standard_normal((512, 64)).astype(np.float32)
 X = sess.from_numpy(x)
 S = similarity.cosine_similarity_expr(X)
-# similar pairs: entries of S above 0.8, off-diagonal, counted
+# similar pairs: entries of S above 0.8, counted (the n diagonal
+# self-pairs cos(x_i, x_i) = 1 are included — subtract n for the
+# off-diagonal count, as the print below notes)
 sim_pairs = R.aggregate(
     R.select_entries(S, lambda v: v > 0.8), "count", "all")
 cnt = sess.compute(sim_pairs).to_numpy()[0, 0]

@@ -590,12 +590,14 @@ class Lowerer:
         # full rematerialisation moving small leaf shardings around);
         # the query side is va for row/all aggregates, vb for col
         query_n = na if axis in ("row", "all") else nb
-        if (structured and axis != "diag" and self.mesh.size > 1
+        if (axis != "diag" and self.mesh.size > 1
                 and query_n >= 128 * self.mesh.size):
-            # the sort path is embarrassingly parallel over the
-            # query side after the sort: shard the query entries
-            # across every device (sorted operand replicated), so
-            # searchsorted/prefix-gathers run on na/P entries per chip
+            # BOTH streaming paths are embarrassingly parallel over the
+            # query side: the sort path's searchsorted/prefix-gathers
+            # and the chunked path's per-row tile reductions each run
+            # on query_n/P entries per chip once the query entries are
+            # sharded across every device (the other operand
+            # replicated — it is read whole by every row's scan)
             from jax.sharding import NamedSharding, PartitionSpec as P
             axes = tuple(self.mesh.axis_names)
             flat = NamedSharding(self.mesh, P(axes))

@@ -133,6 +133,7 @@ def autotune_matmul(n: int, k: int, m: int,
     gx, gy = mesh_lib.mesh_grid_shape(mesh)
     key = (side, gx, gy, str(dtype))
     if key in _CACHE:
+        _maybe_persist_cached(cfg, key)
         return _CACHE[key]
     A = BlockMatrix.random((side, side), mesh=mesh, seed=0, dtype=dtype)
     B = BlockMatrix.random((side, side), mesh=mesh, seed=1, dtype=dtype)
@@ -159,6 +160,22 @@ def autotune_matmul(n: int, k: int, m: int,
     return best, results
 
 
+def _maybe_persist_cached(config: Optional[MatrelConfig],
+                          key: tuple) -> None:
+    """A shape first measured with persistence OFF (one-off call) must
+    still reach the table when a later caller enables the closed loop —
+    both cache-hit early-returns route through here."""
+    cfg = config or default_config()
+    if not (cfg.autotune or cfg.autotune_table_path):
+        return
+    side, gx, gy, dtype = key
+    best, results = _CACHE[key]
+    path = _table_path(cfg)
+    tkey = _table_key(side, gx, gy, dtype)
+    if tkey not in _load_table_cached(path):
+        _persist(path, tkey, best, results)
+
+
 def lookup_or_measure(n: int, k: int, m: int, mesh,
                       dtype: str = "float32",
                       config: Optional[MatrelConfig] = None
@@ -173,6 +190,7 @@ def lookup_or_measure(n: int, k: int, m: int, mesh,
     gx, gy = mesh_lib.mesh_grid_shape(mesh)
     key = (side, gx, gy, str(dtype))
     if key in _CACHE:
+        _maybe_persist_cached(cfg, key)
         return _CACHE[key][0]
     entry = _load_table_cached(_table_path(cfg)).get(
         _table_key(side, gx, gy, str(dtype)))

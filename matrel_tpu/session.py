@@ -160,8 +160,23 @@ class MatrelSession:
     def compute(self, expr: MatExpr) -> BlockMatrix:
         return self.compile(expr).run()
 
-    def explain(self, expr: MatExpr) -> str:
-        return as_expr(expr).explain(self.config)
+    def explain(self, expr: MatExpr, physical: bool = True) -> str:
+        """Logical, optimized AND physical plan text. With ``physical``
+        (default) the expression is compiled (cached — a following
+        compute() reuses the plan), so the optimized section carries
+        the chosen matmul strategies / join schemes and a collectives
+        summary — the reference's EXPLAIN shows its physical operators
+        the same way. ``physical=False`` skips compilation."""
+        e = as_expr(expr)
+        if not physical:
+            return e.explain(self.config)
+        from matrel_tpu.ir.expr import pretty
+        head = "== Logical plan ==\n" + pretty(e)
+        try:
+            return head + "\n" + self.compile(e).explain()
+        except Exception as ex:  # EXPLAIN must not fail on exotic plans
+            return (e.explain(self.config)
+                    + f"\n== Physical plan unavailable: {ex!r} ==")
 
     def sql(self, query: str) -> MatExpr:
         """SQL-ish entry point over registered matrix tables (the reference's

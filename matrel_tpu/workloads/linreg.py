@@ -66,11 +66,21 @@ def fit_fused(X: BlockMatrix, y: BlockMatrix, l2: float = 0.0,
     @jax.jit
     def step(xd, yd):
         xs = jax.lax.with_sharding_constraint(xd, NamedSharding(mesh, row_spec))
-        prec = jax.lax.Precision.HIGHEST
+        prec = getattr(jax.lax.Precision, cfg.matmul_precision.upper(),
+                       jax.lax.Precision.HIGHEST)
+        if (cfg.matmul_precision == "high"
+                and xs.dtype == jnp.float32):
+            # symmetric 2-pass bf16 Gram (ops/gram.py, round-3)
+            from matrel_tpu.ops.gram import symmetric_gram
+            gram_raw = symmetric_gram(
+                xs, lambda p, q: jnp.einsum(
+                    "nk,nj->kj", p, q,
+                    preferred_element_type=jnp.float32))
+        else:
+            gram_raw = jnp.einsum("nk,nj->kj", xs, xs, precision=prec,
+                                  preferred_element_type=jnp.float32)
         gram = jax.lax.with_sharding_constraint(
-            jnp.einsum("nk,nj->kj", xs, xs, precision=prec,
-                       preferred_element_type=jnp.float32),
-            NamedSharding(mesh, P()))
+            gram_raw, NamedSharding(mesh, P()))
         rhs = jax.lax.with_sharding_constraint(
             jnp.einsum("nk,nj->kj", xs, yd, precision=prec,
                        preferred_element_type=jnp.float32),

@@ -221,3 +221,20 @@ def test_plain_autotune_call_leaves_no_table_file(mesh8, tmp_path,
     autotune._CACHE.clear()
     autotune.autotune_matmul(64, 64, 64, mesh=mesh8)
     assert not os.path.exists(tmp_path / ".matrel_autotune.json")
+
+
+def test_cached_measurement_persists_when_loop_enabled_later(mesh8,
+                                                             tmp_path):
+    # review r3: shape measured with persistence OFF, then requested
+    # with the closed loop ON in the same process -> table gains it
+    import os
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.parallel import autotune
+    autotune._CACHE.clear()
+    best, _ = autotune.autotune_matmul(64, 64, 64, mesh=mesh8)  # no persist
+    path = str(tmp_path / "t.json")
+    assert not os.path.exists(path)
+    cfg = MatrelConfig(autotune=True, autotune_table_path=path)
+    got = autotune.lookup_or_measure(64, 64, 64, mesh8, "float32", cfg)
+    assert got == best
+    assert autotune.load_table(path)["64|2x4|float32"]["best"] == best

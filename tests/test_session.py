@@ -296,3 +296,20 @@ class TestPlanKeyGlobalsAndPinning:
         r2 = sess.compute(m.expr().select_value(f2)).to_numpy()
         np.testing.assert_allclose(r1, np.where(a > 0.5, a, 0), rtol=1e-5)
         np.testing.assert_allclose(r2, np.where(a > -0.5, a, 0), rtol=1e-5)
+
+
+def test_session_explain_includes_physical_plan(mesh8, rng):
+    """round-3: EXPLAIN shows the physical annotations (strategy,
+    collectives) without the user reaching for compile().explain()."""
+    sess = MatrelSession(mesh=mesh8)
+    a = sess.from_numpy(rng.standard_normal((32, 32)).astype(np.float32))
+    b = sess.from_numpy(rng.standard_normal((32, 32)).astype(np.float32))
+    e = a.expr().multiply(b.expr())
+    txt = sess.explain(e)
+    assert "strategy=" in txt
+    assert "== Logical plan ==" in txt and "== Optimized plan ==" in txt
+    # logical-only mode skips compilation
+    txt2 = sess.explain(e, physical=False)
+    assert "strategy=" not in txt2
+    # explain warmed the cache: compute() reuses the compiled plan
+    assert sess.plan_cache_info()["plans"] >= 1

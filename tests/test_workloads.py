@@ -363,3 +363,21 @@ class TestCosineSimilarity:
                 if c == (jnp.bfloat16, jnp.bfloat16)], calls
         np.testing.assert_allclose(
             out, S.cosine_similarity_numpy_oracle(x), rtol=5e-3, atol=5e-3)
+
+
+def test_fit_fused_honors_high_precision(mesh8, rng):
+    # round-3: fit_fused with precision="high" takes the symmetric
+    # 2-pass Gram and still recovers theta
+    import jax.numpy as jnp
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.workloads.linreg import fit_fused
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from jax.sharding import PartitionSpec as P
+    x = rng.standard_normal((256, 8)).astype(np.float32)
+    tt = np.linspace(1, 2, 8).reshape(8, 1).astype(np.float32)
+    y = x @ tt
+    X = BlockMatrix.from_numpy(x, mesh=mesh8, spec=P(("x", "y"), None))
+    Y = BlockMatrix.from_numpy(y, mesh=mesh8, spec=P(("x", "y"), None))
+    th = np.asarray(fit_fused(X, Y,
+                              config=MatrelConfig(matmul_precision="high")))
+    np.testing.assert_allclose(th, tt, rtol=5e-3, atol=5e-3)
