@@ -175,8 +175,10 @@ class MatrelSession:
         try:
             return head + "\n" + self.compile(e).explain()
         except Exception as ex:  # EXPLAIN must not fail on exotic plans
-            return (e.explain(self.config)
-                    + f"\n== Physical plan unavailable: {ex!r} ==")
+            # fall back to the PRE-COMPUTED logical text only: when the
+            # failure happened inside optimize(), e.explain() would
+            # re-run the optimizer and re-raise the same exception
+            return head + f"\n== Physical plan unavailable: {ex!r} =="
 
     def sql(self, query: str) -> MatExpr:
         """SQL-ish entry point over registered matrix tables (the reference's

@@ -126,7 +126,20 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",)):
     choice = rng.choice(
         ["matmul", "elemwise", "scalar", "transpose", "agg_chain",
          "select", "select_value", "join_index", "join_value", "rank1",
-         "solve", "leaf"])
+         "solve", "gram", "leaf"])
+    if choice == "gram" and shape[0] == shape[1]:
+        # AᵀA / AAᵀ with a SHARED operand node — under
+        # matmul_precision="high" this takes the symmetric 2-pass
+        # lowering (executor gram path); under other precisions the
+        # generic path. Both must track the oracle.
+        k = int(rng.choice(dims[1:]))
+        if rng.random() < 0.5:
+            x = gen_expr(rng, env, mesh, depth - 1, (k, shape[0]),
+                         leaf_kinds)
+            return E.matmul(E.transpose(x), x)
+        x = gen_expr(rng, env, mesh, depth - 1, (shape[0], k),
+                     leaf_kinds)
+        return E.matmul(x, E.transpose(x))
     if choice == "matmul":
         k = int(rng.choice(dims[1:]))
         a = gen_expr(rng, env, mesh, depth - 1, (shape[0], k), leaf_kinds)
@@ -301,3 +314,36 @@ def test_fuzz_value_join_streaming_vs_pair_matrix(seed, mesh8):
         got, want, rtol=1e-4, atol=1e-4,
         err_msg=f"seed {seed}: {pred}/{merge}/{kind}/{axis} "
                 f"structured={structured}")
+
+
+@pytest.mark.parametrize("seed", range(80, 92))
+def test_fuzz_gram_high_precision(seed, mesh8):
+    """Forced AᵀA/AAᵀ roots over random sub-trees under
+    matmul_precision="high": the symmetric 2-pass bf16 lowering must
+    track the f32 oracle at bf16x3-class tolerance, with and without
+    the optimizer."""
+    rng = np.random.default_rng(seed)
+    env = {}
+    n = int(rng.integers(3, 9))
+    k = int(rng.integers(2, 9))
+    if rng.random() < 0.5:
+        x = gen_expr(rng, env, mesh8, depth=int(rng.integers(1, 3)),
+                     shape=(k, n))
+        e = E.matmul(E.transpose(x), x)
+    else:
+        x = gen_expr(rng, env, mesh8, depth=int(rng.integers(1, 3)),
+                     shape=(n, k))
+        e = E.matmul(x, E.transpose(x))
+    if rng.random() < 0.5:
+        e = E.agg(e, "sum", str(rng.choice(["row", "all", "diag"])))
+    oracle = np_eval(e, env)
+    cfg = MatrelConfig(matmul_precision="high")
+    got = compile_expr(e, mesh8, cfg).run().to_numpy()
+    got_raw = compile_expr(
+        e, mesh8, cfg.replace(rewrite_rules=False,
+                              chain_opt=False)).run().to_numpy()
+    tol = dict(rtol=1e-2, atol=1e-2 * max(1.0, np.abs(oracle).max()))
+    np.testing.assert_allclose(got, oracle, **tol,
+                               err_msg=f"optimized (seed {seed})")
+    np.testing.assert_allclose(got_raw, oracle, **tol,
+                               err_msg=f"unoptimized (seed {seed})")

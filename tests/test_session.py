@@ -313,3 +313,20 @@ def test_session_explain_includes_physical_plan(mesh8, rng):
     assert "strategy=" not in txt2
     # explain warmed the cache: compute() reuses the compiled plan
     assert sess.plan_cache_info()["plans"] >= 1
+
+
+def test_explain_survives_compile_failure(mesh8, rng, monkeypatch):
+    """review r3: when compilation (incl. the optimizer) raises, explain
+    degrades to the logical plan + a note instead of crashing."""
+    from matrel_tpu import executor as executor_lib
+    sess = MatrelSession(mesh=mesh8)
+    a = sess.from_numpy(rng.standard_normal((8, 8)).astype(np.float32))
+    e = a.expr().t()
+
+    def boom(*args, **kw):
+        raise RuntimeError("optimizer exploded")
+
+    monkeypatch.setattr(executor_lib, "compile_expr", boom)
+    txt = sess.explain(e)
+    assert "== Logical plan ==" in txt
+    assert "Physical plan unavailable" in txt and "exploded" in txt
