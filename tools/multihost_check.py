@@ -19,7 +19,12 @@ same control/data plane shape as hosts in a TPU pod connected over DCN
      global mesh),
   6. the sharded COMPACT-table SpMV (the TPU-default executor path,
      pallas interpret per device),
-  7. the sharded tile-stack SpMM (BlockSparseMatrix.shard()).
+  7. the sharded tile-stack SpMM (BlockSparseMatrix.shard()),
+  8. the streaming value-join aggregate with its query side sharded
+     across processes (round-3: both the sorted and the callable
+     chunked path),
+  9. the symmetric 2-pass Gram lowering (round-3) through the full
+     executor under precision="high".
 
 Run:  python tools/multihost_check.py [--nproc 2]
 Exit code 0 on success; worker logs live in a fresh temp dir (path
@@ -116,6 +121,47 @@ full = np.asarray(multihost_utils.process_allgather(
     prod.data, tiled=True))[:64, :8]
 np.testing.assert_allclose(full, sp @ d, rtol=1e-3, atol=1e-3)
 print(f"[p{pid}] sharded tile-stack SpMM matches oracle", flush=True)
+
+# streaming value-join aggregate, query side sharded across processes
+from matrel_tpu.executor import execute as mat_execute
+from matrel_tpu.relational import ops as R
+vj_a = rng.standard_normal((40, 32)).astype(np.float32)
+vj_b = rng.standard_normal((8, 8)).astype(np.float32)
+va_o = vj_a.T.reshape(-1); vb_o = vj_b.T.reshape(-1)
+# sorted (structured) path
+n_pairs_a = vj_a.size
+jv = R.join_on_values(BlockMatrix.from_numpy(vj_a, mesh=mesh),
+                      BlockMatrix.from_numpy(vj_b, mesh=mesh),
+                      merge="mul", predicate="lt")
+got_vj = np.asarray(multihost_utils.process_allgather(
+    mat_execute(R.aggregate(jv, "sum", "row"), mesh, cfg).data,
+    tiled=True))[:n_pairs_a, 0]
+want_p = np.where(va_o[:, None] < vb_o[None, :],
+                  va_o[:, None] * vb_o[None, :], 0.0)
+np.testing.assert_allclose(got_vj, want_p.sum(1), rtol=1e-4, atol=1e-4)
+# chunked (callable) path
+jc = R.join_on_values(BlockMatrix.from_numpy(vj_a, mesh=mesh),
+                      BlockMatrix.from_numpy(vj_b, mesh=mesh),
+                      merge=lambda x, y: x * y + x,
+                      predicate=lambda x, y: x < y)
+got_jc = np.asarray(multihost_utils.process_allgather(
+    mat_execute(R.aggregate(jc, "sum", "row"), mesh, cfg).data,
+    tiled=True))[:n_pairs_a, 0]
+want_c = np.where(va_o[:, None] < vb_o[None, :],
+                  va_o[:, None] * vb_o[None, :] + va_o[:, None], 0.0)
+np.testing.assert_allclose(got_jc, want_c.sum(1), rtol=1e-4, atol=1e-4)
+print(f"[p{pid}] streaming value-joins (sorted + chunked) match oracle",
+      flush=True)
+
+# symmetric 2-pass Gram through the executor across processes
+gx = rng.standard_normal((48, 24)).astype(np.float32)
+GX = BlockMatrix.from_numpy(gx, mesh=mesh)
+got_g = np.asarray(multihost_utils.process_allgather(
+    mat_execute(GX.expr().t().multiply(GX.expr()), mesh,
+                MatrelConfig(matmul_precision="high")).data,
+    tiled=True))[:24, :24]
+np.testing.assert_allclose(got_g, gx.T @ gx, rtol=5e-3, atol=5e-3)
+print(f"[p{pid}] symmetric gram matches oracle", flush=True)
 
 multihost_utils.sync_global_devices("matrel-mh-done")
 print(f"[p{pid}] DONE", flush=True)
