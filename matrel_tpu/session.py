@@ -100,6 +100,28 @@ class MatrelSession:
     def table(self, name: str) -> BlockMatrix:
         return self.catalog[name]
 
+    def save_catalog(self, directory: str, step: int = 0) -> str:
+        """Persist every registered table (atomic step dir, sharding
+        metadata included) — the session-level face of the checkpoint
+        subsystem, so a catalog survives process restarts the way the
+        reference's persisted tables do. Returns the step path."""
+        from matrel_tpu.utils.checkpoint import CheckpointManager
+        return CheckpointManager(directory).save(
+            step, matrices=dict(self.catalog))
+
+    def load_catalog(self, directory: str,
+                     step: Optional[int] = None) -> list:
+        """Restore tables saved by save_catalog into this session's
+        catalog (sharding-preserving, existing names overwritten).
+        Returns the restored names; empty directory → empty list."""
+        from matrel_tpu.utils.checkpoint import CheckpointManager
+        got = CheckpointManager(directory).restore(self.mesh, step)
+        if got is None:
+            return []
+        _step, mats, _arrays, _state = got
+        self.catalog.update(mats)
+        return sorted(mats)
+
     # -- constructors bound to this session's mesh/config ------------------
 
     def from_numpy(self, arr: np.ndarray, **kw) -> BlockMatrix:

@@ -62,10 +62,16 @@ def soak_fuzz(n_seeds: int, base: int, tol: float):
             oracle = fuzz.np_eval(e, env)
             # half the seeds force the Pallas paths (interpret mode off
             # TPU): the compact COO executor dispatch and Pallas SpMM
-            # get soaked alongside the XLA lowerings
-            cfg = MatrelConfig(pallas_interpret=(seed % 2 == 0))
+            # get soaked alongside the XLA lowerings. A third sweep
+            # matmul_precision="high" — the generator's gram nodes then
+            # take the symmetric 2-pass split (round-3) and every f32
+            # matmul runs bf16x3-class, so tolerance widens with it
+            prec = "high" if seed % 3 == 0 else "highest"
+            cfg = MatrelConfig(pallas_interpret=(seed % 2 == 0),
+                               matmul_precision=prec)
+            t = 10 * tol if prec == "high" else tol
             got = compile_expr(e, mesh, cfg).run().to_numpy()
-            np.testing.assert_allclose(got, oracle, rtol=tol, atol=tol)
+            np.testing.assert_allclose(got, oracle, rtol=t, atol=t)
         except Exception as ex:  # noqa: BLE001 — soak collects everything
             fails.append((seed, type(ex).__name__, str(ex)[:200]))
         done = seed - base + 1
