@@ -100,14 +100,20 @@ class MatrelSession:
     def table(self, name: str) -> BlockMatrix:
         return self.catalog[name]
 
-    def save_catalog(self, directory: str, step: int = 0) -> str:
+    def save_catalog(self, directory: str,
+                     step: Optional[int] = None) -> str:
         """Persist every registered table (atomic step dir, sharding
         metadata included) — the session-level face of the checkpoint
         subsystem, so a catalog survives process restarts the way the
-        reference's persisted tables do. Returns the step path."""
+        reference's persisted tables do. ``step`` defaults to the NEXT
+        step in the directory (a fixed default like 0 would be GC'd by
+        the keep-k policy the moment older saves carry higher steps).
+        Returns the step path."""
         from matrel_tpu.utils.checkpoint import CheckpointManager
-        return CheckpointManager(directory).save(
-            step, matrices=dict(self.catalog))
+        mgr = CheckpointManager(directory)
+        if step is None:
+            step = mgr.next_step()
+        return mgr.save(step, matrices=dict(self.catalog))
 
     def load_catalog(self, directory: str,
                      step: Optional[int] = None) -> list:

@@ -31,6 +31,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from matrel_tpu.core.blockmatrix import BlockMatrix
 
 
+def _check_name(name: str) -> None:
+    """Checkpoint entry names become FILENAMES inside the step dir: a
+    separator (or '..') would crash the save on a missing subdir or
+    escape the directory entirely. Surfaced by the session-level
+    catalog API, where names are arbitrary user strings."""
+    if (not name or name in (".", "..") or "/" in name or "\\" in name
+            or "\x00" in name or os.sep in name):
+        raise ValueError(
+            f"checkpoint entry name {name!r} is not a valid filename "
+            f"component (no separators, '..', or NUL)")
+
+
 def _spec_to_json(spec: P) -> list:
     out = []
     for part in spec:
@@ -50,6 +62,12 @@ def _spec_from_json(parts: list) -> P:
 class CheckpointManager:
     """Writes/reads checkpoints of BlockMatrices + pytree-of-arrays state."""
 
+    def next_step(self) -> int:
+        """The step AFTER the latest saved one (0 for an empty dir) —
+        monotonic saves never collide with keep-k GC."""
+        latest = self.latest_step()
+        return 0 if latest is None else latest + 1
+
     def __init__(self, directory: str, keep: int = 2):
         self.directory = directory
         self.keep = keep
@@ -65,6 +83,8 @@ class CheckpointManager:
         matrices = dict(matrices or {})
         arrays = dict(arrays or {})
         sparse = dict(sparse or {})
+        for name in (*matrices, *arrays, *sparse):
+            _check_name(name)
         final = os.path.join(self.directory, f"step_{step:09d}")
         tmp = final + ".tmp"
         if os.path.exists(tmp):

@@ -5,6 +5,8 @@ settings)."""
 
 import logging
 
+import pytest
+
 import numpy as np
 
 from matrel_tpu.config import MatrelConfig
@@ -355,3 +357,28 @@ def test_catalog_save_and_load_roundtrip(mesh8, rng, tmp_path):
 def test_load_catalog_empty_dir(mesh8, tmp_path):
     sess = MatrelSession(mesh=mesh8)
     assert sess.load_catalog(str(tmp_path)) == []
+
+
+def test_save_catalog_steps_are_monotonic(mesh8, rng, tmp_path):
+    # review r3: default step must not collide with keep-k GC — three
+    # consecutive saves all restore the LATEST catalog
+    import os
+    sess = MatrelSession(mesh=mesh8)
+    for i in range(3):
+        sess.register("T", sess.from_numpy(
+            np.full((4, 4), float(i), np.float32)))
+        p = sess.save_catalog(str(tmp_path))
+        assert os.path.isdir(p), p        # the fresh save survives GC
+    fresh = MatrelSession(mesh=mesh8)
+    fresh.load_catalog(str(tmp_path))
+    np.testing.assert_allclose(fresh.table("T").to_numpy(),
+                               np.full((4, 4), 2.0))
+
+
+def test_save_catalog_rejects_path_escaping_names(mesh8, rng, tmp_path):
+    sess = MatrelSession(mesh=mesh8)
+    m = sess.from_numpy(rng.standard_normal((4, 4)).astype(np.float32))
+    for bad in ("a/b", "..", "x\\y", ""):
+        sess.catalog = {bad: m}
+        with pytest.raises(ValueError):
+            sess.save_catalog(str(tmp_path))
