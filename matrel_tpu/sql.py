@@ -15,6 +15,7 @@ Grammar (Python-expression syntax, parsed via ``ast`` — no eval):
     <expr> :=
         A * B            matrix multiply        A + B | A - B  elementwise
         A .* B | A % B   element multiply       A / B          elementwise
+        elemmin(A, B) | elemmax(A, B)           elementwise min/max
         2 * A | A * 2    scalar multiply        A + 2          scalar add
         transpose(A) | t(A)
         rowsum(e) colsum(e) sum(e) trace(e) vec(e)
@@ -223,6 +224,10 @@ class _Compiler(ast.NodeVisitor):
             return self._expr(args[0]).t()
         if name in ("elemmult", "elemmul"):
             return self._expr(args[0]).elem_multiply(self._expr(args[1]))
+        if name == "elemmin":
+            return self._expr(args[0]).elem_min(self._expr(args[1]))
+        if name == "elemmax":
+            return self._expr(args[0]).elem_max(self._expr(args[1]))
         if name == "multiply":
             return self._expr(args[0]).multiply(self._expr(args[1]))
         if name == "add":

@@ -345,3 +345,13 @@ class TestElemmulLexerDigitIdentifiers:
         s, a, b = sess
         out = s.compute(s.sql("SELECT 2.*A")).to_numpy()
         np.testing.assert_allclose(out, 2.0 * a, rtol=1e-5)
+
+
+def test_elemmin_elemmax(sess):
+    # round-3 grammar line: elementwise min/max reachable from SQL
+    s, a, b = sess
+    s.register("C", s.from_numpy(a + 0.5))
+    got_min = s.compute(s.sql("elemmin(A, C)")).to_numpy()
+    got_max = s.compute(s.sql("elemmax(A, C)")).to_numpy()
+    np.testing.assert_allclose(got_min, np.minimum(a, a + 0.5), rtol=1e-5)
+    np.testing.assert_allclose(got_max, np.maximum(a, a + 0.5), rtol=1e-5)

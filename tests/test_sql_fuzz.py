@@ -19,7 +19,7 @@ def _gen(rng, env, depth):
         return name, env[name]
     op = str(rng.choice(["mm", "em", "em_pct", "add", "sub", "div",
                          "smul", "sadd", "t", "sel", "selrows",
-                         "power", "joinidx"]))
+                         "power", "joinidx", "emin", "emax"]))
     a_s, a_v = _gen(rng, env, depth - 1)
     if op == "t":
         return f"transpose({a_s})", a_v.T
@@ -45,6 +45,10 @@ def _gen(rng, env, depth):
         return f"({a_s}) * ({b_s})", a_v @ b_v
     if op == "em":
         return f"elemmult({a_s}, {b_s})", a_v * b_v
+    if op == "emin":
+        return f"elemmin({a_s}, {b_s})", np.minimum(a_v, b_v)
+    if op == "emax":
+        return f"elemmax({a_s}, {b_s})", np.maximum(a_v, b_v)
     if op == "em_pct":
         return f"({a_s}) % ({b_s})", a_v * b_v
     if op == "add":
