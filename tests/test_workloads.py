@@ -381,3 +381,42 @@ def test_fit_fused_honors_high_precision(mesh8, rng):
     th = np.asarray(fit_fused(X, Y,
                               config=MatrelConfig(matmul_precision="high")))
     np.testing.assert_allclose(th, tt, rtol=5e-3, atol=5e-3)
+
+
+class TestPowerIteration:
+    def test_dominant_eigenpair_symmetric(self, mesh8, rng):
+        from matrel_tpu.workloads import eigen
+        n = 24
+        q = rng.standard_normal((n, n)).astype(np.float32)
+        a = (q + q.T) / 2                       # symmetric: real spectrum
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        lam, v = eigen.power_iteration(A, rounds=200)
+        assert abs(abs(lam) - eigen.eig_numpy_oracle(a)) < 1e-2
+        # v is an eigenvector: A v ≈ λ v
+        resid = np.linalg.norm(a @ np.asarray(v) - lam * np.asarray(v))
+        assert resid < 1e-2 * abs(lam)
+
+    def test_spectral_norm_matches_svd(self, mesh8, rng):
+        from matrel_tpu.workloads import eigen
+        a = rng.standard_normal((20, 12)).astype(np.float32)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        got = eigen.spectral_norm(A, rounds=200)
+        want = float(np.linalg.svd(a, compute_uv=False)[0])
+        assert got == pytest.approx(want, rel=1e-3)
+
+    def test_rejects_nonsquare(self, mesh8, rng):
+        from matrel_tpu.workloads import eigen
+        A = BlockMatrix.from_numpy(
+            rng.standard_normal((4, 6)).astype(np.float32), mesh=mesh8)
+        with pytest.raises(ValueError):
+            eigen.power_iteration(A)
+
+    def test_accepts_expression(self, mesh8, rng):
+        from matrel_tpu.workloads import eigen
+        a = rng.standard_normal((12, 12)).astype(np.float32)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        # spectral norm of a lazy expression (2·A): compiles then iterates
+        got = eigen.spectral_norm(A.expr().multiply_scalar(2.0),
+                                  rounds=200)
+        want = 2 * float(np.linalg.svd(a, compute_uv=False)[0])
+        assert got == pytest.approx(want, rel=1e-3)
