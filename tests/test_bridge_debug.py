@@ -45,6 +45,25 @@ class TestBridge:
         with pytest.raises(RuntimeError, match="unknown"):
             server.call("sql", query="Nope * X")
 
+    def test_round3_aggregates_and_explain(self, server):
+        # round-3 SQL spellings + the physical EXPLAIN over the wire
+        server.call("upload", name="M",
+                    data=[[1.0, -2.0], [3.0, 4.0]])
+        assert server.call("sql", query="max(M)")["data"][0][0] == 4.0
+        assert server.call(
+            "sql", query="diagmin(M)")["data"][0][0] == 1.0
+        plan = server.call("explain", query="rowsum(M * M)")["plan"]
+        assert "Optimized plan" in plan and "strategy=" in plan
+
+    def test_joinvalue_streaming_over_bridge(self, server):
+        server.call("upload", name="U", data=[[1.0, 2.0]])
+        server.call("upload", name="V", data=[[1.5]])
+        got = server.call(
+            "sql", query="sum(joinvalue(U, V, 'add', 'lt'))")
+        # pairs with u < 1.5: (1, 1.5) -> 2.5
+        assert got["data"][0][0] == pytest.approx(2.5)
+
+
 
 class TestDebugGuards:
     def test_checked_raises_on_nan(self):
