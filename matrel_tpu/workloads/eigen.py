@@ -89,6 +89,43 @@ def _dense_data(A, e: E.MatExpr):
     return execute(e).data
 
 
+def power_iteration_coo(A, rounds: int = 50,
+                        seed: int = 0) -> Tuple[float, jax.Array]:
+    """Power iteration on an element-sparse ``COOMatrix`` via its
+    one-hot SpMV plan: every round is one planned SpMV inside a single
+    jitted ``fori_loop`` — the graph-spectral path that never
+    densifies A (uses the expanded-table plan; graphs the plan refuses
+    fall back to the dense path)."""
+    from matrel_tpu.ops import spmv as spmv_lib
+
+    if A.shape[0] != A.shape[1]:
+        raise ValueError(f"power iteration needs a square matrix, got "
+                         f"{A.shape}")
+    plan = A._get_plan()
+    if plan is None:          # heavy-tailed graph: plan refused
+        return power_iteration(
+            E.as_expr(
+                BlockMatrix.from_numpy(A.to_dense())), rounds, seed)
+    static = (plan.n_rows, plan.n_cols, plan.block)
+
+    @jax.jit
+    def run(arrays):
+        v0 = jax.random.normal(jax.random.PRNGKey(seed),
+                               (plan.n_cols,), jnp.float32)
+        v0 = v0 / jnp.linalg.norm(v0)
+
+        def body(_, v):
+            w = spmv_lib.spmv_apply(static, arrays, v)
+            return w / jnp.maximum(jnp.linalg.norm(w), 1e-30)
+
+        v = jax.lax.fori_loop(0, rounds, body, v0)
+        lam = v @ spmv_lib.spmv_apply(static, arrays, v)
+        return lam, v
+
+    lam, v = run(plan.arrays())
+    return float(lam), v[: A.shape[0]]
+
+
 def eig_numpy_oracle(a: np.ndarray) -> float:
     """|λ|_max for tests (dense numpy)."""
     return float(np.max(np.abs(np.linalg.eigvals(a))))

@@ -420,3 +420,18 @@ class TestPowerIteration:
                                   rounds=200)
         want = 2 * float(np.linalg.svd(a, compute_uv=False)[0])
         assert got == pytest.approx(want, rel=1e-3)
+
+    def test_coo_power_iteration_matches_dense(self, mesh8, rng):
+        from matrel_tpu.core.coo import COOMatrix
+        from matrel_tpu.workloads import eigen
+        n = 64
+        a = (rng.random((n, n)) < 0.12).astype(np.float32)
+        a = np.maximum(a, a.T)                 # symmetric 0/1 adjacency
+        np.fill_diagonal(a, 0)
+        r, c = np.nonzero(a)
+        coo = COOMatrix.from_edges(r, c, a[r, c], shape=(n, n))
+        lam, v = eigen.power_iteration_coo(coo, rounds=300)
+        assert abs(lam) == pytest.approx(eigen.eig_numpy_oracle(a),
+                                         rel=1e-2)
+        resid = np.linalg.norm(a @ np.asarray(v) - lam * np.asarray(v))
+        assert resid < 2e-2 * abs(lam)
