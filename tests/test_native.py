@@ -338,3 +338,18 @@ class TestNativeSpMVPlan:
                                         n_rows=16, n_cols=4)
         y = np.asarray(spmv_lib.spmv(plan, jnp.ones(4, jnp.float32)))
         assert y[3] == 2.0 and y[9] == 1.0
+
+
+def test_makefile_sources_match_lazy_builder():
+    """native/Makefile and utils/native.py build the SAME source list —
+    a Makefile-built .so missing a source loads fine but silently
+    drops its symbols (numpy fallback; caught round 3 with
+    spmv_plan.cc)."""
+    import os
+    from matrel_tpu.utils import native
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mk = open(os.path.join(repo, "native", "Makefile")).read()
+    srcs_line = next(l for l in mk.splitlines()
+                     if l.replace(" ", "").startswith("SRCS:="))
+    for src in native._SOURCES:
+        assert src in srcs_line, (src, srcs_line)
