@@ -435,3 +435,57 @@ class TestPowerIteration:
                                          rel=1e-2)
         resid = np.linalg.norm(a @ np.asarray(v) - lam * np.asarray(v))
         assert resid < 2e-2 * abs(lam)
+
+
+class TestConjugateGradient:
+    def test_spd_solve_matches_numpy(self, mesh8, rng):
+        from matrel_tpu.workloads import cg
+        n = 24
+        q = rng.standard_normal((n, n)).astype(np.float32)
+        a = q @ q.T + n * np.eye(n, dtype=np.float32)   # SPD
+        b = rng.standard_normal(n).astype(np.float32)
+        A = BlockMatrix.from_numpy(a, mesh=mesh8)
+        x, it = cg.cg_solve(A, b, tol=1e-6)
+        assert 0 < it < 1000
+        np.testing.assert_allclose(np.asarray(x), np.linalg.solve(a, b),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_least_squares_matches_lstsq(self, mesh8, rng):
+        from matrel_tpu.workloads import cg
+        x_np = rng.standard_normal((96, 8)).astype(np.float32)
+        tt = np.linspace(-1, 1, 8).astype(np.float32)
+        y = x_np @ tt
+        X = BlockMatrix.from_numpy(x_np, mesh=mesh8)
+        theta, it = cg.cg_least_squares(X, y, tol=1e-7)
+        np.testing.assert_allclose(np.asarray(theta), tt, rtol=1e-2,
+                                   atol=1e-2)
+
+    def test_linop_form_with_planned_spmv(self, mesh8, rng):
+        # SPD operator from a sparse graph Laplacian via the SpMV plan
+        from matrel_tpu.core.coo import COOMatrix
+        from matrel_tpu.ops import spmv as spmv_lib
+        from matrel_tpu.workloads import cg
+        n = 48
+        adj = (rng.random((n, n)) < 0.15).astype(np.float32)
+        adj = np.maximum(adj, adj.T); np.fill_diagonal(adj, 0)
+        lap = np.diag(adj.sum(1)) - adj + np.eye(n, dtype=np.float32)
+        r, c = np.nonzero(lap)
+        coo = COOMatrix.from_edges(r, c, lap[r, c], shape=(n, n))
+        plan = coo._get_plan()
+        static = (plan.n_rows, plan.n_cols, plan.block)
+        arrays = plan.arrays()
+        b = rng.standard_normal(plan.n_cols).astype(np.float32)
+        b[n:] = 0.0
+        x, it = cg.cg_solve_linop(
+            lambda v: spmv_lib.spmv_apply(static, arrays, v),
+            b, tol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(x)[:n], np.linalg.solve(lap, b[:n]), rtol=1e-3,
+            atol=1e-3)
+
+    def test_rejects_nonsquare(self, mesh8, rng):
+        from matrel_tpu.workloads import cg
+        A = BlockMatrix.from_numpy(
+            rng.standard_normal((4, 6)).astype(np.float32), mesh=mesh8)
+        with pytest.raises(ValueError):
+            cg.cg_solve(A, np.zeros(4))
