@@ -46,6 +46,15 @@ class TestFromEnv:
         assert cfg.join_pair_cap_entries == 1024
         assert cfg.plan_cache_max_plans == 7
 
+    def test_round3_autotune_knobs_via_env(self, monkeypatch):
+        monkeypatch.setenv("MATREL_AUTOTUNE", "true")
+        monkeypatch.setenv("MATREL_AUTOTUNE_TABLE_PATH", "/tmp/t.json")
+        monkeypatch.setenv("MATREL_AUTOTUNE_MAX_DIM", "2048")
+        cfg = MatrelConfig.from_env()
+        assert cfg.autotune is True
+        assert cfg.autotune_table_path == "/tmp/t.json"
+        assert cfg.autotune_max_dim == 2048
+
 
 class TestFromDict:
     def test_valid_and_unknown_keys(self):
