@@ -1,0 +1,46 @@
+#!/bin/sh
+# Poll the axon relay; on recovery run the staged on-chip capture batch.
+#
+# Round-4 version of the round-3 /tmp watcher (VERDICT r3 "what's weak" #4:
+# the staged experiment scripts must live in the repo, not /tmp, so the
+# driver or a fresh session can re-run every BASELINE.md number from a
+# clean checkout). Detach with:
+#   nohup sh tools/relay_watch.sh >/dev/null 2>&1 &
+# State files (repo root):
+#   relay_watch_r4.log          — timestamped probe + experiment output
+#   .relay_experiments_done_r4  — touched once the batch completes
+set -u
+cd "$(dirname "$0")/.."
+# tools/ scripts import matrel_tpu; keep the axon site dir too.
+PYTHONPATH="$(pwd):${PYTHONPATH:-}"
+export PYTHONPATH
+LOG=relay_watch_r4.log
+log() { echo "$(date '+%H:%M:%S') $*" >> "$LOG"; }
+log "watch start (round 4)"
+while true; do
+  timeout 120 python bench.py --_probe > /tmp/probe_out_r4 2>&1
+  rc=$?
+  if [ "$rc" = "0" ] && grep -q '"probe": "ok"' /tmp/probe_out_r4; then
+    log "relay ALIVE - running staged experiments"
+    log "--- gram_manual3 (hi/lo 3-pass vs XLA HIGH microbench)"
+    timeout 600 python tools/gram_manual3.py >> "$LOG" 2>&1
+    log "--- gram_sym_full (10Mx1k fit_streaming, symmetric 2-pass Gram)"
+    timeout 600 python tools/gram_sym_full.py >> "$LOG" 2>&1
+    log "--- pagerank 10x row"
+    timeout 900 python -c "
+import bench_all, json
+from matrel_tpu.config import MatrelConfig, set_default_config
+from matrel_tpu.core import mesh as mesh_lib
+cfg = MatrelConfig(); set_default_config(cfg)
+mesh = mesh_lib.make_mesh()
+print(json.dumps(bench_all.bench_pagerank_10x(mesh, cfg)))
+" >> "$LOG" 2>&1
+    log "--- full tpu batch (bench, soak, bench_all, north-star sweep)"
+    timeout 3600 sh tools/tpu_batch.sh >> "$LOG" 2>&1
+    log "experiments DONE"
+    touch .relay_experiments_done_r4
+    exit 0
+  fi
+  log "relay down (rc=$rc); sleeping 600"
+  sleep 600
+done

@@ -16,4 +16,8 @@ log "--- bench_all.py (all BASELINE rows)"
 python bench_all.py
 log "--- north_star_sweep (VERDICT #10 residual)"
 python tools/north_star_sweep.py
+log "--- gram_manual3 (symmetric-Gram microbench, BASELINE row 3 support)"
+python tools/gram_manual3.py
+log "--- gram_sym_full (10Mx1k linreg, symmetric 2-pass Gram, BASELINE row 3)"
+python tools/gram_sym_full.py
 log "TPU batch done"
