@@ -223,8 +223,10 @@ class Lowerer:
         """Row/col-index joins: statically-shaped pairwise merge along the
         non-join axis (the replication-scheme joins of the reference).
         The planner's attrs['replicate'] (choose_join_scheme) picks the
-        operand to replicate across the mesh; the other keeps its
-        sharding."""
+        scheme: "left"/"right" replicate that operand across the mesh
+        (the other keeps its sharding); "align" replicates NOTHING —
+        both operands are constrained 1D-sharded along the join axis so
+        the pairwise merge computes shard-locally (v3 layout credit)."""
         out_entries = node.shape[0] * node.shape[1]
         cap = self.config.join_pair_cap_entries
         if out_entries > cap:
@@ -242,8 +244,15 @@ class Lowerer:
             repl = NamedSharding(self.mesh, P(None, None))
             if rep == "left":
                 a = jax.lax.with_sharding_constraint(a, repl)
-            else:
+            elif rep == "right":
                 b = jax.lax.with_sharding_constraint(b, repl)
+            else:  # align
+                axes = tuple(self.mesh.axis_names)
+                spec = (P(axes, None) if node.kind == "join_rows"
+                        else P(None, axes))
+                sh = NamedSharding(self.mesh, spec)
+                a = jax.lax.with_sharding_constraint(a, sh)
+                b = jax.lax.with_sharding_constraint(b, sh)
         merge = node.attrs["merge"]
         if node.kind == "join_rows":
             out = merge(a[:, :, None], b[:, None, :])       # (n, ma, mb)

@@ -381,15 +381,19 @@ def select_index(a: MatExpr, *, rows=None, cols=None) -> MatExpr:
                    {"rows": rows, "cols": cols})
 
 
-def join_on_index(a: MatExpr, b: MatExpr, merge: Callable) -> MatExpr:
+def join_on_index(a: MatExpr, b: MatExpr, merge) -> MatExpr:
     """⋈ on block/entry index equality: C[i,j] = merge(A[i,j], B[i,j]).
 
     The cogroup-style join of two co-partitioned matrices (SURVEY.md §2
-    "Physical: relational execs"). merge is a traceable binary fn.
+    "Physical: relational execs"). ``merge`` is a traceable binary fn OR
+    a structured string ("left"/"right"/"add"/"mul") — structured kinds
+    let the planner infer the output dtype (jnp promotion).
     """
     if a.shape != b.shape:
         raise ValueError(f"join_on_index shape mismatch: {a.shape} vs {b.shape}")
-    return MatExpr("join_index", (a, b), a.shape, None, {"merge": merge})
+    merge_kind, merge_fn = resolve_join_merge(merge)
+    return MatExpr("join_index", (a, b), a.shape, None,
+                   {"merge": merge_fn, "merge_kind": merge_kind})
 
 
 JOIN_PREDS = ("eq", "lt", "le", "gt", "ge")

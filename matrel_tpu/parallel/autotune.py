@@ -30,7 +30,12 @@ from matrel_tpu.core import mesh as mesh_lib, padding
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.parallel import planner, strategies
 
-_CACHE: Dict[tuple, Tuple[str, Dict[str, float]]] = {}
+# (best, times) per shape class; best is None when the measured winner was
+# within TIE_REL of the runner-up — a tie is recorded as a tie and the
+# planner's byte model decides (VERDICT r3: noise must not become winners).
+_CACHE: Dict[tuple, Tuple[Optional[str], Dict[str, float]]] = {}
+
+TIE_REL = 0.10
 
 _DEFAULT_TABLE = ".matrel_autotune.json"
 
@@ -75,13 +80,37 @@ def _load_table_cached(path: str) -> Dict[str, dict]:
     return table
 
 
-def _persist(path: str, key: str, best: str,
+def _persist(path: str, key: str, best: Optional[str],
              times: Dict[str, float]) -> None:
-    """Merge one measurement into the JSON table (atomic rename)."""
-    table = load_table(path)
-    table[key] = {"best": best, "times": times}
+    """Merge one measurement into the JSON table (atomic rename).
+
+    A best-effort O_CREAT|O_EXCL lock file guards the read-merge-replace
+    window (advisor r3: two concurrent processes could interleave
+    load/merge/replace and silently drop each other's measurements).
+    On contention the persist is SKIPPED — losing one merge is benign
+    (the in-process cache still holds it and a later call re-persists),
+    and rename atomicity already rules out corruption. A lock older
+    than 60 s is presumed dead and broken."""
+    lock = f"{path}.lock"
+    fd = None
+    try:
+        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        try:
+            if time.time() - os.stat(lock).st_mtime <= 60.0:
+                return
+            os.unlink(lock)
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except OSError:
+            return
+    except OSError:
+        fd = None    # lock unsupported (read-only FS): try unguarded
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
+        # (re-)load under the lock so a concurrent writer's just-merged
+        # entries survive into this replace
+        table = load_table(path)
+        table[key] = {"best": best, "times": times}
         with open(tmp, "w") as f:
             json.dump(table, f, indent=1, sort_keys=True)
         os.replace(tmp, path)
@@ -90,12 +119,27 @@ def _persist(path: str, key: str, best: str,
             os.unlink(tmp)
         except OSError:
             pass
+    finally:
+        if fd is not None:
+            os.close(fd)
+            try:
+                os.unlink(lock)
+            except OSError:
+                pass
 
 
 def measure_strategy(strategy: str, A: BlockMatrix, B: BlockMatrix,
-                     config: MatrelConfig, reps: Tuple[int, int] = (2, 8)
+                     config: MatrelConfig, reps: Tuple[int, int] = (2, 8),
+                     n_estimates: int = 3, min_window_s: float = 0.05
                      ) -> float:
-    """Marginal seconds per multiply for one strategy."""
+    """Marginal seconds per multiply for one strategy: the MEDIAN of
+    ``n_estimates`` independent marginal estimates (bench_all
+    methodology — a single marginal on a shared chip records noise as
+    winners, VERDICT r3). The chained-reps budget is floored: when the
+    long chain completes under ``min_window_s`` the reps are scaled up
+    so the marginal rises above dispatch jitter. May return a
+    NON-POSITIVE value on a hopelessly noisy host — callers must treat
+    that as "no measurement", never clamp it into a fake winner."""
     mesh = A.mesh
     f = jax.jit(lambda x, y: strategies.run_matmul(strategy, x, y, mesh,
                                                    config))
@@ -103,19 +147,43 @@ def measure_strategy(strategy: str, A: BlockMatrix, B: BlockMatrix,
 
     def chained(n: int):
         cur = A.data
-        for _ in range(n):
+        for i in range(n):
             cur = f(cur, B.data).astype(A.dtype)
+            if (i + 1) % 8 == 0:
+                # bound in-flight programs: the CPU in-process
+                # communicator's rendezvous starves (fatal abort) with
+                # tens of queued collective executions; a sync every 8
+                # reps costs the same per rep for every strategy, so
+                # the ranking is unaffected
+                cur.block_until_ready()
         float(fetch(cur))
+
+    def marginal(lo: int, hi: int) -> Tuple[float, float]:
+        t0 = time.perf_counter()
+        chained(lo)
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        chained(hi)
+        t_hi = time.perf_counter() - t0
+        return (t_hi - t_lo) / (hi - lo), t_hi
 
     chained(2)  # compile + warm
     lo, hi = reps
-    t0 = time.perf_counter()
-    chained(lo)
-    t_lo = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    chained(hi)
-    t_hi = time.perf_counter() - t0
-    return max((t_hi - t_lo) / (hi - lo), 1e-9)
+    est, t_hi = marginal(lo, hi)
+    if t_hi < min_window_s:
+        # bounded: the whole re-measure must stay cheap even on a slow
+        # host (a CPU-mesh run pays ~ms dispatch per chained call), so
+        # the chain never exceeds 48 multiplies however short the window
+        scale = min(max(2, round(min_window_s / max(t_hi, 1e-4))),
+                    max(48 // hi, 1))
+        if scale > 1:
+            lo, hi = lo * scale, hi * scale
+            est, t_hi = marginal(lo, hi)
+    ests = [est]
+    for _ in range(max(n_estimates, 1) - 1):
+        ests.append(marginal(lo, hi)[0])
+    ests.sort()
+    return ests[len(ests) // 2]
 
 
 def autotune_matmul(n: int, k: int, m: int,
@@ -145,12 +213,18 @@ def autotune_matmul(n: int, k: int, m: int,
         if not planner.admissible(s, pn, pk, pn, gx, gy):
             continue
         try:
-            results[s] = measure_strategy(s, A, B, cfg)
+            t = measure_strategy(s, A, B, cfg)
         except Exception:  # noqa: BLE001 — a strategy failing to compile
             continue       # on this backend just drops out of the table
-    best = min(results, key=results.get)
+        if t > 0.0:        # non-positive median = noise, not a time
+            results[s] = t
+    best = _pick_winner(results)
     _CACHE[key] = (best, results)
-    if cfg.autotune or cfg.autotune_table_path:
+    if results and (cfg.autotune or cfg.autotune_table_path):
+        # an EMPTY result set (every strategy failed or measured pure
+        # noise) is never persisted — a persisted empty entry would read
+        # as "measured: no winner" and permanently disable re-measurement
+        # of the shape class on later, healthy processes
         # persist only when the closed loop is on or the caller named a
         # table explicitly — a one-off measurement call (the original
         # API contract, also the CLI) must not drop a hidden JSON file
@@ -158,6 +232,23 @@ def autotune_matmul(n: int, k: int, m: int,
         _persist(_table_path(cfg), _table_key(side, gx, gy, str(dtype)),
                  best, results)
     return best, results
+
+
+def _pick_winner(results: Dict[str, float]) -> Optional[str]:
+    """argmin with a tie rule: a winner within TIE_REL of the runner-up
+    is recorded as None ("no measured winner") so the byte model
+    decides — on meshes where strategies compile identically (e.g. 1
+    device) every marginal is pure noise and must not be persisted as
+    a preference."""
+    if not results:
+        return None
+    order = sorted(results, key=results.get)
+    if len(order) == 1:
+        return order[0]
+    best, runner = order[0], order[1]
+    if results[runner] <= results[best] * (1.0 + TIE_REL):
+        return None
+    return best
 
 
 def _maybe_persist_cached(config: Optional[MatrelConfig],
@@ -170,6 +261,8 @@ def _maybe_persist_cached(config: Optional[MatrelConfig],
         return
     side, gx, gy, dtype = key
     best, results = _CACHE[key]
+    if not results:
+        return
     path = _table_path(cfg)
     tkey = _table_key(side, gx, gy, dtype)
     if tkey not in _load_table_cached(path):
@@ -187,6 +280,13 @@ def lookup_or_measure(n: int, k: int, m: int, mesh,
     shapes above config.autotune_max_dim are never measured inline)."""
     cfg = config or default_config()
     side = max(n, k, m)
+    # strongly rectangular shapes are gated out (advisor r3): the table
+    # keys and measures SQUARE side-sized operands, so a 64x8192 matvec
+    # chain would both allocate two side-squared probes at compile time
+    # and inherit a square-dense winner that can mispick for it — the
+    # byte model (which sees the true dims) decides instead
+    if min(n, k, m) * 4 < side:
+        return None
     gx, gy = mesh_lib.mesh_grid_shape(mesh)
     key = (side, gx, gy, str(dtype))
     if key in _CACHE:
@@ -194,9 +294,13 @@ def lookup_or_measure(n: int, k: int, m: int, mesh,
         return _CACHE[key][0]
     entry = _load_table_cached(_table_path(cfg)).get(
         _table_key(side, gx, gy, str(dtype)))
-    if entry and isinstance(entry.get("best"), str):
-        _CACHE[key] = (entry["best"], dict(entry.get("times", {})))
-        return entry["best"]
+    if isinstance(entry, dict) and entry.get("times"):
+        # a persisted TIE (best null) is a measurement too: cache it and
+        # let the model decide — do NOT re-measure every compile
+        best = entry.get("best")
+        best = best if isinstance(best, str) else None
+        _CACHE[key] = (best, dict(entry.get("times", {})))
+        return best
     if side > cfg.autotune_max_dim:
         return None
     best, _ = autotune_matmul(n, k, m, mesh=mesh, dtype=dtype, config=cfg)

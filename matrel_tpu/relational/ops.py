@@ -79,25 +79,31 @@ def join_on_index(a: MatLike, b: MatLike, merge: Callable) -> E.MatExpr:
     return E.as_expr(a).join_on_index(E.as_expr(b), merge)
 
 
-def join_on_rows(a: MatLike, b: MatLike, merge: Callable) -> E.MatExpr:
+def join_on_rows(a: MatLike, b: MatLike, merge) -> E.MatExpr:
     """⋈ on row index only: C[i, (j_a, j_b)] pairs — statically shaped as
     the (n, m_a*m_b) matrix C[i, j_a*m_b + j_b] = merge(A[i,j_a], B[i,j_b]).
-    The replication-scheme row join of the reference."""
+    The replication-scheme row join of the reference. ``merge`` is a
+    callable or a structured string ("left"/"right"/"add"/"mul");
+    structured kinds let the planner infer the output dtype."""
     ae, be = E.as_expr(a), E.as_expr(b)
     if ae.shape[0] != be.shape[0]:
         raise ValueError(f"row join needs equal row counts: {ae.shape} vs {be.shape}")
     shape = (ae.shape[0], ae.shape[1] * be.shape[1])
-    return E.MatExpr("join_rows", (ae, be), shape, None, {"merge": merge})
+    merge_kind, merge_fn = E.resolve_join_merge(merge)
+    return E.MatExpr("join_rows", (ae, be), shape, None,
+                     {"merge": merge_fn, "merge_kind": merge_kind})
 
 
-def join_on_cols(a: MatLike, b: MatLike, merge: Callable) -> E.MatExpr:
+def join_on_cols(a: MatLike, b: MatLike, merge) -> E.MatExpr:
     """⋈ on column index: C[(i_a, i_b), j] = merge(A[i_a,j], B[i_b,j]),
-    statically shaped (n_a*n_b, m)."""
+    statically shaped (n_a*n_b, m). ``merge`` as in join_on_rows."""
     ae, be = E.as_expr(a), E.as_expr(b)
     if ae.shape[1] != be.shape[1]:
         raise ValueError(f"col join needs equal col counts: {ae.shape} vs {be.shape}")
     shape = (ae.shape[0] * be.shape[0], ae.shape[1])
-    return E.MatExpr("join_cols", (ae, be), shape, None, {"merge": merge})
+    merge_kind, merge_fn = E.resolve_join_merge(merge)
+    return E.MatExpr("join_cols", (ae, be), shape, None,
+                     {"merge": merge_fn, "merge_kind": merge_kind})
 
 
 def join_on_values(a: MatLike, b: MatLike, merge,

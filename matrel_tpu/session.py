@@ -233,7 +233,7 @@ def _plan_bytes(plan: executor_lib.CompiledPlan) -> int:
     return total
 
 
-def _fn_token(fn, pins: list) -> str:
+def _fn_token(fn, pins: list, seen: frozenset = frozenset()) -> str:
     """Cache-key token for a callable attr. Distinct predicates/merges MUST
     key differently — dropping them (pre-round-3 behaviour) made the second
     of two same-shaped queries silently return the first's cached result.
@@ -251,10 +251,23 @@ def _fn_token(fn, pins: list) -> str:
     if code is None:
         pins.append(fn)
         return f"fnid:{id(fn)}"
+    if id(fn) in seen:
+        # recursive reference (fn reachable from its own globals or
+        # closure) — key the back-edge by pinned id to terminate
+        pins.append(fn)
+        return f"fnrec:{id(fn)}"
+    seen = seen | {id(fn)}
     parts = [code.co_code.hex(), repr(code.co_consts), repr(code.co_names)]
+    # bound-method instance state is part of the behaviour: two
+    # Thresh(t).pred with different t share code/closure/globals and
+    # would otherwise collide (round-3 advisor finding — the second
+    # query silently returned the first's cached result)
+    self_obj = getattr(fn, "__self__", None)
+    if self_obj is not None:
+        parts.append("self:" + _attr_token(self_obj, pins, seen))
     for cell in (getattr(fn, "__closure__", None) or ()):
         try:
-            parts.append(_attr_token(cell.cell_contents, pins))
+            parts.append(_attr_token(cell.cell_contents, pins, seen))
         except Exception:
             pins.append(cell)
             parts.append(f"cell:{id(cell)}")
@@ -263,25 +276,28 @@ def _fn_token(fn, pins: list) -> str:
     # code/consts/names and must NOT key identically. Names are
     # collected TRANSITIVELY through nested code objects (an inner
     # lambda/genexp reads the same __globals__ but its names live on
-    # its own code constant, not the outer co_names). Scalars key by
-    # value; modules/builtins by name (stable); anything else by
-    # identity (pinned — a REBOUND global's old value would otherwise
-    # free and its address recycle into a false hit).
+    # its own code constant, not the outer co_names). Scalars and small
+    # containers key by value (so in-place mutation of a global list of
+    # thresholds re-keys at the next query); modules/builtins by name
+    # (stable); anything else by identity (pinned — a REBOUND global's
+    # old value would otherwise free and its address recycle into a
+    # false hit).
     g = getattr(fn, "__globals__", None) or {}
     for name in sorted(_code_names(code)):
         if name in g:
             v = g[name]
-            if v is None or isinstance(v, (bool, int, float, str)):
-                parts.append(f"{name}={v!r}")
-            elif isinstance(v, types.ModuleType):
+            if isinstance(v, types.ModuleType):
                 parts.append(f"{name}=mod:{v.__name__}")
             else:
-                pins.append(v)
-                parts.append(f"{name}=gid:{id(v)}")
+                parts.append(f"{name}=" + _attr_token(v, pins, seen))
     # defaults go through _attr_token, NOT bare repr: a default object
-    # with a state-independent custom __repr__ would otherwise collide
+    # with a state-independent custom __repr__ would otherwise collide.
+    # kw-only defaults are behaviour too — factory-made functions
+    # differing only in them must not collide (round-3 advisor finding)
     parts.append(_attr_token(tuple(getattr(fn, "__defaults__", None)
-                                   or ()), pins))
+                                   or ()), pins, seen))
+    parts.append(_attr_token(getattr(fn, "__kwdefaults__", None) or {},
+                             pins, seen))
     digest = hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
     return f"fncode:{digest}"
 
@@ -296,17 +312,39 @@ def _code_names(code) -> set:
     return names
 
 
-def _attr_token(v, pins: list) -> str:
+def _attr_token(v, pins: list, seen: frozenset = frozenset()) -> str:
     """Encode ANY attr value into the plan key — nothing is dropped.
-    Unknown object types key by identity (and are pinned): conservative
-    (may miss the cache) but never shares a plan between distinct
-    semantics."""
+    Containers (tuple/list/dict/set) key by VALUE, so in-place mutation
+    of e.g. a global threshold list or dict is re-read at the next query
+    and correctly misses the cache. Cyclic containers terminate: a
+    container reached again inside its own walk keys the back-edge by
+    pinned id. Unknown object types key by identity (and are pinned):
+    conservative (may miss the cache) but never shares a plan between
+    distinct semantics. Caveat: in-place mutation of an id-keyed OBJECT
+    (not a container) between queries is unsupported for cached
+    predicates — rebind a fresh object instead."""
     if v is None or isinstance(v, (bool, int, float, str)):
         return repr(v)
     if callable(v):
-        return _fn_token(v, pins)
+        return _fn_token(v, pins, seen)
+    if isinstance(v, (tuple, list, dict, set, frozenset)):
+        if id(v) in seen:
+            pins.append(v)
+            return f"cyc:{id(v)}"
+        seen = seen | {id(v)}
     if isinstance(v, (tuple, list)):
-        return "[" + ",".join(_attr_token(x, pins) for x in v) + "]"
+        return "[" + ",".join(_attr_token(x, pins, seen) for x in v) + "]"
+    if isinstance(v, dict):
+        try:
+            items = sorted(v.items())
+        except TypeError:
+            items = sorted(v.items(), key=lambda kv: repr(kv[0]))
+        return "{" + ",".join(
+            _attr_token(k, pins, seen) + ":" + _attr_token(x, pins, seen)
+            for k, x in items) + "}"
+    if isinstance(v, (set, frozenset)):
+        return "{" + ",".join(
+            sorted(_attr_token(x, pins, seen) for x in v)) + "}"
     pins.append(v)
     return f"obj:{type(v).__name__}:{id(v)}"
 

@@ -433,12 +433,13 @@ class TestJoinSchemeLayoutCredit:
         assert self._scheme(small_sharded, big_rep, mesh8) == "right"
 
     def test_density_credit_flips_choice(self, mesh8, rng):
-        # sparse-big has fewer credited bytes than dense-small
-        from matrel_tpu.core.sparse import BlockSparseMatrix
+        # sparse-big has fewer credited bytes than dense-small (credited
+        # ratio kept >8x so the v3 align scheme is not competitive and
+        # the left-vs-right density credit itself is what's exercised)
         dense_small = bm(rng.standard_normal((8, 16)), mesh8)
         a = np.zeros((8, 256), dtype=np.float32)
-        a[:, :4] = 1.0                      # ~1.5% dense
-        sparse_big = BlockMatrix.from_numpy(a, mesh=mesh8, nnz=32)
+        a[:, :1] = 1.0                      # ~0.4% dense
+        sparse_big = BlockMatrix.from_numpy(a, mesh=mesh8, nnz=8)
         assert self._scheme(sparse_big, dense_small, mesh8) == "left"
         assert self._scheme(dense_small, sparse_big, mesh8) == "right"
 
@@ -449,6 +450,83 @@ class TestJoinSchemeLayoutCredit:
         big = bm(rng.standard_normal((8, 64)), mesh8)
         assert self._scheme(small, big, mesh8) == "left"
         assert self._scheme(big, small, mesh8) == "right"
+
+
+class TestJoinSchemeV3PartialLayouts:
+    """Join-scheme v3 (VERDICT r3 #5): per-layout cost terms. An operand
+    whose existing row/col sharding matches the join axis is consumed IN
+    PLACE (reshard term zero) via the new "align" scheme instead of
+    being charged a full (p-1)/p all-gather."""
+
+    def _scheme(self, a, b, mesh, joiner=None):
+        from matrel_tpu.parallel import planner as pl
+        joiner = joiner or R.join_on_rows
+        e = joiner(a, b, lambda x, y: x + y)
+        return pl.annotate_strategies(e, mesh).attrs["replicate"]
+
+    def test_colsharded_larger_beats_2d_smaller_for_coljoin(self, mesh8,
+                                                            rng):
+        # the VERDICT flip test: v2 replicated the smaller 2D operand
+        # (full all-gather); v3 keeps the col-sharded larger operand in
+        # place and just re-lays the small one — "align"
+        from jax.sharding import PartitionSpec as P
+        big_col = BlockMatrix.from_numpy(
+            rng.standard_normal((64, 8)).astype(np.float32),
+            mesh=mesh8, spec=P(None, ("x", "y")))
+        small_2d = bm(rng.standard_normal((4, 8)), mesh8)
+        assert self._scheme(big_col, small_2d, mesh8,
+                            R.join_on_cols) == "align"
+        assert self._scheme(small_2d, big_col, mesh8,
+                            R.join_on_cols) == "align"
+
+    def test_rowsharded_operand_in_place_for_rowjoin(self, mesh8, rng):
+        from jax.sharding import PartitionSpec as P
+        big_row = BlockMatrix.from_numpy(
+            rng.standard_normal((8, 64)).astype(np.float32),
+            mesh=mesh8, spec=P(("x", "y"), None))
+        small_2d = bm(rng.standard_normal((8, 4)), mesh8)
+        assert self._scheme(big_row, small_2d, mesh8,
+                            R.join_on_rows) == "align"
+
+    def test_align_gated_when_axis_smaller_than_mesh(self, mesh8, rng):
+        # review r4: with fewer join-axis rows than devices the align
+        # constraint degenerates to XLA full rematerialization — the
+        # planner must fall back to replicating the smaller side
+        a = bm(rng.standard_normal((4, 32)), mesh8)
+        b = bm(rng.standard_normal((4, 32)), mesh8)
+        assert self._scheme(a, b, mesh8, R.join_on_rows) in ("left",
+                                                             "right")
+
+    def test_similar_sized_2d_operands_align(self, mesh8, rng):
+        # two cheap redistributions beat one full broadcast when the
+        # operands are comparable in size
+        a = bm(rng.standard_normal((8, 32)), mesh8)
+        b = bm(rng.standard_normal((8, 32)), mesh8)
+        assert self._scheme(a, b, mesh8) == "align"
+
+    def test_align_scheme_numerics_match_oracle(self, mesh8, rng):
+        # the executor's align lowering (both sides constrained to the
+        # join axis) must produce oracle results — row and col joins
+        a = rng.standard_normal((8, 6)).astype(np.float32)
+        b = rng.standard_normal((8, 6)).astype(np.float32)
+        e = R.join_on_rows(bm(a, mesh8), bm(b, mesh8),
+                           lambda x, y: x * y)
+        from matrel_tpu.parallel import planner as pl
+        ann = pl.annotate_strategies(e, mesh8)
+        assert ann.attrs["replicate"] == "align"
+        got = ann.compute().to_numpy()
+        want = (a[:, :, None] * b[:, None, :]).reshape(8, 36)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+        ac = rng.standard_normal((6, 8)).astype(np.float32)
+        bc = rng.standard_normal((6, 8)).astype(np.float32)
+        ec = R.join_on_cols(bm(ac, mesh8), bm(bc, mesh8),
+                            lambda x, y: x + y)
+        annc = pl.annotate_strategies(ec, mesh8)
+        assert annc.attrs["replicate"] == "align"
+        gotc = annc.compute().to_numpy()
+        wantc = (ac[:, None, :] + bc[None, :, :]).reshape(36, 8)
+        np.testing.assert_allclose(gotc, wantc, rtol=1e-5, atol=1e-5)
 
 
 class TestChunkedJoinShardedQuerySide:

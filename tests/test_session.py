@@ -300,6 +300,100 @@ class TestPlanKeyGlobalsAndPinning:
         np.testing.assert_allclose(r2, np.where(a > -0.5, a, 0), rtol=1e-5)
 
 
+class TestPlanKeyBoundMethodsAndKwdefaults:
+    """Advisor r3 medium: _fn_token omitted __kwdefaults__ and bound-method
+    __self__ state, so behaviourally distinct callables collided in the
+    plan cache (the second query silently returned the first's result)."""
+
+    def test_bound_method_instance_state_keys_separately(self, mesh8, rng):
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        m = sess.from_numpy(a)
+
+        class Thresh:
+            def __init__(self, t):
+                self.t = t
+
+            def pred(self, v):
+                return v > self.t
+
+        r1 = sess.compute(
+            m.expr().select_value(Thresh(16.5).pred)).to_numpy()
+        r2 = sess.compute(
+            m.expr().select_value(Thresh(0.0).pred)).to_numpy()
+        np.testing.assert_allclose(r1, np.where(a > 16.5, a, 0), rtol=1e-5)
+        np.testing.assert_allclose(r2, np.where(a > 0.0, a, 0), rtol=1e-5)
+
+    def test_kwonly_defaults_key_separately(self, mesh8, rng):
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        m = sess.from_numpy(a)
+
+        def make(t):
+            def pred(v, *, thr=t):
+                return v > thr
+            return pred
+
+        r1 = sess.compute(m.expr().select_value(make(0.5))).to_numpy()
+        r2 = sess.compute(m.expr().select_value(make(-0.5))).to_numpy()
+        np.testing.assert_allclose(r1, np.where(a > 0.5, a, 0), rtol=1e-5)
+        np.testing.assert_allclose(r2, np.where(a > -0.5, a, 0), rtol=1e-5)
+
+    def test_global_list_mutated_in_place_rekeys(self, mesh8, rng):
+        # advisor r3 low: a mutable global mutated IN PLACE (same id)
+        # must not falsely hit the cached plan — containers key by value
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        m = sess.from_numpy(a)
+        g = {"thrs": [0.5]}
+        f1 = eval("lambda v: v > thrs[0]", g)       # noqa: S307
+        r1 = sess.compute(m.expr().select_value(f1)).to_numpy()
+        g["thrs"][0] = -0.5                         # in-place, id unchanged
+        f2 = eval("lambda v: v > thrs[0]", g)       # noqa: S307
+        r2 = sess.compute(m.expr().select_value(f2)).to_numpy()
+        np.testing.assert_allclose(r1, np.where(a > 0.5, a, 0), rtol=1e-5)
+        np.testing.assert_allclose(r2, np.where(a > -0.5, a, 0), rtol=1e-5)
+
+    def test_cyclic_global_container_terminates(self, mesh8, rng):
+        # review r4: a self-referential container reachable from a
+        # predicate's globals must key finitely (back-edge by pinned id)
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        m = sess.from_numpy(a)
+        g = {"cfg": {"thr": 0.5}}
+        g["cfg"]["self"] = g["cfg"]             # cycle
+        f1 = eval("lambda v: v > cfg['thr']", g)   # noqa: S307
+        r1 = sess.compute(m.expr().select_value(f1)).to_numpy()
+        np.testing.assert_allclose(r1, np.where(a > 0.5, a, 0), rtol=1e-5)
+
+    def test_large_dict_mutated_in_place_rekeys(self, mesh8, rng):
+        # review r4: no silent size cap — a 65+-entry global dict
+        # mutated in place must still re-key by value
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        m = sess.from_numpy(a)
+        g = {"thrs": {i: 0.0 for i in range(70)}}
+        g["thrs"][0] = 0.5
+        f1 = eval("lambda v: v > thrs[0]", g)      # noqa: S307
+        r1 = sess.compute(m.expr().select_value(f1)).to_numpy()
+        g["thrs"][0] = -0.5                        # in-place, id unchanged
+        f2 = eval("lambda v: v > thrs[0]", g)      # noqa: S307
+        r2 = sess.compute(m.expr().select_value(f2)).to_numpy()
+        np.testing.assert_allclose(r1, np.where(a > 0.5, a, 0), rtol=1e-5)
+        np.testing.assert_allclose(r2, np.where(a > -0.5, a, 0), rtol=1e-5)
+
+    def test_recursive_global_function_terminates(self, mesh8, rng):
+        # the value-keyed globals walk must terminate when a predicate's
+        # global namespace reaches the predicate itself
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        m = sess.from_numpy(a)
+        g = {}
+        g["pred"] = eval("lambda v: v > 0.5 if pred else v", g)  # noqa: S307
+        r1 = sess.compute(m.expr().select_value(g["pred"])).to_numpy()
+        np.testing.assert_allclose(r1, np.where(a > 0.5, a, 0), rtol=1e-5)
+
+
 def test_session_explain_includes_physical_plan(mesh8, rng):
     """round-3: EXPLAIN shows the physical annotations (strategy,
     collectives) without the user reaching for compile().explain()."""

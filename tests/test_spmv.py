@@ -524,6 +524,26 @@ class TestCompactSpMV:
         want = coo_oracle(rows, cols, vals, x, n)
         assert np.abs(y - want).max() / np.abs(want).max() < 1e-4
 
+    def test_chunked_pipeline_matches_baseline(self, rng):
+        # compact_apply_chunked (VERDICT r3 #6 overlap experiment) must
+        # be bit-identical in result to compact_apply: same kernel, same
+        # tables, block stripes are independent
+        from matrel_tpu.ops import pallas_spmv as pc
+        n_r, n_c, m = 3000, 3000, 25_000
+        rows, cols, vals = random_coo(rng, n_r, n_c, m)
+        plan = spmv_lib.build_spmv_plan(rows, cols, vals,
+                                        n_rows=n_r, n_cols=n_c)
+        static = (plan.n_rows, plan.n_cols, plan.block, spmv_lib.LO)
+        tables = pc.compact_tables(plan)
+        x = jnp.asarray(rng.standard_normal(n_c).astype(np.float32))
+        base = np.asarray(pc.compact_apply(static, tables, plan.overflow,
+                                           x, interpret=True))
+        for k in (2, 3, 8):
+            got = np.asarray(pc.compact_apply_chunked(
+                static, tables, plan.overflow, x, chunks=k,
+                interpret=True))
+            np.testing.assert_array_equal(got, base)
+
     def test_overflow_coo_included(self, rng):
         from matrel_tpu.ops import pallas_spmv as pc
         # hub row forces quantile-capacity overflow

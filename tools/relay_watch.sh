@@ -35,6 +35,8 @@ cfg = MatrelConfig(); set_default_config(cfg)
 mesh = mesh_lib.make_mesh()
 print(json.dumps(bench_all.bench_pagerank_10x(mesh, cfg)))
 " >> "$LOG" 2>&1
+    log "--- pagerank gather/scatter overlap experiment (VERDICT r3 #6)"
+    timeout 900 python tools/pagerank_overlap.py >> "$LOG" 2>&1
     log "--- full tpu batch (bench, soak, bench_all, north-star sweep)"
     timeout 3600 sh tools/tpu_batch.sh >> "$LOG" 2>&1
     log "experiments DONE"

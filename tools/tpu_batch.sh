@@ -20,4 +20,6 @@ log "--- gram_manual3 (symmetric-Gram microbench, BASELINE row 3 support)"
 python tools/gram_manual3.py
 log "--- gram_sym_full (10Mx1k linreg, symmetric 2-pass Gram, BASELINE row 3)"
 python tools/gram_sym_full.py
+log "--- autotune_capture (re-capture table under round-4 tie rules)"
+python tools/autotune_capture.py
 log "TPU batch done"
