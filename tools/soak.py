@@ -235,8 +235,13 @@ def soak_sharded(n_trials: int, base: int, tol: float):
     return fails
 
 
-def soak_routed(n_trials: int, base: int, tol: float):
-    """Routed (gather-free) SpMV plans vs scipy, interpret mode."""
+def soak_routed(n_trials: int, base: int, tol: float,
+                interpret: bool = True):
+    """Routed (gather-free) SpMV plans vs scipy. ``interpret=True`` is
+    the CPU battery; ``interpret=False`` under --tpu runs the kernels
+    through REAL Mosaic once per round (VERDICT r3 #7: a kept kernel
+    that only ever ran interpret mode is latent rot — real-chip soak
+    has caught Mosaic bugs CI missed, e.g. seed 50114)."""
     import numpy as np
     import scipy.sparse as sp
     import jax.numpy as jnp
@@ -246,9 +251,18 @@ def soak_routed(n_trials: int, base: int, tol: float):
     for trial in range(n_trials):
         rng = np.random.default_rng(base + trial)
         try:
-            n_r = int(rng.integers(1000, 50_000))
-            n_c = int(rng.integers(1000, 50_000))
-            m = int(rng.integers(100, 40_000))
+            if interpret:
+                n_r = int(rng.integers(1000, 50_000))
+                n_c = int(rng.integers(1000, 50_000))
+                m = int(rng.integers(100, 40_000))
+            else:
+                # on-chip: small shapes — this battery validates Mosaic
+                # lowering, not throughput (the routed path measured 52
+                # ms vs 29 at row-5 scale and is kept as a reference
+                # formulation)
+                n_r = int(rng.integers(1000, 8_000))
+                n_c = int(rng.integers(1000, 8_000))
+                m = int(rng.integers(100, 10_000))
             rows = rng.integers(0, n_r, m)
             cols = rng.integers(0, n_c, m)
             vals = rng.standard_normal(m).astype(np.float32)
@@ -261,7 +275,7 @@ def soak_routed(n_trials: int, base: int, tol: float):
                                  shape=(n_r, n_c)) @ x
             scale = max(float(np.abs(want).max()), 1.0)
             got = np.asarray(rt.routed_spmv(plan, jnp.asarray(x),
-                                            interpret=True))
+                                            interpret=interpret))
             np.testing.assert_allclose(got / scale, want / scale,
                                        rtol=tol, atol=tol)
         except Exception as ex:  # noqa: BLE001
@@ -358,11 +372,11 @@ def main():
         fails += soak_sharded(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("routed", "all"):
         if args.tpu:
-            # interpret-mode battery; the routed kernels are exercised
-            # on-chip by their own module tests. Say so rather than
-            # reporting a vacuous clean pass.
-            print("routed battery skipped under --tpu "
-                  "(interpret-mode only)", flush=True)
+            # REAL-Mosaic routed battery: few trials, small shapes —
+            # enough to prove the kernels lower and agree with scipy on
+            # the chip (VERDICT r3 #7)
+            fails += soak_routed(max(args.seeds // 4, 3), args.base,
+                                 5e-4, interpret=False)
         else:
             fails += soak_routed(max(args.seeds // 2, 5), args.base,
                                  5e-4)
