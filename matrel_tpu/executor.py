@@ -75,6 +75,11 @@ class Lowerer:
     def __init__(self, mesh: Mesh, config: MatrelConfig):
         self.mesh = mesh
         self.config = config
+        # id(plan) -> measured SpMV executor variant ("compact" |
+        # "expanded"), populated at compile time by the autotune loop
+        # (parallel/autotune.lookup_or_measure_spmv); empty = hand
+        # defaults decide
+        self.spmv_choice: Dict[int, str] = {}
 
     def lower(self, root: MatExpr, leaf_order: List[MatExpr]) -> Callable:
         multi = self.lower_multi((root,), leaf_order)
@@ -282,7 +287,13 @@ class Lowerer:
         SpMM (one shared gather for all columns)."""
         from matrel_tpu.config import pallas_enabled, pallas_interpret_mode
         from matrel_tpu.ops import spmv as spmv_lib
-        if pallas_enabled(self.config):
+        use_pallas = pallas_enabled(self.config)
+        choice = self.spmv_choice.get(id(plan))
+        if choice == "expanded":
+            # measured: the expanded XLA one-hot path beats the compact
+            # Pallas scatter for this plan shape class on this backend
+            use_pallas = False
+        if use_pallas:
             from matrel_tpu.ops import pallas_spmv as pc
             interp = pallas_interpret_mode(self.config)
             static = (plan.n_rows, plan.n_cols, plan.block, spmv_lib.LO)
@@ -361,9 +372,11 @@ class Lowerer:
         # coo_leaf matmuls: per-column one-hot SpMV for narrow dense
         # operands; wide ones (or refused plans) densify — at that point
         # the MXU over a dense block layout beats serialized matvecs.
+        # The dispatch predicate is shared with the autotune walk
+        # (_coo_dispatch_plan) so the two can never drift.
         if l.kind == "coo_leaf":
             A, k = l.attrs["matrix"], r.shape[1]
-            plan = A._get_plan() if 0 < k <= 128 else None
+            plan = _coo_dispatch_plan(node)
             if plan is None:
                 blk = A.to_block(self.mesh, self.config).data
                 return strategies.run_matmul("xla", blk, ev(r), self.mesh,
@@ -376,7 +389,7 @@ class Lowerer:
             # A·S = (Sᵀ·Aᵀ)ᵀ — use the original matrix's cached
             # transpose plan (_get_plan_t), built at most once
             S, k = r.attrs["matrix"], l.shape[0]
-            plan = S._get_plan_t() if 0 < k <= 128 else None
+            plan = _coo_dispatch_plan(node)
             if plan is None:
                 blk = S.to_block(self.mesh, self.config).data
                 return strategies.run_matmul("xla", ev(l), blk, self.mesh,
@@ -909,11 +922,60 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
             if l.uid not in seen:
                 seen.add(l.uid)
                 leaf_order.append(l)
-    fn = Lowerer(mesh, cfg).lower_multi(opts, leaf_order)
+    low = Lowerer(mesh, cfg)
+    if cfg.autotune:
+        low.spmv_choice = _autotune_spmv_choices(opts, mesh, cfg)
+    fn = low.lower_multi(opts, leaf_order)
     fn, extra = _hoist_large_consts(fn, _example_avals(leaf_order))
     return MultiPlan(jitted=jax.jit(fn), leaf_order=leaf_order,
                      optimized=opts, mesh=mesh, config=cfg,
                      extra_args=extra)
+
+
+def _coo_dispatch_plan(node: MatExpr):
+    """The EdgeSpMVPlan a coo_leaf matmul node will dispatch through
+    _coo_spmv_stack, or None (the densify path). SINGLE source of truth
+    for the narrow-operand threshold, shared by Lowerer._matmul and the
+    autotune walk so the two can never drift."""
+    l, r = node.children
+    if l.kind == "coo_leaf":
+        k = r.shape[1]
+        return l.attrs["matrix"]._get_plan() if 0 < k <= 128 else None
+    if r.kind == "coo_leaf":
+        k = l.shape[0]
+        return r.attrs["matrix"]._get_plan_t() if 0 < k <= 128 else None
+    return None
+
+
+def _autotune_spmv_choices(opts, mesh, cfg) -> dict:
+    """Measured SpMV executor variants for every COO matmul this plan
+    will dispatch through _coo_spmv_stack (config.autotune on): maps
+    id(plan) -> "compact"/"expanded". Runs OUTSIDE tracing, at compile
+    time — measurement launches its own jitted probes. Dispatch
+    conditions come from _coo_dispatch_plan (shared with _matmul);
+    anything else keeps the hand defaults."""
+    from matrel_tpu.parallel import autotune
+
+    choices: dict = {}
+    seen: set = set()
+
+    def visit(n: MatExpr):
+        if n.uid in seen:        # expressions are DAGs — walk each
+            return               # shared node once
+        seen.add(n.uid)
+        if n.kind == "matmul" and any(c.kind == "coo_leaf"
+                                      for c in n.children):
+            plan = _coo_dispatch_plan(n)
+            if plan is not None and id(plan) not in choices:
+                best = autotune.lookup_or_measure_spmv(plan, mesh, cfg)
+                if best is not None:
+                    choices[id(plan)] = best
+        for c in n.children:
+            visit(c)
+
+    for o in opts:
+        visit(o)
+    return choices
 
 
 def _check_one_mesh(expr: MatExpr, mesh: Mesh) -> None:
@@ -945,7 +1007,10 @@ def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
                          grid=mesh_lib.mesh_grid_shape(mesh))
     opt = planner.annotate_strategies(opt, mesh, cfg)
     leaf_order = expr_leaves(opt)
-    fn = Lowerer(mesh, cfg).lower(opt, leaf_order)
+    low = Lowerer(mesh, cfg)
+    if cfg.autotune:
+        low.spmv_choice = _autotune_spmv_choices((opt,), mesh, cfg)
+    fn = low.lower(opt, leaf_order)
     fn, extra = _hoist_large_consts(fn, _example_avals(leaf_order))
     jitted = jax.jit(fn)
     return CompiledPlan(jitted=jitted, leaf_order=leaf_order, optimized=opt,

@@ -305,3 +305,121 @@ def lookup_or_measure(n: int, k: int, m: int, mesh,
         return None
     best, _ = autotune_matmul(n, k, m, mesh=mesh, dtype=dtype, config=cfg)
     return best
+
+
+# -- SpMV executor autotuning -------------------------------------------------
+# The largest hand-pinned constants in the codebase are the COO SpMV
+# executor choices (SURVEY.md §7 "detecting when XLA's choice is
+# beaten"): compact-table Pallas scatter vs expanded-table XLA one-hots.
+# The hand default (compact wherever Pallas is available — measured
+# 18.8 ms vs 29.4 per matvec at BASELINE row-5 scale on v5e) stays the
+# fallback; with config.autotune on, the choice is measured once per
+# plan shape class and persisted in the same JSON table.
+
+_SPMV_CACHE: Dict[str, Optional[str]] = {}
+
+# expanded tables cost ~224 B per padded slot; refuse to even MEASURE
+# the expanded variant past this budget (a 10x-graph table would blow
+# the chip's HBM just to lose the comparison)
+SPMV_EXPANDED_BUDGET_BYTES = 2 * 1024 ** 3
+
+SPMV_VARIANTS = ("compact", "expanded")
+
+
+def _spmv_key(plan, gx: int, gy: int) -> str:
+    # backend is part of the key: the compact/expanded trade-off FLIPS
+    # between real Mosaic (compact wins, BASELINE row 5) and CPU
+    # interpret mode (expanded wins ~20x) — a shared table must never
+    # serve one backend's winner to the other
+    nb, cap = plan.src8.shape if hasattr(plan.src8, "shape") else (0, 0)
+    return (f"spmv|{jax.default_backend()}|{plan.n_rows}x{plan.n_cols}"
+            f"|nb{nb}|cap{cap}|blk{plan.block}|{gx}x{gy}")
+
+
+def measure_spmv_variant(variant: str, plan, mesh,
+                         config: Optional[MatrelConfig] = None,
+                         n_times: int = 5) -> float:
+    """Median seconds per matvec for one executor variant, timed through
+    the REAL lowering path (Lowerer._coo_spmv_stack with the choice
+    forced). Sync timing with a forced scalar fetch — both variants pay
+    the identical fetch, so the ranking is unaffected."""
+    import numpy as np
+    from matrel_tpu import executor as executor_lib
+    cfg = config or default_config()
+    low = executor_lib.Lowerer(mesh, cfg)
+    low.spmv_choice = {id(plan): variant}
+    x = jnp.asarray(np.random.default_rng(0)
+                    .standard_normal(plan.n_cols).astype(np.float32))
+    # snapshot the plan's expanded-table caches: the expanded probe
+    # calls plan.arrays(), which eagerly expands and CACHES the ~224
+    # B/slot one-hot tables on the plan — left in place they would pin
+    # up to the measurement budget of HBM for the whole session even
+    # when compact wins (review r4). The winner re-expands on first
+    # real use (one fused program).
+    saved = (plan._tables, plan._spmm_tables)
+    try:
+        f = jax.jit(lambda v: jnp.sum(low._coo_spmv_stack(plan, [v])))
+        float(f(x))    # compile + warm (also table upload/expansion)
+        ts = []
+        for _ in range(max(n_times, 1)):
+            t0 = time.perf_counter()
+            float(f(x))
+            ts.append(time.perf_counter() - t0)
+    finally:
+        if variant == "expanded":
+            plan._tables, plan._spmm_tables = saved
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def _spmv_admissible(variant: str, plan, config: MatrelConfig) -> bool:
+    from matrel_tpu.config import pallas_enabled
+    if variant == "compact":
+        return pallas_enabled(config)
+    # expanded: gate on the materialised-table budget
+    nb, cap = plan.src8.shape
+    return nb * cap * 224 <= SPMV_EXPANDED_BUDGET_BYTES
+
+
+def lookup_or_measure_spmv(plan, mesh,
+                           config: Optional[MatrelConfig] = None
+                           ) -> Optional[str]:
+    """The compile-time entry point (config.autotune=True): the measured
+    executor variant for this plan shape class, or None when the hand
+    default should stand. Same table discipline as the matmul loop:
+    in-process cache → persisted table → measure once; ties and empty
+    result sets resolve to None and are never fake winners."""
+    cfg = config or default_config()
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    key = _spmv_key(plan, gx, gy)
+    if key in _SPMV_CACHE:
+        return _SPMV_CACHE[key]
+    entry = _load_table_cached(_table_path(cfg)).get(key)
+    if isinstance(entry, dict) and entry.get("times"):
+        best = entry.get("best")
+        best = best if isinstance(best, str) else None
+        _SPMV_CACHE[key] = best
+        return best
+    results: Dict[str, float] = {}
+    for v in SPMV_VARIANTS:
+        if not _spmv_admissible(v, plan, cfg):
+            continue
+        try:
+            t = measure_spmv_variant(v, plan, mesh, cfg)
+        except Exception:  # noqa: BLE001 — a variant failing to compile
+            continue       # on this backend drops out of the table
+        if t > 0.0:
+            results[v] = t
+    # a one-variant "comparison" proves nothing, and which variants are
+    # admissible depends on CONFIG state (use_pallas, the expanded
+    # budget) that the table key does not encode — persisting it would
+    # poison shared tables across configs (review r4). Hand default
+    # stands; nothing is written.
+    if len(results) < 2:
+        _SPMV_CACHE[key] = None
+        return None
+    best = _pick_winner(results)
+    _SPMV_CACHE[key] = best
+    if cfg.autotune or cfg.autotune_table_path:
+        _persist(_table_path(cfg), key, best, results)
+    return best

@@ -29,6 +29,27 @@ def main(path: str = "autotune_v5e_1chip.json") -> None:
                               "times": {k: round(v, 6)
                                         for k, v in times.items()}}))
             sys.stdout.flush()
+    # SpMV executor choice (VERDICT r3 #8) at a scale whose expanded
+    # tables still fit the measurement budget (~235 MB; the row-5 graph
+    # itself is compact-only by the 2 GB gate)
+    import numpy as np
+    from matrel_tpu.core.coo import COOMatrix
+    n, m = 100_000, 1_000_000
+    rng = np.random.default_rng(0)
+    A = COOMatrix.from_edges(rng.integers(0, n, m, dtype=np.int32),
+                             rng.integers(0, n, m, dtype=np.int32),
+                             shape=(n, n))
+    plan = A._get_plan()
+    if plan is not None:
+        autotune._SPMV_CACHE.clear()
+        best = autotune.lookup_or_measure_spmv(plan, mesh, cfg)
+        gx, gy = mesh_lib.mesh_grid_shape(mesh)
+        key = autotune._spmv_key(plan, gx, gy)
+        entry = autotune.load_table(path).get(key, {})
+        print(json.dumps({"spmv_key": key, "best": best,
+                          "times": {k: round(v, 6) for k, v in
+                                    entry.get("times", {}).items()}}))
+        sys.stdout.flush()
 
 
 if __name__ == "__main__":
