@@ -31,6 +31,9 @@ Grammar (Python-expression syntax, parsed via ``ast`` — no eval):
         joinindex(a, b, "x * y")        ⋈ on index with merge expr
         joinrows(a, b, "x + y")         ⋈ on row index (pairwise cols)
         joincols(a, b, "x - y")         ⋈ on col index (pairwise rows)
+            — index-join merges also accept the structured keywords
+            ("left"/"right"/"add"/"mul"), which let the planner infer
+            output dtypes (autotune reaches consuming multiplies)
         joinvalue(a, b, <merge>, <pred>)   ⋈ on values; merge/pred are
             either structured keywords ("left"/"right"/"add"/"mul" and
             "eq"/"lt"/"le"/"gt"/"ge" — these stream under aggregates)
@@ -260,11 +263,11 @@ class _Compiler(ast.NodeVisitor):
             pred = _compile_lambda(self._str(args[1]), ("j",))
             return self._expr(args[0]).select_index(cols=pred)
         if name == "joinindex":
-            merge = _compile_lambda(self._str(args[2]), ("x", "y"))
+            merge = self._merge_or_pred(args[2], E.JOIN_MERGES)
             return self._expr(args[0]).join_on_index(self._expr(args[1]), merge)
         if name in ("joinrows", "joincols"):
             from matrel_tpu.relational import ops as R
-            merge = _compile_lambda(self._str(args[2]), ("x", "y"))
+            merge = self._merge_or_pred(args[2], E.JOIN_MERGES)
             join = (R.join_on_rows if name == "joinrows"
                     else R.join_on_cols)
             return join(self._expr(args[0]), self._expr(args[1]), merge)
@@ -283,8 +286,10 @@ class _Compiler(ast.NodeVisitor):
         raise SqlError(f"unknown function {name!r}")
 
     def _merge_or_pred(self, node, keywords):
-        """joinvalue argument: a structured keyword string (streams
-        under aggregates) or an (x, y) expression string."""
+        """Merge/predicate argument of ANY join function (joinvalue's
+        merge+pred, and the merges of joinindex/joinrows/joincols): a
+        structured keyword string (streams under aggregates; gives the
+        planner dtype inference) or an (x, y) expression string."""
         s = self._str(node)
         if s in keywords:
             return s

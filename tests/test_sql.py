@@ -66,6 +66,22 @@ def test_joinindex(sess):
     np.testing.assert_allclose(out, a * (a + 1), rtol=1e-4, atol=1e-4)
 
 
+def test_index_joins_structured_merge_keywords(sess):
+    # round 4: joinindex/joinrows/joincols accept the structured merge
+    # keywords (same set as joinvalue) — dtype-inference-friendly
+    s, a, b = sess
+    s.register("C", s.from_numpy(a + 1))
+    out = s.compute(s.sql("joinindex(A, C, 'add')")).to_numpy()
+    np.testing.assert_allclose(out, a + (a + 1), rtol=1e-4, atol=1e-4)
+    n, m = a.shape
+    out_r = s.compute(s.sql("joinrows(A, A, 'mul')")).to_numpy()
+    want_r = (a[:, :, None] * a[:, None, :]).reshape(n, m * m)
+    np.testing.assert_allclose(out_r, want_r, rtol=1e-4, atol=1e-4)
+    out_c = s.compute(s.sql("joincols(A, A, 'left')")).to_numpy()
+    want_c = np.broadcast_to(a[:, None, :], (n, n, m)).reshape(n * n, m)
+    np.testing.assert_allclose(out_c, want_c, rtol=1e-4, atol=1e-4)
+
+
 def test_unknown_table_raises(sess):
     s, _, _ = sess
     with pytest.raises(SqlError):

@@ -24,7 +24,9 @@ same control/data plane shape as hosts in a TPU pod connected over DCN
      across processes (round-3: both the sorted and the callable
      chunked path),
   9. the symmetric 2-pass Gram lowering (round-3) through the full
-     executor under precision="high".
+     executor under precision="high",
+ 10. the v3 "align" join scheme (round-4): both operands re-laid 1D
+     along the join axis on the global mesh, shard-local pairwise merge.
 
 Run:  python tools/multihost_check.py [--nproc 2]
 Exit code 0 on success; worker logs live in a fresh temp dir (path
@@ -162,6 +164,22 @@ got_g = np.asarray(multihost_utils.process_allgather(
     tiled=True))[:24, :24]
 np.testing.assert_allclose(got_g, gx.T @ gx, rtol=5e-3, atol=5e-3)
 print(f"[p{pid}] symmetric gram matches oracle", flush=True)
+
+# round-4: the v3 "align" join scheme across process boundaries — both
+# operands re-laid 1D along the join axis on the GLOBAL mesh, the
+# pairwise merge computes shard-locally on every process
+from matrel_tpu.parallel import planner as pl_mod
+j_a = rng.standard_normal((8 * nproc, 10)).astype(np.float32)
+j_b = rng.standard_normal((8 * nproc, 6)).astype(np.float32)
+je = R.join_on_rows(BlockMatrix.from_numpy(j_a, mesh=mesh),
+                    BlockMatrix.from_numpy(j_b, mesh=mesh), "mul")
+je_ann = pl_mod.annotate_strategies(je, mesh, cfg)
+assert je_ann.attrs["replicate"] == "align", je_ann.attrs
+got_j = np.asarray(multihost_utils.process_allgather(
+    mat_execute(je_ann, mesh, cfg).data, tiled=True))[:8 * nproc, :60]
+want_j = (j_a[:, :, None] * j_b[:, None, :]).reshape(8 * nproc, 60)
+np.testing.assert_allclose(got_j, want_j, rtol=1e-4, atol=1e-4)
+print(f"[p{pid}] align row-join matches oracle", flush=True)
 
 multihost_utils.sync_global_devices("matrel-mh-done")
 print(f"[p{pid}] DONE", flush=True)
