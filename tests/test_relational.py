@@ -504,6 +504,27 @@ class TestJoinSchemeV3PartialLayouts:
         b = bm(rng.standard_normal((8, 32)), mesh8)
         assert self._scheme(a, b, mesh8) == "align"
 
+    def test_align_hlo_avoids_full_operand_allgather(self, mesh8, rng):
+        # the Catalyst-plan-assertion analogue for the align scheme:
+        # replicate ("left") all-gathers the ENTIRE operand; align must
+        # not — it redistributes shards (all-to-all family) instead
+        import re
+
+        from matrel_tpu import executor as executor_lib
+        a = bm(rng.standard_normal((32, 16)), mesh8)
+        b = bm(rng.standard_normal((32, 16)), mesh8)
+
+        def hlo(scheme):
+            e = R.join_on_rows(a, b, "mul").with_attrs(replicate=scheme)
+            return executor_lib.compile_expr(e, mesh8).hlo()
+
+        full_op_ag = re.compile(r"f32\[32,16\]\{[0-9,]*\} all-gather")
+        assert full_op_ag.search(hlo("left"))
+        # only the ABSENCE is pinned (test_strategies.py convention):
+        # which reshard collective XLA picks for the redistribution is
+        # backend/version-dependent
+        assert not full_op_ag.search(hlo("align"))
+
     def test_align_scheme_numerics_match_oracle(self, mesh8, rng):
         # the executor's align lowering (both sides constrained to the
         # join axis) must produce oracle results — row and col joins
