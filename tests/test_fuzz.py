@@ -95,11 +95,16 @@ def np_eval(e, env):
     raise NotImplementedError(k)
 
 
-def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",)):
+def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",),
+             dtype_pop=("float32",), structured_join=False):
     """Random expression with consistent shapes; fills env[uid] for leaves.
     ``leaf_kinds``: population for leaf flavors — "dense" (BlockMatrix),
     "sparse" (BlockSparseMatrix tile stack), "coo" (element-sparse plan);
-    all three enter the same IR and must agree with the numpy oracle."""
+    all three enter the same IR and must agree with the numpy oracle.
+    ``dtype_pop``: device dtypes for dense leaves (the numpy oracle env
+    always stores exact f32 — mixed-dtype callers compare dtypes, not
+    numerics). ``structured_join``: use structured string merges for
+    join_index (dtype-inferable) instead of a callable."""
     def leaf_of(shape):
         a = rng.standard_normal(shape).astype(np.float32)
         kind = str(rng.choice(leaf_kinds))
@@ -114,7 +119,8 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",)):
             r, c = np.nonzero(a)
             l = COOMatrix.from_edges(r, c, a[r, c], shape=shape).expr()
         else:
-            l = E.leaf(BlockMatrix.from_numpy(a, mesh=mesh))
+            l = E.leaf(BlockMatrix.from_numpy(
+                a, mesh=mesh, dtype=str(rng.choice(dtype_pop))))
         env[l.uid] = a
         return l
 
@@ -135,60 +141,74 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",)):
         k = int(rng.choice(dims[1:]))
         if rng.random() < 0.5:
             x = gen_expr(rng, env, mesh, depth - 1, (k, shape[0]),
-                         leaf_kinds)
+                         leaf_kinds, dtype_pop, structured_join)
             return E.matmul(E.transpose(x), x)
         x = gen_expr(rng, env, mesh, depth - 1, (shape[0], k),
-                     leaf_kinds)
+                     leaf_kinds, dtype_pop, structured_join)
         return E.matmul(x, E.transpose(x))
     if choice == "matmul":
         k = int(rng.choice(dims[1:]))
-        a = gen_expr(rng, env, mesh, depth - 1, (shape[0], k), leaf_kinds)
-        b = gen_expr(rng, env, mesh, depth - 1, (k, shape[1]), leaf_kinds)
+        a = gen_expr(rng, env, mesh, depth - 1, (shape[0], k),
+                     leaf_kinds, dtype_pop, structured_join)
+        b = gen_expr(rng, env, mesh, depth - 1, (k, shape[1]),
+                     leaf_kinds, dtype_pop, structured_join)
         return E.matmul(a, b)
     if choice == "elemwise":
         op = str(rng.choice(["add", "sub", "mul"]))
-        a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
-        b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
+        a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
+                     dtype_pop, structured_join)
+        b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
+                     dtype_pop, structured_join)
         return E.elemwise(op, a, b)
     if choice == "scalar":
         op = str(rng.choice(["add", "mul"]))
-        c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
+        c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
+                     dtype_pop, structured_join)
         return E.scalar_op(op, c, float(rng.uniform(-2, 2)))
     if choice == "transpose":
-        c = gen_expr(rng, env, mesh, depth - 1, (shape[1], shape[0]), leaf_kinds)
+        c = gen_expr(rng, env, mesh, depth - 1, (shape[1], shape[0]),
+                     leaf_kinds, dtype_pop, structured_join)
         return E.transpose(c)
     if choice == "agg_chain":
         # produce shape via aggregation of a larger operand when possible
         if shape[1] == 1 and shape[0] > 1:
             inner = gen_expr(rng, env, mesh, depth - 1,
                              (shape[0], int(rng.choice(dims[1:]))),
-                             leaf_kinds)
+                             leaf_kinds, dtype_pop, structured_join)
             return E.agg(inner, "sum", "row")
         if shape == (1, 1):
             inner = gen_expr(rng, env, mesh, depth - 1,
-                             (int(rng.choice(dims[1:])),) * 2, leaf_kinds)
+                             (int(rng.choice(dims[1:])),) * 2, leaf_kinds,
+                             dtype_pop, structured_join)
             return E.agg(inner, "sum", "all")
         return leaf_of(shape)
     if choice == "select":
-        c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
+        c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
+                     dtype_pop, structured_join)
         m = int(rng.integers(2, 5))
         return E.select_index(c, rows=lambda i, m=m: i % m != 0)
     if choice == "select_value":
-        c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
+        c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
+                     dtype_pop, structured_join)
         t = float(rng.uniform(-0.5, 0.5))
         return E.select_value(c, lambda v, t=t: v > t)
     if choice == "join_index":
-        a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
-        b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
+        a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
+                     dtype_pop, structured_join)
+        b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
+                     dtype_pop, structured_join)
+        if structured_join:
+            return E.join_on_index(
+                a, b, str(rng.choice(["left", "right", "add", "mul"])))
         return E.join_on_index(a, b, lambda x, y: x * y + x)
     if choice == "join_value":
         # pair matrix shaped (s0, s1) from column-vector operands; a
         # parent agg triggers the streaming lowering, otherwise the
         # capped materialisation runs — both fuzzed here
         a = gen_expr(rng, env, mesh, depth - 1, (shape[0], 1),
-                     leaf_kinds)
+                     leaf_kinds, dtype_pop, structured_join)
         b = gen_expr(rng, env, mesh, depth - 1, (shape[1], 1),
-                     leaf_kinds)
+                     leaf_kinds, dtype_pop, structured_join)
         merge = str(rng.choice(["left", "right", "add", "mul"]))
         pred = str(rng.choice(["eq", "lt", "le", "gt", "ge"]))
         return E.join_on_value(a, b, merge, pred)
@@ -201,14 +221,18 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",)):
         m_np = (m_np @ m_np.T / n + 2.0 * np.eye(n, dtype=np.float32))
         l = E.leaf(BlockMatrix.from_numpy(m_np, mesh=mesh))
         env[l.uid] = m_np
-        b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
+        b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
+                     dtype_pop, structured_join)
         if rng.random() < 0.5:
             return E.solve(l, b)
         return E.matmul(E.inverse(l), b)   # exercises the R7 fusion
     if choice == "rank1":
-        a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds)
-        u = gen_expr(rng, env, mesh, depth - 1, (shape[0], 1), leaf_kinds)
-        v = gen_expr(rng, env, mesh, depth - 1, (shape[1], 1), leaf_kinds)
+        a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
+                     dtype_pop, structured_join)
+        u = gen_expr(rng, env, mesh, depth - 1, (shape[0], 1),
+                     leaf_kinds, dtype_pop, structured_join)
+        v = gen_expr(rng, env, mesh, depth - 1, (shape[1], 1),
+                     leaf_kinds, dtype_pop, structured_join)
         return E.rank_one_update(a, u, v)
     return leaf_of(shape)
 
@@ -347,3 +371,34 @@ def test_fuzz_gram_high_precision(seed, mesh8):
                                err_msg=f"optimized (seed {seed})")
     np.testing.assert_allclose(got_raw, oracle, **tol,
                                err_msg=f"unoptimized (seed {seed})")
+
+
+def test_fuzz_infer_dtype_matches_executed_dtype(mesh8):
+    """planner.infer_dtype models the Lowerer's dtype behaviour; this
+    fuzz pins them together (round 4): for random mixed bf16/f32
+    expression trees over the SHARED gen_expr generator (all node
+    kinds), whenever infer_dtype makes a prediction it must equal the
+    dtype the compiled program actually produces — drift between the
+    planner model and the executor would silently mis-key the autotune
+    table. Callable-merge joins legitimately predict None; at least
+    half the seeds must produce a prediction so the assertion has
+    teeth."""
+    from matrel_tpu import executor as executor_lib
+    from matrel_tpu.parallel.planner import infer_dtype
+
+    cfg = MatrelConfig()
+    predicted_count = 0
+    n_seeds = 24
+    for seed in range(n_seeds):
+        rng = np.random.default_rng(4000 + seed)
+        env = {}
+        e = gen_expr(rng, env, mesh8, depth=int(rng.integers(2, 4)),
+                     dtype_pop=("float32", "bfloat16"),
+                     structured_join=True)
+        predicted = infer_dtype(e, cfg)
+        got = executor_lib.execute(e, mesh8, cfg).data.dtype
+        if predicted is not None:
+            predicted_count += 1
+            assert np.dtype(predicted) == np.dtype(got), (
+                f"seed {seed}: predicted {predicted}, executed {got}")
+    assert predicted_count >= n_seeds // 2, predicted_count
