@@ -7,6 +7,11 @@ Rows (BASELINE.json configs):
   4. block-sparse × dense, 1% blocks, 100k×100k  → wall-clock + eff. TFLOPS
   5. PageRank 1M nodes / 10M edges, 30 rounds    → wall-clock/round
   5b. PageRank 10M nodes / 100M edges (10×)      → wall-clock/round
+  x1. conjugate gradient, implicit SPD 8k system → wall-clock + iters
+  x2. power iteration, dense 8k, 50 rounds       → wall-clock
+  6. north star 65k chain A·B·C                  → TFLOPS/chip
+  (x-rows track the round-3 workload families — not BASELINE.json
+  configs, but captured in the same batch so they get on-chip numbers)
 
 Methodology notes: the axon relay acks dispatch before completion, so every
 timing forces a scalar fetch; fast ops use marginal timing over two repeat
@@ -198,6 +203,63 @@ def bench_pagerank_10x(mesh, cfg):
             "note": "expanded tables (~23.5 GB) cannot fit 16 GB HBM"}
 
 
+def bench_cg(mesh, cfg):
+    """Conjugate gradient on an implicit SPD 8k system: two MXU matmuls
+    per iteration inside one jitted while_loop (tracked extra row —
+    round-3 workload family, first on-chip number wanted round 4)."""
+    import jax.numpy as jnp
+
+    from matrel_tpu.workloads.cg import cg_runner
+    n = 8192
+    rng = np.random.default_rng(0)
+    m = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32)
+                    / np.sqrt(n))
+    b = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+
+    def matvec(p):
+        # A = M·Mᵀ/1 + I — SPD, well-conditioned, never materialised
+        return m @ (m.T @ p) + p
+
+    run = cg_runner(matvec, tol=1e-5, maxiter=100)
+
+    def go():
+        x, it = run(b)
+        float(x[0])            # forced fetch (relay acks early)
+        return int(it)
+
+    iters = go()               # compile + warm
+    dt = _timed(go, warm=0)
+    fl = 4.0 * n * n * iters   # 2 matmuls x 2nk flops per iteration
+    return {"metric": "cg_8k_spd_wallclock", "value": round(dt, 3),
+            "unit": "s", "iters": iters,
+            "effective_tflops": round(fl / dt / 1e12, 2)}
+
+
+def bench_eigen(mesh, cfg):
+    """Power iteration, 50 rounds on a dense 8k matrix in one jitted
+    fori_loop (tracked extra row — round-3 workload family)."""
+    import jax.numpy as jnp
+
+    from matrel_tpu.workloads.eigen import power_runner
+    n, rounds = 8192, 50
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32)
+                    / np.sqrt(n))
+    run = power_runner(rounds, 0)
+
+    def go():
+        lam, v = run(a)
+        return float(lam)
+
+    lam = go()                 # compile + warm
+    dt = _timed(go, warm=0)
+    fl = 2.0 * n * n * (rounds + 1)   # rounds matvecs + the final A.v
+    return {"metric": "power_iteration_8k_50rounds_wallclock",
+            "value": round(dt, 3), "unit": "s",
+            "dominant_eig": round(lam, 4),
+            "effective_tflops": round(fl / dt / 1e12, 2)}
+
+
 def bench_north_star(mesh, cfg):
     from matrel_tpu.workloads.big_chain import (
         streaming_chain_slab, cheap_gen, north_star_flops)
@@ -244,7 +306,8 @@ def main():
     set_default_config(cfg)
     mesh = mesh_lib.make_mesh()
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
-               bench_pagerank, bench_pagerank_10x, bench_north_star):
+               bench_pagerank, bench_pagerank_10x, bench_cg,
+               bench_eigen, bench_north_star):
         try:
             print(json.dumps(fn(mesh, cfg)), flush=True)
         except Exception as e:  # keep the suite running

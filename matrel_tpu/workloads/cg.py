@@ -24,15 +24,17 @@ from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.ir import expr as E
 
 
-def cg_solve_linop(matvec: Callable, b: jax.Array,
-                   tol: float = 1e-6, maxiter: int = 1000
-                   ) -> Tuple[jax.Array, jax.Array]:
-    """Solve A·x = b for SPD operator ``matvec`` (traceable). Returns
-    (x, iterations). Stops at ‖r‖ ≤ tol·‖b‖ or maxiter."""
-    b = jnp.asarray(b, jnp.float32).reshape(-1)
+def cg_runner(matvec: Callable, tol: float = 1e-6,
+              maxiter: int = 1000) -> Callable:
+    """Reusable JITTED solver ``run(b) -> (x, iterations)`` for one SPD
+    operator. ``cg_solve_linop`` builds a fresh runner per call (and so
+    re-traces); repeated solves and benchmarks should hold ONE runner
+    so the compiled program is cached across calls. ``b`` may be any
+    float array shaped (n,) or (n, 1) — coerced like cg_solve_linop."""
 
     @jax.jit
     def run(b):
+        b = jnp.asarray(b, jnp.float32).reshape(-1)
         bnorm = jnp.maximum(jnp.linalg.norm(b), 1e-30)
 
         def cond(state):
@@ -54,7 +56,16 @@ def cg_solve_linop(matvec: Callable, b: jax.Array,
         x, _, _, _, it = jax.lax.while_loop(cond, body, state)
         return x, it
 
-    return run(b)
+    return run
+
+
+def cg_solve_linop(matvec: Callable, b: jax.Array,
+                   tol: float = 1e-6, maxiter: int = 1000
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Solve A·x = b for SPD operator ``matvec`` (traceable). Returns
+    (x, iterations). Stops at ‖r‖ ≤ tol·‖b‖ or maxiter."""
+    b = jnp.asarray(b, jnp.float32).reshape(-1)
+    return cg_runner(matvec, tol, maxiter)(b)
 
 
 def cg_solve(A: Union[BlockMatrix, E.MatExpr], b,

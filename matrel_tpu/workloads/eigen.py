@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Tuple, Union
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -35,6 +37,15 @@ def power_iteration(A: Union[BlockMatrix, E.MatExpr],
         raise ValueError(f"power iteration needs a square matrix, got "
                          f"{e.shape}")
     data = _dense_data(A, e)
+    lam, v = power_runner(rounds, seed)(data)
+    return float(lam), v[:n]
+
+
+@functools.lru_cache(maxsize=16)
+def power_runner(rounds: int = 50, seed: int = 0):
+    """Reusable jitted power-iteration ``run(mat) -> (lam, v)`` —
+    memoised per (rounds, seed) so repeated calls (benchmark reps,
+    sweeps over same-shaped matrices) reuse the compiled program."""
 
     @jax.jit
     def run(mat):
@@ -50,8 +61,7 @@ def power_iteration(A: Union[BlockMatrix, E.MatExpr],
         lam = v @ (mat @ v)
         return lam, v
 
-    lam, v = run(data)
-    return float(lam), v[:n]
+    return run
 
 
 def spectral_norm(A: Union[BlockMatrix, E.MatExpr],
