@@ -488,6 +488,8 @@ def pretty(e: MatExpr, indent: int = 0) -> str:
         extra = f" {e.attrs['agg']}/{e.attrs['axis']}"
     elif e.kind == "matmul" and "strategy" in e.attrs:
         extra = f" strategy={e.attrs['strategy']}"
+        if "strategy_source" in e.attrs:
+            extra += f"[{e.attrs['strategy_source']}]"
     elif e.kind in ("join_rows", "join_cols") and "replicate" in e.attrs:
         extra = f" replicate={e.attrs['replicate']}"
     elif e.kind == "join_value":

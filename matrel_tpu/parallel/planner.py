@@ -214,15 +214,27 @@ def choose_strategy(node: MatExpr, mesh: Mesh,
                     config: Optional[MatrelConfig] = None,
                     dtype_memo: Optional[dict] = None) -> str:
     """Pick the cheapest admissible strategy for one matmul node."""
+    return choose_strategy_ex(node, mesh, config, dtype_memo)[0]
+
+
+def choose_strategy_ex(node: MatExpr, mesh: Mesh,
+                       config: Optional[MatrelConfig] = None,
+                       dtype_memo: Optional[dict] = None
+                       ) -> Tuple[str, str]:
+    """(strategy, source) for one matmul node. ``source`` records WHY —
+    the observability side of the closed loop (physical EXPLAIN prints
+    it): "override" (config.strategy_override), "measured" (autotune
+    table hit), "model" (byte-model argmin), "default" (single device /
+    no admissible candidates)."""
     cfg = config or default_config()
     if cfg.strategy_override != "auto":
-        return cfg.strategy_override
+        return cfg.strategy_override, "override"
     a, b = node.children
     n, k = a.shape
     _, m = b.shape
     gx, gy = mesh_lib.mesh_grid_shape(mesh)
     if gx * gy == 1:
-        return "xla"  # single device: plain local dot
+        return "xla", "default"  # single device: plain local dot
     from matrel_tpu.core import padding
     pn, pk = padding.padded_shape((n, k), mesh)
     _, pm = padding.padded_shape((k, m), mesh)
@@ -246,7 +258,7 @@ def choose_strategy(node: MatExpr, mesh: Mesh,
             best = autotune.lookup_or_measure(n, k, m, mesh, str(dta),
                                               cfg)
             if best is not None and admissible(best, pn, pk, pm, gx, gy):
-                return best
+                return best, "measured"
     da, db = a.density, b.density
     la, lb = _layout_of(a, mesh), _layout_of(b, mesh)
     cands = {}
@@ -272,8 +284,8 @@ def choose_strategy(node: MatExpr, mesh: Mesh,
     cands = {s: c for s, c in cands.items()
              if admissible(s, pn, pk, pm, gx, gy)}
     if not cands:
-        return "xla"
-    return min(cands, key=cands.get)
+        return "xla", "default"
+    return min(cands, key=cands.get), "model"
 
 
 def _reshard_to_axis(bytes_: float, layout: str, axis: str,
@@ -353,8 +365,9 @@ def annotate_strategies(e: MatExpr, mesh: Mesh,
     if any(nc is not oc for nc, oc in zip(new_children, e.children)):
         e = e.with_children(new_children)
     if e.kind == "matmul" and "strategy" not in e.attrs:
-        e = e.with_attrs(strategy=choose_strategy(e, mesh, config,
-                                                  dtype_memo=memo))
+        strat, source = choose_strategy_ex(e, mesh, config,
+                                           dtype_memo=memo)
+        e = e.with_attrs(strategy=strat, strategy_source=source)
     if e.kind in ("join_rows", "join_cols") and "replicate" not in e.attrs:
         e = e.with_attrs(replicate=choose_join_scheme(e, mesh, config))
     infer_dtype(e, config, memo)     # seed this (possibly new-uid) node

@@ -253,6 +253,38 @@ class TestAutotuneLoop:
         # the healthy measurement replaced the empty entry on disk
         assert autotune.load_table(path)["64|2x4|float32"]["times"]
 
+    def test_strategy_source_annotation(self, mesh8, tmp_path):
+        # round-4 observability: EXPLAIN records WHY a strategy was
+        # chosen — override / measured / model / default
+        import json
+
+        import numpy as np
+        from matrel_tpu.config import MatrelConfig
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.ir.expr import pretty
+        from matrel_tpu.parallel import autotune, planner
+        rng = np.random.default_rng(21)
+        A = BlockMatrix.from_numpy(
+            rng.standard_normal((64, 64)).astype(np.float32), mesh=mesh8)
+        e = A.expr().multiply(A.expr())
+        assert planner.choose_strategy_ex(e, mesh8,
+                                          MatrelConfig())[1] == "model"
+        assert planner.choose_strategy_ex(
+            e, mesh8, MatrelConfig(strategy_override="rmm")) == (
+                "rmm", "override")
+        path = str(tmp_path / "tuned.json")
+        with open(path, "w") as f:
+            json.dump({"64|2x4|float32":
+                       {"best": "cpmm", "times": {"cpmm": 1e-6}}}, f)
+        autotune._CACHE.clear()
+        cfg = MatrelConfig(autotune=True, autotune_table_path=path)
+        assert planner.choose_strategy_ex(e, mesh8, cfg) == ("cpmm",
+                                                             "measured")
+        ann = planner.annotate_strategies(e, mesh8, cfg)
+        assert ann.attrs["strategy_source"] == "measured"
+        assert "strategy=cpmm[measured]" in pretty(ann)
+        autotune._CACHE.clear()
+
     def test_spmv_choice_measured_and_persisted(self, mesh8, tmp_path,
                                                 monkeypatch):
         # VERDICT r3 #8: the SpMV executor choice (compact Pallas vs
