@@ -59,6 +59,13 @@ def _gen(rng, env, depth):
         return (f"({a_s}) / (({b_s}) % ({b_s}) + 10)",
                 a_v / (b_v * b_v + 10))
     if op == "joinidx":
+        # round-4 grammar: structured merge keywords alongside
+        # expression strings
+        if rng.random() < 0.5:
+            kw = str(rng.choice(["left", "right", "add", "mul"]))
+            oracle = {"left": lambda x, y: x, "right": lambda x, y: y,
+                      "add": np.add, "mul": np.multiply}[kw]
+            return f"joinindex({a_s}, {b_s}, '{kw}')", oracle(a_v, b_v)
         return (f"joinindex({a_s}, {b_s}, 'x * y + x')",
                 a_v * b_v + a_v)
     raise AssertionError(op)
