@@ -9,6 +9,7 @@ Rows (BASELINE.json configs):
   5b. PageRank 10M nodes / 100M edges (10×)      → wall-clock/round
   x1. conjugate gradient, implicit SPD 8k system → wall-clock + iters
   x2. power iteration, dense 8k, 50 rounds       → wall-clock
+  x3. triangle count, dense 8k adjacency         → wall-clock + count
   6. north star 65k chain A·B·C                  → TFLOPS/chip
   (x-rows track the round-3 workload families — not BASELINE.json
   configs, but captured in the same batch so they get on-chip numbers)
@@ -260,6 +261,39 @@ def bench_eigen(mesh, cfg):
             "effective_tflops": round(fl / dt / 1e12, 2)}
 
 
+def bench_triangles(mesh, cfg):
+    """Triangle counting on a dense 8k 0/1 adjacency through the FULL
+    query stack: trace(A·A·A) — chain DP ties, R3 pushes the diagonal
+    aggregate into the final multiply, so the compiled plan does one
+    full matmul plus a diagonal-only contraction (tracked extra row)."""
+    import jax
+    import jax.numpy as jnp
+
+    from matrel_tpu import executor as executor_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.workloads.triangles import triangle_count_expr
+    n = 8192
+    rng = np.random.default_rng(2)
+    a = (rng.random((n, n)) < 0.01).astype(np.float32)
+    a = np.triu(a, 1)
+    a = a + a.T
+    A = BlockMatrix.from_numpy(a, mesh=mesh)
+    plan = executor_lib.compile_expr(triangle_count_expr(A), mesh, cfg)
+    fetch = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+
+    def go():
+        out = plan.run()
+        return float(np.asarray(fetch(out.data)))
+
+    tri6 = go()                # compile + warm
+    dt = _timed(go, warm=0)
+    fl = 2.0 * n * n * n + 2.0 * n * n   # post-R3: one matmul + diag
+    return {"metric": "triangles_8k_dense_wallclock",
+            "value": round(dt, 3), "unit": "s",
+            "triangles": int(round(tri6 / 6.0)),
+            "effective_tflops": round(fl / dt / 1e12, 2)}
+
+
 def bench_north_star(mesh, cfg):
     from matrel_tpu.workloads.big_chain import (
         streaming_chain_slab, cheap_gen, north_star_flops)
@@ -307,7 +341,7 @@ def main():
     mesh = mesh_lib.make_mesh()
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
                bench_pagerank, bench_pagerank_10x, bench_cg,
-               bench_eigen, bench_north_star):
+               bench_eigen, bench_triangles, bench_north_star):
         try:
             print(json.dumps(fn(mesh, cfg)), flush=True)
         except Exception as e:  # keep the suite running
