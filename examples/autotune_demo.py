@@ -1,0 +1,79 @@
+"""The closed autotune loop: measured strategy choice + provenance.
+
+The reference's MatfastPlanner picks BMM/CPMM/RMM from a cost ESTIMATE
+(SURVEY.md §3.2). On the XLA substrate, measuring is cheap — so with
+``MatrelConfig(autotune=True)`` the planner times every admissible
+strategy once per recurring shape class on-device (median-of-3 marginal
+timing; ties are recorded as ties so noise never becomes a winner),
+persists the table as JSON, and lets the measured winner override the
+byte model. EXPLAIN then shows WHY each multiply got its strategy:
+``strategy=cpmm[measured|model|override|default]``.
+
+This demo runs the loop on the CPU mesh: first compile measures and
+persists; a second session (fresh process-cache) inherits the table.
+
+Run: JAX_PLATFORMS=cpu python examples/autotune_demo.py
+"""
+
+import os
+import sys
+import tempfile
+
+# strategy choice is a MULTI-device concern: on one device the planner
+# short-circuits to the local dot before the autotune path ever runs —
+# simulate an 8-device mesh (no-op if the caller already set XLA_FLAGS)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from matrel_tpu import MatrelConfig, MatrelSession
+
+
+def main():
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        table_path = os.path.join(d, "autotune_table.json")
+        cfg = MatrelConfig(autotune=True, autotune_table_path=table_path)
+        sess = MatrelSession(config=cfg)
+        a = sess.from_numpy(rng.standard_normal((256, 256))
+                            .astype(np.float32))
+        b = sess.from_numpy(rng.standard_normal((256, 256))
+                            .astype(np.float32))
+        e = a.expr().multiply(b.expr())
+
+        # first compile: the loop measures every admissible strategy for
+        # this shape class and persists the result
+        txt1 = sess.explain(e)
+        print("first session: ", next(
+            ln for ln in txt1.splitlines() if "strategy=" in ln).strip())
+
+        from matrel_tpu.parallel import autotune
+        table = autotune.load_table(table_path)
+        for key, entry in table.items():
+            times = {s: f"{t * 1e3:.3f} ms"
+                     for s, t in sorted(entry["times"].items(),
+                                        key=lambda kv: kv[1])}
+            print(f"measured {key}: best={entry['best']} {times}")
+
+        # a fresh session (cleared process cache = a new process)
+        # inherits the persisted measurement — no re-measure
+        autotune._CACHE.clear()
+        sess2 = MatrelSession(config=cfg)
+        a2 = sess2.from_numpy(rng.standard_normal((256, 256))
+                              .astype(np.float32))
+        b2 = sess2.from_numpy(rng.standard_normal((256, 256))
+                              .astype(np.float32))
+        txt = sess2.explain(a2.expr().multiply(b2.expr()))
+        line = next(ln for ln in txt.splitlines() if "strategy=" in ln)
+        print("second session:", line.strip())
+        # provenance is either [measured] (a strategy won by >10%) or
+        # [model] (the measurements tied — the byte model decides)
+        assert "[measured]" in line or "[model]" in line
+
+
+if __name__ == "__main__":
+    main()
