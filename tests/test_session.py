@@ -476,3 +476,60 @@ def test_save_catalog_rejects_path_escaping_names(mesh8, rng, tmp_path):
         sess.catalog = {bad: m}
         with pytest.raises(ValueError):
             sess.save_catalog(str(tmp_path))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_plan_cache_never_aliases_predicates(seed, mesh8):
+    """Cache-aliasing fuzz (round 4): across every predicate
+    construction form the keying supports — closures, globals (scalar
+    and container, including in-place mutation), bound methods, kw-only
+    factory defaults — repeated queries must always match the numpy
+    oracle. Repeating an identical threshold is allowed to HIT the
+    cache; a differing one must MISS. The silent-stale-result class
+    (ADVICE r2 high, r3 medium) is exactly what this net catches."""
+    prng = np.random.default_rng(7000 + seed)
+    sess = MatrelSession(mesh=mesh8)
+    a = prng.standard_normal((8, 8)).astype(np.float32)
+    m = sess.from_numpy(a)
+    g = {"thr": 0.0, "thrs": [0.0]}
+
+    class Thresh:
+        def __init__(self, t):
+            self.t = t
+
+        def pred(self, v):
+            return v > self.t
+
+    def factory(t):
+        def pred(v, *, thr=t):
+            return v > thr
+        return pred
+
+    # small pool so thresholds REPEAT across forms and iterations —
+    # exercising both cache hits and misses
+    pool = [-0.5, 0.0, 0.25, 0.8]
+    for _ in range(12):
+        t = float(prng.choice(pool))
+        form = str(prng.choice(["closure", "global", "global_list",
+                                "bound", "kwdefault"]))
+        if form == "closure":
+            pred = lambda v, t=t: v > t          # noqa: E731
+        elif form == "global":
+            g["thr"] = t
+            pred = eval("lambda v: v > thr", g)  # noqa: S307
+        elif form == "global_list":
+            g["thrs"][0] = t                     # in-place mutation
+            pred = eval("lambda v: v > thrs[0]", g)  # noqa: S307
+        elif form == "bound":
+            pred = Thresh(t).pred
+        else:
+            pred = factory(t)
+        got = sess.compute(m.expr().select_value(pred)).to_numpy()
+        np.testing.assert_allclose(
+            got, np.where(a > t, a, 0), rtol=1e-5,
+            err_msg=f"form={form} t={t}")
+    # the fuzz must actually exercise cache HITS: with 12 queries over
+    # <=20 (form, threshold) combinations and per-query-text keys,
+    # always-miss keying (the conservative inverse regression) would
+    # show up as 12 distinct plans
+    assert sess.plan_cache_info()["plans"] < 12
