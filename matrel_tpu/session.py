@@ -312,6 +312,12 @@ def _code_names(code) -> set:
     return names
 
 
+#: Containers above this many elements key by identity+length instead
+#: of by value: re-walking a huge module-level list on EVERY plan-cache
+#: lookup would turn an O(1) query into O(container) (advisor r4).
+_VALUE_KEY_MAX_ELEMS = 256
+
+
 def _attr_token(v, pins: list, seen: frozenset = frozenset()) -> str:
     """Encode ANY attr value into the plan key — nothing is dropped.
     Containers (tuple/list/dict/set) key by VALUE, so in-place mutation
@@ -320,14 +326,21 @@ def _attr_token(v, pins: list, seen: frozenset = frozenset()) -> str:
     container reached again inside its own walk keys the back-edge by
     pinned id. Unknown object types key by identity (and are pinned):
     conservative (may miss the cache) but never shares a plan between
-    distinct semantics. Caveat: in-place mutation of an id-keyed OBJECT
+    distinct semantics. Caveats: in-place mutation of an id-keyed OBJECT
     (not a container) between queries is unsupported for cached
-    predicates — rebind a fresh object instead."""
+    predicates — rebind a fresh object instead; containers above
+    ``_VALUE_KEY_MAX_ELEMS`` elements key by pinned identity + length
+    (the value-walk would cost O(container) per lookup), so in-place
+    mutation of an OVERSIZED container that keeps its length also
+    requires rebinding — growth/shrinkage still re-keys via the length."""
     if v is None or isinstance(v, (bool, int, float, str)):
         return repr(v)
     if callable(v):
         return _fn_token(v, pins, seen)
     if isinstance(v, (tuple, list, dict, set, frozenset)):
+        if len(v) > _VALUE_KEY_MAX_ELEMS:
+            pins.append(v)
+            return f"bigcont:{type(v).__name__}:{id(v)}:len{len(v)}"
         if id(v) in seen:
             pins.append(v)
             return f"cyc:{id(v)}"

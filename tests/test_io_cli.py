@@ -123,7 +123,7 @@ class TestAutotuneLoop:
         base = self._choose(mesh8, MatrelConfig())
         # plant a measured table naming a DIFFERENT admissible strategy
         forced = "rmm" if base != "rmm" else "cpmm"
-        json.dump({"64|2x4|float32": {"best": forced,
+        json.dump({autotune._table_key(64, 2, 4, "float32"): {"best": forced,
                                       "times": {forced: 1e-6}}},
                   open(path, "w"))
         autotune._CACHE.clear()
@@ -147,7 +147,7 @@ class TestAutotuneLoop:
                                           "float32", cfg)
         assert best == "cpmm"
         table = autotune.load_table(path)
-        assert table["64|2x4|float32"]["best"] == best
+        assert table[autotune._table_key(64, 2, 4, "float32")]["best"] == best
         # a fresh process (cache cleared) reads the file, no re-measure
         autotune._CACHE.clear()
         monkeypatch.setattr(autotune, "measure_strategy",
@@ -179,7 +179,7 @@ class TestAutotuneLoop:
         base = planner.choose_strategy(outer, mesh8, MatrelConfig())
         forced = "rmm" if base != "rmm" else "cpmm"
         path = str(tmp_path / "tuned.json")
-        json.dump({"64|2x4|float32": {"best": forced,
+        json.dump({autotune._table_key(64, 2, 4, "float32"): {"best": forced,
                                       "times": {forced: 1e-6}}},
                   open(path, "w"))
         cfg = MatrelConfig(autotune=True, autotune_table_path=path)
@@ -236,7 +236,7 @@ class TestAutotuneLoop:
         from matrel_tpu.config import MatrelConfig
         from matrel_tpu.parallel import autotune
         path = str(tmp_path / "tuned.json")
-        json.dump({"64|2x4|float32": {"best": None, "times": {}}},
+        json.dump({autotune._table_key(64, 2, 4, "float32"): {"best": None, "times": {}}},
                   open(path, "w"))
         cfg = MatrelConfig(autotune=True, autotune_table_path=path)
         autotune._CACHE.clear()
@@ -251,7 +251,7 @@ class TestAutotuneLoop:
             64, 64, 64, mesh8, "float32", cfg) == "cpmm"
         assert called
         # the healthy measurement replaced the empty entry on disk
-        assert autotune.load_table(path)["64|2x4|float32"]["times"]
+        assert autotune.load_table(path)[autotune._table_key(64, 2, 4, "float32")]["times"]
 
     def test_strategy_source_annotation(self, mesh8, tmp_path):
         # round-4 observability: EXPLAIN records WHY a strategy was
@@ -274,7 +274,7 @@ class TestAutotuneLoop:
                 "rmm", "override")
         path = str(tmp_path / "tuned.json")
         with open(path, "w") as f:
-            json.dump({"64|2x4|float32":
+            json.dump({autotune._table_key(64, 2, 4, "float32"):
                        {"best": "cpmm", "times": {"cpmm": 1e-6}}}, f)
         autotune._CACHE.clear()
         cfg = MatrelConfig(autotune=True, autotune_table_path=path)
@@ -421,7 +421,7 @@ class TestAutotuneLoop:
         best, times = autotune.autotune_matmul(64, 64, 64, mesh=mesh8,
                                                config=cfg)
         assert best is None and times == {}
-        assert "64|2x4|float32" not in autotune.load_table(path)
+        assert autotune._table_key(64, 2, 4, "float32") not in autotune.load_table(path)
 
     def test_persisted_tie_not_remeasured(self, mesh8, tmp_path,
                                           monkeypatch):
@@ -431,7 +431,7 @@ class TestAutotuneLoop:
         from matrel_tpu.config import MatrelConfig
         from matrel_tpu.parallel import autotune
         path = str(tmp_path / "tuned.json")
-        json.dump({"64|2x4|float32":
+        json.dump({autotune._table_key(64, 2, 4, "float32"):
                    {"best": None, "times": {"rmm": 1.0, "cpmm": 1.01}}},
                   open(path, "w"))
         cfg = MatrelConfig(autotune=True, autotune_table_path=path)
@@ -481,7 +481,7 @@ class TestAutotuneLoop:
         path = str(tmp_path / "tuned.json")
         # summa needs a square grid: inadmissible on the 2x4 mesh, so
         # the planner must ignore the planted winner and use the model
-        json.dump({"64|2x4|float32": {"best": "summa", "times": {}}},
+        json.dump({autotune._table_key(64, 2, 4, "float32"): {"best": "summa", "times": {}}},
                   open(path, "w"))
         cfg = MatrelConfig(autotune=True, autotune_table_path=path)
         autotune._CACHE.clear()
@@ -577,4 +577,31 @@ def test_cached_measurement_persists_when_loop_enabled_later(mesh8,
     cfg = MatrelConfig(autotune=True, autotune_table_path=path)
     got = autotune.lookup_or_measure(64, 64, 64, mesh8, "float32", cfg)
     assert got == best
-    assert autotune.load_table(path)["64|2x4|float32"]["best"] == best
+    assert autotune.load_table(path)[autotune._table_key(64, 2, 4, "float32")]["best"] == best
+
+
+class TestAutotuneOneVariantGate:
+    def test_lone_survivor_not_a_winner(self, mesh8, monkeypatch,
+                                        tmp_path):
+        # advisor r4: when every strategy but one fails to compile or
+        # measures as noise, the lone survivor must be recorded
+        # best=None (times persisted for observability), mirroring the
+        # SpMV loop's len(results) >= 2 gate
+        from matrel_tpu.config import MatrelConfig
+        from matrel_tpu.parallel import autotune
+
+        def fake(s, A, B, cfg, **kw):
+            if s != "xla":
+                raise RuntimeError("compile failed")
+            return 1.0
+        monkeypatch.setattr(autotune, "measure_strategy", fake)
+        path = str(tmp_path / "tuned.json")
+        cfg = MatrelConfig(autotune=True, autotune_table_path=path)
+        autotune._CACHE.clear()
+        best, results = autotune.autotune_matmul(32, 32, 32, mesh=mesh8,
+                                                 config=cfg)
+        assert best is None
+        assert list(results) == ["xla"]
+        entry = autotune.load_table(path)[
+            autotune._table_key(32, 2, 4, "float32")]
+        assert entry["best"] is None and entry["times"]

@@ -533,3 +533,46 @@ def test_fuzz_plan_cache_never_aliases_predicates(seed, mesh8):
     # always-miss keying (the conservative inverse regression) would
     # show up as 12 distinct plans
     assert sess.plan_cache_info()["plans"] < 12
+
+
+class TestOversizedContainerCap:
+    """advisor r4 low: containers above _VALUE_KEY_MAX_ELEMS key by
+    pinned identity + length instead of by value, so a predicate
+    referencing a big module-level list doesn't re-walk it on every
+    plan-cache lookup. Growth/shrink still re-keys (length is in the
+    token); same-length in-place mutation requires rebinding (documented
+    caveat, same as id-keyed objects)."""
+
+    def test_token_forms(self):
+        from matrel_tpu import session as S
+        big = list(range(S._VALUE_KEY_MAX_ELEMS + 1))
+        pins = []
+        t = S._attr_token(big, pins)
+        assert t.startswith("bigcont:list:") and t.endswith(
+            f"len{len(big)}")
+        assert any(p is big for p in pins)
+        # growth re-keys even at the same id
+        big.append(-1)
+        assert S._attr_token(big, []) != t
+        # small containers still key by value (no pin, no id)
+        small = [1, 2, 3]
+        pins2 = []
+        assert S._attr_token(small, pins2) == S._attr_token(
+            [1, 2, 3], [])
+        assert not pins2
+
+    def test_distinct_oversized_globals_never_collide(self, mesh8, rng):
+        sess = MatrelSession(mesh=mesh8)
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        m = sess.from_numpy(a)
+        from matrel_tpu import session as S
+        n = S._VALUE_KEY_MAX_ELEMS + 10
+        g1 = {"thrs": [0.5] * n}
+        g2 = {"thrs": [-0.5] * n}   # same length, different values/id
+        f1 = eval("lambda v: v > thrs[0]", g1)      # noqa: S307
+        f2 = eval("lambda v: v > thrs[0]", g2)      # noqa: S307
+        r1 = sess.compute(m.expr().select_value(f1)).to_numpy()
+        r2 = sess.compute(m.expr().select_value(f2)).to_numpy()
+        np.testing.assert_allclose(r1, np.where(a > 0.5, a, 0), rtol=1e-5)
+        np.testing.assert_allclose(r2, np.where(a > -0.5, a, 0),
+                                   rtol=1e-5)
