@@ -872,7 +872,8 @@ class CompiledPlan:
         """Logical/physical plan summary incl. strategies and collectives."""
         from matrel_tpu.ir.expr import pretty
         lines = ["== Optimized plan ==",
-                 pretty(self.optimized, mesh=self.mesh)]
+                 pretty(self.optimized, mesh=self.mesh,
+                        config=self.config)]
         try:
             lines += ["== Collectives ==", str(self.collectives())]
         except Exception:  # HLO dump can fail on exotic backends
@@ -945,10 +946,9 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
                      extra_args=extra)
 
 
-# Narrow-operand threshold for the COO SpMV dispatch — the SINGLE
-# source of truth shared by _coo_dispatch_plan (below) and the
-# planner's layout inference (planner._coo_narrow_matmul reads it to
-# know which matmuls emit replicated SpMV results) so they can't drift.
+# Narrow-operand threshold for the COO SpMV dispatch. The planner's
+# layout inference calls _coo_dispatch_plan itself (not this constant)
+# so the plan-refusal fallback is honoured too.
 COO_NARROW_MAX = 128
 
 

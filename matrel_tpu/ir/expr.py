@@ -478,11 +478,13 @@ def leaves(e: MatExpr) -> List[MatExpr]:
 
 
 def pretty(e: MatExpr, indent: int = 0, mesh=None,
-           _lmemo: Optional[dict] = None) -> str:
+           _lmemo: Optional[dict] = None, config=None) -> str:
     """Plan printer. With ``mesh`` given, each non-canonically-laid node
     is annotated ``layout=row/col/rep`` from planner.infer_layout — the
     physical-EXPLAIN view of the co-partitioning credit (round 5), next
-    to the strategy provenance it drives."""
+    to the strategy provenance it drives. Pass the PLAN's config so the
+    printed layouts are the ones the planner actually used (the COO
+    "rep" claim is config-dependent — review r5)."""
     pad = "  " * indent
     extra = ""
     if e.kind == "elemwise":
@@ -506,9 +508,9 @@ def pretty(e: MatExpr, indent: int = 0, mesh=None,
         from matrel_tpu.parallel import planner as _pl   # lazy: no cycle
         if _lmemo is None:
             _lmemo = {}
-        lay = _pl.infer_layout(e, mesh, _lmemo)
+        lay = _pl.infer_layout(e, mesh, _lmemo, config)
         if lay != "2d":
             extra += f" layout={lay}"
     line = f"{pad}{e.kind}{extra} shape={e.shape} nnz={e.nnz}\n"
-    return line + "".join(pretty(c, indent + 1, mesh, _lmemo)
+    return line + "".join(pretty(c, indent + 1, mesh, _lmemo, config)
                           for c in e.children)

@@ -253,14 +253,17 @@ def infer_layout(node: MatExpr, mesh: Mesh,
 
 def _coo_narrow_matmul(n: MatExpr) -> bool:
     """Will this matmul dispatch the narrow COO SpMV path (whose sharded
-    compact executor emits REPLICATED results, out_specs=P())? Mirrors
-    executor._coo_dispatch_plan's threshold via the shared constant —
-    lazily imported to keep the executor→planner import direction."""
+    compact executor emits REPLICATED results, out_specs=P())? Consults
+    executor._coo_dispatch_plan itself — the single source of truth —
+    so the plan-REFUSAL fallback (build_spmv_plan returning None on
+    pathological padding, which densifies onto the 2d XLA path) is
+    honoured too, not just the width threshold (review r5). The plan it
+    builds is memoised on the matrix and needed at lowering anyway.
+    Lazily imported to keep the executor→planner import direction."""
     l, r = n.children
     if l.kind == "coo_leaf" or r.kind == "coo_leaf":
         from matrel_tpu import executor as _exec
-        k = r.shape[1] if l.kind == "coo_leaf" else l.shape[0]
-        return 0 < k <= _exec.COO_NARROW_MAX
+        return _exec._coo_dispatch_plan(n) is not None
     return False
 
 
@@ -403,11 +406,34 @@ def choose_strategy(node: MatExpr, mesh: Mesh,
                               layout_memo)[0]
 
 
+def _root_reshard_cost(strategy: str, n: int, m: int,
+                       gx: int, gy: int,
+                       transposed: bool = False) -> float:
+    """Per-device ICI bytes to re-lay a strategy's OUTPUT to the
+    canonical sharding. The executor constrains every ROOT output to
+    canonical_sharding (lower_multi), so a root-level bmm really pays
+    this row/col→2d move after computing; interior consumers instead
+    see the producer's layout through their own per-layout credit and
+    must NOT be charged here (round 5). ``transposed`` marks an ODD
+    number of transposes between this matmul and the root: the
+    transpose swaps row↔col, so the re-lay gathers along the OTHER
+    perpendicular axis (review r5 — matters on non-square grids).
+    Same closed forms as comm_cost's reshard terms."""
+    p = gx * gy
+    c_bytes = _bytes((n, m), 1.0)
+    out_row = (strategy == "bmm_right") != transposed
+    if strategy == "bmm_right" or strategy == "bmm_left":
+        g_perp = gy if out_row else gx
+        return (c_bytes / p) * (1 - 1 / g_perp)
+    return 0.0                         # cpmm/rmm/summa/xla emit 2d
+
+
 def choose_strategy_ex(node: MatExpr, mesh: Mesh,
                        config: Optional[MatrelConfig] = None,
                        dtype_memo: Optional[dict] = None,
-                       layout_memo: Optional[dict] = None
-                       ) -> Tuple[str, str]:
+                       layout_memo: Optional[dict] = None,
+                       root_output: bool = False,
+                       root_transposed: bool = False) -> Tuple[str, str]:
     """(strategy, source) for one matmul node. ``source`` records WHY —
     the observability side of the closed loop (physical EXPLAIN prints
     it): "override" (config.strategy_override), "measured" (autotune
@@ -479,6 +505,12 @@ def choose_strategy_ex(node: MatExpr, mesh: Mesh,
                                    a_layout=la, b_layout=lb)
     cands = {s: c for s, c in cands.items()
              if admissible(s, pn, pk, pm, gx, gy)}
+    if root_output:
+        # the executor re-lays ROOT outputs to the canonical sharding;
+        # a bmm's 1D-sharded result pays that move, 2d emitters do not
+        cands = {s: c + _root_reshard_cost(s, n, m, gx, gy,
+                                           root_transposed)
+                 for s, c in cands.items()}
     if not cands:
         return "xla", "default"
     return min(cands, key=cands.get), "model"
@@ -596,6 +628,23 @@ def choose_join_scheme(node: MatExpr, mesh: Mesh,
     return best
 
 
+def _child_rootness(e: MatExpr, i: int, is_root: bool) -> bool:
+    """Does child ``i``'s output layout flow unchanged to the plan
+    ROOT (where the executor's canonical-sharding constraint re-lays
+    it)? True through entrywise/layout-preserving wrappers — a scalar
+    op over a bmm output still pays the row→canonical move at the root
+    — false under a matmul/join/agg, whose own cost model sees the
+    child's layout instead (review r5)."""
+    if not is_root:
+        return False
+    if e.kind in ("scalar", "select_value", "select_index",
+                  "select_block", "transpose", "elemwise", "join_index"):
+        return True
+    if e.kind == "rank1":
+        return i == 0
+    return False
+
+
 def _child_layout_hints(e: MatExpr) -> Tuple[Optional[str], ...]:
     """Layout each child's output would be consumed in-place at by this
     node, for the join-scheme tiebreak: a matmul reads its left operand
@@ -611,25 +660,33 @@ def annotate_strategies(e: MatExpr, mesh: Mesh,
                         config: Optional[MatrelConfig] = None,
                         _dtype_memo: Optional[dict] = None,
                         _layout_memo: Optional[dict] = None,
-                        _consumer_hint: Optional[str] = None) -> MatExpr:
+                        _consumer_hint: Optional[str] = None,
+                        _is_root: bool = True,
+                        _root_swap: bool = False) -> MatExpr:
     """Bottom-up pass stamping attrs['strategy'] on every matmul node
     and attrs['replicate'] on every row/col index join. One dtype memo
     and one layout memo are threaded through the whole pass and seeded
     as each rewritten node is produced, so every choose_strategy
     dtype/layout lookup is O(1). ``_consumer_hint`` carries the parent's
-    in-place-consumable layout down to join-scheme ties."""
+    in-place-consumable layout down to join-scheme ties; the ROOT
+    matmul is additionally charged the canonical-output reshard its
+    lowering really pays (_root_reshard_cost)."""
     memo = {} if _dtype_memo is None else _dtype_memo
     lmemo = {} if _layout_memo is None else _layout_memo
     hints = _child_layout_hints(e)
+    swap = _root_swap != (e.kind == "transpose")   # odd transposes flip
     new_children = tuple(
-        annotate_strategies(c, mesh, config, memo, lmemo, h)
-        for c, h in zip(e.children, hints))
+        annotate_strategies(c, mesh, config, memo, lmemo, h,
+                            _child_rootness(e, i, _is_root), swap)
+        for i, (c, h) in enumerate(zip(e.children, hints)))
     if any(nc is not oc for nc, oc in zip(new_children, e.children)):
         e = e.with_children(new_children)
     if e.kind == "matmul" and "strategy" not in e.attrs:
         strat, source = choose_strategy_ex(e, mesh, config,
                                            dtype_memo=memo,
-                                           layout_memo=lmemo)
+                                           layout_memo=lmemo,
+                                           root_output=_is_root,
+                                           root_transposed=_root_swap)
         e = e.with_attrs(strategy=strat, strategy_source=source)
     if e.kind in ("join_rows", "join_cols") and "replicate" not in e.attrs:
         e = e.with_attrs(replicate=choose_join_scheme(

@@ -296,9 +296,12 @@ class TestInteriorLayoutCredit:
         # no planted strategies: A row-sharded makes the inner multiply
         # bmm_right naturally, and its row-sharded OUTPUT then flips the
         # outer multiply to bmm_right too — the credit firing on an
-        # interior node through annotate_strategies
+        # interior node through annotate_strategies. N2 puts k/n in the
+        # band where bmm survives the ROOT canonical-output reshard
+        # charge too (1/4 < k/n < 3/8 on the (2,4) grid)
         from jax.sharding import PartitionSpec as P
-        a = _fab(mesh8, self.N, self.K, spec=P(("x", "y"), None))
+        N2 = 1600
+        a = _fab(mesh8, N2, self.K, spec=P(("x", "y"), None))
         chain = matmul(matmul(a, _fab(mesh8, self.K, self.K)),
                        _fab(mesh8, self.K, self.M))
         ann = planner.annotate_strategies(chain, mesh8)
@@ -496,3 +499,87 @@ class TestLayoutOtherAndCooRep:
         # dispatch onto the GSPMD-decided XLA path -> no claim either
         cfg_at = MatrelConfig(pallas_interpret=True, autotune=True)
         assert planner.infer_layout(e, mesh8, config=cfg_at) == "2d"
+
+
+class TestRootOutputReshardTerm:
+    """Round 5: the executor re-lays ROOT outputs to the canonical
+    sharding (Lowerer.lower_multi), so a root-level bmm pays a
+    row/col->2d move the interior never does. The model charges it for
+    the root only."""
+
+    def test_root_pick_flips_away_from_bmm(self, mesh8):
+        # k/n = 0.32 on the (2,4) grid: bmm_right wins as an interior
+        # (7b/8 + 3a/32 beats rmm/cpmm) but the extra 3c/32 root charge
+        # flips the ROOT pick to a 2d-emitting strategy
+        node = matmul(_fab(mesh8, 1600, 512), _fab(mesh8, 512, 512))
+        interior, _ = planner.choose_strategy_ex(node, mesh8)
+        root, _ = planner.choose_strategy_ex(node, mesh8,
+                                             root_output=True)
+        assert interior == "bmm_right", interior
+        assert root in ("rmm", "cpmm"), root
+
+    def test_rootness_flows_through_entrywise_wrappers(self, mesh8):
+        # a scalar wrapper does NOT shield the multiply from the root
+        # charge (the canonical constraint re-lays the scalar's output,
+        # whose layout is the multiply's); a consuming MATMUL does —
+        # its own cost model sees the producer's layout instead
+        from matrel_tpu.ir.expr import scalar_op
+        inner = matmul(_fab(mesh8, 1600, 512), _fab(mesh8, 512, 512))
+        wrapped = planner.annotate_strategies(
+            scalar_op("mul", inner, 2.0), mesh8)
+        assert wrapped.children[0].attrs["strategy"] in ("rmm", "cpmm")
+        chain = planner.annotate_strategies(
+            matmul(matmul(_fab(mesh8, 1600, 512),
+                          _fab(mesh8, 512, 512)),
+                   _fab(mesh8, 512, 64)), mesh8)
+        assert chain.children[0].attrs["strategy"] == "bmm_right"
+
+
+class TestReviewR5FollowUps:
+    """Third review pass: plan-refusal honoured in the COO layout
+    claim, transpose-swapped root charge, config-faithful EXPLAIN."""
+
+    def test_coo_plan_refusal_drops_rep_claim(self, mesh8, monkeypatch):
+        from matrel_tpu import executor as ex
+        from matrel_tpu.core.coo import COOMatrix
+        rng = np.random.default_rng(0)
+        A = COOMatrix.from_edges(rng.integers(0, 64, 100),
+                                 rng.integers(0, 64, 100), shape=(64, 64))
+        x = BlockMatrix.from_numpy(
+            rng.standard_normal((64, 2)).astype(np.float32), mesh=mesh8)
+        e = A.multiply(x.expr())
+        cfg = MatrelConfig(pallas_interpret=True)
+        assert planner.infer_layout(e, mesh8, config=cfg) == "rep"
+        # the executor refusing the plan (densify fallback, 2d output)
+        # must drop the replication claim — the predicate is shared
+        monkeypatch.setattr(ex, "_coo_dispatch_plan", lambda n: None)
+        assert planner.infer_layout(e, mesh8, config=cfg) == "2d"
+
+    def test_transpose_swaps_root_charge_axis(self, mesh8):
+        # k/n = 512/1896 = 0.27 on the (2,4) grid: the row->2d re-lay
+        # (factor 3/4) sinks bmm at a bare root, but under a root
+        # TRANSPOSE the output arrives col-sharded and re-lays along
+        # the cheaper axis (factor 1/2) — bmm survives
+        from matrel_tpu.ir.expr import transpose
+        bare = planner.annotate_strategies(
+            matmul(_fab(mesh8, 1896, 512), _fab(mesh8, 512, 512)),
+            mesh8)
+        assert bare.attrs["strategy"] in ("rmm", "cpmm")
+        under_t = planner.annotate_strategies(
+            transpose(matmul(_fab(mesh8, 1896, 512),
+                             _fab(mesh8, 512, 512))), mesh8)
+        assert under_t.children[0].attrs["strategy"] == "bmm_right"
+
+    def test_explain_uses_plan_config_for_layouts(self, mesh8):
+        from matrel_tpu.core.coo import COOMatrix
+        rng = np.random.default_rng(1)
+        A = COOMatrix.from_edges(rng.integers(0, 64, 100),
+                                 rng.integers(0, 64, 100), shape=(64, 64))
+        x = BlockMatrix.from_numpy(
+            rng.standard_normal((64, 2)).astype(np.float32), mesh=mesh8)
+        cfg = MatrelConfig(pallas_interpret=True)
+        plan = executor.compile_expr(A.multiply(x.expr()), mesh8, cfg)
+        # the plan's config claims "rep" (compact sharded path); the
+        # DEFAULT config on this CPU backend would claim nothing —
+        # explain must print the planner's view, not default_config's
+        assert "layout=rep" in plan.explain()
