@@ -24,6 +24,22 @@ def _bytes(shape: Tuple[int, int], density: float, itemsize: int = 4) -> float:
     return shape[0] * shape[1] * itemsize * max(density, 0.0)
 
 
+def _to_2d_reshard(bytes_: float, layout: str, gx: int, gy: int) -> float:
+    """Per-device ICI bytes to re-lay an operand into the canonical
+    P(x, y) tiling that cpmm/summa kernels consume. Replicated operands
+    already hold every tile (free); 1D-sharded ones gather along the
+    perpendicular axis (the same closed form as the bmm reshard
+    terms); canonical/"other" inputs are assumed in place."""
+    p = max(gx * gy, 1)
+    if layout == "rep":
+        return 0.0
+    if layout == "row":
+        return (bytes_ / p) * (1 - 1 / gy)
+    if layout == "col":
+        return (bytes_ / p) * (1 - 1 / gx)
+    return 0.0
+
+
 def comm_cost(strategy: str, n: int, k: int, m: int,
               da: float, db: float, gx: int, gy: int,
               itemsize: int = 4,
@@ -31,11 +47,15 @@ def comm_cost(strategy: str, n: int, k: int, m: int,
     """Estimated per-device ICI bytes moved by each strategy.
 
     ``a_layout``/``b_layout`` describe how the operand already lives on the
-    mesh ("2d", "row", "col", "rep"): co-partitioned inputs make their
-    reshard terms free — the analogue of the reference's partitioner-aware
-    planning that skips shuffles for co-partitioned RDDs (SURVEY.md §2
-    "Partitioners", "co-partitioning"). Costs count resharding all-gathers
-    plus execution-time collectives; the closed forms recast the reference's
+    mesh ("2d", "row", "col", "rep", "other"): co-partitioned inputs make
+    their reshard terms free — the analogue of the reference's
+    partitioner-aware planning that skips shuffles for co-partitioned RDDs
+    (SURVEY.md §2 "Partitioners", "co-partitioning"). EVERY strategy
+    branch reads the layouts (round 5 — previously only the bmm branches
+    did): a replicated operand costs nothing to gather for rmm/cpmm
+    either, and a 1D-sharded operand pays its way back to the 2D tiling
+    cpmm/summa consume. Costs count resharding all-gathers plus
+    execution-time collectives; the closed forms recast the reference's
     shuffle-size formulas for a gx × gy mesh.
     """
     a_bytes = _bytes((n, k), da, itemsize)
@@ -53,26 +73,32 @@ def comm_cost(strategy: str, n: int, k: int, m: int,
         reshard_b = 0.0 if b_layout == "col" else (b_bytes / p) * (1 - 1 / gx)
         return bcast + reshard_b
     if strategy == "cpmm":
-        # reshard B to P(y, None): each device gathers b_bytes/gy of B rows
-        # replicated along x (factor (gx-1)/gx of that), then reduce-scatter
-        # of partial C over y.
-        reshard_b = (b_bytes / gy) * (gx - 1) / gx
+        # A consumed P(x, y) in place (re-laid if 1D-sharded); B resharded
+        # to P(y, None): each device gathers b_bytes/gy of B rows
+        # replicated along x (free when B is already replicated), then a
+        # reduce-scatter of partial C over y.
+        reshard_a = _to_2d_reshard(a_bytes, a_layout, gx, gy)
+        reshard_b = (0.0 if b_layout == "rep"
+                     else (b_bytes / gy) * (gx - 1) / gx)
         rs_c = (c_bytes / gx) * (gy - 1) / gy
-        return reshard_b + rs_c
-    if strategy == "rmm":
-        # all-gather A along y (each device ends with n/gx × k) and B along x
-        ag_a = (a_bytes / gx) * (gy - 1) / gy
-        ag_b = (b_bytes / gy) * (gx - 1) / gx
+        return reshard_a + reshard_b + rs_c
+    if strategy in ("rmm", "xla"):
+        # all-gather A along y (each device ends with n/gx × k) and B
+        # along x; replicated operands already hold their gather target.
+        # xla is unknown until the SPMD partitioner runs; modelled as RMM
+        # (its usual pick).
+        ag_a = (0.0 if a_layout == "rep"
+                else (a_bytes / gx) * (gy - 1) / gy)
+        ag_b = (0.0 if b_layout == "rep"
+                else (b_bytes / gy) * (gx - 1) / gx)
         return ag_a + ag_b
     if strategy == "summa":
+        # inputs re-laid to the P(x, y) tiles the ring consumes, then
         # Cannon: g steps, each moves one A tile + one B tile per device
         g = max(gx, gy)
-        return (a_bytes / p + b_bytes / p) * (g - 1)
-    if strategy == "xla":
-        # unknown until SPMD partitioner runs; model as RMM (its usual pick)
-        ag_a = (a_bytes / gx) * (gy - 1) / gy
-        ag_b = (b_bytes / gy) * (gx - 1) / gx
-        return ag_a + ag_b
+        return (_to_2d_reshard(a_bytes, a_layout, gx, gy)
+                + _to_2d_reshard(b_bytes, b_layout, gx, gy)
+                + (a_bytes / p + b_bytes / p) * (g - 1))
     raise ValueError(f"unknown strategy {strategy}")
 
 

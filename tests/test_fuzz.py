@@ -95,8 +95,21 @@ def np_eval(e, env):
     raise NotImplementedError(k)
 
 
+def _rand_spec(rng, shape):
+    """A random leaf PartitionSpec: canonical (None), 1D row/col over
+    all devices, replicated, or a partial sharding. Size-1 dims stay
+    canonical (they are never padded, so 1D specs cannot divide)."""
+    from jax.sharding import PartitionSpec as P
+    if shape[0] <= 1 or shape[1] <= 1:
+        return None
+    pool = [None, P(("x", "y"), None), P(None, ("x", "y")),
+            P(None, None), P("x", None), P(None, "y")]
+    return pool[int(rng.integers(len(pool)))]
+
+
 def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",),
-             dtype_pop=("float32",), structured_join=False):
+             dtype_pop=("float32",), structured_join=False,
+             rand_specs=False):
     """Random expression with consistent shapes; fills env[uid] for leaves.
     ``leaf_kinds``: population for leaf flavors — "dense" (BlockMatrix),
     "sparse" (BlockSparseMatrix tile stack), "coo" (element-sparse plan);
@@ -119,8 +132,10 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",),
             r, c = np.nonzero(a)
             l = COOMatrix.from_edges(r, c, a[r, c], shape=shape).expr()
         else:
+            spec = _rand_spec(rng, shape) if rand_specs else None
             l = E.leaf(BlockMatrix.from_numpy(
-                a, mesh=mesh, dtype=str(rng.choice(dtype_pop))))
+                a, mesh=mesh, dtype=str(rng.choice(dtype_pop)),
+                spec=spec))
         env[l.uid] = a
         return l
 
@@ -141,62 +156,62 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",),
         k = int(rng.choice(dims[1:]))
         if rng.random() < 0.5:
             x = gen_expr(rng, env, mesh, depth - 1, (k, shape[0]),
-                         leaf_kinds, dtype_pop, structured_join)
+                         leaf_kinds, dtype_pop, structured_join, rand_specs)
             return E.matmul(E.transpose(x), x)
         x = gen_expr(rng, env, mesh, depth - 1, (shape[0], k),
-                     leaf_kinds, dtype_pop, structured_join)
+                     leaf_kinds, dtype_pop, structured_join, rand_specs)
         return E.matmul(x, E.transpose(x))
     if choice == "matmul":
         k = int(rng.choice(dims[1:]))
         a = gen_expr(rng, env, mesh, depth - 1, (shape[0], k),
-                     leaf_kinds, dtype_pop, structured_join)
+                     leaf_kinds, dtype_pop, structured_join, rand_specs)
         b = gen_expr(rng, env, mesh, depth - 1, (k, shape[1]),
-                     leaf_kinds, dtype_pop, structured_join)
+                     leaf_kinds, dtype_pop, structured_join, rand_specs)
         return E.matmul(a, b)
     if choice == "elemwise":
         op = str(rng.choice(["add", "sub", "mul"]))
         a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
-                     dtype_pop, structured_join)
+                     dtype_pop, structured_join, rand_specs)
         b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
-                     dtype_pop, structured_join)
+                     dtype_pop, structured_join, rand_specs)
         return E.elemwise(op, a, b)
     if choice == "scalar":
         op = str(rng.choice(["add", "mul"]))
         c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
-                     dtype_pop, structured_join)
+                     dtype_pop, structured_join, rand_specs)
         return E.scalar_op(op, c, float(rng.uniform(-2, 2)))
     if choice == "transpose":
         c = gen_expr(rng, env, mesh, depth - 1, (shape[1], shape[0]),
-                     leaf_kinds, dtype_pop, structured_join)
+                     leaf_kinds, dtype_pop, structured_join, rand_specs)
         return E.transpose(c)
     if choice == "agg_chain":
         # produce shape via aggregation of a larger operand when possible
         if shape[1] == 1 and shape[0] > 1:
             inner = gen_expr(rng, env, mesh, depth - 1,
                              (shape[0], int(rng.choice(dims[1:]))),
-                             leaf_kinds, dtype_pop, structured_join)
+                             leaf_kinds, dtype_pop, structured_join, rand_specs)
             return E.agg(inner, "sum", "row")
         if shape == (1, 1):
             inner = gen_expr(rng, env, mesh, depth - 1,
                              (int(rng.choice(dims[1:])),) * 2, leaf_kinds,
-                             dtype_pop, structured_join)
+                             dtype_pop, structured_join, rand_specs)
             return E.agg(inner, "sum", "all")
         return leaf_of(shape)
     if choice == "select":
         c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
-                     dtype_pop, structured_join)
+                     dtype_pop, structured_join, rand_specs)
         m = int(rng.integers(2, 5))
         return E.select_index(c, rows=lambda i, m=m: i % m != 0)
     if choice == "select_value":
         c = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
-                     dtype_pop, structured_join)
+                     dtype_pop, structured_join, rand_specs)
         t = float(rng.uniform(-0.5, 0.5))
         return E.select_value(c, lambda v, t=t: v > t)
     if choice == "join_index":
         a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
-                     dtype_pop, structured_join)
+                     dtype_pop, structured_join, rand_specs)
         b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
-                     dtype_pop, structured_join)
+                     dtype_pop, structured_join, rand_specs)
         if structured_join:
             return E.join_on_index(
                 a, b, str(rng.choice(["left", "right", "add", "mul"])))
@@ -206,9 +221,9 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",),
         # parent agg triggers the streaming lowering, otherwise the
         # capped materialisation runs — both fuzzed here
         a = gen_expr(rng, env, mesh, depth - 1, (shape[0], 1),
-                     leaf_kinds, dtype_pop, structured_join)
+                     leaf_kinds, dtype_pop, structured_join, rand_specs)
         b = gen_expr(rng, env, mesh, depth - 1, (shape[1], 1),
-                     leaf_kinds, dtype_pop, structured_join)
+                     leaf_kinds, dtype_pop, structured_join, rand_specs)
         merge = str(rng.choice(["left", "right", "add", "mul"]))
         pred = str(rng.choice(["eq", "lt", "le", "gt", "ge"]))
         return E.join_on_value(a, b, merge, pred)
@@ -219,20 +234,22 @@ def gen_expr(rng, env, mesh, depth, shape=None, leaf_kinds=("dense",),
         n = shape[0]
         m_np = rng.standard_normal((n, n)).astype(np.float32)
         m_np = (m_np @ m_np.T / n + 2.0 * np.eye(n, dtype=np.float32))
-        l = E.leaf(BlockMatrix.from_numpy(m_np, mesh=mesh))
+        l = E.leaf(BlockMatrix.from_numpy(
+            m_np, mesh=mesh,
+            spec=_rand_spec(rng, (n, n)) if rand_specs else None))
         env[l.uid] = m_np
         b = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
-                     dtype_pop, structured_join)
+                     dtype_pop, structured_join, rand_specs)
         if rng.random() < 0.5:
             return E.solve(l, b)
         return E.matmul(E.inverse(l), b)   # exercises the R7 fusion
     if choice == "rank1":
         a = gen_expr(rng, env, mesh, depth - 1, shape, leaf_kinds,
-                     dtype_pop, structured_join)
+                     dtype_pop, structured_join, rand_specs)
         u = gen_expr(rng, env, mesh, depth - 1, (shape[0], 1),
-                     leaf_kinds, dtype_pop, structured_join)
+                     leaf_kinds, dtype_pop, structured_join, rand_specs)
         v = gen_expr(rng, env, mesh, depth - 1, (shape[1], 1),
-                     leaf_kinds, dtype_pop, structured_join)
+                     leaf_kinds, dtype_pop, structured_join, rand_specs)
         return E.rank_one_update(a, u, v)
     return leaf_of(shape)
 
@@ -402,3 +419,18 @@ def test_fuzz_infer_dtype_matches_executed_dtype(mesh8):
             assert np.dtype(predicted) == np.dtype(got), (
                 f"seed {seed}: predicted {predicted}, executed {got}")
     assert predicted_count >= n_seeds // 2, predicted_count
+
+
+@pytest.mark.parametrize("seed", range(60, 75))
+def test_fuzz_random_leaf_layouts(seed, mesh8):
+    # round-5 layout net: random leaf PartitionSpecs through random
+    # trees — infer_layout's claims steer strategy/join-scheme/root
+    # charges, and none of it may move the numbers
+    rng = np.random.default_rng(seed)
+    env = {}
+    e = gen_expr(rng, env, mesh8, depth=int(rng.integers(2, 5)),
+                 rand_specs=True)
+    oracle = np_eval(e, env)
+    got = compile_expr(e, mesh8, MatrelConfig()).run().to_numpy()
+    np.testing.assert_allclose(got, oracle, rtol=2e-3, atol=2e-3,
+                               err_msg=f"layout fuzz (seed {seed})")

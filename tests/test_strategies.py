@@ -583,3 +583,39 @@ class TestReviewR5FollowUps:
         # DEFAULT config on this CPU backend would claim nothing —
         # explain must print the planner's view, not default_config's
         assert "layout=rep" in plan.explain()
+
+
+class TestSymmetricLayoutTerms:
+    """Round 5: every comm_cost branch reads operand layouts, not just
+    the bmm ones — a replicated operand gathers for free under rmm/cpmm
+    too, and a 1D-sharded operand pays its way back to the 2D tiling
+    cpmm consumes."""
+
+    def test_replicated_A_flips_cpmm_to_rmm(self, mesh8):
+        # big replicated A (over the bcast threshold, so bmm_left is
+        # out), k > m: rmm's A-gather is now free and beats cpmm's
+        # C reduce-scatter; with the old layout-blind rmm term cpmm won
+        from jax.sharding import PartitionSpec as P
+        cfg = MatrelConfig(broadcast_threshold_bytes=1024)
+        a_rep = _fab(mesh8, 4096, 4096, spec=P(None, None))
+        b = _fab(mesh8, 4096, 1024)
+        got = planner.choose_strategy(matmul(a_rep, b), mesh8, cfg)
+        assert got == "rmm", got
+        ctl = planner.choose_strategy(
+            matmul(_fab(mesh8, 4096, 4096), b), mesh8, cfg)
+        assert ctl == "cpmm", ctl
+
+    def test_row_sharded_A_charges_cpmm_relay(self, mesh8):
+        # 3a/4 < c < a band on the (2,4) grid: cpmm wins for 2D A, but
+        # a row-sharded A must pay its re-lay to P(x, y) and rmm takes
+        # over (bmm excluded by the threshold)
+        from jax.sharding import PartitionSpec as P
+        cfg = MatrelConfig(broadcast_threshold_bytes=1024)
+        b = _fab(mesh8, 1024, 896)
+        ctl = planner.choose_strategy(
+            matmul(_fab(mesh8, 8192, 1024), b), mesh8, cfg)
+        assert ctl == "cpmm", ctl
+        got = planner.choose_strategy(
+            matmul(_fab(mesh8, 8192, 1024, spec=P(("x", "y"), None)), b),
+            mesh8, cfg)
+        assert got == "rmm", got
