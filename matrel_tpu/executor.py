@@ -928,7 +928,8 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
         _check_one_mesh(e, mesh)
     grid = mesh_lib.mesh_grid_shape(mesh)
     opts = tuple(planner.annotate_strategies(
-        rules.optimize(e, cfg, grid=grid), mesh, cfg) for e in exprs)
+        rules.optimize(e, cfg, grid=grid, mesh=mesh), mesh, cfg)
+        for e in exprs)
     leaf_order = []
     seen = set()
     for o in opts:
@@ -1026,7 +1027,7 @@ def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
             cfg.mesh_shape, cfg.mesh_axis_names)
     _check_one_mesh(expr, mesh)
     opt = rules.optimize(expr, cfg,
-                         grid=mesh_lib.mesh_grid_shape(mesh))
+                         grid=mesh_lib.mesh_grid_shape(mesh), mesh=mesh)
     opt = planner.annotate_strategies(opt, mesh, cfg)
     leaf_order = expr_leaves(opt)
     low = Lowerer(mesh, cfg)

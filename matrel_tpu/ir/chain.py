@@ -23,35 +23,54 @@ def collect_chain(e: MatExpr) -> List[MatExpr]:
     return collect_chain(e.children[0]) + collect_chain(e.children[1])
 
 
+def _operand_layouts(operands: List[MatExpr], mesh,
+                     config=None) -> List[str]:
+    """Layout of each chain operand on the mesh (planner.infer_layout
+    under the SESSION config — its COO claim is config-dependent), or
+    all-"2d" when no mesh is given (the layout-blind DP)."""
+    if mesh is None:
+        return ["2d"] * len(operands)
+    from matrel_tpu.parallel import planner   # lazy: no import cycle
+    memo: dict = {}
+    return [planner.infer_layout(op, mesh, memo, config)
+            for op in operands]
+
+
 def optimal_order(operands: List[MatExpr],
-                  grid: Tuple[int, int] = (1, 1)
-                  ) -> Tuple[MatExpr, float]:
+                  grid: Tuple[int, int] = (1, 1),
+                  mesh=None, config=None) -> Tuple[MatExpr, float]:
     """Interval DP over the operand list; returns (rebuilt expr, est. cost).
 
     cost[i][j] = min over split s of cost[i][s] + cost[s+1][j]
-                 + stepCost(dims, densities, grid)
-    stepCost (stats.chain_step_cost) = sparsity-aware FLOPs + the
+                 + stepCost(dims, densities, layouts, grid)
+    stepCost (stats.chain_step_cost_layout) = sparsity-aware FLOPs + the
     collective bill of the cheapest MM strategy on the grid in
     FLOP-equivalents — two parenthesisations with equal FLOPs but
-    different comm bills no longer tie arbitrarily. grid == (1, 1)
-    reduces to pure FLOPs. Densities of intermediates are re-estimated
-    per split via the same propagation the stats module uses, so sparse
-    chains order correctly.
+    different comm bills no longer tie arbitrarily, and with ``mesh``
+    given the bill is PER-LAYOUT (round 5): a replicated or 1D-sharded
+    operand makes the order that broadcasts it free strictly cheaper,
+    and each interval's result carries the layout its cheapest strategy
+    would emit. grid == (1, 1) reduces to pure FLOPs. Densities of
+    intermediates are re-estimated per split via the same propagation
+    the stats module uses, so sparse chains order correctly.
 
     For chains of ≥3 operands the O(n³) loop runs in the native optimizer
-    core (native/chain_dp.cc, same cost semantics incl. the comm term);
-    the pure-Python DP below is the always-available fallback and the
-    reference implementation for equivalence tests.
+    core (native/chain_dp.cc, same cost semantics incl. the layout-aware
+    comm term); the pure-Python DP below is the always-available fallback
+    and the reference implementation for equivalence tests.
     """
     n = len(operands)
     gx, gy = grid
     if n == 1:
         return operands[0], 0.0
+    lays = _operand_layouts(operands, mesh if gx * gy > 1 else None,
+                            config)
     if n >= 3:
         from matrel_tpu.utils import native
         dims = [op.shape[0] for op in operands] + [operands[-1].shape[1]]
         dens = [op.density for op in operands]
-        res = native.chain_dp(dims, dens, grid=grid)
+        codes = [stats.LAYOUT_CODES[l] for l in lays]
+        res = native.chain_dp(dims, dens, grid=grid, layouts=codes)
         if res is not None:
             splits, cost = res
 
@@ -62,42 +81,46 @@ def optimal_order(operands: List[MatExpr],
                 return matmul(build(i, s), build(s + 1, j))
 
             return build(0, n - 1), cost
-    # best[i][j] = (cost, expr) for operands[i..j] inclusive
-    best: List[List[Optional[Tuple[float, MatExpr]]]] = [
+    # best[i][j] = (cost, expr, layout) for operands[i..j] inclusive
+    best: List[List[Optional[Tuple[float, MatExpr, str]]]] = [
         [None] * n for _ in range(n)
     ]
     for i in range(n):
-        best[i][i] = (0.0, operands[i])
+        best[i][i] = (0.0, operands[i], lays[i])
     for span in range(2, n + 1):
         for i in range(0, n - span + 1):
             j = i + span - 1
-            cand: Optional[Tuple[float, MatExpr]] = None
+            cand: Optional[Tuple[float, MatExpr, str]] = None
             for s in range(i, j):
-                cl, el = best[i][s]
-                cr, er = best[s + 1][j]
-                step = stats.chain_step_cost(
+                cl, el, ll = best[i][s]
+                cr, er, lr = best[s + 1][j]
+                step, lay = stats.chain_step_cost_layout(
                     el.shape[0], el.shape[1], er.shape[1],
-                    el.density, er.density, gx, gy,
+                    el.density, er.density, gx, gy, ll, lr,
                 )
                 total = cl + cr + step
                 if cand is None or total < cand[0]:
-                    cand = (total, matmul(el, er))
+                    cand = (total, matmul(el, er), lay)
             best[i][j] = cand
-    cost, e = best[0][n - 1]
+    cost, e, _ = best[0][n - 1]
     return e, cost
 
 
 def reorder_chains(e: MatExpr,
-                   grid: Tuple[int, int] = (1, 1)) -> MatExpr:
+                   grid: Tuple[int, int] = (1, 1),
+                   mesh=None, config=None) -> MatExpr:
     """Recursively find maximal matmul chains and DP-reorder each.
-    ``grid`` is the mesh grid shape feeding the comm-aware step cost."""
+    ``grid`` is the mesh grid shape feeding the comm-aware step cost;
+    ``mesh`` additionally makes the step cost layout-aware (the DP sees
+    which operands are replicated/1D-sharded on it), under the session
+    ``config`` the planner will also use."""
     if e.kind == "matmul":
         ops = collect_chain(e)
         # optimize below each chain operand first, then the chain itself
-        ops = [reorder_chains(o, grid) if o.kind != "leaf" else o
-               for o in ops]
+        ops = [reorder_chains(o, grid, mesh, config)
+               if o.kind != "leaf" else o for o in ops]
         if len(ops) > 2:
-            new, _ = optimal_order(ops, grid)
+            new, _ = optimal_order(ops, grid, mesh, config)
             return new
         if len(ops) == 2:
             return matmul(ops[0], ops[1])
@@ -105,7 +128,7 @@ def reorder_chains(e: MatExpr,
     if not e.children:
         return e
     new_children = tuple(
-        reorder_chains(c, grid) for c in e.children
+        reorder_chains(c, grid, mesh, config) for c in e.children
     )
     if all(nc is oc for nc, oc in zip(new_children, e.children)):
         return e

@@ -307,16 +307,17 @@ def common_subexpressions(e: MatExpr) -> MatExpr:
 
 
 def optimize(e: MatExpr, config: Optional[MatrelConfig] = None,
-             grid: tuple = (1, 1)) -> MatExpr:
+             grid: tuple = (1, 1), mesh=None) -> MatExpr:
     """Full logical optimization: rewrites, chain-DP reorder, CSE.
     ``grid`` is the mesh grid shape — the chain DP's step cost then
     includes each candidate multiply's collective bill (comm-aware
-    reorder); (1, 1) keeps the pure-FLOPs DP."""
+    reorder); (1, 1) keeps the pure-FLOPs DP. ``mesh`` makes the bill
+    layout-aware (round 5): operand PartitionSpecs steer the reorder."""
     cfg = config or default_config()
     if cfg.rewrite_rules:
         e = apply_rewrites(e)
     if cfg.chain_opt:
-        e = chain_lib.reorder_chains(e, grid)
+        e = chain_lib.reorder_chains(e, grid, mesh, cfg)
         if cfg.rewrite_rules:
             e = apply_rewrites(e)  # reorder can expose new folds
     if cfg.rewrite_rules:

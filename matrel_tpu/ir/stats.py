@@ -68,30 +68,49 @@ ICI byte. Order-of-magnitude is what matters — the term breaks
 FLOP-ties toward the cheaper collective bill."""
 
 
-def comm_proxy(n: int, k: int, m: int, da: float, db: float,
-               gx: int, gy: int, itemsize: int = 4) -> float:
-    """Simplified per-device ICI bytes of the cheapest MM strategy for
-    an (n×k)·(k×m) multiply on a gx×gy mesh — the chain DP's comm term.
+#: Layout codes shared with native/chain_dp.cc's layout-aware DP — the
+#: C side receives operand layouts as int8 with exactly this mapping.
+LAYOUT_CODES = {"2d": 0, "row": 1, "col": 2, "rep": 3, "other": 4}
 
-    Mirrors planner.comm_cost's closed forms WITHOUT layout credits or
-    admissibility gates (physical layouts aren't known while the DP
-    reorders the logical chain); the planner still picks the real
-    strategy per multiply afterwards. Must stay in sync with
-    native/chain_dp.cc's comm_proxy."""
+
+def comm_proxy_layout(n: int, k: int, m: int, da: float, db: float,
+                      gx: int, gy: int, itemsize: int = 4,
+                      la: str = "2d", lb: str = "2d"
+                      ) -> tuple:
+    """(cheapest per-device ICI bytes, output layout of the argmin
+    strategy) for an (n×k)·(k×m) multiply on a gx×gy mesh — the chain
+    DP's comm term, now PER-LAYOUT (round 5: the DP can see that a
+    replicated/1D-sharded operand makes one parenthesisation's
+    broadcast free, and it tracks the layout each interval's result
+    would have).
+
+    Delegates to planner.comm_cost per strategy (ONE Python source of
+    truth for the per-layout closed forms — review r5; the only copy is
+    the C mirror in native/chain_dp.cc, equivalence-fuzzed by
+    test_native) but still applies NO admissibility or broadcast-
+    threshold gates (the planner picks the real strategy per multiply
+    afterwards). Tie-break order (bmm_right, bmm_left, cpmm, rmm) MUST
+    stay in sync with native/chain_dp.cc's comm_proxy_layout."""
     p = gx * gy
     if p <= 1:
-        return 0.0
-    a_b = n * k * itemsize * da
-    b_b = k * m * itemsize * db
-    c_b = n * m * itemsize
-    # planner.comm_cost's forms at the canonical "2d" layout (the bmm
-    # reshard terms are unconditional there, only their layout CREDITS
-    # are dropped)
-    bmm_r = b_b * (p - 1) / p + (a_b / p) * (1 - 1 / gy)
-    bmm_l = a_b * (p - 1) / p + (b_b / p) * (1 - 1 / gx)
-    cpmm = (b_b / gy) * (gx - 1) / gx + (c_b / gx) * (gy - 1) / gy
-    rmm = (a_b / gx) * (gy - 1) / gy + (b_b / gy) * (gx - 1) / gx
-    return min(bmm_r, bmm_l, cpmm, rmm)
+        return 0.0, "2d"
+    from matrel_tpu.parallel import planner   # lazy: no import cycle
+    best, lay = None, "2d"
+    for strat, out_lay in (("bmm_right", "row"), ("bmm_left", "col"),
+                           ("cpmm", "2d"), ("rmm", "2d")):
+        c = planner.comm_cost(strat, n, k, m, da, db, gx, gy,
+                              itemsize, la, lb)
+        if best is None or c < best:
+            best, lay = c, out_lay
+    return best, lay
+
+
+def comm_proxy(n: int, k: int, m: int, da: float, db: float,
+               gx: int, gy: int, itemsize: int = 4) -> float:
+    """comm_proxy_layout at the canonical "2d" layouts — the
+    layout-blind view kept for callers that predate the layout-aware
+    DP (and for the native matrel_chain_dp_comm symbol's semantics)."""
+    return comm_proxy_layout(n, k, m, da, db, gx, gy, itemsize)[0]
 
 
 def chain_step_cost(n: int, k: int, m: int, da: float, db: float,
@@ -101,6 +120,15 @@ def chain_step_cost(n: int, k: int, m: int, da: float, db: float,
     single-device plans are unchanged."""
     return (matmul_cost(n, k, m, da, db)
             + COMM_FLOPS_PER_BYTE * comm_proxy(n, k, m, da, db, gx, gy))
+
+
+def chain_step_cost_layout(n: int, k: int, m: int, da: float, db: float,
+                           gx: int, gy: int, la: str, lb: str) -> tuple:
+    """(step cost, output layout): chain_step_cost with per-layout comm
+    terms — the layout-aware DP's step (round 5)."""
+    comm, lay = comm_proxy_layout(n, k, m, da, db, gx, gy, la=la, lb=lb)
+    return (matmul_cost(n, k, m, da, db)
+            + COMM_FLOPS_PER_BYTE * comm), lay
 
 
 def matmul_out_nnz(

@@ -63,14 +63,18 @@ def comm_cost(strategy: str, n: int, k: int, m: int,
     c_bytes = _bytes((n, m), 1.0, itemsize)
     p = gx * gy
     if strategy == "bmm_right":
-        # replicate B everywhere (all-gather to every device) + reshard A to
-        # row-sharding over all devices (free when already row-sharded).
+        # replicate B everywhere (all-gather to every device) + reshard A
+        # to row-sharding over all devices (free when already row-sharded
+        # — and when replicated: slicing holds-everything down to a row
+        # shard moves nothing, review r5).
         bcast = 0.0 if b_layout == "rep" else b_bytes * (p - 1) / p
-        reshard_a = 0.0 if a_layout == "row" else (a_bytes / p) * (1 - 1 / gy)
+        reshard_a = (0.0 if a_layout in ("row", "rep")
+                     else (a_bytes / p) * (1 - 1 / gy))
         return bcast + reshard_a
     if strategy == "bmm_left":
         bcast = 0.0 if a_layout == "rep" else a_bytes * (p - 1) / p
-        reshard_b = 0.0 if b_layout == "col" else (b_bytes / p) * (1 - 1 / gx)
+        reshard_b = (0.0 if b_layout in ("col", "rep")
+                     else (b_bytes / p) * (1 - 1 / gx))
         return bcast + reshard_b
     if strategy == "cpmm":
         # A consumed P(x, y) in place (re-laid if 1D-sharded); B resharded

@@ -106,6 +106,26 @@ def load() -> Optional[ctypes.CDLL]:
         except AttributeError as e:
             log.debug("native comm-aware chain-dp unavailable: %s", e)
             lib._matrel_has_dp_comm = False
+        try:
+            # layout-aware DP binds separately for the same stale-lib
+            # tolerance reason
+            lib.matrel_chain_dp_layout.restype = ctypes.c_int
+            lib.matrel_chain_dp_layout.argtypes = [
+                ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.c_double,
+                ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.POINTER(ctypes.c_double),
+            ]
+            lib._matrel_has_dp_layout = True
+        except AttributeError as e:
+            log.debug("native layout-aware chain-dp unavailable: %s", e)
+            lib._matrel_has_dp_layout = False
         _lib = lib
         try:
             # Ingestion symbols bind separately so a stale prebuilt lib
@@ -163,12 +183,16 @@ def load() -> Optional[ctypes.CDLL]:
 def chain_dp(dims: Sequence[int], densities: Sequence[float],
              grid: Tuple[int, int] = (1, 1),
              comm_weight: Optional[float] = None,
-             itemsize: int = 4) -> Optional[Tuple[np.ndarray, float]]:
+             itemsize: int = 4,
+             layouts: Optional[Sequence[int]] = None
+             ) -> Optional[Tuple[np.ndarray, float]]:
     """Run the native interval DP. dims has n+1 entries; densities n.
     With grid != (1,1) the step cost adds the comm term (ir/stats.py::
-    chain_step_cost semantics). Returns (split table [n,n] int32, total
-    cost) or None if the native path is unavailable — including a stale
-    prebuilt lib lacking the comm symbol when comm is requested."""
+    chain_step_cost semantics); non-trivial ``layouts`` (int codes,
+    ir/stats.py::LAYOUT_CODES) make it layout-aware. Returns (split
+    table [n,n] int32, total cost) or None if the native path is
+    unavailable — including a stale prebuilt lib lacking the needed
+    symbol (the caller's pure-Python DP then decides)."""
     lib = load()
     if lib is None or not getattr(lib, "_matrel_has_dp", False):
         return None
@@ -181,15 +205,26 @@ def chain_dp(dims: Sequence[int], densities: Sequence[float],
     cost = ctypes.c_double(0.0)
     gx, gy = grid
     if gx * gy > 1:
-        if not getattr(lib, "_matrel_has_dp_comm", False):
-            return None
         if comm_weight is None:
             from matrel_tpu.ir.stats import COMM_FLOPS_PER_BYTE
             comm_weight = COMM_FLOPS_PER_BYTE
-        rc = lib.matrel_chain_dp_comm(
-            n, dims_arr, dens_arr, int(gx), int(gy),
-            float(comm_weight), int(itemsize), splits.reshape(-1),
-            ctypes.byref(cost))
+        if layouts is not None and any(layouts):
+            if not getattr(lib, "_matrel_has_dp_layout", False):
+                return None
+            if len(layouts) != n:
+                raise ValueError("layouts must have one entry per operand")
+            lays_arr = np.ascontiguousarray(layouts, dtype=np.int8)
+            rc = lib.matrel_chain_dp_layout(
+                n, dims_arr, dens_arr, lays_arr, int(gx), int(gy),
+                float(comm_weight), int(itemsize), splits.reshape(-1),
+                ctypes.byref(cost))
+        else:
+            if not getattr(lib, "_matrel_has_dp_comm", False):
+                return None
+            rc = lib.matrel_chain_dp_comm(
+                n, dims_arr, dens_arr, int(gx), int(gy),
+                float(comm_weight), int(itemsize), splits.reshape(-1),
+                ctypes.byref(cost))
     else:
         rc = lib.matrel_chain_dp(n, dims_arr, dens_arr,
                                  splits.reshape(-1), ctypes.byref(cost))

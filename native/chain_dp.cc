@@ -29,31 +29,67 @@ double matmul_density(double da, double db, double k) {
   return -std::expm1(k * std::log1p(-p));
 }
 
+// Layout codes — MUST match ir/stats.py::LAYOUT_CODES.
+constexpr int8_t kLay2d = 0;
+constexpr int8_t kLayRow = 1;
+constexpr int8_t kLayCol = 2;
+constexpr int8_t kLayRep = 3;
+// 4 ("other") behaves as 2d in every formula below.
+
+// Per-device bytes to re-lay an operand into the canonical P(x, y)
+// tiling (cpmm/summa input). Mirrors ir/stats.py comm_proxy_layout's
+// to2d helper.
+double to_2d_reshard(double bytes, int8_t lay, double gx, double gy,
+                     double p) {
+  if (lay == kLayRep) return 0.0;
+  if (lay == kLayRow) return (bytes / p) * (1.0 - 1.0 / gy);
+  if (lay == kLayCol) return (bytes / p) * (1.0 - 1.0 / gx);
+  return 0.0;
+}
+
 // Per-device ICI bytes of the cheapest MM strategy for (n×k)·(k×m) on a
-// gx×gy mesh. MUST mirror ir/stats.py::comm_proxy (planner.comm_cost at
-// the canonical 2d layout: no layout credits, no admissibility gates) —
-// the equivalence is asserted by tests/test_native.py::
-// test_comm_dp_native_matches_python.
-double comm_proxy(double n, double k, double m, double da, double db,
-                  double gx, double gy, double itemsize) {
+// gx×gy mesh, given operand layouts; *out_lay receives the layout the
+// argmin strategy emits (bmm_r → row, bmm_l → col, cpmm/rmm → 2d). MUST
+// mirror ir/stats.py::comm_proxy_layout (planner.comm_cost's per-layout
+// forms, no admissibility gates) INCLUDING the tie-break order — the
+// equivalence is asserted by tests/test_native.py.
+double comm_proxy_layout(double n, double k, double m, double da, double db,
+                         double gx, double gy, double itemsize,
+                         int8_t la, int8_t lb, int8_t* out_lay) {
   double p = gx * gy;
-  if (p <= 1.0) return 0.0;
+  if (p <= 1.0) {
+    *out_lay = kLay2d;
+    return 0.0;
+  }
   double a_b = n * k * itemsize * da;
   double b_b = k * m * itemsize * db;
   double c_b = n * m * itemsize;
-  double bmm_r = b_b * (p - 1.0) / p + (a_b / p) * (1.0 - 1.0 / gy);
-  double bmm_l = a_b * (p - 1.0) / p + (b_b / p) * (1.0 - 1.0 / gx);
-  double cpmm = (b_b / gy) * (gx - 1.0) / gx + (c_b / gx) * (gy - 1.0) / gy;
-  double rmm = (a_b / gx) * (gy - 1.0) / gy + (b_b / gy) * (gx - 1.0) / gx;
-  double best = bmm_r < bmm_l ? bmm_r : bmm_l;
-  if (cpmm < best) best = cpmm;
-  if (rmm < best) best = rmm;
+  double bmm_r =
+      (lb == kLayRep ? 0.0 : b_b * (p - 1.0) / p) +
+      (la == kLayRow || la == kLayRep ? 0.0
+                                      : (a_b / p) * (1.0 - 1.0 / gy));
+  double bmm_l =
+      (la == kLayRep ? 0.0 : a_b * (p - 1.0) / p) +
+      (lb == kLayCol || lb == kLayRep ? 0.0
+                                      : (b_b / p) * (1.0 - 1.0 / gx));
+  double cpmm = to_2d_reshard(a_b, la, gx, gy, p) +
+                (lb == kLayRep ? 0.0 : (b_b / gy) * (gx - 1.0) / gx) +
+                (c_b / gx) * (gy - 1.0) / gy;
+  double rmm = (la == kLayRep ? 0.0 : (a_b / gx) * (gy - 1.0) / gy) +
+               (lb == kLayRep ? 0.0 : (b_b / gy) * (gx - 1.0) / gx);
+  double best = bmm_r;
+  int8_t lay = kLayRow;
+  if (bmm_l < best) { best = bmm_l; lay = kLayCol; }
+  if (cpmm < best) { best = cpmm; lay = kLay2d; }
+  if (rmm < best) { best = rmm; lay = kLay2d; }
+  *out_lay = lay;
   return best;
 }
 
 int chain_dp_impl(int32_t n, const int64_t* dims, const double* dens,
-                  double gx, double gy, double comm_weight, double itemsize,
-                  int32_t* split_out, double* cost_out) {
+                  const int8_t* lays, double gx, double gy,
+                  double comm_weight, double itemsize, int32_t* split_out,
+                  double* cost_out) {
   if (n <= 0 || dims == nullptr || dens == nullptr || split_out == nullptr ||
       cost_out == nullptr)
     return 1;
@@ -63,7 +99,11 @@ int chain_dp_impl(int32_t n, const int64_t* dims, const double* dens,
   }
   std::vector<double> cost(static_cast<size_t>(n) * n, 0.0);
   std::vector<double> density(static_cast<size_t>(n) * n, 1.0);
-  for (int i = 0; i < n; ++i) density[i * n + i] = dens[i];
+  std::vector<int8_t> layout(static_cast<size_t>(n) * n, kLay2d);
+  for (int i = 0; i < n; ++i) {
+    density[i * n + i] = dens[i];
+    layout[i * n + i] = lays ? lays[i] : kLay2d;
+  }
 
   for (int span = 2; span <= n; ++span) {
     for (int i = 0; i + span - 1 < n; ++i) {
@@ -71,6 +111,7 @@ int chain_dp_impl(int32_t n, const int64_t* dims, const double* dens,
       double best = -1.0;
       int best_s = i;
       double best_d = 1.0;
+      int8_t best_l = kLay2d;
       for (int s = i; s < j; ++s) {
         double dl = density[i * n + s];
         double dr = density[(s + 1) * n + j];
@@ -78,18 +119,23 @@ int chain_dp_impl(int32_t n, const int64_t* dims, const double* dens,
         double mid = static_cast<double>(dims[s + 1]);
         double colsj = static_cast<double>(dims[j + 1]);
         double step = 2.0 * rows * mid * colsj * dl * dr;
+        int8_t out_lay = kLay2d;
         if (comm_weight > 0.0)
           step += comm_weight *
-                  comm_proxy(rows, mid, colsj, dl, dr, gx, gy, itemsize);
+                  comm_proxy_layout(rows, mid, colsj, dl, dr, gx, gy,
+                                    itemsize, layout[i * n + s],
+                                    layout[(s + 1) * n + j], &out_lay);
         double total = cost[i * n + s] + cost[(s + 1) * n + j] + step;
         if (best < 0.0 || total < best) {
           best = total;
           best_s = s;
           best_d = matmul_density(dl, dr, mid);
+          best_l = out_lay;
         }
       }
       cost[i * n + j] = best;
       density[i * n + j] = best_d;
+      layout[i * n + j] = best_l;
       split_out[i * n + j] = best_s;
     }
   }
@@ -109,19 +155,35 @@ extern "C" {
 // returns 0 on success, nonzero on bad input
 int matrel_chain_dp(int32_t n, const int64_t* dims, const double* dens,
                     int32_t* split_out, double* cost_out) {
-  return chain_dp_impl(n, dims, dens, 1.0, 1.0, 0.0, 4.0, split_out,
-                       cost_out);
+  return chain_dp_impl(n, dims, dens, nullptr, 1.0, 1.0, 0.0, 4.0,
+                       split_out, cost_out);
 }
 
 // Comm-aware variant: step cost additionally pays
 // comm_weight * comm_proxy(dims, densities, gx, gy, itemsize) —
-// FLOP-equivalents of the cheapest collective bill on the gx×gy mesh.
+// FLOP-equivalents of the cheapest collective bill on the gx×gy mesh,
+// at the canonical 2d layouts.
 int matrel_chain_dp_comm(int32_t n, const int64_t* dims, const double* dens,
                          int32_t gx, int32_t gy, double comm_weight,
                          int32_t itemsize, int32_t* split_out,
                          double* cost_out) {
   if (gx <= 0 || gy <= 0 || itemsize <= 0) return 1;
-  return chain_dp_impl(n, dims, dens, static_cast<double>(gx),
+  return chain_dp_impl(n, dims, dens, nullptr, static_cast<double>(gx),
+                       static_cast<double>(gy), comm_weight,
+                       static_cast<double>(itemsize), split_out, cost_out);
+}
+
+// Layout-aware variant (round 5): lays is n int8 layout codes
+// (ir/stats.py::LAYOUT_CODES — 0=2d, 1=row, 2=col, 3=rep, 4=other);
+// the comm term gains the per-layout credits/charges and each DP
+// interval tracks the layout its cheapest strategy emits.
+int matrel_chain_dp_layout(int32_t n, const int64_t* dims,
+                           const double* dens, const int8_t* lays,
+                           int32_t gx, int32_t gy, double comm_weight,
+                           int32_t itemsize, int32_t* split_out,
+                           double* cost_out) {
+  if (gx <= 0 || gy <= 0 || itemsize <= 0 || lays == nullptr) return 1;
+  return chain_dp_impl(n, dims, dens, lays, static_cast<double>(gx),
                        static_cast<double>(gy), comm_weight,
                        static_cast<double>(itemsize), split_out, cost_out);
 }

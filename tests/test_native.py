@@ -356,3 +356,42 @@ def test_makefile_sources_match_lazy_builder():
         if tok.endswith(".cc"))
     assert mk_srcs == sorted(native._SOURCES), (mk_srcs,
                                                 native._SOURCES)
+
+
+def test_layout_dp_native_matches_python(lib, mesh8, monkeypatch):
+    """Layout-aware DP equivalence fuzz: random chains, grids AND
+    operand layout codes through native matrel_chain_dp_layout vs the
+    forced-Python DP — costs must agree (native/chain_dp.cc
+    comm_proxy_layout mirrors ir/stats.py exactly)."""
+    if not getattr(lib, "_matrel_has_dp_layout", False):
+        pytest.skip("native layout DP unavailable")
+    import dataclasses
+    from jax.sharding import PartitionSpec as P
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    specs = {"2d": None, "row": P(("x", "y"), None),
+             "col": P(None, ("x", "y")), "rep": P(None, None)}
+    base = {name: BlockMatrix.from_numpy(np.zeros((8, 8), np.float32),
+                                         mesh=mesh8, spec=sp)
+            for name, sp in specs.items()}
+    rng = np.random.default_rng(17)
+    for _ in range(10):
+        n = int(rng.integers(3, 7))
+        dims = [int(rng.integers(2, 600)) for _ in range(n + 1)]
+        dens = [float(rng.choice([1.0, 1.0, 0.2, 0.02]))
+                for _ in range(n)]
+        lays = [str(rng.choice(list(specs))) for _ in range(n)]
+        grid = tuple(rng.choice([(2, 2), (2, 4), (4, 2)]))
+        ops = []
+        for i in range(n):
+            shape = (dims[i], dims[i + 1])
+            nnz = int(dens[i] * shape[0] * shape[1])
+            ops.append(leaf(dataclasses.replace(
+                base[lays[i]], shape=shape, nnz=nnz)))
+        e_nat, c_nat = chain_lib.optimal_order(ops, grid=grid,
+                                               mesh=mesh8)
+        with monkeypatch.context() as mp:
+            mp.setattr(native, "chain_dp", lambda *a, **k: None)
+            e_py, c_py = chain_lib.optimal_order(ops, grid=grid,
+                                                 mesh=mesh8)
+        assert c_nat == pytest.approx(c_py, rel=0.05), (dims, dens,
+                                                        lays, grid)

@@ -379,3 +379,62 @@ class TestCommAwareChainDP:
                    for s in ("bmm_right", "bmm_left", "cpmm", "rmm"))
         assert stats.comm_proxy(n, k, m, 1.0, 1.0, gx, gy) == \
             pytest.approx(want)
+
+
+class TestLayoutAwareChainDP:
+    """Round 5: with a mesh given, the chain DP's comm term reads
+    operand layouts — a replicated operand makes the order that
+    broadcasts it free strictly cheaper, breaking what the layout-blind
+    DP saw as an exact tie."""
+
+    def _chain(self, mesh, b_spec=None):
+        # dims (16,512)(512,512)(512,16): exact FLOP tie between the
+        # two parenthesisations (the TestCommAwareChainDP shape)
+        n, k = 16, 512
+        a = L(n, k, mesh)
+        b = leaf(BlockMatrix.from_numpy(
+            np.zeros((k, k), dtype=np.float32), mesh=mesh, spec=b_spec))
+        c = L(k, n, mesh)
+        return [a, b, c]
+
+    def test_colsharded_middle_flips_to_left_assoc(self, mesh8):
+        from jax.sharding import PartitionSpec as P
+        # layout-blind: the comm tie-break picks RIGHT-assoc A·(B·C)
+        # (B·C rides a cheap cpmm; the left order pays to re-lay the
+        # 1 MB middle operand for bmm_left)
+        blind, _ = chain.optimal_order(self._chain(mesh8), grid=(2, 4),
+                                       mesh=mesh8)
+        assert blind.children[1].kind == "matmul"       # A·(B·C)
+        # with B ALREADY col-sharded, (A·B) consumes it in place as
+        # bmm_left's broadcast target — the left order is now strictly
+        # cheaper and the layout-aware DP flips the association
+        aware, _ = chain.optimal_order(
+            self._chain(mesh8, b_spec=P(None, ("x", "y"))), grid=(2, 4),
+            mesh=mesh8)
+        assert aware.children[0].kind == "matmul"       # (A·B)·C
+
+    def test_python_and_native_layout_dp_agree(self, mesh8, monkeypatch):
+        from jax.sharding import PartitionSpec as P
+        from matrel_tpu.utils import native
+        if native.load() is None or not getattr(
+                native.load(), "_matrel_has_dp_layout", False):
+            pytest.skip("native layout DP unavailable")
+        ops = self._chain(mesh8, b_spec=P(None, ("x", "y")))
+        e_nat, c_nat = chain.optimal_order(ops, grid=(2, 4), mesh=mesh8)
+        with monkeypatch.context() as mp:
+            mp.setattr(native, "chain_dp", lambda *a, **k: None)
+            e_py, c_py = chain.optimal_order(ops, grid=(2, 4),
+                                             mesh=mesh8)
+        assert c_nat == pytest.approx(c_py, rel=1e-9)
+        assert e_nat.children[0].kind == e_py.children[0].kind
+
+    def test_comm_proxy_layout_2d_matches_blind(self):
+        # the layout-aware proxy at canonical layouts IS the old proxy —
+        # the native matrel_chain_dp_comm semantics are unchanged
+        rng = np.random.default_rng(3)
+        for _ in range(50):
+            n, k, m = (int(rng.integers(2, 2000)) for _ in range(3))
+            da, db = rng.uniform(0.01, 1.0), rng.uniform(0.01, 1.0)
+            gx, gy = int(rng.integers(1, 5)), int(rng.integers(1, 5))
+            got, _lay = stats.comm_proxy_layout(n, k, m, da, db, gx, gy)
+            assert got == stats.comm_proxy(n, k, m, da, db, gx, gy)
