@@ -229,12 +229,8 @@ def infer_layout(node: MatExpr, mesh: Mesh,
                                       and not cfg.autotune):
                     return "rep"
                 return "2d"
-            s = n.attrs.get("strategy")
-            if s == "bmm_right":
-                return "row"
-            if s == "bmm_left":
-                return "col"
-            return "2d"
+            return STRATEGY_OUT_LAYOUT.get(n.attrs.get("strategy"),
+                                           "2d")
         if k == "transpose":
             c = walk(n.children[0])
             return {"row": "col", "col": "row"}.get(c, c)
@@ -458,12 +454,46 @@ def _root_reshard_cost(strategy: str, n: int, m: int,
     return 0.0                         # cpmm/rmm/summa/xla emit 2d
 
 
+#: Output layout each matmul strategy emits (strategies.py out_specs) —
+#: the ONE mapping shared by infer_layout's matmul rule and the
+#: consumer-aware tiebreak (review r5).
+STRATEGY_OUT_LAYOUT = {"bmm_right": "row", "bmm_left": "col",
+                       "cpmm": "2d", "rmm": "2d", "summa": "2d",
+                       "xla": "2d"}
+
+#: Near-tie band for the consumer-aware STRATEGY tiebreak (the matmul
+#: analogue of JOIN_TIE_REL): candidates within this margin of the
+#: cheapest may be flipped toward the layout the consumer reads free.
+STRATEGY_TIE_REL = 0.10
+
+
+def _hint_tiebreak(costs: dict, best, out_layout_of,
+                   hint: Optional[str], tie_rel: float):
+    """Shared near-tie flip for the consumer-aware tiebreaks (join
+    schemes and matmul strategies — review r5: one band/epsilon rule,
+    not two drifting copies): among candidates within ``tie_rel`` of
+    the cheapest, return the cheapest one whose output layout (per
+    ``out_layout_of``) matches ``hint``; otherwise ``best``."""
+    if hint is None:
+        return best
+    near = sorted(
+        (s for s in costs
+         if costs[s] <= costs[best] * (1.0 + tie_rel) + 1e-9),
+        key=costs.get)
+    for s in near:
+        if out_layout_of(s) == hint:
+            return s
+    return best
+
+
 def choose_strategy_ex(node: MatExpr, mesh: Mesh,
                        config: Optional[MatrelConfig] = None,
                        dtype_memo: Optional[dict] = None,
                        layout_memo: Optional[dict] = None,
                        root_output: bool = False,
-                       root_transposed: bool = False) -> Tuple[str, str]:
+                       root_transposed: bool = False,
+                       consumer_hint: Optional[str] = None
+                       ) -> Tuple[str, str]:
     """(strategy, source) for one matmul node. ``source`` records WHY —
     the observability side of the closed loop (physical EXPLAIN prints
     it): "override" (config.strategy_override), "measured" (autotune
@@ -543,7 +573,16 @@ def choose_strategy_ex(node: MatExpr, mesh: Mesh,
                  for s, c in cands.items()}
     if not cands:
         return "xla", "default"
-    return min(cands, key=cands.get), "model"
+    best = min(cands, key=cands.get)
+    if not root_output:
+        # consumer-aware tiebreak (the matmul analogue of the join
+        # scheme's, round 5): among near-tied candidates prefer the one
+        # whose output layout the PARENT consumes in place — e.g. a
+        # left-child multiply flips an ε-worse bmm_right over rmm
+        # because the parent reads its row-sharded result for free.
+        best = _hint_tiebreak(cands, best, STRATEGY_OUT_LAYOUT.get,
+                              consumer_hint, STRATEGY_TIE_REL)
+    return best, "model"
 
 
 def _reshard_to_axis(bytes_: float, layout: str, axis: str,
@@ -647,15 +686,9 @@ def choose_join_scheme(node: MatExpr, mesh: Mesh,
         cost["align"] = (_reshard_to_axis(a_bytes, la, axis, gx, gy)
                          + _reshard_to_axis(b_bytes, lb, axis, gx, gy))
     best = min(cost, key=cost.get)
-    if consumer_hint is not None:
-        near = sorted(
-            (s for s in cost
-             if cost[s] <= cost[best] * (1.0 + JOIN_TIE_REL) + 1e-9),
-            key=cost.get)
-        for s in near:
-            if _scheme_out_layout(s, node, la, lb) == consumer_hint:
-                return s
-    return best
+    return _hint_tiebreak(
+        cost, best, lambda s: _scheme_out_layout(s, node, la, lb),
+        consumer_hint, JOIN_TIE_REL)
 
 
 def _child_rootness(e: MatExpr, i: int, is_root: bool) -> bool:
@@ -675,14 +708,24 @@ def _child_rootness(e: MatExpr, i: int, is_root: bool) -> bool:
     return False
 
 
-def _child_layout_hints(e: MatExpr) -> Tuple[Optional[str], ...]:
+def _child_layout_hints(e: MatExpr,
+                        config: Optional[MatrelConfig] = None
+                        ) -> Tuple[Optional[str], ...]:
     """Layout each child's output would be consumed in-place at by this
-    node, for the join-scheme tiebreak: a matmul reads its left operand
-    row-sharded for free (bmm_right's reshard credit) and its right
-    operand col-sharded (bmm_left). Other parents express no
-    preference."""
+    node, for the consumer-aware tiebreaks: a matmul reads its left
+    operand row-sharded for free (bmm_right's reshard credit) and its
+    right operand col-sharded (bmm_left). A hint is only emitted when
+    the parent could actually RUN that bmm — its broadcast side under
+    the threshold (review r5: an inadmissible hint flips the child to a
+    worse pick AND leaves the parent paying a 1D→2d re-lay, a double
+    loss). Other parents express no preference."""
     if e.kind == "matmul":
-        return ("row", "col")
+        cfg = config or default_config()
+        a, b = e.children
+        b_fits = _bytes(b.shape, b.density) <= cfg.broadcast_threshold_bytes
+        a_fits = _bytes(a.shape, a.density) <= cfg.broadcast_threshold_bytes
+        return ("row" if b_fits else None,      # parent bmm_right viable
+                "col" if a_fits else None)      # parent bmm_left viable
     return (None,) * len(e.children)
 
 
@@ -698,12 +741,13 @@ def annotate_strategies(e: MatExpr, mesh: Mesh,
     and one layout memo are threaded through the whole pass and seeded
     as each rewritten node is produced, so every choose_strategy
     dtype/layout lookup is O(1). ``_consumer_hint`` carries the parent's
-    in-place-consumable layout down to join-scheme ties; the ROOT
-    matmul is additionally charged the canonical-output reshard its
-    lowering really pays (_root_reshard_cost)."""
+    in-place-consumable layout down to BOTH join-scheme and matmul
+    strategy near-ties (_hint_tiebreak); the ROOT matmul is additionally
+    charged the canonical-output reshard its lowering really pays
+    (_root_reshard_cost)."""
     memo = {} if _dtype_memo is None else _dtype_memo
     lmemo = {} if _layout_memo is None else _layout_memo
-    hints = _child_layout_hints(e)
+    hints = _child_layout_hints(e, config)
     swap = _root_swap != (e.kind == "transpose")   # odd transposes flip
     new_children = tuple(
         annotate_strategies(c, mesh, config, memo, lmemo, h,
@@ -716,7 +760,8 @@ def annotate_strategies(e: MatExpr, mesh: Mesh,
                                            dtype_memo=memo,
                                            layout_memo=lmemo,
                                            root_output=_is_root,
-                                           root_transposed=_root_swap)
+                                           root_transposed=_root_swap,
+                                           consumer_hint=_consumer_hint)
         e = e.with_attrs(strategy=strat, strategy_source=source)
     if e.kind in ("join_rows", "join_cols") and "replicate" not in e.attrs:
         e = e.with_attrs(replicate=choose_join_scheme(

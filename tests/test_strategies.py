@@ -619,3 +619,41 @@ class TestSymmetricLayoutTerms:
             matmul(_fab(mesh8, 8192, 1024, spec=P(("x", "y"), None)), b),
             mesh8, cfg)
         assert got == "rmm", got
+
+
+class TestConsumerAwareStrategyTiebreak:
+    """The matmul analogue of the join-scheme tiebreak (round 5): a
+    near-tied strategy pick flips toward the output layout the parent
+    consumes in place."""
+
+    def _inner(self, mesh, m):
+        # (2048x512)·(512xm) on the (2,4) grid: at m=800 rmm beats
+        # bmm_right by ~4% (within the tie band); at m=1024 by ~21%
+        return matmul(_fab(mesh, 2048, 512), _fab(mesh, 512, m))
+
+    def test_left_child_hint_flips_to_bmm_right(self, mesh8):
+        standalone, _ = planner.choose_strategy_ex(self._inner(mesh8,
+                                                               800),
+                                                   mesh8)
+        assert standalone == "rmm", standalone
+        ann = planner.annotate_strategies(
+            matmul(self._inner(mesh8, 800), _fab(mesh8, 800, 64)),
+            mesh8)
+        assert ann.children[0].attrs["strategy"] == "bmm_right"
+
+    def test_hint_never_overrides_clear_winner(self, mesh8):
+        ann = planner.annotate_strategies(
+            matmul(self._inner(mesh8, 1024), _fab(mesh8, 1024, 64)),
+            mesh8)
+        assert ann.children[0].attrs["strategy"] == "rmm"
+
+
+def test_hint_gated_by_parent_bmm_admissibility(mesh8):
+    # review r5: a parent whose broadcast side exceeds the threshold
+    # can never run the bmm that would consume the hinted layout — no
+    # hint is emitted, so a near-tied child keeps its cheapest pick
+    cfg = MatrelConfig(broadcast_threshold_bytes=1024)
+    inner = matmul(_fab(mesh8, 2048, 512), _fab(mesh8, 512, 800))
+    ann = planner.annotate_strategies(
+        matmul(inner, _fab(mesh8, 800, 800)), mesh8, cfg)
+    assert ann.children[0].attrs["strategy"] == "rmm"
