@@ -24,8 +24,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    # append, don't setdefault: a pre-existing XLA_FLAGS would
+    # otherwise leave a 1-device mesh where nothing here fires
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import numpy as np  # noqa: E402
 
@@ -41,7 +45,6 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
     mesh = mesh_lib.make_mesh()
-    gx, gy = mesh_lib.mesh_grid_shape(mesh)
     print(f"mesh: {dict(mesh.shape)} over {mesh.size} devices\n")
     rng = np.random.default_rng(0)
 

@@ -58,11 +58,15 @@ def soak_fuzz(n_seeds: int, base: int, tol: float):
             e = fuzz.gen_expr(rng, env, mesh,
                               depth=int(rng.integers(2, 5)),
                               leaf_kinds=("dense", "dense", "sparse",
-                                          "coo"))
+                                          "coo"),
+                              rand_specs=(seed % 2 == 1))
             oracle = fuzz.np_eval(e, env)
-            # half the seeds force the Pallas paths (interpret mode off
-            # TPU): the compact COO executor dispatch and Pallas SpMM
-            # get soaked alongside the XLA lowerings. A third sweep
+            # half the seeds force the Pallas paths (interpret mode
+            # off TPU): the compact COO executor dispatch and Pallas
+            # SpMM get soaked alongside the XLA lowerings. The OTHER
+            # half randomise leaf PartitionSpecs (round-5 layout net:
+            # the planner's per-layout credits must never move
+            # numerics). A third sweep runs
             # matmul_precision="high" — the generator's gram nodes then
             # take the symmetric 2-pass split (round-3) and every f32
             # matmul runs bf16x3-class, so tolerance widens with it
@@ -103,7 +107,8 @@ def soak_deep(n_seeds: int, base: int, tol: float):
             e = fuzz.gen_expr(rng, env, mesh,
                               depth=int(rng.integers(5, 8)),
                               leaf_kinds=("dense", "dense", "sparse",
-                                          "coo"))
+                                          "coo"),
+                              rand_specs=(seed % 2 == 1))
             oracle = fuzz.np_eval(e, env)
             cfg = MatrelConfig(pallas_interpret=(seed % 2 == 0))
             got = compile_expr(e, mesh, cfg).run().to_numpy()
