@@ -49,8 +49,9 @@ def _table_key(side: int, gx: int, gy: int, dtype: str) -> str:
     # backend is part of the key, mirroring _spmv_key's rationale
     # (advisor r4): a shared table must never serve one backend's
     # winner to the other — a persisted CPU-mesh winner has nothing to
-    # say about Mosaic. Old un-suffixed entries simply never hit and
-    # age out.
+    # say about Mosaic. Old un-suffixed entries simply never hit; they
+    # linger in the JSON (persist rewrites the whole table) but are
+    # inert — delete the file to reclaim the bytes.
     return f"{side}|{gx}x{gy}|{dtype}|{jax.default_backend()}"
 
 
@@ -246,11 +247,10 @@ def autotune_matmul(n: int, k: int, m: int,
             continue       # on this backend just drops out of the table
         if t > 0.0:        # non-positive median = noise, not a time
             results[s] = t
-    # a one-variant "comparison" proves nothing (same gate as the SpMV
-    # loop, advisor r4): when every other candidate failed to compile
-    # or measured as noise, the lone survivor is recorded best=None —
+    # _pick_winner owns the one-variant and tie gates (advisor r4):
+    # a compile-failure-reduced lone survivor records best=None —
     # times still persist for observability, the model decides
-    best = _pick_winner(results) if len(results) >= 2 else None
+    best = _pick_winner(results)
     _CACHE[key] = (best, results)
     if results and (cfg.autotune or cfg.autotune_table_path):
         # an EMPTY result set (every strategy failed or measured pure
@@ -267,16 +267,16 @@ def autotune_matmul(n: int, k: int, m: int,
 
 
 def _pick_winner(results: Dict[str, float]) -> Optional[str]:
-    """argmin with a tie rule: a winner within TIE_REL of the runner-up
-    is recorded as None ("no measured winner") so the byte model
-    decides — on meshes where strategies compile identically (e.g. 1
-    device) every marginal is pure noise and must not be persisted as
-    a preference."""
-    if not results:
+    """argmin with two guards, BOTH owned here (review r5 — one policy,
+    not copies at each call site): a one-variant "comparison" proves
+    nothing (None — the lone survivor of compile failures/noise must
+    not become a measured preference), and a winner within TIE_REL of
+    the runner-up is recorded as None ("no measured winner") so the
+    byte model decides — on meshes where strategies compile identically
+    (e.g. 1 device) every marginal is pure noise."""
+    if len(results) < 2:
         return None
     order = sorted(results, key=results.get)
-    if len(order) == 1:
-        return order[0]
     best, runner = order[0], order[1]
     if results[runner] <= results[best] * (1.0 + TIE_REL):
         return None

@@ -540,7 +540,15 @@ def choose_strategy_ex(node: MatExpr, mesh: Mesh,
             from matrel_tpu.parallel import autotune
             best = autotune.lookup_or_measure(n, k, m, mesh, str(dta),
                                               cfg)
-            if best is not None and admissible(best, pn, pk, pm, gx, gy):
+            if (best is not None
+                    and admissible(best, pn, pk, pm, gx, gy)
+                    and not (root_output
+                             and STRATEGY_OUT_LAYOUT.get(best) != "2d")):
+                # a measured 1D-emitting winner is NOT applied at a
+                # plan ROOT: the probes never pay the canonical-output
+                # re-lay the executor performs there, so the premise
+                # doesn't cover this context (review r5) — the model,
+                # which charges _root_reshard_cost, decides instead
                 return best, "measured"
     da, db = a.density, b.density
     cands = {}
@@ -716,10 +724,14 @@ def _child_layout_hints(e: MatExpr,
     operand row-sharded for free (bmm_right's reshard credit) and its
     right operand col-sharded (bmm_left). A hint is only emitted when
     the parent could actually RUN that bmm — its broadcast side under
-    the threshold (review r5: an inadmissible hint flips the child to a
-    worse pick AND leaves the parent paying a 1D→2d re-lay, a double
-    loss). Other parents express no preference."""
+    the threshold, and not a sparse/COO dispatch (whose SpMV/SpMM
+    lowerings ignore the hinted layout entirely) — review r5: an
+    unusable hint flips the child to a worse pick AND leaves the
+    parent paying a 1D→2d re-lay, a double loss. Other parents express
+    no preference."""
     if e.kind == "matmul":
+        if any(c.kind in ("sparse_leaf", "coo_leaf") for c in e.children):
+            return (None, None)
         cfg = config or default_config()
         a, b = e.children
         b_fits = _bytes(b.shape, b.density) <= cfg.broadcast_threshold_bytes

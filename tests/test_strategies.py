@@ -657,3 +657,38 @@ def test_hint_gated_by_parent_bmm_admissibility(mesh8):
     ann = planner.annotate_strategies(
         matmul(inner, _fab(mesh8, 800, 800)), mesh8, cfg)
     assert ann.children[0].attrs["strategy"] == "rmm"
+
+
+def test_measured_bmm_winner_not_applied_at_root(mesh8, tmp_path):
+    # review r5: autotune probes never pay the root canonical-output
+    # re-lay, so a measured 1D-emitting winner doesn't cover the root
+    # context — the model (which charges _root_reshard_cost) decides;
+    # a 2d-emitting measured winner still applies at the root
+    import json
+    from matrel_tpu.parallel import autotune
+    node = matmul(_fab(mesh8, 64, 64), _fab(mesh8, 64, 64))
+    for planted, want_src in (("bmm_right", "model"), ("rmm", "measured")):
+        path = str(tmp_path / f"t_{planted}.json")
+        json.dump({autotune._table_key(64, 2, 4, "float32"):
+                   {"best": planted, "times": {planted: 1e-6}}},
+                  open(path, "w"))
+        autotune._CACHE.clear()
+        cfg = MatrelConfig(autotune=True, autotune_table_path=path)
+        _, src = planner.choose_strategy_ex(node, mesh8, cfg,
+                                            root_output=True)
+        assert src == want_src, (planted, src)
+        _, src_int = planner.choose_strategy_ex(node, mesh8, cfg)
+        assert src_int == "measured", planted   # interior: always applies
+
+
+def test_no_hint_for_sparse_dispatch_parents(mesh8):
+    # review r5: a parent matmul dispatching the COO SpMV path cannot
+    # consume any hinted layout — no hint reaches its children
+    from matrel_tpu.core.coo import COOMatrix
+    rng = np.random.default_rng(0)
+    A = COOMatrix.from_edges(rng.integers(0, 64, 100),
+                             rng.integers(0, 64, 100), shape=(64, 64))
+    parent = A.multiply(matmul(_fab(mesh8, 64, 32), _fab(mesh8, 32, 2)))
+    assert planner._child_layout_hints(parent) == (None, None)
+    dense = matmul(_fab(mesh8, 64, 64), _fab(mesh8, 64, 2))
+    assert planner._child_layout_hints(dense) == ("row", "col")
