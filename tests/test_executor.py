@@ -458,3 +458,30 @@ class TestSymmetricGramLowering:
         out = execute(B.expr().t().multiply(A.expr()), mesh8,
                       self._cfg()).to_numpy()
         np.testing.assert_allclose(out, b.T @ a, rtol=2e-3, atol=2e-3)
+
+
+def test_rebound_leaf_with_different_layout_stays_correct(mesh8, rng):
+    # round-5 net: a compiled plan is OPTIMIZED for the layouts its
+    # leaves had at compile time; rebinding a matrix with a different
+    # PartitionSpec may make the cached strategy suboptimal but must
+    # never change the numbers (jit re-specializes on the new input
+    # sharding; the strategy recipes are layout-correct for any input)
+    from jax.sharding import PartitionSpec as P
+    from matrel_tpu import executor
+    from matrel_tpu.ir.expr import leaf, matmul
+    a = rng.standard_normal((64, 32)).astype(np.float32)
+    b = rng.standard_normal((32, 16)).astype(np.float32)
+    a2 = rng.standard_normal((64, 32)).astype(np.float32)
+    A_row = bm(a, mesh8, spec=P(("x", "y"), None))
+    B = bm(b, mesh8)
+    la = leaf(A_row)
+    plan = executor.compile_expr(matmul(la, leaf(B)), mesh8)
+    np.testing.assert_allclose(plan.run().to_numpy(), a @ b,
+                               rtol=1e-4, atol=1e-4)
+    # rebind with canonical-2D data of the same shape
+    got = plan.run(bindings={la.uid: bm(a2, mesh8)}).to_numpy()
+    np.testing.assert_allclose(got, a2 @ b, rtol=1e-4, atol=1e-4)
+    # and with a replicated rebind
+    A3_rep = bm(a2, mesh8, spec=P(None, None))
+    got3 = plan.run(bindings={la.uid: A3_rep}).to_numpy()
+    np.testing.assert_allclose(got3, a2 @ b, rtol=1e-4, atol=1e-4)
