@@ -692,3 +692,25 @@ def test_no_hint_for_sparse_dispatch_parents(mesh8):
     assert planner._child_layout_hints(parent) == (None, None)
     dense = matmul(_fab(mesh8, 64, 64), _fab(mesh8, 64, 2))
     assert planner._child_layout_hints(dense) == ("row", "col")
+
+
+def test_planner_works_with_custom_axis_names(rng):
+    # robustness: nothing in the layout machinery may assume the
+    # default ("x", "y") axis names — infer_layout, the strategies'
+    # shard_map specs and the align lowering all read mesh.axis_names
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from matrel_tpu.core import mesh as mesh_lib
+    mesh = mesh_lib.make_mesh((2, 4), axis_names=("rows", "cols"))
+    a = rng.standard_normal((64, 32)).astype(np.float32)
+    b = rng.standard_normal((32, 16)).astype(np.float32)
+    A = BlockMatrix.from_numpy(a, mesh=mesh,
+                               spec=P(("rows", "cols"), None))
+    B = BlockMatrix.from_numpy(b, mesh=mesh)
+    node = matmul(leaf(A), leaf(B))
+    assert planner.infer_layout(node.children[0], mesh) == "row"
+    ann = planner.annotate_strategies(node, mesh)
+    assert "strategy" in ann.attrs
+    plan = executor.compile_expr(node, mesh)
+    np.testing.assert_allclose(plan.run().to_numpy(), a @ b,
+                               rtol=1e-4, atol=1e-4)
