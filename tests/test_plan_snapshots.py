@@ -1,0 +1,70 @@
+"""Plan-snapshot regression suite — the Catalyst ``comparePlans`` idiom
+at corpus scale (SURVEY.md §4): every representative expression's
+OPTIMIZED plan signature (kinds, strategies + provenance, join schemes,
+inferred layouts) must match the committed snapshot, so planner changes
+show their plan-shape consequences explicitly in review.
+
+On an INTENTIONAL planner change, regenerate with
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/plan_snapshot.py --update
+
+and commit the JSON with the change that moved it.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "plan_snapshot", os.path.join(REPO, "tools", "plan_snapshot.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def snapshots():
+    tool = _load_tool()
+    with open(tool.SNAPSHOT_PATH) as f:
+        want = json.load(f)
+    got = tool.build_snapshots()
+    return want, got
+
+
+def test_snapshot_corpus_covered(snapshots):
+    want, got = snapshots
+    assert set(want) == set(got), (
+        "corpus and snapshot disagree on entry names — regenerate via "
+        "tools/plan_snapshot.py --update")
+
+
+def _snapshot_names():
+    """Collection-time name list; a missing/corrupt snapshot file must
+    fail THIS module's tests with a pointer to --update, not abort the
+    whole pytest collection."""
+    try:
+        with open(os.path.join(REPO, "tests",
+                               "plan_snapshots.json")) as f:
+            return sorted(json.load(f))
+    except (OSError, json.JSONDecodeError):
+        return ["__snapshot_file_unreadable__"]
+
+
+@pytest.mark.parametrize("name", _snapshot_names())
+def test_plan_signature_stable(name, snapshots):
+    assert name != "__snapshot_file_unreadable__", (
+        "tests/plan_snapshots.json is missing or corrupt — regenerate "
+        "via tools/plan_snapshot.py --update")
+    want, got = snapshots
+    assert got[name] == want[name], (
+        f"plan for {name!r} changed — if intentional, regenerate via "
+        f"tools/plan_snapshot.py --update and commit the JSON\n"
+        f"now:  {json.dumps(got[name], sort_keys=True)}\n"
+        f"snap: {json.dumps(want[name], sort_keys=True)}")

@@ -1,0 +1,184 @@
+"""Plan-snapshot corpus — the Catalyst ``comparePlans`` idiom at corpus
+scale (SURVEY.md §4 "Optimizer tests: plan-level assertions").
+
+A fixed corpus of representative expressions is planned on the standard
+(2, 4) test grid and each OPTIMIZED plan's signature — node kinds,
+chosen strategies with provenance, join schemes, inferred layouts — is
+recorded in ``tests/plan_snapshots.json``. The paired test
+(tests/test_plan_snapshots.py) replans the corpus and diffs against the
+snapshot, so any future planner/optimizer change shows its plan-shape
+consequences EXPLICITLY in review instead of silently reshaping
+downstream collectives (the plan-stability discipline of database
+query optimizers, which the reference inherits from Catalyst).
+
+Regenerate after an INTENTIONAL planner change:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/plan_snapshot.py --update
+
+and commit the JSON alongside the change that moved it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SNAPSHOT_PATH = os.path.join(REPO, "tests", "plan_snapshots.json")
+
+
+def _setup():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, REPO)
+
+
+def corpus(mesh):
+    """(name, optimized-ready MatExpr) pairs. Deterministic: fixed
+    seeds, fixed shapes; planning has no randomness."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.core.coo import COOMatrix
+    from matrel_tpu.core.sparse import BlockSparseMatrix
+    from matrel_tpu.relational import ops as R
+
+    rng = np.random.default_rng(1234)
+    axes = tuple(mesh.axis_names)
+
+    def bm(n, m, spec=None):
+        return BlockMatrix.from_numpy(
+            rng.standard_normal((n, m)).astype(np.float32), mesh=mesh,
+            spec=spec)
+
+    X = bm(4096, 256)
+    y = bm(4096, 1)
+    entries = []
+    # 1. normal-equations linreg: the reference's headline pipeline
+    entries.append(("linreg_normal_equations",
+                    X.expr().t().multiply(X.expr()).solve(
+                        X.expr().t().multiply(y.expr()))))
+    # 2. skewed chain: the flagship chain-DP reorder
+    A = bm(2048, 64)
+    B = bm(64, 2048)
+    C = bm(2048, 64)
+    entries.append(("chain_skewed", A.expr().multiply(B.expr())
+                    .multiply(C.expr())))
+    # 3. FLOP-tied chain with a col-sharded middle operand: the
+    #    round-5 layout-aware association flip
+    entries.append(("chain_layout_flip",
+                    bm(16, 512).expr()
+                    .multiply(bm(512, 512, spec=P(None, axes)).expr())
+                    .multiply(bm(512, 16).expr())))
+    # 4. row-sharded leaf through a chain: interior bmm credit
+    entries.append(("chain_interior_credit",
+                    bm(1600, 512, spec=P(axes, None)).expr()
+                    .multiply(bm(512, 512).expr())
+                    .multiply(bm(512, 512).expr())))
+    # 5. join feeding a matmul: align + consumer tiebreak
+    entries.append(("join_under_matmul",
+                    R.join_on_rows(bm(64, 4, spec=P(None, None)),
+                                   bm(64, 3, spec=P(None, None)),
+                                   "mul")
+                    .multiply(bm(12, 8).expr())))
+    # 6. replicated big operand: the symmetric rmm credit
+    entries.append(("replicated_operand_matmul",
+                    bm(512, 512, spec=P(None, None)).expr()
+                    .multiply(bm(512, 128).expr())))
+    # 7. COO SpMV dispatch (pagerank-shaped matvec chain step)
+    adj = COOMatrix.from_edges(rng.integers(0, 2048, 8192),
+                               rng.integers(0, 2048, 8192),
+                               shape=(2048, 2048))
+    entries.append(("coo_spmv_matvec", adj.multiply(bm(2048, 1).expr())))
+    # 8. block-sparse x dense
+    dense_for_tiles = rng.standard_normal((256, 256)).astype(np.float32)
+    dense_for_tiles *= rng.random((256, 256)) < 0.3
+    S = BlockSparseMatrix.from_numpy(dense_for_tiles, block_size=64,
+                                     mesh=mesh)
+    entries.append(("block_sparse_matmul",
+                    S.multiply(bm(256, 128))))
+    # 9. gram through transpose sharing (symmetric lowering candidate)
+    G = bm(1024, 256)
+    entries.append(("gram_AtA", G.expr().t().multiply(G.expr())))
+    # 10. rank-1 update pushed through a multiply (R8)
+    entries.append(("rank1_pushdown",
+                    G.expr().rank_one_update(bm(1024, 1).expr(),
+                                             bm(256, 1).expr())
+                    .multiply(bm(256, 64).expr())))
+    return entries
+
+
+def signature(e, mesh, _lmemo=None):
+    """Deterministic nested plan signature: kinds, strategy choices
+    with provenance, join schemes, inferred layouts."""
+    from matrel_tpu.parallel import planner
+
+    if _lmemo is None:
+        _lmemo = {}
+    sig = {"kind": e.kind, "shape": list(e.shape)}
+    if "strategy" in e.attrs:
+        sig["strategy"] = e.attrs["strategy"]
+        sig["source"] = e.attrs.get("strategy_source")
+    if "replicate" in e.attrs:
+        sig["scheme"] = e.attrs["replicate"]
+    lay = planner.infer_layout(e, mesh, _lmemo)
+    if lay != "2d":
+        sig["layout"] = lay
+    if e.children:
+        sig["children"] = [signature(c, mesh, _lmemo)
+                           for c in e.children]
+    return sig
+
+
+def build_snapshots():
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.ir import rules
+    from matrel_tpu.parallel import planner
+
+    mesh = mesh_lib.make_mesh((2, 4))
+    grid = mesh_lib.mesh_grid_shape(mesh)
+    snaps = {}
+    for name, e in corpus(mesh):
+        opt = planner.annotate_strategies(
+            rules.optimize(e, grid=grid, mesh=mesh), mesh)
+        snaps[name] = signature(opt, mesh)
+    return snaps
+
+
+def main():
+    _setup()
+    snaps = build_snapshots()
+    if "--update" in sys.argv:
+        with open(SNAPSHOT_PATH, "w") as f:
+            json.dump(snaps, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {len(snaps)} plan snapshots to {SNAPSHOT_PATH}")
+        return 0
+    try:
+        with open(SNAPSHOT_PATH) as f:
+            want = json.load(f)
+    except (OSError, json.JSONDecodeError) as ex:
+        print(f"snapshot unreadable ({ex!r}); run with --update first")
+        return 1
+    bad = sorted(set(n for n in snaps if snaps[n] != want.get(n))
+                 | set(n for n in want if n not in snaps))
+    for n in bad:
+        print(f"PLAN CHANGED: {n}")
+        print("  now:  ", json.dumps(snaps.get(n), sort_keys=True))
+        print("  snap: ", json.dumps(want.get(n), sort_keys=True))
+    matches = sum(1 for n in snaps if snaps[n] == want.get(n))
+    print(f"{matches}/{len(snaps)} plans match")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
