@@ -9,11 +9,14 @@
 # multihost  — 2- and 4-process Gloo collectives (DCN shape)
 # native     — build the C++ optimizer/ingestion core
 # bench      — the driver's headline metric (TPU; wedge-safe)
+# obs-report — aggregate the repo's query/bench/soak event log
+#              (.matrel_events.jsonl — the history-server analogue)
 
 PY ?= python
 SEEDS ?= 10
+OBS_LOG ?= .matrel_events.jsonl
 
-.PHONY: test soak soak-tpu multihost native bench tpu-batch
+.PHONY: test soak soak-tpu multihost native bench tpu-batch obs-report
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -35,3 +38,6 @@ bench:
 
 tpu-batch:
 	sh tools/tpu_batch.sh
+
+obs-report:
+	$(PY) -m matrel_tpu history --summary --log $(OBS_LOG)

@@ -103,8 +103,10 @@ def probe_tpu() -> None:
     assert abs(val - 256.0 ** 3) < 1e-3 * 256.0 ** 3, val
 
 
-def measure_tpu() -> float:
+def measure_tpu() -> dict:
     """Marginal per-multiply time through the framework's compiled plan.
+    Returns ``{"tflops": float, "phases": {...}}`` — per-phase
+    wall-clock for the obs/ bench event.
 
     The axon relay acks dispatches before execution completes
     (block_until_ready is unreliable), so: chain each multiply on the
@@ -119,10 +121,16 @@ def measure_tpu() -> float:
     from matrel_tpu.core.blockmatrix import BlockMatrix
     from matrel_tpu.executor import compile_expr
 
-    set_default_config(MatrelConfig())
+    # obs_level="off" is the bench contract: the query hot path must
+    # carry zero instrumentation syncs. Phase timings below are taken
+    # by THIS harness around whole phases, not inside them.
+    set_default_config(MatrelConfig(obs_level="off"))
+    phases: dict = {}
+    t_phase = time.perf_counter()
     mesh = mesh_lib.make_mesh()
     A = BlockMatrix.random((N, N), mesh=mesh, seed=0, dtype=DTYPE)
     B = BlockMatrix.random((N, N), mesh=mesh, seed=1, dtype=DTYPE)
+    phases["setup_s"] = round(time.perf_counter() - t_phase, 3)
     # the chained step computes (C·B)·(2/N), NOT C·B: with uniform[0,1)
     # entries the product grows ~N/2× per multiply (Perron eigenvalue
     # N·mean), overflowing bf16 to inf well before the 45th repeat and
@@ -132,12 +140,15 @@ def measure_tpu() -> float:
     # 2·mean(B) ≈ 1, so the chain converges along the Perron direction
     # with O(1) entries and the fetch doubles as a correctness canary.
     step_expr = A.expr().multiply(B.expr()).multiply_scalar(2.0 / N)
+    t_phase = time.perf_counter()
     plan = compile_expr(step_expr, mesh)
     a_leaf = plan.leaf_order[0]
     # bound_runner: the framework's iterative-execution fast path (leaf
     # layout resolved once; raw padded arrays in/out)
     step = plan.bound_runner(rebind_uids=(a_leaf.uid,))
     fetch = jax.jit(lambda x: jnp.mean(jnp.abs(x.astype(jnp.float32))))
+    phases["compile_s"] = round(time.perf_counter() - t_phase, 3)
+    phases["optimize_ms"] = (plan.meta or {}).get("optimize_ms")
 
     def chained(reps: int) -> float:
         cur = step(A.data)  # C = A·B·(2/N)
@@ -145,7 +156,10 @@ def measure_tpu() -> float:
             cur = step(cur)  # C ← C·B·(2/N)
         return float(np.asarray(fetch(cur)))
 
+    t_phase = time.perf_counter()
     chained(2)  # warm both programs
+    phases["warmup_s"] = round(time.perf_counter() - t_phase, 3)
+    t_phase = time.perf_counter()
     lo, hi = 5, 5 + REPEATS
     dts = []
     canary = None
@@ -165,9 +179,10 @@ def measure_tpu() -> float:
     if not (np.isfinite(canary) and 1e-3 < canary < 1e3):
         raise RuntimeError(
             f"chain correctness canary out of band: mean|C| = {canary!r}")
+    phases["measure_s"] = round(time.perf_counter() - t_phase, 3)
     dt = sorted(dts)[len(dts) // 2]
     n_chips = max(1, len(mesh.devices.ravel()))
-    return flops(N) / dt / 1e12 / n_chips
+    return {"tflops": flops(N) / dt / 1e12 / n_chips, "phases": phases}
 
 
 # ---------------------------------------------------------------------------
@@ -248,11 +263,34 @@ def _store_last_good(tflops: float) -> None:
         pass
 
 
+def _emit_bench_event(record: dict) -> None:
+    """Append this run to the obs/ event log (the same JSONL file the
+    session's query records land in — "bench" kind), so BENCH_*.json
+    trajectories gain per-phase breakdowns via
+    `python -m matrel_tpu history --summary`. Harness-level: runs in
+    the PARENT process after measurement, so it cannot perturb the
+    measured hot path. obs/events.py is loaded by FILE PATH — importing
+    the matrel_tpu package would pull jax into this parent, which is
+    deliberately kept backend-free (relay-wedge safety). Never fails
+    the bench."""
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "_matrel_obs_events",
+            os.path.join(_HERE, "matrel_tpu", "obs", "events.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.emit_tool_event("bench", record, anchor_dir=_HERE)
+    except Exception as e:  # obs must never fail the bench
+        print(f"# bench event not logged: {e}", file=sys.stderr)
+
+
 def main() -> None:
     base = cpu_baseline()
     t_start = time.monotonic()
     errors: list[str] = []
     tpu: float | None = None
+    phases: dict | None = None
     for attempt in range(1 + len(BACKOFFS_S)):
         if attempt > 0:
             delay = BACKOFFS_S[attempt - 1]
@@ -283,6 +321,7 @@ def main() -> None:
             continue
         try:
             tpu = float(payload["tflops"])
+            phases = payload.get("phases")
             break
         except (KeyError, TypeError, ValueError):
             errors.append(f"measure returned unexpected payload: "
@@ -291,6 +330,11 @@ def main() -> None:
 
     if tpu is not None:
         _store_last_good(tpu)
+        _emit_bench_event({
+            "metric": "dense_blockmatmul_tflops_per_chip",
+            "value": round(tpu, 3), "n": N, "dtype": DTYPE,
+            "attempts": 1 + len(errors), "phases": phases,
+            "wall_s": round(time.monotonic() - t_start, 1)})
         print(json.dumps({
             "metric": "dense_blockmatmul_tflops_per_chip",
             "value": round(tpu, 3),
@@ -302,6 +346,11 @@ def main() -> None:
     # Final failure: still one parseable JSON line, rc 0 — the harness
     # records the structured error instead of a stack trace.
     last = _load_last_good()
+    _emit_bench_event({
+        "metric": "dense_blockmatmul_tflops_per_chip", "value": None,
+        "n": N, "dtype": DTYPE, "attempts": 1 + len(errors),
+        "error": "; ".join(errors)[-500:],
+        "wall_s": round(time.monotonic() - t_start, 1)})
     print(json.dumps({
         "metric": "dense_blockmatmul_tflops_per_chip",
         "value": None,
@@ -317,6 +366,6 @@ if __name__ == "__main__":
         probe_tpu()
         print(json.dumps({"probe": "ok"}))
     elif "--_measure" in sys.argv:
-        print(json.dumps({"tflops": measure_tpu()}))
+        print(json.dumps(measure_tpu()))
     else:
         main()

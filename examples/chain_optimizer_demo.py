@@ -45,13 +45,18 @@ def main():
     print(f"(A·B)·C costs {left/1e6:.0f} MFLOPs; "
           f"A·(B·C) costs {right/1e6:.0f} MFLOPs")
 
-    print("\n--- optimizer explain ---")
-    print(sess.explain(expr))
+    print("\n--- optimizer explain (analyze=True: measured per-op ms "
+          "next to the planner's strategy + ICI estimate) ---")
+    print(sess.explain(expr, analyze=True))
 
     def compiled_flops(plan):
         arrays = [l.attrs["matrix"].data for l in plan.leaf_order]
         lowered = plan.jitted.lower(*arrays, *plan.extra_args)
-        return lowered.compile().cost_analysis()["flops"]
+        cost = lowered.compile().cost_analysis()
+        # jax 0.4.x returns one dict per computation; modern jax a dict
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return cost["flops"]
 
     def timed(plan, label):
         run = plan.bound_runner()

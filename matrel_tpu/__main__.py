@@ -7,6 +7,9 @@ Commands:
   sql "<query>" [--table name=path.npy ...]   one-shot SQL query
   autotune N [K M]      time every matmul strategy for the given dims
   pagerank PATH         PageRank over a .mtx adjacency or src,dst CSV
+  history [--last N] [--summary] [--log PATH]
+                        aggregate a query event log (the history-server
+                        analogue; log written when MATREL_OBS_LEVEL=on)
 """
 
 from __future__ import annotations
@@ -70,6 +73,12 @@ def cmd_autotune(args):
                      indent=2))
 
 
+def cmd_history(args):
+    import sys
+    from matrel_tpu.obs import history
+    sys.exit(history.main(args))
+
+
 def cmd_pagerank(args):
     import numpy as np
     from matrel_tpu import io as mio
@@ -120,6 +129,16 @@ def main(argv=None):
     sa.add_argument("k", type=int, nargs="?")
     sa.add_argument("m", type=int, nargs="?")
     sa.set_defaults(fn=cmd_autotune)
+    hi = sub.add_parser("history")
+    hi.add_argument("--last", type=int, default=None,
+                    help="show only the most recent N query records")
+    hi.add_argument("--summary", action="store_true",
+                    help="per-strategy / cache roll-up instead of the "
+                         "per-query table")
+    hi.add_argument("--log", default=None,
+                    help="event-log path (default: the obs default, "
+                         ".matrel_events.jsonl)")
+    hi.set_defaults(fn=cmd_history)
     pr = sub.add_parser("pagerank")
     pr.add_argument("path", help=".mtx adjacency or 'src,dst' CSV edges")
     pr.add_argument("--rounds", type=int, default=30)

@@ -75,6 +75,16 @@ class MatrelConfig:
       autotune_max_dim: shapes with max(n,k,m) above this are never
         measured inline (measuring allocates two square operands of
         that size); the cost model keeps those.
+      obs_level: query-lifecycle observability (matrel_tpu/obs/).
+        "off" (default — the bench config: zero event emission, zero
+        extra device syncs on the query path), "on" (one JSONL event
+        record per session query run + metrics registry updates; event
+        assembly happens outside jitted code), "analyze" (additionally
+        per-op wall-clock on every explain — equivalent to passing
+        ``analyze=True`` to ``session.explain``).
+      obs_event_log: JSONL event-log path (the Spark event-log
+        analogue). Empty → ".matrel_events.jsonl" in the working
+        directory. Read it back with ``python -m matrel_tpu history``.
     """
 
     block_size: int = 512
@@ -99,6 +109,20 @@ class MatrelConfig:
     autotune: bool = False
     autotune_table_path: str = ""
     autotune_max_dim: int = 8192
+    obs_level: str = "off"
+    obs_event_log: str = ""
+
+    def __post_init__(self):
+        # enablement is "anything != off", so an unvalidated typo/case
+        # variant ("OFF", "of") would silently switch the production
+        # query path onto the instrumented one — reject it at
+        # construction (case-insensitively normalised)
+        level = self.obs_level.lower()
+        if level not in ("off", "on", "analyze"):
+            raise ValueError(
+                f"obs_level must be one of 'off'/'on'/'analyze', "
+                f"got {self.obs_level!r}")
+        object.__setattr__(self, "obs_level", level)
 
     def replace(self, **kw: Any) -> "MatrelConfig":
         return dataclasses.replace(self, **kw)

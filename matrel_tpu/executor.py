@@ -17,6 +17,7 @@ mask padding where zeros would change the answer (max/min/avg/count).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -29,6 +30,7 @@ from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.ir import expr as expr_mod, rules
 from matrel_tpu.ir.expr import MatExpr, leaves as expr_leaves
 from matrel_tpu.parallel import planner, strategies
+from matrel_tpu.utils.profiling import annotate
 
 Array = jax.Array
 
@@ -72,9 +74,17 @@ def _diag_reduce(d: Array, kind: str) -> Array:
 class Lowerer:
     """Recursively lowers MatExpr nodes to jnp ops over padded arrays."""
 
-    def __init__(self, mesh: Mesh, config: MatrelConfig):
+    def __init__(self, mesh: Mesh, config: MatrelConfig,
+                 op_hook: Optional[Callable] = None):
         self.mesh = mesh
         self.config = config
+        # analyze-mode per-op wall-clock hook: callable(node, label,
+        # seconds), invoked after each node's lowering completes WITH a
+        # device sync. Only meaningful when the lowered function runs
+        # EAGERLY (obs/analyze.py) — inside a jit trace a perf_counter
+        # around tracing measures nothing, so compile_expr never sets
+        # it; the hot path stays sync-free (obs_level contract).
+        self.op_hook = op_hook
         # id(plan) -> (plan, measured SpMV executor variant "compact" |
         # "expanded"), populated at compile time by the autotune loop
         # (parallel/autotune.lookup_or_measure_spmv); empty = hand
@@ -109,18 +119,37 @@ class Lowerer:
 
         def fn(*leaf_arrays: Array):
             memo: Dict[int, Array] = {}
+            # analyze-mode bookkeeping: _eval recurses through ev, so a
+            # node's wall-clock window CONTAINS its children's — track
+            # child time per frame and report the EXCLUSIVE remainder
+            # (otherwise a depth-N tree reports ~N× the real runtime)
+            child_time = []
 
             def ev(node: MatExpr) -> Array:
                 if node.uid in memo:
                     return memo[node.uid]
-                # named scope per physical operator: the profiler-timeline
+                # annotate() per physical operator: the profiler-timeline
                 # visibility the reference gets from Spark stage names
-                # (SURVEY.md §5 "Tracing / profiling")
+                # (SURVEY.md §5 "Tracing / profiling"). EVERY node
+                # lowering dispatch must go through this one wrapped
+                # call — tests/test_obs.py structurally enforces it, so
+                # new ops can't silently skip instrumentation.
                 label = node.kind
                 if node.kind == "matmul":
                     label += ":" + node.attrs.get("strategy", "xla")
-                with jax.named_scope(f"matrel.{label}"):
+                if self.op_hook is not None:
+                    child_time.append(0.0)
+                    t0 = time.perf_counter()
+                with annotate(f"matrel.{label}"):
                     out = self._eval(node, ev, leaf_arrays, leaf_pos)
+                if self.op_hook is not None:
+                    jax.block_until_ready(out)
+                    dt = time.perf_counter() - t0
+                    spent_in_children = child_time.pop()
+                    if child_time:
+                        child_time[-1] += dt
+                    self.op_hook(node, label,
+                                 max(dt - spent_in_children, 0.0))
                 memo[node.uid] = out
                 return out
 
@@ -348,7 +377,7 @@ class Lowerer:
         tables ride the trace as committed device arrays and are hoisted
         into call-time args by _hoist_large_consts like any other
         payload constant."""
-        from jax import shard_map
+        from matrel_tpu.utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
         tables = pc.shard_compact_tables(plan, self.mesh)
         axes = tuple(self.mesh.axis_names)
@@ -776,6 +805,12 @@ class CompiledPlan:
     config: MatrelConfig
     extra_args: List = dataclasses.field(default_factory=list)
     _donating: Dict[tuple, Callable] = dataclasses.field(default_factory=dict)
+    #: compile-time observability record (obs/ event log + explain):
+    #: optimize_ms, trace_ms, rewrite-rule hit counts; per-matmul
+    #: planner decisions ("matmuls") are added lazily by
+    #: :func:`plan_matmul_decisions` so the obs-off compile path does
+    #: not pay for them.
+    meta: Dict = dataclasses.field(default_factory=dict)
 
     def run(self, bindings: Optional[Dict[int, BlockMatrix]] = None,
             donate: bool = False) -> BlockMatrix:
@@ -893,6 +928,7 @@ class MultiPlan:
     mesh: Mesh
     config: MatrelConfig
     extra_args: List = dataclasses.field(default_factory=list)
+    meta: Dict = dataclasses.field(default_factory=dict)
 
     def run(self, bindings: Optional[Dict[int, BlockMatrix]] = None
             ) -> Tuple[BlockMatrix, ...]:
@@ -927,9 +963,13 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
     for e in exprs:
         _check_one_mesh(e, mesh)
     grid = mesh_lib.mesh_grid_shape(mesh)
+    t0 = time.perf_counter()
+    rule_hits: Dict[str, int] = {}
     opts = tuple(planner.annotate_strategies(
-        rules.optimize(e, cfg, grid=grid, mesh=mesh), mesh, cfg)
+        rules.optimize(e, cfg, grid=grid, mesh=mesh, counts=rule_hits),
+        mesh, cfg)
         for e in exprs)
+    optimize_ms = (time.perf_counter() - t0) * 1e3
     leaf_order = []
     seen = set()
     for o in opts:
@@ -941,10 +981,14 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
     if cfg.autotune:
         low.spmv_choice = _autotune_spmv_choices(opts, mesh, cfg)
     fn = low.lower_multi(opts, leaf_order)
+    t1 = time.perf_counter()
     fn, extra = _hoist_large_consts(fn, _example_avals(leaf_order))
+    meta = {"optimize_ms": round(optimize_ms, 3),
+            "trace_ms": round((time.perf_counter() - t1) * 1e3, 3),
+            "rule_hits": rule_hits}
     return MultiPlan(jitted=jax.jit(fn), leaf_order=leaf_order,
                      optimized=opts, mesh=mesh, config=cfg,
-                     extra_args=extra)
+                     extra_args=extra, meta=meta)
 
 
 # Narrow-operand threshold for the COO SpMV dispatch. The planner's
@@ -1026,18 +1070,44 @@ def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
         mesh = lvs[0].attrs["matrix"].mesh if lvs else mesh_lib.make_mesh(
             cfg.mesh_shape, cfg.mesh_axis_names)
     _check_one_mesh(expr, mesh)
+    t0 = time.perf_counter()
+    rule_hits: Dict[str, int] = {}
     opt = rules.optimize(expr, cfg,
-                         grid=mesh_lib.mesh_grid_shape(mesh), mesh=mesh)
+                         grid=mesh_lib.mesh_grid_shape(mesh), mesh=mesh,
+                         counts=rule_hits)
     opt = planner.annotate_strategies(opt, mesh, cfg)
+    optimize_ms = (time.perf_counter() - t0) * 1e3
     leaf_order = expr_leaves(opt)
     low = Lowerer(mesh, cfg)
     if cfg.autotune:
         low.spmv_choice = _autotune_spmv_choices((opt,), mesh, cfg)
     fn = low.lower(opt, leaf_order)
+    t1 = time.perf_counter()
     fn, extra = _hoist_large_consts(fn, _example_avals(leaf_order))
     jitted = jax.jit(fn)
+    meta = {"optimize_ms": round(optimize_ms, 3),
+            "trace_ms": round((time.perf_counter() - t1) * 1e3, 3),
+            "rule_hits": rule_hits}
     return CompiledPlan(jitted=jitted, leaf_order=leaf_order, optimized=opt,
-                        mesh=mesh, config=cfg, extra_args=extra)
+                        mesh=mesh, config=cfg, extra_args=extra, meta=meta)
+
+
+def plan_matmul_decisions(plan) -> List[dict]:
+    """Per-matmul planner-decision records for a compiled plan (obs/
+    event log, ``explain(analyze=True)``), computed on FIRST access and
+    cached in ``plan.meta`` — deriving them re-walks the tree through
+    ``infer_layout``/``comm_cost``, work the obs-off compile path must
+    not pay for."""
+    meta = plan.meta
+    if meta is None:
+        return []
+    if "matmuls" not in meta:
+        roots = (plan.optimized if isinstance(plan.optimized, tuple)
+                 else (plan.optimized,))
+        meta["matmuls"] = [
+            d for o in roots
+            for d in planner.matmul_decisions(o, plan.mesh, plan.config)]
+    return meta["matmuls"]
 
 
 def execute(expr: MatExpr, mesh: Optional[Mesh] = None,

@@ -42,11 +42,18 @@ from matrel_tpu.ir.expr import (
 Rule = Callable[[MatExpr], Optional[MatExpr]]
 
 
-def _rewrite_bottom_up(e: MatExpr, rule: Rule) -> MatExpr:
-    new_children = tuple(_rewrite_bottom_up(c, rule) for c in e.children)
+def _rewrite_bottom_up(e: MatExpr, rule: Rule,
+                       counts: Optional[dict] = None) -> MatExpr:
+    new_children = tuple(_rewrite_bottom_up(c, rule, counts)
+                         for c in e.children)
     if any(nc is not oc for nc, oc in zip(new_children, e.children)):
         e = e.with_children(new_children)
     out = rule(e)
+    if out is not None and counts is not None:
+        # per-rule hit counter — the observability feed (obs/ event
+        # records carry these, the SparkListener rule-metrics analogue)
+        name = getattr(rule, "__name__", str(rule))
+        counts[name] = counts.get(name, 0) + 1
     return out if out is not None else e
 
 
@@ -243,12 +250,14 @@ _RULES: List[Rule] = [
 _MAX_ITERS = 10
 
 
-def apply_rewrites(e: MatExpr) -> MatExpr:
-    """Run the rule batch to fixpoint (bounded, Catalyst-style)."""
+def apply_rewrites(e: MatExpr,
+                   counts: Optional[dict] = None) -> MatExpr:
+    """Run the rule batch to fixpoint (bounded, Catalyst-style).
+    ``counts`` (optional) accumulates per-rule hit counts."""
     for _ in range(_MAX_ITERS):
         before = e
         for rule in _RULES:
-            e = _rewrite_bottom_up(e, rule)
+            e = _rewrite_bottom_up(e, rule, counts)
         if _same_structure(e, before):
             break
     return e
@@ -307,19 +316,29 @@ def common_subexpressions(e: MatExpr) -> MatExpr:
 
 
 def optimize(e: MatExpr, config: Optional[MatrelConfig] = None,
-             grid: tuple = (1, 1), mesh=None) -> MatExpr:
+             grid: tuple = (1, 1), mesh=None,
+             counts: Optional[dict] = None) -> MatExpr:
     """Full logical optimization: rewrites, chain-DP reorder, CSE.
     ``grid`` is the mesh grid shape — the chain DP's step cost then
     includes each candidate multiply's collective bill (comm-aware
     reorder); (1, 1) keeps the pure-FLOPs DP. ``mesh`` makes the bill
-    layout-aware (round 5): operand PartitionSpecs steer the reorder."""
+    layout-aware (round 5): operand PartitionSpecs steer the reorder.
+    ``counts`` (optional) accumulates per-rule hit counts plus a
+    ``chain_dp`` entry when the reorder restructured a chain — the
+    rewrite-metrics feed of the obs/ event log."""
     cfg = config or default_config()
     if cfg.rewrite_rules:
-        e = apply_rewrites(e)
+        e = apply_rewrites(e, counts)
     if cfg.chain_opt:
-        e = chain_lib.reorder_chains(e, grid, mesh, cfg)
+        reordered = chain_lib.reorder_chains(e, grid, mesh, cfg)
+        # structural comparison, not identity: reorder_chains rebuilds
+        # matmul nodes even when it keeps the original parenthesisation
+        if counts is not None and reordered is not e \
+                and not _same_structure(reordered, e):
+            counts["chain_dp"] = counts.get("chain_dp", 0) + 1
+        e = reordered
         if cfg.rewrite_rules:
-            e = apply_rewrites(e)  # reorder can expose new folds
+            e = apply_rewrites(e, counts)  # reorder can expose new folds
     if cfg.rewrite_rules:
         e = common_subexpressions(e)
     return e

@@ -1,0 +1,35 @@
+"""Query-lifecycle observability — the Spark UI / SparkListener analogue.
+
+The reference inherits Spark's entire observability stack: the UI's
+stage/task timelines, the JSON event log a history server replays, and
+accumulator counters (both papers report their strategy wins off those
+surfaces). This package is the TPU rebuild's equivalent, three layers:
+
+- :mod:`matrel_tpu.obs.metrics` — process-wide metrics registry
+  (counters / gauges / timing histograms; thread-safe, zero-dep), the
+  accumulator analogue. ``utils/profiling.StepTimer`` is a view over it.
+- :mod:`matrel_tpu.obs.events` — structured JSONL event log, the Spark
+  event-log analogue: ``MatrelSession`` emits one record per query run
+  (optimize/compile/execute phases, rewrite-rule hits, plan-cache
+  hit/miss/evictions, per-matmul planner decisions with estimated ICI
+  bytes + FLOPs); ``bench.py`` and ``tools/soak_guard.py`` emit theirs
+  into the same log.
+- :mod:`matrel_tpu.obs.analyze` + :mod:`matrel_tpu.obs.history` — the
+  debugging surfaces: ``session.explain(expr, analyze=True)`` renders
+  the physical tree with MEASURED per-op milliseconds next to the
+  planner's estimates, and ``python -m matrel_tpu history`` aggregates
+  an event-log file (the history-server analogue).
+
+Instrumentation is off-hot-path by contract: event assembly happens
+outside jitted code, per-op timing only under ``analyze=True``, and with
+``config.obs_level == "off"`` (the default) the query path takes zero
+extra syncs and appends zero events.
+"""
+
+from matrel_tpu.obs.events import EventLog, SCHEMA_VERSION, read_events
+from matrel_tpu.obs.metrics import MetricsRegistry, REGISTRY
+
+__all__ = [
+    "EventLog", "MetricsRegistry", "REGISTRY", "SCHEMA_VERSION",
+    "read_events",
+]

@@ -1,0 +1,139 @@
+"""Measured per-op plan analysis — ``session.explain(expr, analyze=True)``.
+
+The single most useful debugging surface the reference's Spark UI
+provides is the per-stage timeline next to the plan: which operator the
+time actually went to, compared against what the planner THOUGHT. This
+module is that surface for the TPU rebuild.
+
+How it measures: the compiled plan's optimized tree is lowered a second
+time with the executor's ``op_hook`` installed and run EAGERLY (no jit)
+— each physical node executes as its own dispatch, is synced
+(``block_until_ready``) and wall-clocked. Eager per-op times do not sum
+to the fused program's runtime (XLA fuses elementwise traffic into the
+matmuls — that is the point of the single-program executor), so the
+fused end-to-end time is measured too and printed alongside; the per-op
+column answers "where does the time go", the fused line answers "what
+does it cost in production". Strictly off-hot-path: nothing here runs
+unless analysis was explicitly requested.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Tuple
+
+import jax
+
+
+def measure_per_op(plan) -> Tuple[Dict[int, Tuple[str, float]], float]:
+    """Run the plan's physical tree once, eagerly, timing every node.
+
+    Returns ``(per_op, eager_total_s)`` where ``per_op`` maps node uid →
+    (label, seconds) — EXCLUSIVE of children (the executor's op_hook
+    subtracts time spent in child frames), so the per-op values sum to
+    roughly the eager total instead of multiplying it by tree depth.
+    Shared DAG nodes execute (and are timed) once, like in the real
+    executor's memo. Autotune SpMV reroutes are not re-derived
+    here — analysis times the hand-default dispatches.
+    """
+    from matrel_tpu import executor as executor_lib
+
+    per_op: Dict[int, Tuple[str, float]] = {}
+
+    def hook(node, label, seconds):
+        per_op[node.uid] = (label, seconds)
+
+    low = executor_lib.Lowerer(plan.mesh, plan.config, op_hook=hook)
+    roots = (plan.optimized if isinstance(plan.optimized, tuple)
+             else (plan.optimized,))
+    fn = low.lower_multi(roots, plan.leaf_order)
+    arrays = [l.attrs["matrix"].data for l in plan.leaf_order]
+    t0 = time.perf_counter()
+    out = fn(*arrays)
+    jax.block_until_ready(out)
+    return per_op, time.perf_counter() - t0
+
+
+def measure_fused(plan) -> float:
+    """End-to-end seconds for ONE synced run of the real jitted program
+    (warmed first so the number is execution, not XLA compilation)."""
+    arrays = [l.attrs["matrix"].data for l in plan.leaf_order]
+    jax.block_until_ready(plan.jitted(*arrays, *plan.extra_args))
+    t0 = time.perf_counter()
+    jax.block_until_ready(plan.jitted(*arrays, *plan.extra_args))
+    return time.perf_counter() - t0
+
+
+def _fmt_bytes(b) -> str:
+    if b is None:
+        return "?"
+    b = float(b)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if b < 1024.0 or unit == "GiB":
+            return f"{b:.1f}{unit}"
+        b /= 1024.0
+    return f"{b:.1f}GiB"
+
+
+def render(plan, per_op: Dict[int, Tuple[str, float]],
+           fused_s: float) -> str:
+    """Physical tree annotated with measured per-op milliseconds and,
+    per matmul, the planner's choice + its estimated ICI bytes/FLOPs —
+    measured-vs-estimated on one screen."""
+    from matrel_tpu import executor as executor_lib
+    decisions = {d["uid"]: d
+                 for d in executor_lib.plan_matmul_decisions(plan)
+                 if "uid" in d}
+    lines = ["== Analyzed physical plan (per-op measured, eager) =="]
+    printed = set()
+
+    def walk(n, indent):
+        pad = "  " * indent
+        extra = ""
+        if n.kind == "matmul":
+            extra = f" strategy={n.attrs.get('strategy', 'xla')}"
+            if "strategy_source" in n.attrs:
+                extra += f"[{n.attrs['strategy_source']}]"
+        elif n.kind == "elemwise":
+            extra = f" op={n.attrs['op']}"
+        elif n.kind == "scalar":
+            extra = f" op={n.attrs['op']} v={n.attrs['value']}"
+        elif n.kind == "agg":
+            extra = f" {n.attrs['agg']}/{n.attrs['axis']}"
+        elif n.kind in ("join_rows", "join_cols") \
+                and "replicate" in n.attrs:
+            extra = f" replicate={n.attrs['replicate']}"
+        timed = per_op.get(n.uid)
+        if n.uid in printed:
+            lines.append(f"{pad}{n.kind}{extra} shape={n.shape} "
+                         f"(shared — timed above)")
+            return
+        printed.add(n.uid)
+        ms = f" [{timed[1] * 1e3:.3f} ms]" if timed else ""
+        line = f"{pad}{n.kind}{extra} shape={n.shape}{ms}"
+        d = decisions.get(n.uid)
+        if d is not None:
+            if d.get("est_ici_bytes") is not None:
+                line += (f" est_ici={_fmt_bytes(d['est_ici_bytes'])}"
+                         f" flops={d['flops']:.3g}")
+            elif d.get("dispatch"):
+                line += f" dispatch={d['dispatch']} flops={d['flops']:.3g}"
+        lines.append(line)
+        for c in n.children:
+            walk(c, indent + 1)
+
+    roots = (plan.optimized if isinstance(plan.optimized, tuple)
+             else (plan.optimized,))
+    for r in roots:
+        walk(r, 0)
+    eager_total = sum(s for _, s in per_op.values())
+    lines.append(f"== Eager per-op total: {eager_total * 1e3:.3f} ms; "
+                 f"fused program: {fused_s * 1e3:.3f} ms ==")
+    return "\n".join(lines)
+
+
+def explain_analyzed(plan) -> str:
+    """The full analyze block for ``session.explain(analyze=True)``."""
+    per_op, _eager = measure_per_op(plan)
+    fused = measure_fused(plan)
+    return render(plan, per_op, fused)

@@ -1,0 +1,135 @@
+"""Structured JSONL event log — the Spark event-log analogue.
+
+One line per event, append-only, schema-versioned. ``MatrelSession``
+emits one ``query`` record per run; ``bench.py`` emits ``bench`` records
+and ``tools/soak_guard.py`` ``soak`` records into the same file, so one
+log replays the whole history of a host (the history-server input —
+``python -m matrel_tpu history`` aggregates it).
+
+Writing discipline mirrors the repo's other append-only logs
+(PROGRESS.jsonl, SOAKLOG.jsonl): a single ``write()`` of one line per
+event (atomic for sane line sizes on POSIX), emission failures are
+swallowed after a one-time warning — observability must never fail a
+query — and every record carries ``schema`` + ``ts`` so readers can
+filter and migrate.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Iterator, List, Optional
+
+log = logging.getLogger("matrel_tpu.obs")
+
+#: Bump when a reader-visible field changes meaning. Readers skip
+#: records with a MAJOR version they don't know.
+SCHEMA_VERSION = 1
+
+#: Default log file (cwd-relative, like the autotune table's default).
+DEFAULT_EVENT_LOG = ".matrel_events.jsonl"
+
+
+def resolve_path(path: Optional[str]) -> str:
+    """Config value → concrete path ('' / None → the default name)."""
+    return path or DEFAULT_EVENT_LOG
+
+
+class EventLog:
+    """Append-only JSONL writer. ``emit`` stamps schema/ts/kind and
+    writes one line; it never raises (a broken disk must not break the
+    query that happened to be observed)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = resolve_path(path)
+        self._warned = False
+
+    def emit(self, kind: str, record: dict) -> Optional[dict]:
+        """Append one event. Returns the full record as written, or
+        None when the write failed (already logged)."""
+        full = {"schema": SCHEMA_VERSION, "ts": round(time.time(), 3),
+                "kind": kind}
+        full.update(record)
+        try:
+            line = json.dumps(full, default=_jsonable)
+        except (TypeError, ValueError) as e:
+            self._warn(f"unserialisable event dropped: {e}")
+            return None
+        try:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        except OSError as e:
+            self._warn(f"could not append to {self.path}: {e}")
+            return None
+        return full
+
+    def _warn(self, msg: str) -> None:
+        if not self._warned:
+            log.warning("event log: %s (further failures silenced)", msg)
+            self._warned = True
+
+
+def _jsonable(v):
+    """Last-resort encoder: numpy scalars/arrays and anything else that
+    slipped into a record become plain Python or a repr string."""
+    tolist = getattr(v, "tolist", None)
+    if callable(tolist):
+        try:
+            return tolist()
+        except Exception:
+            pass
+    item = getattr(v, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:
+            pass
+    return repr(v)
+
+
+def emit_tool_event(kind: str, record: dict,
+                    anchor_dir: Optional[str] = None) -> Optional[dict]:
+    """Emission entry point for out-of-session tools (bench.py,
+    tools/soak_guard.py): resolves the log path from
+    ``$MATREL_OBS_EVENT_LOG``, else the default log name anchored at
+    ``anchor_dir`` (typically the repo root, so tool records land in
+    the same file regardless of cwd). Same never-raises contract as
+    :meth:`EventLog.emit`."""
+    path = os.environ.get("MATREL_OBS_EVENT_LOG")
+    if not path and anchor_dir:
+        path = os.path.join(anchor_dir, DEFAULT_EVENT_LOG)
+    return EventLog(path).emit(kind, record)
+
+
+def read_events(path: Optional[str] = None,
+                kinds: Optional[tuple] = None) -> List[dict]:
+    """Parse an event-log file. Unparseable lines and unknown schema
+    versions are skipped (a reader must survive a log written by a
+    crashed process mid-line). Missing file → empty list."""
+    out: List[dict] = []
+    for rec in iter_events(path):
+        if kinds is None or rec.get("kind") in kinds:
+            out.append(rec)
+    return out
+
+
+def iter_events(path: Optional[str] = None) -> Iterator[dict]:
+    p = resolve_path(path)
+    if not os.path.exists(p):
+        return
+    with open(p) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if rec.get("schema") != SCHEMA_VERSION:
+                continue
+            yield rec

@@ -1,0 +1,127 @@
+"""Event-log aggregation — the history-server analogue.
+
+``python -m matrel_tpu history [--last N] [--summary] [--log PATH]``
+replays a JSONL event log (obs/events.py) into per-query and
+per-strategy tables, the way the reference's Spark history server
+replays an event log into the UI. Plain text out; no state kept.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from matrel_tpu.obs.events import read_events, resolve_path
+
+
+def _fmt(v, nd=2) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render_queries(events: List[dict], last: Optional[int] = None) -> str:
+    """Per-query table (most recent last), one row per query record."""
+    qs = [e for e in events if e.get("kind") == "query"]
+    if last is not None:
+        # qs[-0:] would be the WHOLE list — 0 must mean "none"
+        qs = qs[-last:] if last > 0 else []
+    if not qs:
+        return "no query events"
+    header = (f"{'query_id':<18}{'src':<5}{'cache':<6}{'opt_ms':>8}"
+              f"{'exec_ms':>9}  {'strategies':<22}{'out_shape'}")
+    lines = [header, "-" * len(header)]
+    for e in qs:
+        strats = ",".join(sorted({d.get("strategy", "?")
+                                  for d in e.get("matmuls", [])})) or "-"
+        shape = "x".join(str(s) for s in e.get("out_shape", [])) or "-"
+        lines.append(
+            f"{e.get('query_id', '?'):<18}{e.get('source', '?'):<5}"
+            f"{e.get('cache', '?'):<6}{_fmt(e.get('optimize_ms')):>8}"
+            f"{_fmt(e.get('execute_ms')):>9}  {strats:<22}{shape}")
+    return "\n".join(lines)
+
+
+def summarize(events: List[dict]) -> dict:
+    """Aggregate a log into the per-query / per-strategy roll-up the
+    papers' strategy-win tables come from."""
+    qs = [e for e in events if e.get("kind") == "query"]
+    hits = sum(1 for e in qs if e.get("cache") == "hit")
+    exec_ms = [e["execute_ms"] for e in qs
+               if isinstance(e.get("execute_ms"), (int, float))]
+    strategies: Dict[str, dict] = {}
+    rule_hits: Dict[str, int] = {}
+    for e in qs:
+        for d in e.get("matmuls", []):
+            s = strategies.setdefault(
+                d.get("strategy", "?"),
+                {"count": 0, "flops": 0.0, "est_ici_bytes": 0.0})
+            s["count"] += 1
+            if isinstance(d.get("flops"), (int, float)):
+                s["flops"] += d["flops"]
+            if isinstance(d.get("est_ici_bytes"), (int, float)):
+                s["est_ici_bytes"] += d["est_ici_bytes"]
+        for rule, n in (e.get("rule_hits") or {}).items():
+            rule_hits[rule] = rule_hits.get(rule, 0) + int(n)
+    last_cache = qs[-1].get("plan_cache", {}) if qs else {}
+    return {
+        "queries": len(qs),
+        "cache_hits": hits,
+        "cache_hit_rate": round(hits / len(qs), 3) if qs else None,
+        "execute_ms_total": round(sum(exec_ms), 3),
+        "execute_ms_mean": (round(sum(exec_ms) / len(exec_ms), 3)
+                            if exec_ms else None),
+        "plan_cache": last_cache,
+        "strategies": strategies,
+        "rule_hits": rule_hits,
+        "bench_runs": sum(1 for e in events if e.get("kind") == "bench"),
+        "soak_runs": sum(1 for e in events if e.get("kind") == "soak"),
+    }
+
+
+def render_summary(events: List[dict]) -> str:
+    s = summarize(events)
+    lines = [
+        f"queries: {s['queries']}  cache hit rate: "
+        f"{_fmt(s['cache_hit_rate'], 3)}  "
+        f"(evicted: {s['plan_cache'].get('evicted', 0)})",
+        f"execute_ms: total {_fmt(s['execute_ms_total'])}  "
+        f"mean {_fmt(s['execute_ms_mean'])}",
+        f"other events: bench={s['bench_runs']} soak={s['soak_runs']}",
+    ]
+    if s["strategies"]:
+        lines.append("")
+        header = (f"{'strategy':<12}{'matmuls':>8}{'GFLOPs':>10}"
+                  f"{'est ICI MiB':>13}")
+        lines += [header, "-" * len(header)]
+        for name in sorted(s["strategies"],
+                           key=lambda k: -s["strategies"][k]["count"]):
+            d = s["strategies"][name]
+            lines.append(f"{name:<12}{d['count']:>8}"
+                         f"{d['flops'] / 1e9:>10.2f}"
+                         f"{d['est_ici_bytes'] / 2**20:>13.2f}")
+    if s["rule_hits"]:
+        lines.append("")
+        lines.append("rewrite-rule hits: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(s["rule_hits"].items())))
+    return "\n".join(lines)
+
+
+def main(args) -> int:
+    """CLI backend for ``python -m matrel_tpu history``. Path
+    precedence matches the writers: ``--log`` beats
+    ``$MATREL_OBS_EVENT_LOG`` beats the cwd default — so the reader
+    aimed at a host follows the same env var its tools emit under."""
+    import os
+    path = resolve_path(args.log or os.environ.get("MATREL_OBS_EVENT_LOG"))
+    events = read_events(path)
+    if not events:
+        print(f"no events in {path}")
+        return 0
+    print(f"# {len(events)} event(s) in {path}")
+    if args.summary:
+        print(render_summary(events))
+    else:
+        print(render_queries(events, last=args.last))
+    return 0

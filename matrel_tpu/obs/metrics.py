@@ -1,0 +1,163 @@
+"""Metrics registry — counters, gauges and timing histograms.
+
+The accumulator/metrics-system analogue of the reference (Spark
+accumulators + the metrics registry the UI reads). Thread-safe and
+dependency-free: the session, planner and executor record into the
+process registry; ``snapshot()`` is the read surface (the event log
+embeds slices of it, ``StepTimer.table()`` renders from it).
+
+Design constraints, in order: recording must be cheap (a lock + a few
+float ops — it runs once per QUERY, never per element, and never inside
+jitted code), values must be aggregatable after the fact (histograms
+keep count/total/min/max plus a bounded reservoir of recent samples,
+not an unbounded list), and names are plain dotted strings so the log
+stays greppable (``plan_cache.hit``, ``query.execute_ms``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+#: Bounded sample memory per histogram: enough for percentile estimates
+#: over a recent window without letting a long-lived server grow a list
+#: per metric forever.
+_RESERVOIR = 512
+
+
+class Counter:
+    """Monotonic accumulator (the Spark accumulator analogue)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self._value = 0.0
+
+    def inc(self, value: float = 1.0) -> None:
+        with self._lock:
+            self._value += value
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (e.g. cache occupancy)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Timing/size distribution: count, total, min, max + a bounded
+    ring of recent samples for percentile estimates."""
+
+    __slots__ = ("_lock", "count", "total", "min", "max", "_ring", "_i")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._ring: List[float] = []
+        self._i = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            if len(self._ring) < _RESERVOIR:
+                self._ring.append(v)
+            else:
+                self._ring[self._i] = v
+                self._i = (self._i + 1) % _RESERVOIR
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 1], over the bounded recent window (not all-time)."""
+        with self._lock:
+            window = sorted(self._ring)
+        if not window:
+            return 0.0
+        idx = min(int(q * len(window)), len(window) - 1)
+        return window[idx]
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {"count": self.count,
+                    "total": round(self.total, 6),
+                    "mean": round(self.mean, 6),
+                    "min": self.min, "max": self.max}
+
+
+class MetricsRegistry:
+    """Name → metric map; one lock per registry (recording is per-query,
+    not per-element — contention is irrelevant at that rate and a single
+    lock keeps snapshot() consistent)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(self._lock)
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(self._lock)
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(self._lock)
+        return h
+
+    def snapshot(self) -> dict:
+        """Plain-dict view of every metric — JSON-ready."""
+        with self._lock:
+            counters = {k: c._value for k, c in self._counters.items()}
+            gauges = {k: g._value for k, g in self._gauges.items()}
+            hists = list(self._histograms.items())
+        return {"counters": counters, "gauges": gauges,
+                "histograms": {k: h.summary() for k, h in hists}}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+#: Process-wide default registry — what the session and StepTimer use
+#: unless handed a private one.
+REGISTRY = MetricsRegistry()

@@ -18,6 +18,8 @@ in the MXU via preferred_element_type.
 from __future__ import annotations
 
 import jax
+
+from matrel_tpu.utils import compat
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -136,7 +138,7 @@ def make_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg: MatrelConfig,
         _make_kernel(precision, nnzb),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((gr * bs, pm), out_dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )

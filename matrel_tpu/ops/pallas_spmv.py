@@ -34,6 +34,8 @@ from __future__ import annotations
 import functools
 
 import jax
+
+from matrel_tpu.utils import compat
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
@@ -91,7 +93,7 @@ def _compact_runner(nb: int, cap: int, block: int, lo: int, passes: int,
         ],
         out_specs=pl.BlockSpec((1, hi_n, lo), lambda b: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((nb, hi_n, lo), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )
@@ -290,7 +292,7 @@ def compact_sharded_specs(axes, n_ov: int):
 @functools.lru_cache(maxsize=32)
 def _compact_sharded_runner(plan_static, mesh, passes: int, n_ov: int,
                             interpret: bool):
-    from jax import shard_map
+    from matrel_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     axes = tuple(mesh.axis_names)
@@ -375,7 +377,7 @@ def _compact_runner_k(nb: int, cap: int, block: int, lo: int,
         ],
         out_specs=pl.BlockSpec((1, hi_n, k * lo), lambda b: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((nb, hi_n, k * lo), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )

@@ -133,7 +133,7 @@ def shard_block_sparse(S: BlockSparseMatrix,
 @functools.lru_cache(maxsize=32)
 def _sharded_spmm_runner(mesh, bs: int, gc: int, rows_per_dev: int,
                          cap: int, pm: int, out_pshape, precision):
-    from jax import shard_map
+    from matrel_tpu.utils.compat import shard_map
 
     axes = tuple(mesh.axis_names)
 

@@ -446,7 +446,7 @@ def spmm_sharded_apply(plan_static, arrays, extra, X: jax.Array,
 
 @functools.lru_cache(maxsize=32)
 def _sharded_spmm_runner(plan_static, mesh, has_overflow: bool):
-    from jax import shard_map
+    from matrel_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     axes = tuple(mesh.axis_names)
@@ -551,7 +551,7 @@ def sharded_table_specs(axes, n_arrays: int):
 
 @functools.lru_cache(maxsize=32)
 def _sharded_spmv_runner(plan_static, mesh, has_overflow: bool):
-    from jax import shard_map
+    from matrel_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     axes = tuple(mesh.axis_names)

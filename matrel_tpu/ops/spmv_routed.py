@@ -61,6 +61,8 @@ import functools
 from typing import Optional
 
 import jax
+
+from matrel_tpu.utils import compat
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
@@ -317,7 +319,7 @@ def _routed_runner(g_s: int, g_d: int, cap: int, passes: int,
         out_specs=pl.BlockSpec(cell, lambda gs, gd: (gs, gd, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((g_s, g_d, cap_r, LANE),
                                        jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )
@@ -333,7 +335,7 @@ def _routed_runner(g_s: int, g_d: int, cap: int, passes: int,
         out_specs=pl.BlockSpec((1, LANE, LANE), lambda gd, gs: (gd, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((g_d, LANE, LANE), jnp.float32),
         scratch_shapes=[pltpu.VMEM((LANE, LANE), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )

@@ -57,13 +57,37 @@ def _table_key(side: int, gx: int, gy: int, dtype: str) -> str:
 
 def load_table(path: str) -> Dict[str, dict]:
     """Persisted {key: {"best": strategy, "times": {...}}} or {}.
-    A corrupt/absent file is an empty table, never an error."""
+    A corrupt/absent file is an empty table, never an error.
+
+    Tables written BEFORE the backend key suffix landed are migrated on
+    load by PRUNING their un-suffixed entries (advisor r5 low): those
+    keys can never hit again — `_table_key`/`_spmv_key` always emit the
+    suffixed form — so left in place they would ride every whole-table
+    rewrite forever as dead bytes. Dropping them here means the next
+    `_persist` rewrites a clean table; the one-time re-measure cost of
+    the orphaned winners is the accepted price of backend-safe keys."""
     try:
         with open(path) as f:
             t = json.load(f)
-        return t if isinstance(t, dict) else {}
     except (OSError, ValueError):
         return {}
+    if not isinstance(t, dict):
+        return {}
+    return {k: v for k, v in t.items() if _current_key_format(k)}
+
+
+def _current_key_format(key: str) -> bool:
+    """Does a persisted key match the CURRENT (backend-suffixed) key
+    formats? Matmul keys are ``side|gxXgy|dtype|backend`` (4 fields);
+    SpMV keys ``spmv|backend|rows x cols|nb|cap|blk|grid`` (7 fields).
+    Legacy un-suffixed entries (one field short) and anything unknown
+    read as stale."""
+    if not isinstance(key, str):
+        return False
+    n = key.count("|") + 1
+    if key.startswith("spmv|"):
+        return n == 7
+    return n == 4
 
 
 _TABLE_CACHE: Dict[str, Tuple[float, Dict[str, dict]]] = {}

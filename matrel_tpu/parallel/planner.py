@@ -217,7 +217,18 @@ def infer_layout(node: MatExpr, mesh: Mesh,
         if k == "leaf":
             return _layout_of(n, mesh)
         if k == "matmul":
-            if _coo_narrow_matmul(n):
+            # the lowering IGNORES the stamped strategy for sparse_leaf
+            # matmuls (the SpMM path) and for wide/refused COO matmuls
+            # (densify path runs hard-coded "xla") — consulting
+            # STRATEGY_OUT_LAYOUT there claimed a "row"/"col" the
+            # executor never produces, an unearned free-consume credit
+            # (advisor r5 medium). Free-ness is only claimed where the
+            # lowering pins it: both off-strategy dispatches read "2d".
+            if any(c.kind == "sparse_leaf" for c in n.children):
+                return "2d"
+            if any(c.kind == "coo_leaf" for c in n.children):
+                if not _coo_narrow_matmul(n):
+                    return "2d"          # densify path: hard-coded xla
                 from matrel_tpu.config import pallas_enabled
                 # "rep" only where the lowering PINS it: single device,
                 # or the compact sharded path (out_specs=P()) is
@@ -782,3 +793,56 @@ def annotate_strategies(e: MatExpr, mesh: Mesh,
     infer_dtype(e, config, memo)     # seed this (possibly new-uid) node
     infer_layout(e, mesh, lmemo, config)
     return e
+
+
+def matmul_decisions(root: MatExpr, mesh: Mesh,
+                     config: Optional[MatrelConfig] = None) -> list:
+    """Per-matmul planner-decision records for an ANNOTATED plan — the
+    observability feed (obs/ event log, explain(analyze=True)): for
+    every matmul node, the chosen strategy, WHY (strategy_source), the
+    operand layouts the choice saw, the model's estimated per-device
+    ICI bytes for that strategy under those layouts, and the multiply's
+    FLOPs. Pure read — never re-chooses; shared DAG nodes appear once.
+    Dispatches the byte model ignores (sparse/COO fast paths) are
+    tagged ``dispatch`` so readers don't attribute ICI estimates to
+    lowerings that bypass the strategy."""
+    cfg = config or default_config()
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    lmemo: dict = {}
+    out: list = []
+    seen: set = set()
+
+    def walk(n: MatExpr):
+        if n.uid in seen:
+            return
+        seen.add(n.uid)
+        for c in n.children:
+            walk(c)
+        if n.kind != "matmul":
+            return
+        a, b = n.children
+        nn, kk = a.shape
+        mm = b.shape[1]
+        rec = {"uid": n.uid, "dims": [nn, kk, mm],
+               "strategy": n.attrs.get("strategy", "xla"),
+               "source": n.attrs.get("strategy_source", "unknown"),
+               "flops": 2.0 * nn * kk * mm}
+        if any(c.kind == "sparse_leaf" for c in n.children):
+            rec["dispatch"] = "spmm"
+        elif any(c.kind == "coo_leaf" for c in n.children):
+            rec["dispatch"] = ("coo_spmv" if _coo_narrow_matmul(n)
+                               else "densify")
+        else:
+            la = infer_layout(a, mesh, lmemo, cfg)
+            lb = infer_layout(b, mesh, lmemo, cfg)
+            rec["layouts"] = [la, lb]
+            try:
+                rec["est_ici_bytes"] = comm_cost(
+                    rec["strategy"], nn, kk, mm, a.density, b.density,
+                    gx, gy, a_layout=la, b_layout=lb)
+            except ValueError:       # an override string the model
+                rec["est_ici_bytes"] = None   # doesn't know
+        out.append(rec)
+
+    walk(root)
+    return out

@@ -34,9 +34,11 @@ import functools
 from typing import Optional
 
 import jax
+
+from matrel_tpu.utils import compat
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from matrel_tpu.utils.compat import shard_map
 
 from matrel_tpu.config import MatrelConfig, default_config
 
@@ -206,7 +208,7 @@ def matmul_summa(a: jax.Array, b: jax.Array, mesh: Mesh,
         if pcast is not None:
             acc0 = pcast(acc0, (x, y), to="varying")
         else:
-            acc0 = jax.lax.pvary(acc0, (x, y))
+            acc0 = compat.pvary(acc0, (x, y))
         if g == 1:
             return _local_dot(ab, bb, prec, out_dtype)
         _, _, acc = jax.lax.fori_loop(0, g, step, (ab, bb, acc0))

@@ -13,7 +13,10 @@ analogue).
 from __future__ import annotations
 
 import hashlib
+import itertools
 import logging
+import os
+import time
 import types
 from collections import OrderedDict
 from typing import Optional, Tuple
@@ -30,6 +33,8 @@ from matrel_tpu.ir.expr import MatExpr, as_expr
 log = logging.getLogger("matrel_tpu")
 
 _active: Optional["MatrelSession"] = None
+
+_query_seq = itertools.count()
 
 
 class MatrelSession:
@@ -49,6 +54,8 @@ class MatrelSession:
         self._plan_cache: "OrderedDict[str, executor_lib.CompiledPlan]" \
             = OrderedDict()
         self._plan_cache_bytes = 0
+        self._plan_cache_evicted = 0
+        self._event_log = None      # lazily built (obs_level != "off")
 
     # -- builder (MatfastSession.builder().getOrCreate() analogue) ---------
 
@@ -145,12 +152,18 @@ class MatrelSession:
     # -- actions ------------------------------------------------------------
 
     def compile(self, expr: MatExpr) -> executor_lib.CompiledPlan:
-        e = as_expr(expr)
+        return self._compile_entry(as_expr(expr))[0]
+
+    def _compile_entry(self, e: MatExpr
+                       ) -> Tuple[executor_lib.CompiledPlan, bool, str]:
+        """(plan, cache_hit, key) — the compile path with its cache
+        outcome exposed, so compute() can emit hit/miss events without
+        a second key computation."""
         key, pins = _plan_key(e)
         plan = self._plan_cache.get(key)
         if plan is not None:
             self._plan_cache.move_to_end(key)
-            return plan
+            return plan, True, key
         plan = executor_lib.compile_expr(e, self.mesh, self.config)
         # pin every id()-keyed object on the cached plan: a garbage-
         # collected object's address can be REUSED by CPython, and a
@@ -163,7 +176,7 @@ class MatrelSession:
         self._plan_cache[key] = plan
         self._plan_cache_bytes += _plan_bytes(plan)
         self._evict_plans()
-        return plan
+        return plan, False, key
 
     def _evict_plans(self) -> None:
         """Drop least-recently-used plans past the config bounds. The
@@ -178,35 +191,148 @@ class MatrelSession:
                 break    # never evict the sole (just-inserted) plan
             _, old = self._plan_cache.popitem(last=False)
             self._plan_cache_bytes -= _plan_bytes(old)
+            self._plan_cache_evicted += 1
         self._plan_cache_bytes = max(self._plan_cache_bytes, 0)
 
     def plan_cache_info(self) -> dict:
-        """Cache observability: entry count + pinned hoisted bytes."""
+        """Cache observability: entry count + pinned hoisted bytes +
+        lifetime eviction count."""
         return {"plans": len(self._plan_cache),
-                "hoisted_bytes": self._plan_cache_bytes}
+                "hoisted_bytes": self._plan_cache_bytes,
+                "evicted": self._plan_cache_evicted}
+
+    # -- observability (obs/ — the SparkListener analogue) ------------------
+
+    def _obs_enabled(self) -> bool:
+        return self.config.obs_level != "off"
+
+    def _obs_event_log(self):
+        from matrel_tpu.obs.events import EventLog, resolve_path
+        path = resolve_path(self.config.obs_event_log)
+        if self._event_log is None or self._event_log.path != path:
+            self._event_log = EventLog(path)
+        return self._event_log
+
+    def _emit_query_event(self, e: MatExpr, plan, hit: bool, key: str,
+                          execute_ms: float, first_execution: bool,
+                          out: BlockMatrix) -> None:
+        """One event-log record + metrics-registry updates per query run.
+        Assembled entirely OUTSIDE jitted code, from data the compile
+        path already produced (plan.meta) — the only device sync the obs
+        path adds is the one execute-time block in compute()."""
+        from matrel_tpu.obs.metrics import REGISTRY
+        meta = plan.meta or {}
+        matmuls = executor_lib.plan_matmul_decisions(plan)
+        sql_hash = getattr(e, "_sql_hash", None)
+        record = {
+            "query_id": f"q{os.getpid()}-{next(_query_seq)}",
+            "source": "sql" if sql_hash else "dsl",
+            "source_hash": sql_hash
+            or hashlib.sha1(key.encode()).hexdigest()[:16],
+            "root_kind": e.kind,
+            "cache": "hit" if hit else "miss",
+            "optimize_ms": meta.get("optimize_ms"),
+            "trace_ms": meta.get("trace_ms"),
+            # compile-scoped: a cache hit ran no rewrite rules, so hit
+            # records carry {} and history's roll-up counts real
+            # optimizer work (optimize_ms/trace_ms DO repeat on hits —
+            # they describe the plan, "cache" says no compile ran)
+            "rule_hits": {} if hit else meta.get("rule_hits", {}),
+            "matmuls": matmuls,
+            "execute_ms": round(execute_ms, 3),
+            "first_execution": first_execution,
+            "out_shape": list(out.shape),
+            "out_nnz": out.nnz,
+            "plan_cache": self.plan_cache_info(),
+        }
+        self._obs_event_log().emit("query", record)
+        REGISTRY.counter("query.count").inc()
+        REGISTRY.counter("plan_cache.hit" if hit
+                         else "plan_cache.miss").inc()
+        REGISTRY.gauge("plan_cache.plans").set(len(self._plan_cache))
+        REGISTRY.gauge("plan_cache.hoisted_bytes").set(
+            self._plan_cache_bytes)
+        REGISTRY.gauge("plan_cache.evicted").set(
+            self._plan_cache_evicted)
+        REGISTRY.histogram("query.execute_ms").observe(execute_ms)
+        if not hit:
+            if meta.get("optimize_ms") is not None:
+                REGISTRY.histogram("query.optimize_ms").observe(
+                    meta["optimize_ms"])
+            # compile-scoped like optimize_ms: rules fire once per
+            # compile, not per run
+            for rule, n in meta.get("rule_hits", {}).items():
+                REGISTRY.counter(f"optimizer.rule.{rule}").inc(n)
+        for d in matmuls:
+            REGISTRY.counter(f"planner.strategy.{d['strategy']}").inc()
 
     def compute(self, expr: MatExpr) -> BlockMatrix:
-        return self.compile(expr).run()
+        e = as_expr(expr)
+        if not self._obs_enabled():
+            # the production path: zero event assembly, zero extra
+            # device syncs (the obs_level="off" contract bench.py
+            # relies on)
+            return self.compile(e).run()
+        plan, hit, key = self._compile_entry(e)
+        first = not getattr(plan, "_obs_executed", False)
+        t0 = time.perf_counter()
+        out = plan.run()
+        out.data.block_until_ready()
+        execute_ms = (time.perf_counter() - t0) * 1e3
+        plan._obs_executed = True
+        try:
+            self._emit_query_event(e, plan, hit, key, execute_ms, first,
+                                   out)
+        except Exception:   # the result is already computed — keep the
+            # never-fail-a-query contract (obs/events.py) even when
+            # record ASSEMBLY breaks, not just the file write
+            log.warning("obs: query event dropped", exc_info=True)
+        return out
 
-    def explain(self, expr: MatExpr, physical: bool = True) -> str:
+    # alias: the reference's Dataset actions read as "run the query"
+    run = compute
+
+    def explain(self, expr: MatExpr, physical: bool = True,
+                analyze: bool = False) -> str:
         """Logical, optimized AND physical plan text. With ``physical``
         (default) the expression is compiled (cached — a following
         compute() reuses the plan), so the optimized section carries
         the chosen matmul strategies / join schemes and a collectives
         summary — the reference's EXPLAIN shows its physical operators
-        the same way. ``physical=False`` skips compilation."""
+        the same way. ``physical=False`` skips compilation.
+
+        ``analyze=True`` (or ``config.obs_level == "analyze"``) RUNS
+        the plan once per-op (eager, each node synced and wall-clocked)
+        plus once fused, and appends the measured tree — per-op
+        milliseconds next to each matmul's chosen strategy and the
+        model's estimated ICI bytes (obs/analyze.py; the reference's
+        Spark-UI stage-timeline-next-to-plan view). Off-hot-path by
+        construction: nothing is measured unless asked."""
         e = as_expr(expr)
         if not physical:
+            if analyze:
+                # contradictory ask: measuring requires a compiled plan
+                # (the config-level "analyze" default just degrades)
+                raise ValueError(
+                    "explain(analyze=True) requires physical=True")
             return e.explain(self.config)
         from matrel_tpu.ir.expr import pretty
         head = "== Logical plan ==\n" + pretty(e)
         try:
-            return head + "\n" + self.compile(e).explain()
+            plan = self.compile(e)
+            text = head + "\n" + plan.explain()
         except Exception as ex:  # EXPLAIN must not fail on exotic plans
             # fall back to the PRE-COMPUTED logical text only: when the
             # failure happened inside optimize(), e.explain() would
             # re-run the optimizer and re-raise the same exception
             return head + f"\n== Physical plan unavailable: {ex!r} =="
+        if analyze or self.config.obs_level == "analyze":
+            from matrel_tpu.obs import analyze as analyze_mod
+            try:
+                text += "\n" + analyze_mod.explain_analyzed(plan)
+            except Exception as ex:   # analysis must not fail EXPLAIN
+                text += f"\n== Analysis unavailable: {ex!r} =="
+        return text
 
     def sql(self, query: str) -> MatExpr:
         """SQL-ish entry point over registered matrix tables (the reference's
@@ -214,10 +340,12 @@ class MatrelSession:
         from matrel_tpu.sql import parse_sql
         return parse_sql(query, self)
 
-    def explain_sql(self, query: str) -> str:
+    def explain_sql(self, query: str, analyze: bool = False) -> str:
         """Optimized-plan text for a SQL query — the EXPLAIN analogue
-        (strategies, join schemes and value-join kinds included)."""
-        return self.explain(self.sql(query))
+        (strategies, join schemes and value-join kinds included).
+        ``analyze=True`` appends the measured per-op tree (EXPLAIN
+        ANALYZE)."""
+        return self.explain(self.sql(query), analyze=analyze)
 
 
 def _plan_bytes(plan: executor_lib.CompiledPlan) -> int:

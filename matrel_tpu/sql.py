@@ -48,6 +48,7 @@ Malformed input of any kind raises SqlError.
 from __future__ import annotations
 
 import ast
+import hashlib
 from typing import Any, Callable, Dict
 
 import jax.numpy as jnp
@@ -410,4 +411,15 @@ def parse_sql(query: str, session) -> E.MatExpr:
     expr = _Compiler(catalog).compile(q.strip())
     if where_src is not None:
         expr = expr.select_value(_compile_lambda(where_src, ("v",)))
+    # stamp the query-text fingerprint for the obs/ event log (the
+    # session's query records carry source="sql" + this hash, so the
+    # history CLI can group runs of the same statement). Out-of-band on
+    # purpose: an attrs entry would flow into the plan-cache key and
+    # split the cache between SQL- and DSL-built identical plans.
+    # Scalar-only queries ("2 * 3") legitimately compile to a plain
+    # number — nothing to stamp there.
+    if isinstance(expr, E.MatExpr):
+        object.__setattr__(
+            expr, "_sql_hash",
+            hashlib.sha1(query.strip().encode()).hexdigest()[:16])
     return expr

@@ -4,18 +4,25 @@
 The reference gets stage/task timelines from the Spark UI for free; here:
   - ``trace(dir)``: jax.profiler context writing TensorBoard/Perfetto traces
   - ``annotate``: named_scope so each physical operator is visible in XLA
-    traces (the executor wraps every node lowering)
-  - ``StepTimer``: wall-clock per-step table with device sync, the
-    accumulator-style counter surface
+    traces (the executor wraps every node lowering — structurally
+    enforced by tests/test_obs.py)
+  - ``StepTimer``: wall-clock per-step table with device sync — since the
+    obs/ subsystem landed, a thin VIEW over a
+    :class:`matrel_tpu.obs.metrics.MetricsRegistry` (timings record as
+    histograms, ``count`` as counters), so ad-hoc timer use and the
+    session's query metrics share one aggregation surface instead of the
+    old private dicts.
 """
 
 from __future__ import annotations
 
 import contextlib
 import time
-from typing import Dict, Iterator, List, Optional
+from typing import Iterator, Optional
 
 import jax
+
+from matrel_tpu.obs.metrics import MetricsRegistry
 
 
 @contextlib.contextmanager
@@ -34,7 +41,11 @@ def annotate(name: str):
 
 
 class StepTimer:
-    """Per-step wall-clock accounting with explicit device sync.
+    """Per-step wall-clock accounting with explicit device sync, backed
+    by a metrics registry (private by default — back-compat with the
+    original free-standing timer; pass the process
+    :data:`matrel_tpu.obs.metrics.REGISTRY` to aggregate with the
+    session's query metrics).
 
     Usage:
         t = StepTimer()
@@ -43,9 +54,10 @@ class StepTimer:
         print(t.table())
     """
 
-    def __init__(self):
-        self.records: List[tuple] = []
-        self.counters: Dict[str, float] = {}
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or MetricsRegistry()
+        self._steps: list = []      # insertion order for table()
+        self._counts: list = []
 
     @contextlib.contextmanager
     def step(self, name: str, sync: Optional[jax.Array] = None):
@@ -53,21 +65,30 @@ class StepTimer:
         yield
         if sync is not None:
             sync.block_until_ready()
-        self.records.append((name, time.perf_counter() - t0))
+        if name not in self._steps:
+            self._steps.append(name)
+        self.registry.histogram(f"step.{name}").observe(
+            time.perf_counter() - t0)
 
     def count(self, name: str, value: float = 1.0) -> None:
         """Accumulator-style counter (the reference counts e.g. nnz
         processed via Spark accumulators)."""
-        self.counters[name] = self.counters.get(name, 0.0) + value
+        if name not in self._counts:
+            self._counts.append(name)
+        self.registry.counter(name).inc(value)
+
+    @property
+    def counters(self) -> dict:
+        """Name → accumulated value (the pre-obs dict surface)."""
+        return {n: self.registry.counter(n).value for n in self._counts}
 
     def table(self) -> str:
-        by_name: Dict[str, List[float]] = {}
-        for name, dt in self.records:
-            by_name.setdefault(name, []).append(dt)
         lines = [f"{'step':<28}{'count':>6}{'total_s':>10}{'mean_ms':>10}"]
-        for name, ds in by_name.items():
-            lines.append(f"{name:<28}{len(ds):>6}{sum(ds):>10.3f}"
-                         f"{1e3 * sum(ds) / len(ds):>10.2f}")
-        for name, v in self.counters.items():
+        for name in self._steps:
+            h = self.registry.histogram(f"step.{name}")
+            lines.append(f"{name:<28}{h.count:>6}{h.total:>10.3f}"
+                         f"{1e3 * h.mean:>10.2f}")
+        for name in self._counts:
+            v = self.registry.counter(name).value
             lines.append(f"{name:<28}{'-':>6}{v:>10.0f}{'':>10}")
         return "\n".join(lines)

@@ -24,6 +24,8 @@ import functools
 from typing import Callable
 
 import jax
+
+from matrel_tpu.utils import compat
 import jax.numpy as jnp
 
 Gen = Callable[[jax.Array, jax.Array], jax.Array]
@@ -149,7 +151,7 @@ def _vma_zeros(shape, dt, vma_axes):
     if vma_axes:
         pcast = getattr(jax.lax, "pcast", None)
         z = (pcast(z, vma_axes, to="varying") if pcast is not None
-             else jax.lax.pvary(z, vma_axes))
+             else compat.pvary(z, vma_axes))
     return z
 
 
@@ -220,7 +222,7 @@ def streaming_chain_sharded(n: int,
     This is the v5e-64 shape of the north star: wall-clock scales ~1/P.
     Validated on the virtual CPU mesh by dryrun_multichip.
     """
-    from jax import shard_map
+    from matrel_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     if n % tile or n % panel or panel % tile:
@@ -259,7 +261,7 @@ def streaming_chain_sharded(n: int,
         acc0 = jnp.zeros((), jnp.float32)
         pcast = getattr(jax.lax, "pcast", None)
         acc0 = (pcast(acc0, axes, to="varying") if pcast is not None
-                else jax.lax.pvary(acc0, axes))
+                else compat.pvary(acc0, axes))
         local = jax.lax.fori_loop(0, per_dev, body, acc0)
         return jax.lax.psum(local, axes)
 

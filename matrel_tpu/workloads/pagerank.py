@@ -13,6 +13,8 @@ import functools
 from typing import Optional
 
 import jax
+
+from matrel_tpu.utils import compat
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -361,7 +363,7 @@ def _pagerank_compact_sharded(src, dst, n: int, rounds: int, alpha: float,
 @functools.lru_cache(maxsize=32)
 def _compact_sharded_loop(n: int, rounds: int, alpha: float, plan_static,
                           n_ov: int, passes: int, interpret: bool, mesh):
-    from jax import shard_map
+    from matrel_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
     from matrel_tpu.ops import pallas_spmv as pc
     from matrel_tpu.ops import spmv as spmv_lib
@@ -379,7 +381,7 @@ def _compact_sharded_loop(n: int, rounds: int, alpha: float, plan_static,
         r0 = _r0(n)
         pcast = getattr(jax.lax, "pcast", None)
         r0 = (pcast(r0, axes, to="varying") if pcast is not None
-              else jax.lax.pvary(r0, axes))
+              else compat.pvary(r0, axes))
         return jax.lax.fori_loop(0, rounds, body, r0)
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=in_specs,
@@ -420,7 +422,7 @@ def _pagerank_onehot_sharded(src, dst, n: int, rounds: int, alpha: float,
 @functools.lru_cache(maxsize=32)
 def _onehot_sharded_runner(n: int, rounds: int, alpha: float, plan_static,
                            n_arrays: int, mesh):
-    from jax import shard_map
+    from matrel_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
     from matrel_tpu.ops import spmv as spmv_lib
 
@@ -439,7 +441,7 @@ def _onehot_sharded_runner(n: int, rounds: int, alpha: float, plan_static,
         r0 = _r0(n)
         pcast = getattr(jax.lax, "pcast", None)
         r0 = (pcast(r0, axes, to="varying") if pcast is not None
-              else jax.lax.pvary(r0, axes))
+              else compat.pvary(r0, axes))
         return jax.lax.fori_loop(0, rounds, body, r0)
 
     # check_vma=False: see _sharded_spmv_runner — the all_gathered carry

@@ -1,0 +1,441 @@
+"""Query-lifecycle observability (matrel_tpu/obs/) — registry, event
+log, explain(analyze=True) and the obs_level="off" zero-overhead
+contract the bench relies on."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from matrel_tpu.config import MatrelConfig
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.obs.events import (EventLog, SCHEMA_VERSION, iter_events,
+                                   read_events)
+from matrel_tpu.obs.metrics import MetricsRegistry
+from matrel_tpu.session import MatrelSession
+
+
+@pytest.fixture
+def chain3(mesh8, rng):
+    """The 3-matrix chain demo shape: (64x96)(96x128)(128x32)."""
+    A = BlockMatrix.from_numpy(
+        rng.standard_normal((64, 96)).astype(np.float32), mesh=mesh8)
+    B = BlockMatrix.from_numpy(
+        rng.standard_normal((96, 128)).astype(np.float32), mesh=mesh8)
+    C = BlockMatrix.from_numpy(
+        rng.standard_normal((128, 32)).astype(np.float32), mesh=mesh8)
+    return A.expr() @ B.expr() @ C.expr()
+
+
+def _session(mesh, tmp_path, level="on", **cfg):
+    return MatrelSession(mesh=mesh, config=MatrelConfig(
+        obs_level=level,
+        obs_event_log=str(tmp_path / "events.jsonl"), **cfg))
+
+
+class TestMetricsRegistry:
+    def test_counter_semantics(self):
+        reg = MetricsRegistry()
+        c = reg.counter("plan_cache.hit")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        # same name → same counter; distinct names are independent
+        assert reg.counter("plan_cache.hit") is c
+        assert reg.counter("plan_cache.miss").value == 0.0
+
+    def test_gauge_last_write_wins(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("plan_cache.plans")
+        g.set(3)
+        g.set(1)
+        assert g.value == 1.0
+
+    def test_histogram_semantics(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("query.execute_ms")
+        for v in (4.0, 1.0, 3.0, 2.0):
+            h.observe(v)
+        assert h.count == 4
+        assert h.total == 10.0
+        assert h.mean == 2.5
+        assert (h.min, h.max) == (1.0, 4.0)
+        assert h.percentile(0.0) == 1.0
+        assert h.percentile(1.0) == 4.0
+        s = h.summary()
+        assert s["count"] == 4 and s["mean"] == 2.5
+
+    def test_histogram_reservoir_bounded(self):
+        from matrel_tpu.obs import metrics as m
+        reg = MetricsRegistry()
+        h = reg.histogram("x")
+        for v in range(3 * m._RESERVOIR):
+            h.observe(float(v))
+        assert h.count == 3 * m._RESERVOIR          # all-time stats kept
+        assert len(h._ring) == m._RESERVOIR         # memory bounded
+        assert h.max == float(3 * m._RESERVOIR - 1)
+
+    def test_snapshot_and_reset(self):
+        reg = MetricsRegistry()
+        reg.counter("a").inc(2)
+        reg.gauge("b").set(7)
+        reg.histogram("c").observe(1.5)
+        snap = reg.snapshot()
+        assert snap["counters"]["a"] == 2.0
+        assert snap["gauges"]["b"] == 7.0
+        assert snap["histograms"]["c"]["count"] == 1
+        json.dumps(snap)                            # JSON-ready contract
+        reg.reset()
+        assert reg.snapshot() == {"counters": {}, "gauges": {},
+                                  "histograms": {}}
+
+    def test_thread_safety(self):
+        reg = MetricsRegistry()
+        c = reg.counter("n")
+        h = reg.histogram("h")
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+                h.observe(1.0)
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.value == 8000
+        assert h.count == 8000 and h.total == 8000.0
+
+
+class TestEventLog:
+    def test_jsonl_round_trip(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        log = EventLog(path)
+        written = log.emit("query", {"query_id": "q1", "execute_ms": 1.25,
+                                     "out_shape": [4, 4]})
+        assert written["schema"] == SCHEMA_VERSION
+        assert written["kind"] == "query" and "ts" in written
+        [back] = read_events(path)
+        assert back == json.loads(json.dumps(written))
+
+    def test_numpy_values_serialise(self, tmp_path):
+        log = EventLog(str(tmp_path / "ev.jsonl"))
+        log.emit("query", {"nnz": np.int64(7), "ms": np.float32(1.5),
+                           "shape": np.array([2, 3])})
+        [rec] = read_events(log.path)
+        assert rec["nnz"] == 7 and rec["shape"] == [2, 3]
+
+    def test_reader_skips_garbage_and_foreign_schema(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        EventLog(path).emit("query", {"query_id": "q1"})
+        with open(path, "a") as f:
+            f.write("{truncated mid-cra\n")               # crashed writer
+            f.write(json.dumps({"schema": SCHEMA_VERSION + 99,
+                                "kind": "query"}) + "\n")  # future schema
+            f.write("[1, 2]\n")                            # non-record
+        recs = read_events(path)
+        assert len(recs) == 1 and recs[0]["query_id"] == "q1"
+
+    def test_missing_file_reads_empty(self, tmp_path):
+        assert read_events(str(tmp_path / "nope.jsonl")) == []
+        assert list(iter_events(str(tmp_path / "nope.jsonl"))) == []
+
+    def test_emit_never_raises(self, tmp_path):
+        log = EventLog(str(tmp_path / "no" / "such" / "dir" / "ev.jsonl"))
+        assert log.emit("query", {"query_id": "q1"}) is None   # swallowed
+
+    def test_emit_tool_event_path_resolution(self, tmp_path,
+                                             monkeypatch):
+        from matrel_tpu.obs.events import emit_tool_event
+        # env var wins
+        envlog = str(tmp_path / "env.jsonl")
+        monkeypatch.setenv("MATREL_OBS_EVENT_LOG", envlog)
+        emit_tool_event("bench", {"value": 1.0},
+                        anchor_dir=str(tmp_path / "anchor"))
+        assert len(read_events(envlog)) == 1
+        # else the default name anchored at anchor_dir
+        monkeypatch.delenv("MATREL_OBS_EVENT_LOG")
+        (tmp_path / "anchor").mkdir()
+        emit_tool_event("soak", {"ok": True},
+                        anchor_dir=str(tmp_path / "anchor"))
+        [rec] = read_events(str(tmp_path / "anchor"
+                                / ".matrel_events.jsonl"))
+        assert rec["kind"] == "soak"
+
+
+class TestSessionEvents:
+    def test_one_record_per_run_with_cache_outcomes(self, mesh8, tmp_path,
+                                                    chain3):
+        sess = _session(mesh8, tmp_path)
+        sess.run(chain3)
+        sess.run(chain3)
+        recs = read_events(sess.config.obs_event_log)
+        assert len(recs) == 2                  # exactly one per run
+        first, second = recs
+        assert first["cache"] == "miss" and second["cache"] == "hit"
+        assert first["query_id"] != second["query_id"]
+        for r in recs:
+            # the documented schema (docs/OBSERVABILITY.md)
+            assert r["schema"] == SCHEMA_VERSION and r["kind"] == "query"
+            assert r["source"] == "dsl"
+            assert r["out_shape"] == [64, 32]
+            assert isinstance(r["execute_ms"], (int, float))
+            assert isinstance(r["matmuls"], list) and len(r["matmuls"]) == 2
+            for d in r["matmuls"]:
+                assert {"uid", "strategy", "source", "flops",
+                        "dims"} <= set(d)
+            assert "plans" in r["plan_cache"]
+        # compile-time fields come from the plan meta (shared by both)
+        assert isinstance(first["optimize_ms"], (int, float))
+        assert first["first_execution"] is True
+        assert second["first_execution"] is False
+
+    def test_metrics_registry_updated(self, mesh8, tmp_path, chain3):
+        from matrel_tpu.obs.metrics import REGISTRY
+        REGISTRY.reset()
+        sess = _session(mesh8, tmp_path)
+        sess.run(chain3)
+        sess.run(chain3)
+        snap = REGISTRY.snapshot()
+        assert snap["counters"]["query.count"] == 2
+        assert snap["counters"]["plan_cache.miss"] == 1
+        assert snap["counters"]["plan_cache.hit"] == 1
+        assert snap["histograms"]["query.execute_ms"]["count"] == 2
+        REGISTRY.reset()
+
+    def test_chain_dp_not_counted_for_plain_matmul(self, mesh8, rng):
+        # reorder_chains rebuilds matmul nodes even when it keeps the
+        # parenthesisation — a plain 2-operand matmul must not count as
+        # a chain_dp restructure
+        from matrel_tpu.ir import rules
+        a = BlockMatrix.from_numpy(
+            rng.standard_normal((8, 8)).astype(np.float32), mesh=mesh8)
+        b = BlockMatrix.from_numpy(
+            rng.standard_normal((8, 8)).astype(np.float32), mesh=mesh8)
+        counts = {}
+        rules.optimize(a.expr() @ b.expr(), counts=counts)
+        assert "chain_dp" not in counts
+
+    def test_rule_hits_compile_scoped(self, mesh8, tmp_path, chain3):
+        """Hit records carry {} rule_hits (rules fired once, at
+        compile), so history's roll-up counts real optimizer work."""
+        from matrel_tpu.obs.metrics import REGISTRY
+        REGISTRY.reset()
+        sess = _session(mesh8, tmp_path)
+        sess.run(chain3)
+        sess.run(chain3)
+        miss, hit = read_events(sess.config.obs_event_log)
+        assert miss["rule_hits"].get("chain_dp") == 1
+        assert hit["rule_hits"] == {}
+        assert REGISTRY.snapshot()["counters"]["optimizer.rule.chain_dp"] \
+            == 1
+        REGISTRY.reset()
+
+    def test_scalar_sql_still_returns_plain_number(self, mesh8,
+                                                   tmp_path):
+        # the _sql_hash stamp must not break scalar-only queries, which
+        # compile to a plain float rather than a MatExpr
+        sess = _session(mesh8, tmp_path)
+        assert sess.sql("2 * 3") == 6.0
+
+    def test_sql_source_hash(self, mesh8, tmp_path, rng):
+        sess = _session(mesh8, tmp_path)
+        a = BlockMatrix.from_numpy(
+            rng.standard_normal((16, 16)).astype(np.float32), mesh=mesh8)
+        sess.register("A", a)
+        sess.run(sess.sql("SELECT A * A FROM A"))
+        [rec] = read_events(sess.config.obs_event_log)
+        assert rec["source"] == "sql"
+        assert len(rec["source_hash"]) == 16
+
+    def test_eviction_counted(self, mesh8, tmp_path, rng):
+        sess = _session(mesh8, tmp_path, plan_cache_max_plans=2)
+        for _ in range(4):
+            m = BlockMatrix.from_numpy(
+                rng.standard_normal((8, 8)).astype(np.float32), mesh=mesh8)
+            sess.run(m.expr().t())
+        recs = read_events(sess.config.obs_event_log)
+        assert recs[-1]["plan_cache"]["evicted"] == 2
+        assert sess.plan_cache_info()["evicted"] == 2
+
+
+class TestExplainAnalyze:
+    def test_one_timed_row_per_physical_op(self, mesh8, tmp_path, chain3):
+        sess = _session(mesh8, tmp_path)
+        text = sess.explain(chain3, analyze=True)
+        assert "== Analyzed physical plan" in text
+        plan = sess.compile(chain3)
+
+        def uids(n, acc):
+            acc.add(n.uid)
+            for c in n.children:
+                uids(c, acc)
+            return acc
+
+        n_ops = len(uids(plan.optimized, set()))
+        analyzed = text.split("== Analyzed physical plan")[1]
+        assert analyzed.count(" ms]") == n_ops
+        # the chain demo acceptance surface: strategy + estimated bytes
+        # on every matmul row, and the fused-program line
+        matmul_rows = [ln for ln in analyzed.splitlines()
+                       if ln.lstrip().startswith("matmul")]
+        assert len(matmul_rows) == 2
+        for row in matmul_rows:
+            assert "strategy=" in row and "est_ici=" in row
+        assert "fused program:" in analyzed
+
+    def test_per_op_times_are_exclusive(self, mesh8, tmp_path, chain3):
+        """ev() recurses through _eval, so naive timing would report
+        each parent inclusive of its children (~depth x the real
+        runtime when summed); the hook must subtract child frames."""
+        from matrel_tpu.obs.analyze import measure_per_op
+        sess = _session(mesh8, tmp_path)
+        plan = sess.compile(chain3)
+        per_op, eager_total = measure_per_op(plan)
+        total = sum(s for _, s in per_op.values())
+        # exclusive times sum to at most the whole eager run (plus a
+        # little hook overhead); inclusive times would sum to ~2x+ on
+        # this depth-3 tree
+        assert total <= eager_total * 1.1 + 0.05
+
+    def test_analyze_requires_physical(self, mesh8, tmp_path, chain3):
+        sess = _session(mesh8, tmp_path)
+        with pytest.raises(ValueError, match="physical"):
+            sess.explain(chain3, physical=False, analyze=True)
+
+    def test_explain_sql_analyze(self, mesh8, tmp_path, rng):
+        sess = _session(mesh8, tmp_path)
+        a = BlockMatrix.from_numpy(
+            rng.standard_normal((16, 16)).astype(np.float32), mesh=mesh8)
+        sess.register("A", a)
+        text = sess.explain_sql("SELECT A * A FROM A", analyze=True)
+        assert "== Analyzed physical plan" in text and " ms]" in text
+
+
+class TestObsOffContract:
+    """obs_level="off" (the bench default): zero events, zero extra
+    syncs on the query path."""
+
+    def test_no_events_no_syncs(self, mesh8, tmp_path, chain3,
+                                monkeypatch):
+        import jax
+        emits = []
+        monkeypatch.setattr(EventLog, "emit",
+                            lambda self, *a, **k: emits.append(a))
+        syncs = []
+        real_sync = jax.block_until_ready
+        monkeypatch.setattr(jax, "block_until_ready",
+                            lambda x: (syncs.append(1), real_sync(x))[1])
+        sess = _session(mesh8, tmp_path, level="off")
+        out = sess.run(chain3)
+        assert out.shape == (64, 32)
+        assert emits == []                      # zero events
+        assert syncs == []                      # zero per-op syncs
+        assert not (tmp_path / "events.jsonl").exists()
+
+    def test_default_config_is_off(self):
+        assert MatrelConfig().obs_level == "off"
+
+    def test_obs_level_validated_and_normalised(self):
+        # "OFF" must not silently enable instrumentation
+        assert MatrelConfig(obs_level="OFF").obs_level == "off"
+        assert MatrelConfig(obs_level="Analyze").obs_level == "analyze"
+        with pytest.raises(ValueError, match="obs_level"):
+            MatrelConfig(obs_level="of")
+
+
+class TestHistory:
+    def _seed_log(self, tmp_path):
+        log = EventLog(str(tmp_path / "ev.jsonl"))
+        for i, cache in enumerate(["miss", "hit", "hit"]):
+            log.emit("query", {
+                "query_id": f"q{i}", "source": "dsl", "cache": cache,
+                "optimize_ms": 4.0, "execute_ms": 10.0,
+                "out_shape": [4, 4],
+                "rule_hits": {"fold_transpose": 1},
+                "plan_cache": {"plans": 1, "evicted": 0},
+                "matmuls": [{"uid": 1, "strategy": "rmm",
+                             "flops": 1e9, "est_ici_bytes": 2.0 ** 20}]})
+        log.emit("bench", {"value": 100.0})
+        log.emit("soak", {"ok": True})
+        return log.path
+
+    def test_summarize(self, tmp_path):
+        from matrel_tpu.obs.history import summarize
+        s = summarize(read_events(self._seed_log(tmp_path)))
+        assert s["queries"] == 3
+        assert s["cache_hit_rate"] == pytest.approx(2 / 3, abs=1e-3)
+        assert s["execute_ms_total"] == 30.0
+        assert s["strategies"]["rmm"]["count"] == 3
+        assert s["rule_hits"]["fold_transpose"] == 3
+        assert s["bench_runs"] == 1 and s["soak_runs"] == 1
+
+    def test_render_tables(self, tmp_path):
+        from matrel_tpu.obs.history import render_queries, render_summary
+        events = read_events(self._seed_log(tmp_path))
+        table = render_queries(events, last=2)
+        assert "q1" in table and "q2" in table and "q0" not in table
+        summary = render_summary(events)
+        assert "cache hit rate: 0.667" in summary
+        assert "rmm" in summary
+
+    def test_cli(self, tmp_path, capsys):
+        import subprocess
+        import sys
+        path = self._seed_log(tmp_path)
+        out = subprocess.run(
+            [sys.executable, "-m", "matrel_tpu", "history", "--summary",
+             "--log", path],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0
+        assert "cache hit rate" in out.stdout
+
+
+class TestInstrumentationGuard:
+    def test_every_lowering_dispatch_is_annotated(self):
+        """Structural check: each `self._eval(` dispatch call site in
+        executor.py sits inside a `with annotate(` block, so a new op
+        path can't silently skip the per-op scope/timing hook."""
+        import inspect
+        from matrel_tpu import executor
+        lines = inspect.getsource(executor).splitlines()
+        sites = [i for i, ln in enumerate(lines)
+                 if "self._eval(" in ln and "def _eval" not in ln]
+        assert sites, "executor lost its central _eval dispatch"
+        for i in sites:
+            window = "\n".join(lines[max(0, i - 3):i])
+            assert "with annotate(" in window, (
+                f"executor.py line {i + 1}: lowering dispatch not "
+                f"wrapped in annotate()")
+
+    def test_bench_emits_bench_event(self, tmp_path, monkeypatch):
+        """bench.py main() appends a `bench` record to the shared log."""
+        import bench
+        path = str(tmp_path / "ev.jsonl")
+        monkeypatch.setenv("MATREL_OBS_EVENT_LOG", path)
+        bench._emit_bench_event({"value": 1.23, "phases": {"setup_s": 0.1}})
+        [rec] = read_events(path)
+        assert rec["kind"] == "bench" and rec["value"] == 1.23
+
+    def test_bench_event_emission_stays_jax_free(self, tmp_path):
+        """The bench parent is deliberately backend-free (relay-wedge
+        safety): emitting its obs event must not import jax."""
+        import os
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, MATREL_OBS_EVENT_LOG=str(
+            tmp_path / "ev.jsonl"))
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import sys, bench; "
+             "bench._emit_bench_event({'value': 1.0}); "
+             "print('jax' in sys.modules)"],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=repo)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == "False"
+        [rec] = read_events(str(tmp_path / "ev.jsonl"))
+        assert rec["kind"] == "bench"

@@ -39,6 +39,23 @@ def _log(event: dict) -> None:
     except OSError as e:
         print(f"# could not append to PROGRESS.jsonl: {e}",
               file=sys.stderr)
+    # mirror into the obs/ event log ("soak" kind) so `python -m
+    # matrel_tpu history --summary` sees soak outcomes next to query
+    # and bench records. obs/events.py loaded by FILE PATH: importing
+    # the matrel_tpu package would pull jax into this watchdog, which
+    # must stay backend-free (relay-wedge safety). Never fails the soak.
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "_matrel_obs_events",
+            os.path.join(REPO, "matrel_tpu", "obs", "events.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.emit_tool_event("soak",
+                            {k: v for k, v in event.items() if k != "ts"},
+                            anchor_dir=REPO)
+    except Exception as e:
+        print(f"# soak event not logged: {e}", file=sys.stderr)
     print(json.dumps(event))
 
 
