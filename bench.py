@@ -185,6 +185,291 @@ def measure_tpu() -> dict:
     return {"tflops": flops(N) / dt / 1e12 / n_chips, "phases": phases}
 
 
+def measure_spgemm() -> dict:
+    """SpGEMM (S×S) bench row — the tile-intersection kernel at
+    BASELINE row-4 scale (100k×100k, 1% block density, 512 tiles) plus
+    the executor-dispatch crossover comparison vs the densify fallback
+    at a reduced scale where the densified operand actually fits.
+
+    Two measurements on purpose: at full scale the densify path's
+    100k×100k dense intermediate (~20 GB bf16) exceeds a v5e chip's
+    HBM — that infeasibility IS the headline win — so the full-scale
+    number times the sparse-result kernel alone (``ops/spgemm.spgemm``,
+    nothing dense ever materialises), and the dispatch-vs-densify
+    ratio is taken at ``MATREL_SPGEMM_CMP_N`` where both paths run.
+    Single-run medians with forced fetches (the sub-ms kernel is
+    relay-latency-bound on chip — same caveat as BASELINE row 2)."""
+    import jax
+    import jax.numpy as jnp
+    from matrel_tpu.config import MatrelConfig, set_default_config
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.sparse import BlockSparseMatrix
+    from matrel_tpu import executor as executor_lib
+    from matrel_tpu.ops import spgemm as spgemm_lib
+
+    set_default_config(MatrelConfig(obs_level="off"))
+    cfg = MatrelConfig(obs_level="off")
+    mesh = mesh_lib.make_mesh()
+    bs = 512
+    n = _env_int("MATREL_SPGEMM_N", 100_352)          # 196 tile grid
+    n_cmp = _env_int("MATREL_SPGEMM_CMP_N", 32_768)   # densify fits
+    dtype = os.environ.get("MATREL_SPGEMM_DTYPE", "bfloat16")
+    fetch = jax.jit(lambda t: jnp.sum(t.astype(jnp.float32)))
+
+    def median_ms(fn, reps=5):
+        return _median_s(fn, reps=reps) * 1e3   # warm once, median
+
+    out: dict = {"block_size": bs, "dtype": dtype}
+    # -- full scale: sparse-result kernel only --------------------------
+    S1 = BlockSparseMatrix.random((n, n), block_density=0.01,
+                                  block_size=bs, mesh=mesh, seed=0,
+                                  dtype=dtype)
+    S2 = BlockSparseMatrix.random((n, n), block_density=0.01,
+                                  block_size=bs, mesh=mesh, seed=1,
+                                  dtype=dtype)
+
+    def run_full():
+        C = spgemm_lib.spgemm(S1, S2, cfg)
+        float(np.asarray(fetch(C.blocks)))
+
+    out["n"] = n
+    out["spgemm_full_ms"] = round(median_ms(run_full), 3)
+    pairs = spgemm_lib.pair_structure(
+        np.asarray(S1.block_rows), np.asarray(S1.block_cols),
+        np.asarray(S2.block_rows), np.asarray(S2.block_cols),
+        S2.grid[1])[0].size
+    out["pairs"] = int(pairs)
+    fl = 2.0 * pairs * bs ** 3
+    out["effective_tflops"] = round(
+        fl / (out["spgemm_full_ms"] / 1e3) / 1e12, 3)
+    # -- reduced scale: executor dispatch vs densify fallback -----------
+    T1 = BlockSparseMatrix.random((n_cmp, n_cmp), block_density=0.01,
+                                  block_size=bs, mesh=mesh, seed=2,
+                                  dtype=dtype)
+    T2 = BlockSparseMatrix.random((n_cmp, n_cmp), block_density=0.01,
+                                  block_size=bs, mesh=mesh, seed=3,
+                                  dtype=dtype)
+    expr = T1.multiply(T2)
+    assert executor_lib._spgemm_dispatch(expr, cfg), \
+        "comparison config must sit below the SpGEMM crossover"
+    plan_sp = executor_lib.compile_expr(expr, mesh, cfg)
+    cfg_dense = MatrelConfig(obs_level="off",
+                             spgemm_density_threshold=0.0)
+    plan_dn = executor_lib.compile_expr(T1.multiply(T2), mesh,
+                                        cfg_dense)
+
+    def run_plan(plan):
+        def go():
+            float(np.asarray(fetch(plan.run().data)))
+        return go
+
+    out["cmp_n"] = n_cmp
+    out["cmp_spgemm_ms"] = round(median_ms(run_plan(plan_sp), reps=3), 3)
+    out["cmp_densify_ms"] = round(median_ms(run_plan(plan_dn), reps=3),
+                                  3)
+    out["cmp_speedup"] = round(
+        out["cmp_densify_ms"] / max(out["cmp_spgemm_ms"], 1e-9), 2)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CPU reference rows (BASELINE rows 2-6) — VERDICT r5 "Missing #2".
+# Pure numpy/scipy on the HOST: nothing here imports jax, so this path
+# cannot touch (or hang on) the axon relay and is runnable with the
+# relay down. Full-scale where host memory/time allow; rows 3 and 6 use
+# a reduced config with an EXPLICIT, recorded extrapolation (linear in
+# streamed rows for the Gram; cubic in n for the dense chain).
+# ---------------------------------------------------------------------------
+
+
+def _median_s(fn, reps: int = 3, warm: int = 1) -> float:
+    for _ in range(warm):
+        fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def _cpu_row_chain() -> dict:                               # row 2
+    rng = np.random.default_rng(0)
+    n, mid = 10_000, 100
+    A = rng.standard_normal((n, mid)).astype(np.float32)
+    B = rng.standard_normal((mid, n)).astype(np.float32)
+    C = rng.standard_normal((n, mid)).astype(np.float32)
+    dt = _median_s(lambda: A @ (B @ C), reps=5)
+    return {"metric": "chain_abc_10k_skewed_wallclock", "unit": "ms",
+            "value": round(dt * 1e3, 3),
+            "config": "full scale, optimal order A·(B·C), numpy BLAS"}
+
+
+def _cpu_row_linreg() -> dict:                              # row 3
+    n_full, k, panel = 10_000_000, 1000, 250_000
+    n_meas = 1_000_000
+    rng = np.random.default_rng(1)
+    G = np.zeros((k, k), np.float32)
+    b = np.zeros((k, 1), np.float32)
+
+    def run():
+        G[:] = 0
+        b[:] = 0
+        for _ in range(n_meas // panel):
+            Xp = rng.standard_normal((panel, k)).astype(np.float32)
+            yp = Xp @ np.ones((k, 1), np.float32)
+            G[:, :] += Xp.T @ Xp       # item-assign: G/b stay closure
+            b[:, :] += Xp.T @ yp       # vars (+= on the name rebinds)
+        np.linalg.solve(G.astype(np.float64), b.astype(np.float64))
+
+    dt = _median_s(run, reps=1, warm=0)
+    scale = n_full / n_meas
+    return {"metric": "linreg_normal_eq_10Mx1k_wallclock", "unit": "s",
+            "value": round(dt * scale, 3),
+            "config": f"measured at {n_meas}x{k} panel-streamed Gram, "
+                      f"extrapolated x{scale:.0f} (linear in rows; "
+                      "generator included, as in the TPU row)"}
+
+
+def _cpu_row_spmm() -> dict:                                # row 4
+    n, bs, m = 100_352, 512, 512
+    gr = gc = n // bs                                       # 196
+    rng = np.random.default_rng(2)
+    nnzb = max(1, int(round(gr * gc * 0.01)))               # 384
+    flat = rng.choice(gr * gc, size=nnzb, replace=False)
+    rows, cols = flat // gc, flat % gc
+    tiles = rng.standard_normal((nnzb, bs, bs)).astype(np.float32)
+    D = rng.standard_normal((n, m)).astype(np.float32)
+    out = np.zeros((n, m), np.float32)
+
+    def run():
+        out[:] = 0
+        for t in range(nnzb):
+            out[rows[t] * bs:(rows[t] + 1) * bs] += (
+                tiles[t] @ D[cols[t] * bs:(cols[t] + 1) * bs])
+
+    dt = _median_s(run)
+    fl = 2.0 * nnzb * bs * bs * m
+    return {"metric": "blocksparse_spmm_100k_1pct_wallclock",
+            "unit": "ms", "value": round(dt * 1e3, 2), "nnzb": nnzb,
+            "effective_tflops": round(fl / dt / 1e12, 4),
+            "config": "full scale, blocked numpy BLAS"}
+
+
+def _cpu_row_pagerank() -> dict:                            # row 5
+    import scipy.sparse as sp
+    n, n_edges, rounds = 1_000_000, 10_000_000, 5
+    rng = np.random.default_rng(3)
+    src = rng.integers(0, n, n_edges, dtype=np.int64)
+    dst = rng.integers(0, n, n_edges, dtype=np.int64)
+    M = sp.csr_matrix(
+        (np.ones(n_edges, np.float32), (dst, src)), shape=(n, n))
+    x = np.full(n, 1.0 / n, np.float32)
+
+    def run():
+        y = x
+        for _ in range(rounds):
+            y = 0.85 * (M @ y) + 0.15 / n
+        float(y[0])
+
+    dt = _median_s(run)
+    return {"metric": "pagerank_1M_30rounds_wallclock_per_round",
+            "unit": "ms/round", "value": round(dt / rounds * 1e3, 2),
+            "config": f"full scale, scipy CSR, {rounds} rounds timed"}
+
+
+def _cpu_row_north_star() -> dict:                          # row 6
+    n_full, n_meas = 65_536, 8_192
+    rng = np.random.default_rng(4)
+    A = rng.standard_normal((n_meas, n_meas)).astype(np.float32)
+    B = rng.standard_normal((n_meas, n_meas)).astype(np.float32)
+    C = rng.standard_normal((n_meas, n_meas)).astype(np.float32)
+    dt = _median_s(lambda: (A @ B) @ C, reps=1)
+    scale = (n_full / n_meas) ** 3
+    return {"metric": "north_star_65k_chain_wallclock", "unit": "s",
+            "value": round(dt * scale, 1),
+            "config": f"measured at {n_meas} (full 65k needs ~17 GB/"
+                      f"operand and hours of host BLAS), extrapolated "
+                      f"x{scale:.0f} (cubic in n)"}
+
+
+def _cpu_row_spgemm() -> dict:          # new SpGEMM row (CPU reference)
+    n, bs = 100_352, 512
+    gr = gc = n // bs
+    nnzb = max(1, int(round(gr * gc * 0.01)))
+
+    def sample(seed):
+        r = np.random.default_rng(seed)
+        flat = np.sort(r.choice(gr * gc, size=nnzb, replace=False))
+        return (flat // gc, flat % gc,
+                r.standard_normal((nnzb, bs, bs)).astype(np.float32))
+
+    ar, ac, at = sample(10)
+    br, bc, bt = sample(11)
+    order = np.argsort(br, kind="stable")
+    brs = br[order]
+
+    def run():
+        acc: dict = {}
+        starts = np.searchsorted(brs, ac, side="left")
+        ends = np.searchsorted(brs, ac, side="right")
+        for i in range(nnzb):
+            for j0 in range(starts[i], ends[i]):
+                j = order[j0]
+                k = (int(ar[i]), int(bc[j]))
+                p = at[i] @ bt[j]
+                if k in acc:
+                    acc[k] += p
+                else:
+                    acc[k] = p
+        return len(acc)
+
+    dt = _median_s(run, reps=3, warm=1)
+    return {"metric": "blocksparse_spgemm_100k_1pct_wallclock",
+            "unit": "ms", "value": round(dt * 1e3, 2), "nnzb": nnzb,
+            "config": "full scale, tile-intersection blocked numpy "
+                      "BLAS (the ops/spgemm.py algorithm on host)"}
+
+
+#: BASELINE row number → measurement fn ("spgemm" is the staged row).
+CPU_ROWS = {
+    "2": _cpu_row_chain,
+    "3": _cpu_row_linreg,
+    "4": _cpu_row_spmm,
+    "5": _cpu_row_pagerank,
+    "6": _cpu_row_north_star,
+    "spgemm": _cpu_row_spgemm,
+}
+
+
+def cpu_rows() -> dict:
+    """Measure every missing CPU reference row and merge the results
+    into cpu_baseline.json under "rows" (the row-1 top-level schema is
+    untouched — bench.cpu_baseline() keeps reading it)."""
+    results = {}
+    for row, fn in CPU_ROWS.items():
+        t0 = time.perf_counter()
+        try:
+            rec = fn()
+        except Exception as e:            # one broken row must not
+            rec = {"error": repr(e)}      # lose the others
+        rec["measure_s"] = round(time.perf_counter() - t0, 1)
+        results[row] = rec
+        print(json.dumps({"row": row, **rec}), flush=True)
+    try:
+        with open(CPU_CACHE) as f:
+            cached = json.load(f)
+    except (OSError, ValueError):
+        cached = {}
+    cached["rows"] = results
+    cached["rows_measured"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    tmp = CPU_CACHE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(cached, f, indent=1)
+    os.replace(tmp, CPU_CACHE)
+    return results
+
+
 # ---------------------------------------------------------------------------
 # Subprocess harness: the relay can HANG (not just error), so both the probe
 # and the measurement run as child processes under hard timeouts.
@@ -361,11 +646,35 @@ def main() -> None:
     }))
 
 
+def main_spgemm() -> None:
+    """Wedge-safe SpGEMM row capture (tools/tpu_batch.sh step): probe,
+    then the measurement child under a hard timeout; one parseable JSON
+    line either way, rc 0 — same contract as the headline metric."""
+    ok, payload = _run_child("probe", PROBE_TIMEOUT_S)
+    if ok:
+        ok, payload = _run_child("spgemm", MEASURE_TIMEOUT_S)
+    record = {"metric": "blocksparse_spgemm_100k_1pct"}
+    if ok and isinstance(payload, dict):
+        record.update(payload)
+    else:
+        record.update({"value": None, "error": str(payload)[:500]})
+    _emit_bench_event(dict(record))
+    print(json.dumps(record))
+
+
 if __name__ == "__main__":
     if "--_probe" in sys.argv:
         probe_tpu()
         print(json.dumps({"probe": "ok"}))
     elif "--_measure" in sys.argv:
         print(json.dumps(measure_tpu()))
+    elif "--_spgemm" in sys.argv:
+        print(json.dumps(measure_spgemm()))
+    elif "--spgemm" in sys.argv:
+        main_spgemm()
+    elif "--cpu-rows" in sys.argv:
+        # host-only (no jax, relay-safe): BASELINE rows 2-6 + the
+        # SpGEMM row's CPU reference column, cached in cpu_baseline.json
+        cpu_rows()
     else:
         main()

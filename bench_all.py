@@ -5,6 +5,7 @@ Rows (BASELINE.json configs):
   2. chain A·B·C, 10k dims, skewed, DP reorder   → wall-clock + plan
   3. tall-skinny linreg 10M×1k (streaming Gram)  → wall-clock
   4. block-sparse × dense, 1% blocks, 100k×100k  → wall-clock + eff. TFLOPS
+  4b. block-sparse × block-sparse SpGEMM, same S → wall-clock + crossover
   5. PageRank 1M nodes / 10M edges, 30 rounds    → wall-clock/round
   5b. PageRank 10M nodes / 100M edges (10×)      → wall-clock/round
   x1. conjugate gradient, implicit SPD 8k system → wall-clock + iters
@@ -42,9 +43,19 @@ def _timed(fn, warm: int = 1, reps: int = 3) -> float:
 
 def bench_dense_4k(mesh, cfg):
     import bench
-    tflops = bench.measure_tpu()
-    return {"metric": "dense_blockmatmul_tflops_per_chip", "value": round(tflops, 2),
+    payload = bench.measure_tpu()      # {"tflops": ..., "phases": ...}
+    return {"metric": "dense_blockmatmul_tflops_per_chip",
+            "value": round(payload["tflops"], 2),
             "unit": "TFLOPS", "config": "4096x4096 bf16, f32 accumulate"}
+
+
+def bench_spgemm(mesh, cfg):
+    """S×S tile-intersection SpGEMM (ops/spgemm.py) at BASELINE row-4
+    scale + the executor-dispatch crossover comparison vs the densify
+    fallback at a reduced scale (see bench.measure_spgemm)."""
+    import bench
+    payload = bench.measure_spgemm()
+    return {"metric": "blocksparse_spgemm_100k_1pct", **payload}
 
 
 def bench_chain(mesh, cfg):
@@ -340,8 +351,9 @@ def main():
     set_default_config(cfg)
     mesh = mesh_lib.make_mesh()
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
-               bench_pagerank, bench_pagerank_10x, bench_cg,
-               bench_eigen, bench_triangles, bench_north_star):
+               bench_spgemm, bench_pagerank, bench_pagerank_10x,
+               bench_cg, bench_eigen, bench_triangles,
+               bench_north_star):
         try:
             print(json.dumps(fn(mesh, cfg)), flush=True)
         except Exception as e:  # keep the suite running

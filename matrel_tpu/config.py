@@ -33,6 +33,22 @@ class MatrelConfig:
         every matmul, bypassing the cost model. "auto" = cost-based.
       sparsity_threshold: density below which a matrix is considered sparse
         by the planner/cost model.
+      spgemm_density_threshold: S×S matmuls whose ESTIMATED output block
+        density (ir/stats.matmul_density at tile granularity) is below
+        this dispatch the tile-intersection SpGEMM kernel
+        (ops/spgemm.py) — neither operand is densified. At or above it
+        the multiply falls back to the densify path (SpMM over a
+        densified right operand), where the MXU's dense throughput wins.
+        0 disables SpGEMM entirely.
+      comm_alpha_bytes: per-collective-STEP latency charge for the
+        planner's comm model, in per-device byte-equivalents (the α of
+        an α-β model; ~1 µs of v5e ICI ≈ 200 kB). Stepped strategies
+        pay it per step — SUMMA's ring 2·(g−1) times, cpmm's
+        reduce-scatter once, each nonzero reshard once — so small
+        latency-bound multiplies (BASELINE row 2 class) stop ranking
+        purely by bytes. 0 restores the β-only model. The chain DP's
+        comm proxy stays β-only (its native mirror is
+        equivalence-fuzzed against the alpha-free closed forms).
       default_dtype: dtype for constructors that don't specify one.
       matmul_precision: jax.lax precision for dot_general ("default",
         "high", "highest"). bfloat16 inputs + "highest" ≈ f32 accumulate.
@@ -93,6 +109,8 @@ class MatrelConfig:
     broadcast_threshold_bytes: int = 64 * 1024 * 1024
     strategy_override: str = "auto"
     sparsity_threshold: float = 0.05
+    spgemm_density_threshold: float = 0.25
+    comm_alpha_bytes: float = 200_000.0
     default_dtype: str = "float32"
     matmul_precision: str = "highest"
     keep_input_dtype: bool = True

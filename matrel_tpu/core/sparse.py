@@ -115,31 +115,49 @@ class BlockSparseMatrix:
         reference, SURVEY.md §2 'Local matrix kernels'): element-sparse
         input is bucketed into block-granular payloads WITHOUT densifying
         the full matrix — only touched tiles are materialised."""
+        coo = sp.tocoo()
+        return cls.from_coo_arrays(coo.row, coo.col, coo.data, coo.shape,
+                                   block_size=block_size, mesh=mesh,
+                                   config=config, dtype=dtype)
+
+    @classmethod
+    def from_coo_arrays(cls, rows, cols, vals, shape: Tuple[int, int],
+                        block_size: Optional[int] = None,
+                        mesh: Optional[Mesh] = None,
+                        config: Optional[MatrelConfig] = None,
+                        dtype: Any = None) -> "BlockSparseMatrix":
+        """From raw COO coordinate arrays — the shared bucketing core of
+        ``from_scipy`` and the executor's COOMatrix→block-sparse
+        conversion for the SpGEMM dispatch (ops/spgemm.py): only touched
+        tiles are materialised, the full matrix never is. Duplicate
+        coordinates accumulate (scipy COO semantics)."""
         cfg = config or default_config()
         bs = block_size or cfg.block_size
         mesh = mesh or mesh_lib.make_mesh(cfg.mesh_shape, cfg.mesh_axis_names)
         dtype = dtype or cfg.default_dtype
-        coo = sp.tocoo()
-        n, m = coo.shape
+        rows = np.asarray(rows, np.int64).ravel()
+        cols = np.asarray(cols, np.int64).ravel()
+        vals = np.asarray(vals).ravel()
+        n, m = shape
         gc = math.ceil(m / bs)
-        bi = (coo.row // bs).astype(np.int64)
-        bj = (coo.col // bs).astype(np.int64)
+        bi = rows // bs
+        bj = cols // bs
         keys = bi * gc + bj
         uniq, tile_idx = np.unique(keys, return_inverse=True)
         payload = np.zeros((max(len(uniq), 1), bs, bs), dtype=dtype)
         np.add.at(payload,
-                  (tile_idx, coo.row % bs, coo.col % bs),
-                  coo.data.astype(dtype))
-        rows = (uniq // gc).astype(np.int32)
-        cols = (uniq % gc).astype(np.int32)
+                  (tile_idx.ravel(), rows % bs, cols % bs),
+                  vals.astype(payload.dtype))
+        trows = (uniq // gc).astype(np.int32)
+        tcols = (uniq % gc).astype(np.int32)
         if len(uniq) == 0:
-            rows = np.zeros(1, np.int32)
-            cols = np.zeros(1, np.int32)
+            trows = np.zeros(1, np.int32)
+            tcols = np.zeros(1, np.int32)
         rep = NamedSharding(mesh, P())
         return cls(blocks=jax.device_put(payload, rep),
-                   block_rows=jax.device_put(rows, rep),
-                   block_cols=jax.device_put(cols, rep),
-                   shape=(n, m), block_size=bs, mesh=mesh)
+                   block_rows=jax.device_put(trows, rep),
+                   block_cols=jax.device_put(tcols, rep),
+                   shape=(int(n), int(m)), block_size=bs, mesh=mesh)
 
     @classmethod
     def random(cls, shape: Tuple[int, int], block_density: float,

@@ -408,8 +408,50 @@ class Lowerer:
         return (u.kind == "leaf" and v.kind == "leaf"
                 and u.attrs["matrix"] is v.attrs["matrix"])
 
+    def _as_block_sparse(self, leaf_node: MatExpr, bs: int):
+        """The BlockSparseMatrix form of an S×S matmul operand:
+        sparse_leaf carries one already; coo_leaf is BUCKETED into
+        block-granular tiles (never densified — only touched tiles
+        materialise), memoised on the matrix per (block_size, mesh)."""
+        m = leaf_node.attrs["matrix"]
+        if leaf_node.kind == "sparse_leaf":
+            return m
+        from matrel_tpu.core.sparse import BlockSparseMatrix
+        memo = getattr(m, "_block_sparse_memo", None)
+        if memo is not None and memo[0] == bs and memo[1] is self.mesh:
+            return memo[2]
+        # eager even when the cache miss happens inside an outer trace:
+        # the conversion builds committed device arrays that must stay
+        # static metadata, not tracers (the spmm transpose-memo lesson)
+        with jax.ensure_compile_time_eval():
+            S = BlockSparseMatrix.from_coo_arrays(
+                m.rows, m.cols, m.vals, m.shape, block_size=bs,
+                mesh=self.mesh, config=self.config, dtype="float32")
+        m._block_sparse_memo = (bs, self.mesh, S)
+        return S
+
+    def _spgemm(self, node: MatExpr) -> Array:
+        """S×S below the density crossover: tile-intersection SpGEMM —
+        neither operand is densified (ops/spgemm.py); the product is
+        scattered to the padded dense canonical layout every consumer
+        expects (apply_dense pads to padded_shape(node.shape, mesh) —
+        the same pair this lowering's consumers compute)."""
+        from matrel_tpu.ops import spgemm as spgemm_lib
+        bs = _spgemm_block_size(node, self.config)
+        SA = self._as_block_sparse(node.children[0], bs)
+        SB = self._as_block_sparse(node.children[1], bs)
+        return spgemm_lib.apply_dense(SA, SB, self.config)
+
     def _matmul(self, node: MatExpr, ev) -> Array:
         l, r = node.children
+        # S×S (block-sparse AND element-sparse leaves, any mix): the
+        # tile-intersection SpGEMM when the ESTIMATED output block
+        # density sits below the crossover — above it the densify
+        # fallthrough below wins on MXU throughput. ONE dispatch
+        # predicate (_spgemm_dispatch) shared with the planner's
+        # pricing/layout/decision readers so they can never drift.
+        if _spgemm_dispatch(node, self.config):
+            return self._spgemm(node)
         # coo_leaf matmuls: per-column one-hot SpMV for narrow dense
         # operands; wide ones (or refused plans) densify — at that point
         # the MXU over a dense block layout beats serialized matvecs.
@@ -995,6 +1037,111 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
 # layout inference calls _coo_dispatch_plan itself (not this constant)
 # so the plan-refusal fallback is honoured too.
 COO_NARROW_MAX = 128
+
+
+#: Matmul operand kinds the SpGEMM dispatch accepts.
+_SPGEMM_LEAF_KINDS = ("sparse_leaf", "coo_leaf")
+
+
+def _spgemm_block_size(node: MatExpr, config=None):
+    """The tile edge an S×S matmul's SpGEMM would run at, or None when
+    the node is not an S×S candidate at all: both operands must be
+    sparse leaves, and two block-sparse operands must already agree on
+    block size (their tile grids intersect 1:1). COO operands adopt the
+    block-sparse partner's grid, or config.block_size for COO×COO."""
+    l, r = node.children
+    if (l.kind not in _SPGEMM_LEAF_KINDS
+            or r.kind not in _SPGEMM_LEAF_KINDS):
+        return None
+    sizes = [c.attrs["matrix"].block_size for c in node.children
+             if c.kind == "sparse_leaf"]
+    if len(sizes) == 2 and sizes[0] != sizes[1]:
+        return None
+    if sizes:
+        return sizes[0]
+    cfg = config or default_config()
+    return cfg.block_size
+
+
+def _block_density_of(child: MatExpr, bs: int) -> float:
+    """Block-granular density of an S×S operand: block-sparse leaves
+    carry it; element-sparse leaves COUNT their touched tiles exactly
+    from the host edge lists (memoised per block size). The
+    probabilistic lift (ir/stats.block_density) is wrong in both
+    directions here: under its uniform-independence assumption any
+    element density above ~1/bs² saturates the estimate to ~1.0, so
+    CLUSTERED edge lists — the very inputs tile-intersection SpGEMM
+    exists for — could never dispatch (review r6), while the exact
+    count costs one O(nnz) numpy pass, work from_coo_arrays would
+    redo at lowering anyway."""
+    import math as _math
+    m = child.attrs["matrix"]
+    if child.kind == "sparse_leaf":
+        return m.density
+    memo = getattr(m, "_block_density_memo", None)
+    if memo is not None and memo[0] == bs:
+        return memo[1]
+    import numpy as _np
+    gr = _math.ceil(m.shape[0] / bs)
+    gc = _math.ceil(m.shape[1] / bs)
+    keys = (_np.asarray(m.rows, _np.int64) // bs) * gc \
+        + _np.asarray(m.cols, _np.int64) // bs
+    d = len(_np.unique(keys)) / max(gr * gc, 1)
+    m._block_density_memo = (bs, d)
+    return d
+
+
+def spgemm_out_block_density(node: MatExpr, config=None):
+    """Estimated output BLOCK density of an S×S matmul — the quantity
+    the dispatch threshold compares. None when not an S×S candidate."""
+    from matrel_tpu.ir import stats
+    import math as _math
+    bs = _spgemm_block_size(node, config)
+    if bs is None:
+        return None
+    l, r = node.children
+    kb = max(1, _math.ceil(l.shape[1] / bs))
+    return stats.matmul_density(_block_density_of(l, bs),
+                                _block_density_of(r, bs), kb)
+
+
+def _spgemm_dispatch(node: MatExpr, config=None) -> bool:
+    """Will this matmul lower through the SpGEMM path? SINGLE source of
+    truth, shared by Lowerer._matmul, the planner's strategy pricing
+    (choose_strategy_ex), layout inference and matmul_decisions —
+    mirroring the _coo_dispatch_plan contract."""
+    cfg = config or default_config()
+    if cfg.spgemm_density_threshold <= 0.0:
+        return False
+    est = spgemm_out_block_density(node, cfg)
+    return est is not None and est < cfg.spgemm_density_threshold
+
+
+def spgemm_estimates(node: MatExpr, config=None) -> dict:
+    """Observability record for a SpGEMM dispatch (planner.
+    matmul_decisions → obs/ query events): estimated output block
+    density plus the FLOPs/HBM bytes saved vs the densify fallback."""
+    from matrel_tpu.ir import stats
+    import math as _math
+    cfg = config or default_config()
+    bs = _spgemm_block_size(node, cfg)
+    l, r = node.children
+    k, m = l.shape[1], r.shape[1]
+    kb = max(1, _math.ceil(k / bs))
+
+    def nnzb_of(child):
+        mtx = child.attrs["matrix"]
+        if child.kind == "sparse_leaf":
+            return float(mtx.nnzb)
+        gr = _math.ceil(child.shape[0] / bs)
+        gc = _math.ceil(child.shape[1] / bs)
+        return _block_density_of(child, bs) * gr * gc
+
+    rec = stats.spgemm_saved_estimate(nnzb_of(l), nnzb_of(r), kb, k, m,
+                                      bs)
+    rec["est_out_block_density"] = spgemm_out_block_density(node, cfg)
+    rec["block_size"] = bs
+    return rec
 
 
 def _coo_dispatch_plan(node: MatExpr):

@@ -249,6 +249,14 @@ def as_expr(x: Union[MatExpr, BlockMatrix]) -> MatExpr:
         return x
     if isinstance(x, BlockMatrix):
         return leaf(x)
+    # sparse leaves (BlockSparseMatrix, COOMatrix) lift through their
+    # own .expr() — so S1.multiply(S2) builds the S×S matmul node the
+    # SpGEMM dispatch reads, without an import cycle here
+    make = getattr(x, "expr", None)
+    if callable(make):
+        e = make()
+        if isinstance(e, MatExpr):
+            return e
     raise TypeError(f"cannot lift {type(x)} into MatExpr")
 
 

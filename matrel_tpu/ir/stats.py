@@ -139,3 +139,46 @@ def matmul_out_nnz(
     da = density_of(nnz_a, (n, k))
     db = density_of(nnz_b, (k, m))
     return nnz_from_density(matmul_density(da, db, k), (n, m))
+
+
+# -- block-granular SpGEMM estimates (ops/spgemm.py dispatch + pricing) -----
+
+
+def block_density(elem_density: float, block_size: int) -> float:
+    """Probability a block_size×block_size tile holds ≥1 nonzero, under
+    the same independence assumption as matmul_density — lifts an
+    ELEMENT density (COO leaves) to the BLOCK granularity the SpGEMM
+    tile-intersection reasons at. Same stable 1-(1-p)^k form."""
+    if elem_density <= 0.0:
+        return 0.0
+    if elem_density >= 1.0:
+        return 1.0
+    return -math.expm1(block_size * block_size
+                       * math.log1p(-elem_density))
+
+
+def spgemm_pairs_estimate(nnzb_a: float, nnzb_b: float, kb: int) -> float:
+    """Expected (A-tile, B-tile) intersection pairs for a blocked
+    S×S multiply with kb contraction block-columns, tiles uniformly
+    scattered: each A tile in contraction column c meets the
+    ~nnzb_b/kb B tiles of block-row c."""
+    return nnzb_a * (nnzb_b / max(kb, 1))
+
+
+def spgemm_saved_estimate(nnzb_a: float, nnzb_b: float,
+                          kb: int, k: int, m: int, bs: int,
+                          itemsize: int = 4) -> dict:
+    """Estimated work the SpGEMM dispatch avoids vs the densify
+    fallback (SpMM over a DENSIFIED right operand — executor.py's S×S
+    fallthrough): FLOPs of 2·nnzb_a·bs²·m against 2·pairs·bs³, and the
+    HBM bytes of the dense (k, m) operand that is never materialised.
+    Feeds planner.matmul_decisions → obs/ query events."""
+    pairs = spgemm_pairs_estimate(nnzb_a, nnzb_b, kb)
+    flops_densify = 2.0 * nnzb_a * bs * bs * m
+    flops_spgemm = 2.0 * pairs * bs * bs * bs
+    return {
+        "est_pairs": pairs,
+        "est_saved_flops": max(0.0, flops_densify - flops_spgemm),
+        "est_saved_hbm_bytes": max(
+            0.0, float(k) * m * itemsize - nnzb_b * bs * bs * itemsize),
+    }

@@ -118,6 +118,13 @@ def render(plan, per_op: Dict[int, Tuple[str, float]],
                          f" flops={d['flops']:.3g}")
             elif d.get("dispatch"):
                 line += f" dispatch={d['dispatch']} flops={d['flops']:.3g}"
+                if d.get("est_saved_flops") is not None:
+                    # SpGEMM records: what the tile-intersection saved
+                    # vs densifying (planner.matmul_decisions)
+                    line += (
+                        f" est_saved_flops={d['est_saved_flops']:.3g}"
+                        f" est_saved_hbm="
+                        f"{_fmt_bytes(d.get('est_saved_hbm_bytes'))}")
         lines.append(line)
         for c in n.children:
             walk(c, indent + 1)

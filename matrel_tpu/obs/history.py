@@ -62,6 +62,16 @@ def summarize(events: List[dict]) -> dict:
                 s["flops"] += d["flops"]
             if isinstance(d.get("est_ici_bytes"), (int, float)):
                 s["est_ici_bytes"] += d["est_ici_bytes"]
+            # SpGEMM dispatch records carry estimated savings vs the
+            # densify fallback (planner.matmul_decisions) — rolled up
+            # so `make obs-report` shows the win per strategy
+            if isinstance(d.get("est_saved_flops"), (int, float)):
+                s["est_saved_flops"] = (s.get("est_saved_flops", 0.0)
+                                        + d["est_saved_flops"])
+            if isinstance(d.get("est_saved_hbm_bytes"), (int, float)):
+                s["est_saved_hbm_bytes"] = (
+                    s.get("est_saved_hbm_bytes", 0.0)
+                    + d["est_saved_hbm_bytes"])
         for rule, n in (e.get("rule_hits") or {}).items():
             rule_hits[rule] = rule_hits.get(rule, 0) + int(n)
     last_cache = qs[-1].get("plan_cache", {}) if qs else {}
@@ -98,9 +108,15 @@ def render_summary(events: List[dict]) -> str:
         for name in sorted(s["strategies"],
                            key=lambda k: -s["strategies"][k]["count"]):
             d = s["strategies"][name]
-            lines.append(f"{name:<12}{d['count']:>8}"
-                         f"{d['flops'] / 1e9:>10.2f}"
-                         f"{d['est_ici_bytes'] / 2**20:>13.2f}")
+            line = (f"{name:<12}{d['count']:>8}"
+                    f"{d['flops'] / 1e9:>10.2f}"
+                    f"{d['est_ici_bytes'] / 2**20:>13.2f}")
+            if d.get("est_saved_flops") or d.get("est_saved_hbm_bytes"):
+                line += (f"  saved: {d.get('est_saved_flops', 0) / 1e9:.2f}"
+                         f" GFLOPs / "
+                         f"{d.get('est_saved_hbm_bytes', 0) / 2**20:.1f}"
+                         f" MiB HBM")
+            lines.append(line)
     if s["rule_hits"]:
         lines.append("")
         lines.append("rewrite-rule hits: " + ", ".join(

@@ -1,0 +1,635 @@
+"""SpGEMM (S×S tile-intersection) tests — ISSUE 2 tentpole.
+
+Covers: pair-structure host math, kernel equivalence vs dense oracles
+across densities/dtypes/grids (incl. fuzz seeds), the Pallas interpret
+variant, the sharded wrapper, the executor's density-crossover dispatch
+(structurally asserting NO densify below the threshold), COO-leaf
+combinations, planner stamping/pricing/layout, the α-step comm term and
+the two ADVICE r5 planner fixes that ride along this PR.
+"""
+
+import numpy as np
+import pytest
+
+from matrel_tpu.config import MatrelConfig
+from matrel_tpu.core.coo import COOMatrix
+from matrel_tpu.core.sparse import BlockSparseMatrix
+from matrel_tpu.ops import spgemm as spgemm_lib
+
+
+def random_block_sparse_np(rng, n, k, bs, density):
+    """Host oracle generator (shared idiom with test_sparse.py)."""
+    import math
+    gr, gc = math.ceil(n / bs), math.ceil(k / bs)
+    a = np.zeros((n, k), dtype=np.float32)
+    nblocks = max(1, int(gr * gc * density))
+    flat = rng.choice(gr * gc, size=nblocks, replace=False)
+    for f in flat:
+        bi, bj = f // gc, f % gc
+        blk = rng.standard_normal((bs, bs)).astype(np.float32)
+        a[bi * bs:(bi + 1) * bs, bj * bs:(bj + 1) * bs] = \
+            blk[: n - bi * bs, : k - bj * bs]
+    return a
+
+
+class TestPairStructure:
+    def test_hand_case(self):
+        # A tiles: (0,0), (0,1), (1,1); B tiles: (0,0), (1,0), (1,1)
+        pa, pb, slot, orows, ocols = spgemm_lib.pair_structure(
+            np.array([0, 0, 1]), np.array([0, 1, 1]),
+            np.array([0, 1, 1]), np.array([0, 0, 1]), gc_out=2)
+        # pairs: A0·B0→(0,0), A1·B1→(0,0), A1·B2→(0,1), A2·B1→(1,0),
+        # A2·B2→(1,1); sorted by output slot
+        assert pa.size == 5
+        got = sorted(zip(pa.tolist(), pb.tolist(), slot.tolist()))
+        assert got == [(0, 0, 0), (1, 1, 0), (1, 2, 1), (2, 1, 2),
+                       (2, 2, 3)]
+        assert orows.tolist() == [0, 0, 1, 1]
+        assert ocols.tolist() == [0, 1, 0, 1]
+        # pairs sorted by slot (the accumulate invariant)
+        assert (np.diff(slot) >= 0).all()
+
+    def test_unsorted_b_rows(self):
+        # a hand-built B whose tile list is NOT row-major sorted must
+        # still intersect correctly (pair_structure sorts defensively)
+        pa, pb, slot, orows, ocols = spgemm_lib.pair_structure(
+            np.array([0]), np.array([1]),
+            np.array([2, 1, 0]), np.array([0, 1, 0]), gc_out=2)
+        assert pa.tolist() == [0]
+        assert pb.tolist() == [1]          # the block-row-1 B tile
+        assert orows.tolist() == [0] and ocols.tolist() == [1]
+
+    def test_empty_intersection(self):
+        pa, pb, slot, orows, ocols = spgemm_lib.pair_structure(
+            np.array([0]), np.array([0]),
+            np.array([1]), np.array([0]), gc_out=1)
+        assert pa.size == 0 and orows.size == 0
+
+
+class TestKernelEquivalence:
+    @pytest.mark.parametrize("n,k,m,bs,density", [
+        (32, 32, 32, 8, 0.3),
+        (64, 32, 48, 8, 0.2),        # rectangular, distinct grids
+        (48, 48, 48, 16, 0.5),       # denser than the dispatch takes
+        (40, 24, 56, 8, 0.15),
+    ])
+    def test_matches_dense_oracle(self, mesh8, rng, n, k, m, bs,
+                                  density):
+        a = random_block_sparse_np(rng, n, k, bs, density)
+        b = random_block_sparse_np(rng, k, m, bs, density)
+        A = BlockSparseMatrix.from_numpy(a, block_size=bs, mesh=mesh8)
+        B = BlockSparseMatrix.from_numpy(b, block_size=bs, mesh=mesh8)
+        C = spgemm_lib.spgemm(A, B, MatrelConfig())
+        np.testing.assert_allclose(C.to_numpy(), a @ b, rtol=1e-5,
+                                   atol=1e-5)
+        assert C.shape == (n, m)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzz_random_patterns(self, mesh8, seed):
+        rng = np.random.default_rng(1000 + seed)
+        bs = int(rng.choice([8, 16]))
+        gr, gk, gm = rng.integers(1, 6, 3)
+        n, k, m = int(gr) * bs, int(gk) * bs, int(gm) * bs
+        a = random_block_sparse_np(rng, n, k, bs,
+                                   float(rng.uniform(0.05, 0.6)))
+        b = random_block_sparse_np(rng, k, m, bs,
+                                   float(rng.uniform(0.05, 0.6)))
+        A = BlockSparseMatrix.from_numpy(a, block_size=bs, mesh=mesh8)
+        B = BlockSparseMatrix.from_numpy(b, block_size=bs, mesh=mesh8)
+        C = spgemm_lib.spgemm(A, B, MatrelConfig())
+        np.testing.assert_allclose(C.to_numpy(), a @ b, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_bfloat16_payloads(self, mesh8, rng):
+        import jax.numpy as jnp
+        a = random_block_sparse_np(rng, 32, 32, 8, 0.3)
+        b = random_block_sparse_np(rng, 32, 32, 8, 0.3)
+        A = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8,
+                                         dtype="bfloat16")
+        B = BlockSparseMatrix.from_numpy(b, block_size=8, mesh=mesh8,
+                                         dtype="bfloat16")
+        C = spgemm_lib.spgemm(A, B, MatrelConfig())
+        assert C.dtype == jnp.bfloat16     # keep_input_dtype policy
+        ref = (np.asarray(A.to_numpy(), np.float32)
+               @ np.asarray(B.to_numpy(), np.float32))
+        np.testing.assert_allclose(
+            np.asarray(C.to_numpy(), np.float32), ref,
+            rtol=5e-2, atol=5e-2)          # bf16 storage tolerance
+
+    def test_empty_product(self, mesh8):
+        # disjoint contraction structure → the zero-tile convention
+        a = np.zeros((16, 16), np.float32)
+        a[0, 0] = 1.0                      # tile (0, 0) only
+        b = np.zeros((16, 16), np.float32)
+        b[8, 8] = 1.0                      # tile (1, 1) only
+        A = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        B = BlockSparseMatrix.from_numpy(b, block_size=8, mesh=mesh8)
+        C = spgemm_lib.spgemm(A, B, MatrelConfig())
+        assert C.nnzb == 1
+        np.testing.assert_allclose(C.to_numpy(), np.zeros((16, 16)))
+
+    @pytest.mark.parametrize("shapes", [
+        ((100, 100), (100, 100)),     # ragged everywhere (bs=16)
+        ((96, 90), (90, 96)),         # ragged contraction dim only
+    ])
+    def test_ragged_random_operands(self, mesh8, shapes):
+        """Regression (ragged verify probe): BlockSparseMatrix.random
+        fills WHOLE tiles, so edge tiles carry nonzeros beyond the
+        logical region — in S×S both operands overhang the contraction
+        edge and garbage×garbage landed in kept entries until
+        _edge_masked. The executor path must also keep the padded
+        region exactly zero (the zero-padding invariant)."""
+        from matrel_tpu import executor as executor_lib
+        sa, sb = shapes
+        A = BlockSparseMatrix.random(sa, 0.3, 16, mesh8, seed=31)
+        B = BlockSparseMatrix.random(sb, 0.3, 16, mesh8, seed=32)
+        ref = A.to_numpy() @ B.to_numpy()
+        C = spgemm_lib.spgemm(A, B, MatrelConfig())
+        np.testing.assert_allclose(C.to_numpy(), ref, rtol=1e-4,
+                                   atol=1e-4)
+        Cs = spgemm_lib.spgemm_sharded(A, B, MatrelConfig())
+        np.testing.assert_allclose(Cs.to_numpy(), ref, rtol=1e-4,
+                                   atol=1e-4)
+        # executor leg: sparser pair so the estimate sits BELOW the
+        # crossover (0.3-density operands estimate ~0.5 — correctly
+        # routed to densify, which has its own masking)
+        A2 = BlockSparseMatrix.random(sa, 0.1, 16, mesh8, seed=33)
+        B2 = BlockSparseMatrix.random(sb, 0.1, 16, mesh8, seed=34)
+        e = A2.multiply(B2)
+        assert executor_lib._spgemm_dispatch(e, MatrelConfig())
+        out = executor_lib.execute(e, mesh8, MatrelConfig())
+        full = np.array(np.asarray(out.data))
+        n, m = sa[0], sb[1]
+        np.testing.assert_allclose(full[:n, :m],
+                                   A2.to_numpy() @ B2.to_numpy(),
+                                   rtol=1e-4, atol=1e-4)
+        full[:n, :m] = 0
+        assert not full.any(), "padded region must be exact zeros"
+
+    def test_block_size_mismatch_raises(self, mesh8, rng):
+        a = random_block_sparse_np(rng, 32, 32, 8, 0.3)
+        A = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        B = BlockSparseMatrix.from_numpy(a, block_size=16, mesh=mesh8)
+        with pytest.raises(ValueError, match="matching block sizes"):
+            spgemm_lib.spgemm(A, B, MatrelConfig())
+
+    def test_apply_dense_padded_canonical(self, mesh8, rng):
+        from matrel_tpu.core import padding
+        a = random_block_sparse_np(rng, 40, 24, 8, 0.3)
+        b = random_block_sparse_np(rng, 24, 40, 8, 0.3)
+        A = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        B = BlockSparseMatrix.from_numpy(b, block_size=8, mesh=mesh8)
+        out = spgemm_lib.apply_dense(A, B, MatrelConfig())
+        pshape = padding.padded_shape((40, 40), mesh8)
+        assert tuple(out.shape) == pshape
+        got = np.asarray(out)[:40, :40]
+        np.testing.assert_allclose(got, a @ b, rtol=1e-5, atol=1e-5)
+        # the zero-padding invariant every consumer relies on
+        full = np.array(out)               # writable copy
+        full[:40, :40] = 0
+        assert not full.any()
+
+
+def test_pallas_interpret_variant(mesh8, rng):
+    """The scalar-prefetch Pallas kernel (interpret mode on CPU) must
+    agree with the XLA gather/segment-sum runner bit-for-tolerance."""
+    cfg = MatrelConfig(use_pallas=True, pallas_interpret=True)
+    a = random_block_sparse_np(rng, 32, 32, 8, 0.4)
+    b = random_block_sparse_np(rng, 32, 32, 8, 0.4)
+    A = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+    B = BlockSparseMatrix.from_numpy(b, block_size=8, mesh=mesh8)
+    assert spgemm_lib.pallas_eligible(8, 4)
+    C = spgemm_lib.spgemm(A, B, cfg)
+    np.testing.assert_allclose(C.to_numpy(), a @ b, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_pallas_eligibility_gate():
+    assert not spgemm_lib.pallas_eligible(4, 10)   # sub-8 sublane tile
+    assert not spgemm_lib.pallas_eligible(8, 0)    # no pairs
+    assert spgemm_lib.pallas_eligible(16, 1)
+
+
+class TestShardedSpGEMM:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_matches_oracle_on_mesh(self, mesh8, seed):
+        rng = np.random.default_rng(2000 + seed)
+        a = random_block_sparse_np(rng, 64, 48, 8, 0.3)
+        b = random_block_sparse_np(rng, 48, 64, 8, 0.3)
+        A = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        B = BlockSparseMatrix.from_numpy(b, block_size=8, mesh=mesh8)
+        C = spgemm_lib.spgemm_sharded(A, B, MatrelConfig())
+        np.testing.assert_allclose(C.to_numpy(), a @ b, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_empty_intersection_sharded(self, mesh8):
+        a = np.zeros((16, 16), np.float32)
+        a[0, 0] = 1.0
+        b = np.zeros((16, 16), np.float32)
+        b[8, 8] = 1.0
+        A = BlockSparseMatrix.from_numpy(a, block_size=8, mesh=mesh8)
+        B = BlockSparseMatrix.from_numpy(b, block_size=8, mesh=mesh8)
+        C = spgemm_lib.spgemm_sharded(A, B, MatrelConfig())
+        np.testing.assert_allclose(C.to_numpy(), np.zeros((16, 16)))
+
+
+class TestFromCooArrays:
+    def test_matches_from_scipy(self, mesh8, rng):
+        import scipy.sparse as sp
+        m = sp.random(40, 30, density=0.05, random_state=7,
+                      format="coo", dtype=np.float32)
+        S1 = BlockSparseMatrix.from_scipy(m, block_size=8, mesh=mesh8)
+        S2 = BlockSparseMatrix.from_coo_arrays(
+            m.row, m.col, m.data, m.shape, block_size=8, mesh=mesh8)
+        np.testing.assert_allclose(S1.to_numpy(), S2.to_numpy())
+
+    def test_duplicates_accumulate(self, mesh8):
+        S = BlockSparseMatrix.from_coo_arrays(
+            [0, 0, 5], [0, 0, 5], [1.0, 2.0, 4.0], (16, 16),
+            block_size=8, mesh=mesh8)
+        d = S.to_numpy()
+        assert d[0, 0] == pytest.approx(3.0)   # scipy COO semantics
+        assert d[5, 5] == pytest.approx(4.0)
+        assert S.nnzb == 1                      # one touched tile
+
+
+# -- executor dispatch -------------------------------------------------------
+
+
+def _sparse_pair(mesh, bs=8, n=128, density=0.05, seeds=(11, 12)):
+    A = BlockSparseMatrix.random((n, n), block_density=density,
+                                 block_size=bs, mesh=mesh,
+                                 seed=seeds[0])
+    B = BlockSparseMatrix.random((n, n), block_density=density,
+                                 block_size=bs, mesh=mesh,
+                                 seed=seeds[1])
+    return A, B
+
+
+class TestExecutorDispatch:
+    def test_dispatch_below_threshold_no_densify(self, mesh8,
+                                                 monkeypatch):
+        """The acceptance-criterion structural assert: an S×S matmul
+        below the crossover must lower WITHOUT densifying either
+        operand — to_dense/to_block poisoned, plan still runs."""
+        from matrel_tpu import executor as executor_lib
+        cfg = MatrelConfig()
+        A, B = _sparse_pair(mesh8)
+        e = A.multiply(B)
+        assert executor_lib._spgemm_dispatch(e, cfg)
+        ref = A.to_numpy() @ B.to_numpy()
+
+        def boom(self, *a, **k):
+            raise AssertionError(
+                "S×S below the SpGEMM threshold densified an operand")
+
+        monkeypatch.setattr(BlockSparseMatrix, "to_dense", boom)
+        monkeypatch.setattr(COOMatrix, "to_block", boom)
+        out = executor_lib.execute(e, mesh8, cfg)
+        np.testing.assert_allclose(out.to_numpy()[:128, :128], ref,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_equals_densify_path(self, mesh8):
+        """Equivalence across the crossover: the SpGEMM lowering and
+        the densify fallback produce the same product."""
+        from matrel_tpu import executor as executor_lib
+        A, B = _sparse_pair(mesh8, density=0.1, seeds=(13, 14))
+        sp = executor_lib.execute(A.multiply(B), mesh8, MatrelConfig())
+        dn = executor_lib.execute(
+            A.multiply(B), mesh8,
+            MatrelConfig(spgemm_density_threshold=0.0))
+        np.testing.assert_allclose(sp.to_numpy(), dn.to_numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_above_threshold_takes_densify(self, mesh8, monkeypatch):
+        """Crossover: a dense-ish S×S (estimated output density ≥ the
+        threshold) must route to the existing densify path."""
+        from matrel_tpu import executor as executor_lib
+        cfg = MatrelConfig()
+        A, B = _sparse_pair(mesh8, density=0.9, seeds=(15, 16))
+        e = A.multiply(B)
+        assert not executor_lib._spgemm_dispatch(e, cfg)
+        calls = []
+        orig = BlockSparseMatrix.to_dense
+
+        def spy(self, *a, **k):
+            calls.append(1)
+            return orig(self, *a, **k)
+
+        monkeypatch.setattr(BlockSparseMatrix, "to_dense", spy)
+        executor_lib.execute(e, mesh8, cfg)
+        assert calls, "densify fallback did not run above the threshold"
+
+    def test_threshold_zero_disables(self, mesh8):
+        from matrel_tpu import executor as executor_lib
+        A, B = _sparse_pair(mesh8)
+        assert not executor_lib._spgemm_dispatch(
+            A.multiply(B), MatrelConfig(spgemm_density_threshold=0.0))
+
+    def test_coo_coo_dispatch(self, mesh8, monkeypatch):
+        """Element-sparse × element-sparse: COO leaves bucket into
+        block tiles (from_coo_arrays) — never through to_block."""
+        from matrel_tpu import executor as executor_lib
+        cfg = MatrelConfig(block_size=8)
+        rng = np.random.default_rng(3)
+        n, nnz = 256, 100
+        C1 = COOMatrix.from_edges(rng.integers(0, n, nnz),
+                                  rng.integers(0, n, nnz),
+                                  shape=(n, n))
+        C2 = COOMatrix.from_edges(rng.integers(0, n, nnz),
+                                  rng.integers(0, n, nnz),
+                                  shape=(n, n))
+        e = C1.multiply(C2.expr())
+        assert executor_lib._spgemm_dispatch(e, cfg)
+        ref = C1.to_dense() @ C2.to_dense()
+        monkeypatch.setattr(
+            COOMatrix, "to_block",
+            lambda self, *a, **k: (_ for _ in ()).throw(
+                AssertionError("COO operand densified")))
+        out = executor_lib.execute(e, mesh8, cfg)
+        np.testing.assert_allclose(out.to_numpy()[:n, :n], ref,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_coo_clustered_exact_block_density(self, mesh8):
+        """Review r6: COO block density is COUNTED from the edge list,
+        not lifted probabilistically — clustered entries (500 nonzeros
+        confined to 3 tiles) must dispatch; the uniform-independence
+        lift would have saturated to ~0.86 and refused the very inputs
+        tile-intersection SpGEMM exists for."""
+        from matrel_tpu import executor as executor_lib
+        cfg = MatrelConfig(block_size=16)
+        rng = np.random.default_rng(6)
+        rs, cs = [], []
+        for (bi, bj) in [(0, 0), (3, 7), (9, 2)]:      # 3 tiles of 256
+            rs.append(bi * 16 + rng.integers(0, 16, 170))
+            cs.append(bj * 16 + rng.integers(0, 16, 170))
+        C1 = COOMatrix.from_edges(np.concatenate(rs),
+                                  np.concatenate(cs),
+                                  shape=(256, 256))
+        e = C1.multiply(C1.expr())
+        (l, _) = e.children
+        assert executor_lib._block_density_of(l, 16) == \
+            pytest.approx(3 / 256)
+        assert executor_lib._spgemm_dispatch(e, cfg)
+        out = executor_lib.execute(e, mesh8, cfg)
+        ref = C1.to_dense() @ C1.to_dense()
+        np.testing.assert_allclose(out.to_numpy()[:256, :256], ref,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_pair_structure_cached_per_operand_pair(self, mesh8,
+                                                    monkeypatch):
+        """Review r6: the host intersection runs once per (A, B) pair —
+        iterative reuse re-runs only device compute."""
+        calls = []
+        orig = spgemm_lib.pair_structure
+
+        def counting(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        monkeypatch.setattr(spgemm_lib, "pair_structure", counting)
+        spgemm_lib._STRUCT_CACHE.clear()
+        A, B = _sparse_pair(mesh8, seeds=(21, 22))
+        spgemm_lib.spgemm(A, B, MatrelConfig())
+        spgemm_lib.spgemm(A, B, MatrelConfig())
+        spgemm_lib.spgemm_sharded(A, B, MatrelConfig())
+        assert len(calls) == 1
+
+    def test_mixed_bsr_coo_dispatch(self, mesh8):
+        """BlockSparse × COO adopts the block-sparse partner's grid."""
+        from matrel_tpu import executor as executor_lib
+        cfg = MatrelConfig()
+        rng = np.random.default_rng(4)
+        A, _ = _sparse_pair(mesh8)
+        C = COOMatrix.from_edges(rng.integers(0, 128, 60),
+                                 rng.integers(0, 128, 60),
+                                 shape=(128, 128))
+        e = A.multiply(C.expr())
+        assert executor_lib._spgemm_block_size(e, cfg) == A.block_size
+        assert executor_lib._spgemm_dispatch(e, cfg)
+        out = executor_lib.execute(e, mesh8, cfg)
+        ref = A.to_numpy() @ C.to_dense()
+        np.testing.assert_allclose(out.to_numpy()[:128, :128], ref,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_spgemm_feeds_downstream_ops(self, mesh8):
+        """The scattered dense output must compose with the rest of the
+        executor (scalar ops, aggregates) like any other matmul."""
+        from matrel_tpu import executor as executor_lib
+        A, B = _sparse_pair(mesh8, seeds=(17, 18))
+        e = A.multiply(B).multiply_scalar(2.0).sum()
+        out = executor_lib.execute(e, mesh8, MatrelConfig())
+        ref = 2.0 * (A.to_numpy() @ B.to_numpy()).sum()
+        assert np.asarray(out.to_numpy()).ravel()[0] == pytest.approx(
+            ref, rel=1e-4)
+
+
+# -- planner integration -----------------------------------------------------
+
+
+class TestPlannerIntegration:
+    def test_strategy_stamped_spgemm(self, mesh8):
+        from matrel_tpu.parallel import planner
+        A, B = _sparse_pair(mesh8)
+        ann = planner.annotate_strategies(A.multiply(B), mesh8,
+                                          MatrelConfig())
+        assert ann.attrs["strategy"] == "spgemm"
+        assert ann.attrs["strategy_source"] == "dispatch"
+
+    def test_infer_layout_2d(self, mesh8):
+        from matrel_tpu.parallel import planner
+        A, B = _sparse_pair(mesh8)
+        ann = planner.annotate_strategies(A.multiply(B), mesh8,
+                                          MatrelConfig())
+        assert planner.infer_layout(ann, mesh8,
+                                    config=MatrelConfig()) == "2d"
+
+    def test_comm_cost_spgemm_zero(self):
+        from matrel_tpu.parallel import planner
+        assert planner.comm_cost("spgemm", 128, 128, 128, 0.05, 0.05,
+                                 2, 4) == 0.0
+
+    def test_matmul_decisions_record(self, mesh8):
+        from matrel_tpu.parallel import planner
+        cfg = MatrelConfig()
+        A, B = _sparse_pair(mesh8)
+        ann = planner.annotate_strategies(A.multiply(B), mesh8, cfg)
+        (rec,) = planner.matmul_decisions(ann, mesh8, cfg)
+        assert rec["strategy"] == "spgemm"
+        assert rec["dispatch"] == "spgemm"
+        assert rec["est_saved_flops"] > 0
+        assert rec["est_saved_hbm_bytes"] > 0
+        assert 0.0 < rec["est_out_block_density"] < \
+            cfg.spgemm_density_threshold
+
+    def test_override_cannot_misreport_dispatch(self, mesh8):
+        """strategy_override cannot reroute the S×S dispatch (the
+        lowering checks _spgemm_dispatch before reading the strategy),
+        so the stamp must still say spgemm — an 'rmm[override]' stamp
+        would price a comm bill that never executes (review)."""
+        from matrel_tpu.parallel import planner
+        cfg = MatrelConfig(strategy_override="rmm")
+        A, B = _sparse_pair(mesh8)
+        assert planner.choose_strategy_ex(
+            A.multiply(B), mesh8, cfg) == ("spgemm", "dispatch")
+        # the documented way to force the densify path instead:
+        cfg_off = MatrelConfig(strategy_override="rmm",
+                               spgemm_density_threshold=0.0)
+        assert planner.choose_strategy_ex(
+            A.multiply(B), mesh8, cfg_off) == ("rmm", "override")
+
+    def test_above_threshold_not_stamped_spgemm(self, mesh8):
+        from matrel_tpu.parallel import planner
+        A, B = _sparse_pair(mesh8, density=0.9, seeds=(15, 16))
+        ann = planner.annotate_strategies(A.multiply(B), mesh8,
+                                          MatrelConfig())
+        assert ann.attrs["strategy"] != "spgemm"
+
+    def test_query_event_carries_spgemm(self, mesh8, tmp_path):
+        """End to end through the obs/ surface: the session's query
+        event records the spgemm strategy + saved estimates."""
+        import json
+        from matrel_tpu import session as session_lib
+        log = tmp_path / "events.jsonl"
+        s = session_lib.MatrelSession(
+            mesh=mesh8, config=MatrelConfig(obs_level="on",
+                                            obs_event_log=str(log)))
+        A, B = _sparse_pair(mesh8)
+        s.compute(A.multiply(B))
+        recs = [json.loads(l) for l in log.read_text().splitlines()]
+        (q,) = [r for r in recs if r["kind"] == "query"]
+        (mm,) = q["matmuls"]
+        assert mm["strategy"] == "spgemm"
+        assert mm["est_saved_flops"] > 0
+
+
+# -- α-step comm model + ADVICE r5 planner fixes (satellites) ---------------
+
+
+class TestAlphaCommModel:
+    def test_alpha_charges_per_step(self):
+        """Exact step counts per strategy: cost(α) - cost(0) = steps·α."""
+        from matrel_tpu.parallel import planner
+        n = k = m = 1024
+        al = 1e6
+
+        def steps(strategy, gx, gy, **kw):
+            c1 = planner.comm_cost(strategy, n, k, m, 1.0, 1.0, gx, gy,
+                                   alpha_bytes=al, **kw)
+            c0 = planner.comm_cost(strategy, n, k, m, 1.0, 1.0, gx, gy,
+                                   **kw)
+            return (c1 - c0) / al
+
+        assert steps("bmm_right", 2, 4) == 2      # bcast + reshard
+        assert steps("bmm_left", 2, 4) == 2
+        assert steps("rmm", 2, 4) == 2            # two all-gathers
+        assert steps("cpmm", 2, 4) == 2           # reshard_b + rs_c
+        # SUMMA: 2·(g−1) ring ppermute steps (2d inputs: no reshard)
+        assert steps("summa", 4, 4) == 2 * 3
+        # replicated operands: gather terms vanish AND their steps do
+        assert steps("rmm", 2, 4, a_layout="rep", b_layout="rep") == 0
+        assert steps("spgemm", 2, 4) == 0
+
+    def test_alpha_flips_latency_bound_choice(self, mesh8):
+        """VERDICT r5 Missing #4: a small latency-bound multiply whose
+        cheapest-β strategy needs MORE collective steps must flip to
+        the fewer-step strategy once α is on — a col-sharded 16×512
+        left operand gives cpmm three nonzero steps (re-lay A, gather
+        B rows, reduce-scatter C) against rmm's two all-gathers."""
+        from jax.sharding import PartitionSpec as P
+        from matrel_tpu.ir.expr import matmul
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.parallel import planner
+        rng = np.random.default_rng(0)
+        A = BlockMatrix.from_numpy(
+            rng.standard_normal((16, 512)).astype(np.float32),
+            mesh=mesh8, spec=P(None, ("x", "y")))
+        B = BlockMatrix.from_numpy(
+            rng.standard_normal((512, 16)).astype(np.float32),
+            mesh=mesh8)
+        e = matmul(A.expr(), B.expr())
+        beta_only, _ = planner.choose_strategy_ex(
+            e, mesh8, MatrelConfig(comm_alpha_bytes=0.0),
+            root_output=True)
+        alpha, _ = planner.choose_strategy_ex(
+            e, mesh8, MatrelConfig(), root_output=True)
+        assert beta_only == "cpmm"     # β bytes alone prefer cpmm
+        assert alpha == "rmm"          # α charges cpmm's third step
+
+
+class TestChildRootScale:
+    def test_wrappers_preserve_scale(self, mesh8, rng):
+        from matrel_tpu.ir.expr import matmul
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.parallel import planner
+        A = BlockMatrix.from_numpy(
+            rng.standard_normal((64, 64)).astype(np.float32),
+            mesh=mesh8)
+        mm = matmul(A.expr(), A.expr())
+        scalar = mm.multiply_scalar(2.0)
+        assert planner._child_root_scale(scalar, 0, 1.0) == 1.0
+        # a matmul parent consumes the child's layout itself: no flow
+        mm2 = matmul(mm, A.expr())
+        assert planner._child_root_scale(mm2, 0, 1.0) == 0.0
+        # non-root context: nothing flows
+        assert planner._child_root_scale(scalar, 0, 0.0) == 0.0
+
+    def test_elemwise_splits_charge(self, mesh8, rng):
+        """ADVICE r5: at most ONE root re-lay occurs under a root
+        elemwise — each full-shaped child carries half, and under
+        broadcast only the full-shaped operand carries any."""
+        from matrel_tpu.ir.expr import matmul
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.parallel import planner
+        A = BlockMatrix.from_numpy(
+            rng.standard_normal((64, 64)).astype(np.float32),
+            mesh=mesh8)
+        v = BlockMatrix.from_numpy(
+            rng.standard_normal((64, 1)).astype(np.float32),
+            mesh=mesh8)
+        mm = matmul(A.expr(), A.expr())
+        ew = mm.add(mm)
+        assert planner._child_root_scale(ew, 0, 1.0) == 0.5
+        assert planner._child_root_scale(ew, 1, 1.0) == 0.5
+        bc = mm.add(v.expr())          # broadcast: v is not full-shaped
+        assert planner._child_root_scale(bc, 0, 1.0) == 1.0
+        assert planner._child_root_scale(bc, 1, 1.0) == 0.0
+
+    def test_rank1_layout_carrier_only(self, mesh8, rng):
+        from matrel_tpu.ir.expr import matmul, rank_one_update
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        from matrel_tpu.parallel import planner
+        A = BlockMatrix.from_numpy(
+            rng.standard_normal((64, 64)).astype(np.float32),
+            mesh=mesh8)
+        u = BlockMatrix.from_numpy(
+            rng.standard_normal((64, 1)).astype(np.float32),
+            mesh=mesh8)
+        r1 = rank_one_update(matmul(A.expr(), A.expr()), u.expr(),
+                             u.expr())
+        assert planner._child_root_scale(r1, 0, 1.0) == 1.0
+        assert planner._child_root_scale(r1, 1, 1.0) == 0.0
+
+
+def test_child_layout_hints_admissibility_gate(mesh8, rng):
+    """ADVICE r5: no hint toward a bmm the parent's padded dims cannot
+    shard on this grid — a matvec-shaped (64,64)@(64,1) keeps its
+    size-1 dim unpadded (padding.py), so bmm_left can never divide m
+    across 8 devices and the 'col' hint must not be emitted."""
+    from matrel_tpu.ir.expr import matmul
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.parallel import planner
+
+    def mat(n, m):
+        return BlockMatrix.from_numpy(
+            rng.standard_normal((n, m)).astype(np.float32),
+            mesh=mesh8).expr()
+
+    matvec = matmul(mat(64, 64), mat(64, 1))
+    assert planner._child_layout_hints(matvec, mesh8) == ("row", None)
+    vecmat = matmul(mat(1, 64), mat(64, 64))
+    assert planner._child_layout_hints(vecmat, mesh8) == (None, "col")
+    wide = matmul(mat(64, 64), mat(64, 64))
+    assert planner._child_layout_hints(wide, mesh8) == ("row", "col")
+    # meshless call sites keep the threshold-only behaviour
+    assert planner._child_layout_hints(matvec) == ("row", "col")

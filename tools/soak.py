@@ -216,6 +216,23 @@ def soak_sharded(n_trials: int, base: int, tol: float):
                     BlockMatrix.from_numpy(d, mesh=mesh)).to_numpy()
                 np.testing.assert_allclose(got, a @ d, rtol=tol, atol=tol)
 
+            # tile-intersection SpGEMM (plain + sharded) vs oracle
+            from matrel_tpu.ops import spgemm as spgemm_lib
+            gm = int(rng.integers(1, 12))
+            b = np.zeros((k, gm * bs), np.float32)
+            for f in range(gc * gm):
+                if rng.random() < dens:
+                    bi, bj = f // gm, f % gm
+                    b[bi*bs:(bi+1)*bs, bj*bs:(bj+1)*bs] = \
+                        rng.standard_normal((bs, bs))
+            B2 = BlockSparseMatrix.from_numpy(b, block_size=bs,
+                                              mesh=mesh)
+            want = a @ b
+            got = spgemm_lib.spgemm(S, B2).to_numpy()
+            np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+            got = spgemm_lib.spgemm_sharded(S, B2).to_numpy()
+            np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+
             # sharded one-hot SpMV
             n_r = int(rng.integers(64, 4000))
             n_c = int(rng.integers(64, 4000))

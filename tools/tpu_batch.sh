@@ -12,6 +12,8 @@ log "--- bench.py (headline, BENCH row 1)"
 python bench.py
 log "--- soak_guard (on-chip oracle soak)"
 python tools/soak_guard.py --seeds 8
+log "--- bench.py --spgemm (S x S tile-intersection SpGEMM row, staged this round)"
+python bench.py --spgemm
 log "--- bench_all.py (all BASELINE rows)"
 python bench_all.py
 log "--- north_star_sweep (VERDICT #10 residual)"
