@@ -1,5 +1,11 @@
 # matrel_tpu developer entry points.
 #
+# lint       — matlint (AST hazard rules, tools/matlint.py) + the
+#              static-verifier self-check over the plan-snapshot
+#              corpus (tools/plan_verify.py). Runs repo-wide; rc != 0
+#              on any finding/diagnostic. `test` depends on it, and
+#              tests/test_matlint.py re-runs it in-process so the
+#              tier-1 pytest path cannot silently skip it either.
 # test       — full CPU suite on the simulated 8-device mesh
 # soak       — oracle fuzz batteries on CPU (fast sanity)
 # soak-tpu   — on-chip soak with relay-wedge-safe probe/timeouts;
@@ -16,9 +22,14 @@ PY ?= python
 SEEDS ?= 10
 OBS_LOG ?= .matrel_events.jsonl
 
-.PHONY: test soak soak-tpu multihost native bench tpu-batch obs-report
+.PHONY: test lint soak soak-tpu multihost native bench tpu-batch \
+        tpu-batch-dry obs-report
 
-test:
+lint:
+	$(PY) tools/matlint.py
+	$(PY) tools/plan_verify.py
+
+test: lint
 	$(PY) -m pytest tests/ -q
 
 soak:
@@ -38,6 +49,14 @@ bench:
 
 tpu-batch:
 	sh tools/tpu_batch.sh
+
+# fire-drill: the WHOLE staged relay-recovery batch on the CPU backend
+# at toy sizes (VERDICT r5 Next #2) — proves every step runs and emits
+# its parseable artifact, so a real relay window is spent measuring,
+# not debugging the harness. tests/test_batch_dry.py asserts the
+# artifacts.
+tpu-batch-dry:
+	sh tools/tpu_batch.sh --dry
 
 obs-report:
 	$(PY) -m matrel_tpu history --summary --log $(OBS_LOG)

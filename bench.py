@@ -40,8 +40,21 @@ N = _env_int("MATREL_BENCH_N", 4096)
 DTYPE = "bfloat16"
 REPEATS = _env_int("MATREL_BENCH_REPEATS", 40)
 _HERE = os.path.dirname(os.path.abspath(__file__))
-CPU_CACHE = os.path.join(_HERE, "cpu_baseline.json")
-LAST_GOOD = os.path.join(_HERE, "bench_last_good.json")
+# path overrides exist for the dry-batch fire-drill (tools/tpu_batch.sh
+# --dry): a toy-scale CPU run must not clobber the real CPU baseline or
+# the last-known-good on-chip record
+CPU_CACHE = os.environ.get("MATREL_BENCH_CPU_CACHE",
+                           os.path.join(_HERE, "cpu_baseline.json"))
+LAST_GOOD = os.environ.get("MATREL_BENCH_LAST_GOOD",
+                           os.path.join(_HERE, "bench_last_good.json"))
+# Weak #5 (round 5): sub-5-ms rows showed a 4.6x run-to-run band. For
+# any per-multiply time under this threshold, measure_tpu RAISES the
+# chained-rep count until the marginal-time band half-width is under
+# BAND_TARGET of the median (or the escalation cap is hit) and records
+# the interval in the bench JSON either way.
+BAND_ROW_THRESHOLD_S = 5e-3
+BAND_TARGET = 0.15
+BAND_MAX_DOUBLINGS = _env_int("MATREL_BENCH_BAND_DOUBLINGS", 4)
 
 PROBE_TIMEOUT_S = _env_int("MATREL_BENCH_PROBE_TIMEOUT", 180)
 MEASURE_TIMEOUT_S = _env_int("MATREL_BENCH_MEASURE_TIMEOUT", 900)
@@ -160,17 +173,36 @@ def measure_tpu() -> dict:
     chained(2)  # warm both programs
     phases["warmup_s"] = round(time.perf_counter() - t_phase, 3)
     t_phase = time.perf_counter()
-    lo, hi = 5, 5 + REPEATS
-    dts = []
-    canary = None
-    for _ in range(5):
-        t0 = time.perf_counter()
-        chained(lo)
-        t_lo = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        canary = chained(hi)
-        t_hi = time.perf_counter() - t0
-        dts.append(max((t_hi - t_lo) / (hi - lo), 1e-9))
+    reps = REPEATS
+    escalations = 0
+    while True:
+        lo, hi = 5, 5 + reps
+        dts = []
+        canary = None
+        for _ in range(5):
+            t0 = time.perf_counter()
+            chained(lo)
+            t_lo = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            canary = chained(hi)
+            t_hi = time.perf_counter() - t0
+            dts.append(max((t_hi - t_lo) / (hi - lo), 1e-9))
+        dt = sorted(dts)[len(dts) // 2]
+        half_width = (max(dts) - min(dts)) / 2
+        # latency-bound rows (sub-5-ms per multiply — BASELINE row 2
+        # class, VERDICT r5 Weak #5) drown the marginal in dispatch
+        # jitter: escalate the chained-rep count until the band
+        # half-width is inside BAND_TARGET of the median, so
+        # regressions at this size stop hiding in a 4.6x spread.
+        # Bounded doublings: a noisy host must still report (with its
+        # interval on record) rather than spin past the harness
+        # deadline.
+        if (dt >= BAND_ROW_THRESHOLD_S
+                or half_width <= BAND_TARGET * dt
+                or escalations >= BAND_MAX_DOUBLINGS):
+            break
+        reps *= 2
+        escalations += 1
     # canary: mean|entry| of the final chain product. The rescaled chain
     # keeps it O(1); inf/nan (overflow, garbage results) or a collapsed/
     # exploded scale means the multiply chain computed wrong values and
@@ -180,9 +212,17 @@ def measure_tpu() -> dict:
         raise RuntimeError(
             f"chain correctness canary out of band: mean|C| = {canary!r}")
     phases["measure_s"] = round(time.perf_counter() - t_phase, 3)
-    dt = sorted(dts)[len(dts) // 2]
     n_chips = max(1, len(mesh.devices.ravel()))
-    return {"tflops": flops(N) / dt / 1e12 / n_chips, "phases": phases}
+    interval = {
+        "median_ms": round(dt * 1e3, 4),
+        "half_width_ms": round(half_width * 1e3, 4),
+        "half_width_frac": round(half_width / dt, 4),
+        "reps": reps,
+        "escalations": escalations,
+        "band_target": BAND_TARGET,
+    }
+    return {"tflops": flops(N) / dt / 1e12 / n_chips, "phases": phases,
+            "interval": interval}
 
 
 def measure_spgemm() -> dict:
@@ -576,6 +616,7 @@ def main() -> None:
     errors: list[str] = []
     tpu: float | None = None
     phases: dict | None = None
+    interval: dict | None = None
     for attempt in range(1 + len(BACKOFFS_S)):
         if attempt > 0:
             delay = BACKOFFS_S[attempt - 1]
@@ -607,6 +648,7 @@ def main() -> None:
         try:
             tpu = float(payload["tflops"])
             phases = payload.get("phases")
+            interval = payload.get("interval")
             break
         except (KeyError, TypeError, ValueError):
             errors.append(f"measure returned unexpected payload: "
@@ -619,12 +661,14 @@ def main() -> None:
             "metric": "dense_blockmatmul_tflops_per_chip",
             "value": round(tpu, 3), "n": N, "dtype": DTYPE,
             "attempts": 1 + len(errors), "phases": phases,
+            "interval": interval,
             "wall_s": round(time.monotonic() - t_start, 1)})
         print(json.dumps({
             "metric": "dense_blockmatmul_tflops_per_chip",
             "value": round(tpu, 3),
             "unit": "TFLOPS",
             "vs_baseline": round(tpu / base, 2),
+            "interval": interval,
         }))
         return
 

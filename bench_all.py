@@ -23,6 +23,7 @@ counts (see bench.py). Run on the real chip: `python bench_all.py`.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -350,10 +351,22 @@ def main():
     cfg = MatrelConfig()
     set_default_config(cfg)
     mesh = mesh_lib.make_mesh()
+    # MATREL_DRY (tools/tpu_batch.sh --dry): run the rows whose fixed
+    # configs are CPU-feasible, emit an explicit parseable skip record
+    # for each row whose hard-coded full scale is not (10M-row linreg,
+    # 100k SpMM, the 65k north star, …) — the fire-drill proves the
+    # step order, the JSON contract and the harness glue, not the
+    # numbers.
+    dry = bool(os.environ.get("MATREL_DRY"))
+    dry_rows = (bench_dense_4k, bench_chain, bench_spgemm)
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
                bench_spgemm, bench_pagerank, bench_pagerank_10x,
                bench_cg, bench_eigen, bench_triangles,
                bench_north_star):
+        if dry and fn not in dry_rows:
+            print(json.dumps({"metric": fn.__name__, "skipped": "dry"}),
+                  flush=True)
+            continue
         try:
             print(json.dumps(fn(mesh, cfg)), flush=True)
         except Exception as e:  # keep the suite running

@@ -1,0 +1,100 @@
+"""Static plan verifier — pre-execution invariant analysis for
+physical plans (the missing static half of the planner subsystem).
+
+The optimizer/planner's value proposition is picking a CORRECT AND
+FEASIBLE physical plan before anything runs on hardware (PAPER.md;
+SURVEY.md §2 "Physical planner"); through round 5 the repo had a deep
+cost model but nothing that statically checked its outputs — the
+invariants (strategy admissibility, layout-claim truthfulness, the
+zero-padding rule, the SpGEMM no-densify guarantee, per-chip HBM
+feasibility) were enforced only by scattered dynamic tests.
+Array-redistribution correctness at scale is exactly the class of bug
+a static checker catches before the chip does (arXiv:2112.01075), and
+per-chip memory is the binding constraint there (arXiv:2112.09017).
+
+Usage:
+
+    from matrel_tpu import analysis
+    diags = analysis.verify_plan(annotated_expr, mesh, config)
+
+``verify_plan`` expects a PLANNED tree (post
+``planner.annotate_strategies``); the executor runs it automatically
+under ``config.verify_plans`` ("warn" logs, "error" raises
+:class:`VerificationError` before tracing), ``session.verify(expr)``
+runs it on demand, and ``session.explain`` renders the findings.
+
+Pass registry (each: ``fn(root, mesh, config) -> Iterator[Diagnostic]``;
+codes documented in :mod:`matrel_tpu.analysis.diagnostics`):
+
+  strategy   MV101  stamped strategy admissible on this mesh
+  spgemm     MV104  SpGEMM stamp <-> dispatch predicate agreement
+  layout     MV102  infer_layout claims pinned by the lowering
+  padding    MV103  zero-padding invariant restored after breakers
+  hbm        MV105  per-device working set fits hbm_budget_bytes
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from matrel_tpu.analysis.diagnostics import (  # noqa: F401 (re-export)
+    Diagnostic, VerificationError)
+from matrel_tpu.analysis.hbm_pass import check_hbm_feasibility
+from matrel_tpu.analysis.layout_pass import check_layout_claims
+from matrel_tpu.analysis.padding_pass import check_padding_flow
+from matrel_tpu.analysis.strategy_pass import (check_spgemm_dispatch,
+                                               check_strategy_stamps)
+from matrel_tpu.config import MatrelConfig, default_config
+
+log = logging.getLogger("matrel_tpu.analysis")
+
+#: (name, pass_fn) in report order. Passes are independent reads of the
+#: same annotated tree; each walks the DAG once, so a full verify is
+#: O(passes x nodes) with no tracing and no device work.
+PASSES = (
+    ("strategy", check_strategy_stamps),
+    ("spgemm", check_spgemm_dispatch),
+    ("layout", check_layout_claims),
+    ("padding", check_padding_flow),
+    ("hbm", check_hbm_feasibility),
+)
+
+
+def verify_plan(root, mesh, config: Optional[MatrelConfig] = None,
+                passes=None) -> List[Diagnostic]:
+    """Run every verifier pass over an ANNOTATED plan; returns the
+    (possibly empty) diagnostic list, errors first. Never raises on a
+    bad plan — escalation is the caller's policy (see
+    :func:`enforce`)."""
+    cfg = config or default_config()
+    out: List[Diagnostic] = []
+    for _name, fn in (PASSES if passes is None else passes):
+        out.extend(fn(root, mesh, cfg))
+    out.sort(key=lambda d: (d.severity != "error", d.code))
+    return out
+
+
+def enforce(diagnostics: List[Diagnostic],
+            mode: str, context: str = "plan") -> None:
+    """Apply a ``config.verify_plans`` policy to a diagnostic list:
+    "warn" logs each finding; "error" additionally raises
+    :class:`VerificationError` when any error-severity diagnostic is
+    present (warnings alone never fail a query). "off" or an empty
+    list is a no-op."""
+    if mode == "off" or not diagnostics:
+        return
+    for d in diagnostics:
+        log.warning("verify(%s): %s", context, d.render())
+    if mode == "error" and any(d.severity == "error"
+                               for d in diagnostics):
+        raise VerificationError(diagnostics)
+
+
+def render(diagnostics: List[Diagnostic]) -> str:
+    """The EXPLAIN section body: one line per finding, or the explicit
+    all-clear (so a clean report is distinguishable from a skipped
+    verify)."""
+    if not diagnostics:
+        return "clean (0 diagnostics)"
+    return "\n".join(d.render() for d in diagnostics)

@@ -1,0 +1,89 @@
+"""Sharding-flow check (MV102): every layout the cost model CLAIMS for
+a matmul's output must be one its lowering actually PINS.
+
+``planner.infer_layout`` hands out co-partitioning credits ("this bmm
+output is row-sharded, the consumer reads it free") that change
+strategy rankings and join schemes. The executor only honours those
+claims where the lowering hard-codes an out_spec — the exact bug class
+ADVICE r5 found by hand: sparse_leaf matmuls run the SpMM path and
+wide/refused COO matmuls run hard-coded xla, both IGNORING the stamped
+strategy, so consulting STRATEGY_OUT_LAYOUT there claimed a "row"/"col"
+the executor never produces (an unearned free-consume credit). This
+pass re-derives the pinned layout from the executor's own dispatch
+predicates and out_spec contracts and diffs it against the claim, so
+that fix can never silently regress and no new dispatch can earn a
+credit without pinning it.
+
+Severity is "warning": a false claim mis-COSTS the plan (a worse
+strategy may win, an extra reshard is unpriced) but the computed
+numbers stay correct — GSPMD inserts the resharding the model forgot.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from matrel_tpu.analysis.diagnostics import Diagnostic, node_addr
+from matrel_tpu.config import pallas_enabled
+from matrel_tpu.parallel import planner
+
+
+def pinned_matmul_layout(node, mesh, config) -> str:
+    """Output layout the EXECUTOR's matmul lowering actually pins for
+    this node, mirrored from Lowerer._matmul's dispatch order via the
+    executor's single-source-of-truth predicates. "2d" doubles as
+    "no claim" — the conservative answer for paths whose output
+    sharding GSPMD decides."""
+    from matrel_tpu import executor as exec_lib
+    # branch order mirrors Lowerer._matmul: spgemm, then coo_leaf on
+    # EITHER side, then sparse_leaf (review r6 — a mixed coo×sparse
+    # matmul runs the COO path, and its compact lowering pins "rep")
+    if exec_lib._spgemm_dispatch(node, config):
+        return "2d"         # apply_dense scatters to the canonical layout
+    if any(c.kind == "coo_leaf" for c in node.children):
+        if exec_lib._coo_dispatch_plan(node) is None:
+            return "2d"     # densify path: hard-coded xla
+        # compact Pallas path pins out_specs=P() (replicated); the
+        # expanded XLA path leaves sharding to GSPMD. With autotune on,
+        # a measured "expanded" winner can reroute at compile time, so
+        # "rep" may only be claimed when the compact path is guaranteed.
+        if mesh.size == 1 or (pallas_enabled(config)
+                              and not config.autotune):
+            return "rep"
+        return "2d"
+    if any(c.kind == "sparse_leaf" for c in node.children):
+        return "2d"         # SpMM path ignores the stamp
+    return planner.STRATEGY_OUT_LAYOUT.get(node.attrs.get("strategy"),
+                                           "2d")
+
+
+def check_layout_claims(root, mesh, config) -> Iterator[Diagnostic]:
+    """MV102 on every matmul node: planner.infer_layout's claim must
+    equal the pinned layout. Non-matmul nodes propagate claims
+    structurally (transpose swaps, elemwise agrees, …) — the matmul
+    rule is where claims are MINTED, so that is what gets verified."""
+    seen = set()
+    lmemo: dict = {}
+
+    def walk(n) -> Iterator[Diagnostic]:
+        if n.uid in seen:
+            return
+        seen.add(n.uid)
+        for c in n.children:
+            yield from walk(c)
+        if n.kind != "matmul":
+            return
+        claimed = planner.infer_layout(n, mesh, lmemo, config)
+        pinned = pinned_matmul_layout(n, mesh, config)
+        if claimed != pinned:
+            yield Diagnostic(
+                code="MV102", severity="warning", node=node_addr(n),
+                message=f"cost model claims output layout {claimed!r} "
+                        f"but the lowering pins {pinned!r} — a "
+                        "co-partitioning credit the executor never "
+                        "earns (or a free consume it never reports)",
+                fix_hint="teach planner.infer_layout's matmul rule the "
+                         "dispatch this node takes, or re-plan under "
+                         "the executing config")
+
+    yield from walk(root)

@@ -1,0 +1,148 @@
+"""Strategy-stamp consistency passes: MV101 (admissibility) and MV104
+(SpGEMM dispatch consistency).
+
+The planner stamps every matmul with ``attrs["strategy"]``; the
+executor's shard_map recipes then carve the PADDED dims by that
+strategy's specs. A stamp outside the admissible set would make the
+shard_map spec fail to divide — a trace-time crash at best, a silent
+GSPMD fallback at worst — and a stamp the lowering will not actually
+run (the S×S SpGEMM dispatch ignores the byte model entirely) makes
+every obs/ report and comm estimate describe a program that never
+executes. Both are exactly the class of plan bug arXiv:2112.01075
+argues must be caught before the chip sees the program.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from matrel_tpu.analysis.diagnostics import Diagnostic, node_addr
+from matrel_tpu.core import mesh as mesh_lib, padding
+from matrel_tpu.parallel import planner
+
+#: Strategy vocabulary a stamp may carry (planner.STRATEGY_OUT_LAYOUT
+#: is the one shared mapping; "spgemm" is the dispatch stamp).
+KNOWN_STRATEGIES = tuple(planner.STRATEGY_OUT_LAYOUT)
+
+
+def _dispatch_kind(node, config) -> Optional[str]:
+    """Which off-strategy fast path the lowering takes for this matmul,
+    or None for the dense shard_map path. Consults the executor's OWN
+    single-source-of-truth predicates (never a re-derivation), and
+    checks them in Lowerer._matmul's exact ORDER — spgemm, then
+    coo_leaf on either side, then sparse_leaf: a mixed coo×sparse
+    matmul takes the COO path, not SpMM (review r6 — the sparse-first
+    order silently misclassified that mix)."""
+    from matrel_tpu import executor as exec_lib
+    if exec_lib._spgemm_dispatch(node, config):
+        return "spgemm"
+    if any(c.kind == "coo_leaf" for c in node.children):
+        return ("coo_spmv" if exec_lib._coo_dispatch_plan(node) is not None
+                else "densify")
+    if any(c.kind == "sparse_leaf" for c in node.children):
+        return "spmm"
+    return None
+
+
+def check_strategy_stamps(root, mesh, config) -> Iterator[Diagnostic]:
+    """MV101: every stamped strategy must be (a) in the known
+    vocabulary and (b) admissible for the node's padded dims on this
+    mesh grid — divisibility AND the HBM budget, the same
+    ``planner.admissible`` gate the planner itself now runs, re-checked
+    here so a plan annotated under a DIFFERENT mesh/config (a cached or
+    hand-stamped plan) cannot smuggle an infeasible recipe through.
+    Dispatch-overridden matmuls (SpMM/SpMV/SpGEMM paths) skip (b): the
+    stamp is reporting metadata there, not a shard_map recipe."""
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    seen = set()
+
+    def walk(n) -> Iterator[Diagnostic]:
+        if n.uid in seen:
+            return
+        seen.add(n.uid)
+        for c in n.children:
+            yield from walk(c)
+        if n.kind != "matmul" or "strategy" not in n.attrs:
+            return
+        strat = n.attrs["strategy"]
+        if strat not in KNOWN_STRATEGIES:
+            yield Diagnostic(
+                code="MV101", severity="error", node=node_addr(n),
+                message=f"stamped strategy {strat!r} is not in the "
+                        f"planner vocabulary {KNOWN_STRATEGIES}",
+                fix_hint="re-run planner.annotate_strategies, or fix "
+                         "the strategy_override string")
+            return
+        if _dispatch_kind(n, config) is not None:
+            return          # fast-path dispatch: no shard_map specs run
+        a, b = n.children
+        nn, kk = a.shape
+        mm = b.shape[1]
+        pn, pk = padding.padded_shape((nn, kk), mesh)
+        _, pm = padding.padded_shape((kk, mm), mesh)
+        if not planner.admissible(strat, pn, pk, pm, gx, gy,
+                                  hbm_budget_bytes=0):
+            yield Diagnostic(
+                code="MV101", severity="error", node=node_addr(n),
+                message=f"stamped strategy {strat!r} cannot divide the "
+                        f"padded dims ({pn}, {pk}, {pm}) on the "
+                        f"{gx}x{gy} grid",
+                fix_hint="the plan was annotated for a different "
+                         "mesh/padding — re-plan on this mesh")
+
+    yield from walk(root)
+
+
+def check_spgemm_dispatch(root, mesh, config) -> Iterator[Diagnostic]:
+    """MV104: a ``("spgemm", "dispatch")`` stamp and the executor's
+    ``_spgemm_dispatch`` predicate must agree in BOTH directions.
+
+    Stamp without dispatch: the lowering will densify (or run a
+    shard_map strategy) while obs/explain report a SpGEMM that never
+    ran and the comm model priced 0 bytes — the estimated-savings
+    records (``spgemm_estimates``) become fiction. Dispatch without
+    stamp: the lowering runs the tile-intersection kernel while the
+    plan claims a dense strategy, so ``to_dense`` no-densify guarantees
+    are asserted against the wrong path. The no-densify guarantee
+    itself holds exactly when the stamp is truthful: the dispatch
+    predicate requires both operands to be sparse leaves and the
+    estimated output block density under the threshold, and the
+    spgemm lowering (ops/spgemm.py) touches only the operand tile
+    stacks — no ``to_dense`` is reachable from a truthfully-stamped
+    node (test_spgemm.py's poisoned-to_dense test proves it
+    dynamically; this pass pins it statically)."""
+    seen = set()
+
+    def walk(n) -> Iterator[Diagnostic]:
+        if n.uid in seen:
+            return
+        seen.add(n.uid)
+        for c in n.children:
+            yield from walk(c)
+        if n.kind != "matmul":
+            return
+        stamped = n.attrs.get("strategy") == "spgemm"
+        dispatches = _dispatch_kind(n, config) == "spgemm"
+        if stamped and not dispatches:
+            yield Diagnostic(
+                code="MV104", severity="error", node=node_addr(n),
+                message="stamped ('spgemm', "
+                        f"{n.attrs.get('strategy_source', '?')!r}) but "
+                        "executor._spgemm_dispatch refuses this node "
+                        "under the verifying config — the lowering "
+                        "would densify while the plan reports a "
+                        "no-densify SpGEMM",
+                fix_hint="re-plan under the executing config (the "
+                         "spgemm_density_threshold or operand stats "
+                         "changed since annotation)")
+        elif dispatches and not stamped:
+            yield Diagnostic(
+                code="MV104", severity="error", node=node_addr(n),
+                message=f"executor will dispatch the S×S SpGEMM but "
+                        f"the stamp says "
+                        f"{n.attrs.get('strategy', '<unstamped>')!r} — "
+                        "obs/explain would misreport what executes",
+                fix_hint="stamp via planner.annotate_strategies instead "
+                         "of hand-setting attrs['strategy']")
+
+    yield from walk(root)

@@ -143,7 +143,11 @@ class Lowerer:
                 with annotate(f"matrel.{label}"):
                     out = self._eval(node, ev, leaf_arrays, leaf_pos)
                 if self.op_hook is not None:
-                    jax.block_until_ready(out)
+                    # the ONE sanctioned lowering-path sync: analyze
+                    # mode only (op_hook is never set on the hot path —
+                    # compile_expr leaves it None; obs/analyze.py sets
+                    # it for eager per-op wall-clocking)
+                    jax.block_until_ready(out)  # matlint: disable=ML001 analyze-mode op_hook
                     dt = time.perf_counter() - t0
                     spent_in_children = child_time.pop()
                     if child_time:
@@ -354,6 +358,24 @@ class Lowerer:
             # expanded ~224 B/slot XLA tables are never built).
             return self._coo_compact_sharded(pc, plan, static, vectors,
                                              interp)
+        if self.mesh.size > 1:
+            # replicate the (small) input vectors before the expanded
+            # one-hot contraction. A vector sliced from a 2D-sharded
+            # operand arrives PARTIALLY sharded (e.g. P('y',) on a
+            # (2, 4) mesh) and this container's jax 0.4.37 GSPMD
+            # partitioner miscompiles the gather/one-hot contraction
+            # over such inputs: every result entry comes out scaled by
+            # exactly gx (the unsharded mesh axis), eager and jitted
+            # alike — the pre-existing "COO DSL 2x-scale" failure pair
+            # and fuzz[49], root-caused round 6. The compact sharded
+            # path replicates x by in_spec already; this pins the same
+            # contract on the XLA path. Vectors are SpMV inputs —
+            # n_cols floats — so the reshard is noise next to the
+            # gather it feeds.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            repl = NamedSharding(self.mesh, P())
+            vectors = [jax.lax.with_sharding_constraint(v, repl)
+                       for v in vectors]
         static = (plan.n_rows, plan.n_cols, plan.block)
         arrays = plan.arrays()
         if len(vectors) == 1:
@@ -987,6 +1009,25 @@ class MultiPlan:
             for out, root in zip(outs, self.optimized))
 
 
+def _verify_plans(opts, mesh, cfg) -> Optional[List[dict]]:
+    """Run the static verifier (matrel_tpu/analysis/) over annotated
+    roots when ``config.verify_plans`` asks for it — PRE-execution,
+    pre-trace: at "error" an infeasible/misdescribed plan raises here
+    and nothing is ever lowered, at "warn" the findings are logged and
+    recorded. Returns the diagnostic dicts for plan.meta (None when the
+    gate is off, so the obs-off compile path pays nothing). Lazily
+    imported to keep the analysis->executor dependency one-way at
+    module load."""
+    if cfg.verify_plans == "off":
+        return None
+    from matrel_tpu import analysis
+    diags = []
+    for o in opts:
+        diags.extend(analysis.verify_plan(o, mesh, cfg))
+    analysis.enforce(diags, cfg.verify_plans)
+    return [d.to_dict() for d in diags]
+
+
 def compile_exprs(exprs, mesh: Optional[Mesh] = None,
                   config: Optional[MatrelConfig] = None) -> MultiPlan:
     """Compile several expressions into one program with shared leaves."""
@@ -1012,6 +1053,7 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
         mesh, cfg)
         for e in exprs)
     optimize_ms = (time.perf_counter() - t0) * 1e3
+    verify_diags = _verify_plans(opts, mesh, cfg)
     leaf_order = []
     seen = set()
     for o in opts:
@@ -1028,6 +1070,8 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
     meta = {"optimize_ms": round(optimize_ms, 3),
             "trace_ms": round((time.perf_counter() - t1) * 1e3, 3),
             "rule_hits": rule_hits}
+    if verify_diags is not None:
+        meta["diagnostics"] = verify_diags
     return MultiPlan(jitted=jax.jit(fn), leaf_order=leaf_order,
                      optimized=opts, mesh=mesh, config=cfg,
                      extra_args=extra, meta=meta)
@@ -1224,6 +1268,7 @@ def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
                          counts=rule_hits)
     opt = planner.annotate_strategies(opt, mesh, cfg)
     optimize_ms = (time.perf_counter() - t0) * 1e3
+    verify_diags = _verify_plans((opt,), mesh, cfg)
     leaf_order = expr_leaves(opt)
     low = Lowerer(mesh, cfg)
     if cfg.autotune:
@@ -1235,6 +1280,8 @@ def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
     meta = {"optimize_ms": round(optimize_ms, 3),
             "trace_ms": round((time.perf_counter() - t1) * 1e3, 3),
             "rule_hits": rule_hits}
+    if verify_diags is not None:
+        meta["diagnostics"] = verify_diags
     return CompiledPlan(jitted=jitted, leaf_order=leaf_order, optimized=opt,
                         mesh=mesh, config=cfg, extra_args=extra, meta=meta)
 

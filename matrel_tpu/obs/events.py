@@ -1,10 +1,12 @@
 """Structured JSONL event log — the Spark event-log analogue.
 
 One line per event, append-only, schema-versioned. ``MatrelSession``
-emits one ``query`` record per run; ``bench.py`` emits ``bench`` records
-and ``tools/soak_guard.py`` ``soak`` records into the same file, so one
-log replays the whole history of a host (the history-server input —
-``python -m matrel_tpu history`` aggregates it).
+emits one ``query`` record per run (plus one ``verify`` record when the
+static plan verifier is on — mode, diagnostic count, codes);
+``bench.py`` emits ``bench`` records and ``tools/soak_guard.py``
+``soak`` records into the same file, so one log replays the whole
+history of a host (the history-server input — ``python -m matrel_tpu
+history`` aggregates it).
 
 Writing discipline mirrors the repo's other append-only logs
 (PROGRESS.jsonl, SOAKLOG.jsonl): a single ``write()`` of one line per

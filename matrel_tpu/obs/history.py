@@ -87,6 +87,11 @@ def summarize(events: List[dict]) -> dict:
         "rule_hits": rule_hits,
         "bench_runs": sum(1 for e in events if e.get("kind") == "bench"),
         "soak_runs": sum(1 for e in events if e.get("kind") == "soak"),
+        "verify_runs": sum(1 for e in events
+                           if e.get("kind") == "verify"),
+        "verify_diagnostics": sum(
+            int(e.get("count", 0)) for e in events
+            if e.get("kind") == "verify"),
     }
 
 
@@ -98,7 +103,10 @@ def render_summary(events: List[dict]) -> str:
         f"(evicted: {s['plan_cache'].get('evicted', 0)})",
         f"execute_ms: total {_fmt(s['execute_ms_total'])}  "
         f"mean {_fmt(s['execute_ms_mean'])}",
-        f"other events: bench={s['bench_runs']} soak={s['soak_runs']}",
+        f"other events: bench={s['bench_runs']} soak={s['soak_runs']} "
+        f"verify={s['verify_runs']}"
+        + (f" ({s['verify_diagnostics']} diagnostic(s))"
+           if s["verify_diagnostics"] else ""),
     ]
     if s["strategies"]:
         lines.append("")

@@ -266,6 +266,43 @@ class MatrelSession:
         for d in matmuls:
             REGISTRY.counter(f"planner.strategy.{d['strategy']}").inc()
 
+    def _emit_verify_event(self, plan) -> None:
+        """One ``verify`` record per observed query run (obs_level on
+        AND verify_plans on): the diagnostic codes the compile-time
+        verifier produced for this plan — empty codes = verified clean.
+        Cache hits re-report the compile-time findings (the record
+        describes the plan that ran, "cache" on the query record says
+        no new verify happened)."""
+        diags = (plan.meta or {}).get("diagnostics")
+        if diags is None:
+            return        # verifier was off when this plan compiled
+        from matrel_tpu.obs.metrics import REGISTRY
+        self._obs_event_log().emit("verify", {
+            "mode": self.config.verify_plans,
+            "count": len(diags),
+            "errors": sum(1 for d in diags if d["severity"] == "error"),
+            "codes": sorted({d["code"] for d in diags}),
+        })
+        REGISTRY.counter("verify.count").inc()
+        if diags:
+            REGISTRY.counter("verify.diagnostics").inc(len(diags))
+
+    def verify(self, expr: MatExpr) -> list:
+        """Run the static plan verifier (matrel_tpu/analysis/) on this
+        expression's OPTIMIZED, strategy-annotated plan and return the
+        diagnostic list — regardless of ``config.verify_plans`` (that
+        gate controls the compile path; this is the on-demand surface).
+        Planning only: nothing is traced, jitted, or executed."""
+        from matrel_tpu import analysis
+        from matrel_tpu.ir import rules
+        from matrel_tpu.parallel import planner
+        e = as_expr(expr)
+        grid = mesh_lib.mesh_grid_shape(self.mesh)
+        opt = planner.annotate_strategies(
+            rules.optimize(e, self.config, grid=grid, mesh=self.mesh),
+            self.mesh, self.config)
+        return analysis.verify_plan(opt, self.mesh, self.config)
+
     def compute(self, expr: MatExpr) -> BlockMatrix:
         e = as_expr(expr)
         if not self._obs_enabled():
@@ -283,6 +320,7 @@ class MatrelSession:
         try:
             self._emit_query_event(e, plan, hit, key, execute_ms, first,
                                    out)
+            self._emit_verify_event(plan)
         except Exception:   # the result is already computed — keep the
             # never-fail-a-query contract (obs/events.py) even when
             # record ASSEMBLY breaks, not just the file write
@@ -326,6 +364,22 @@ class MatrelSession:
             # failure happened inside optimize(), e.explain() would
             # re-run the optimizer and re-raise the same exception
             return head + f"\n== Physical plan unavailable: {ex!r} =="
+        # static-verifier findings next to the physical plan they
+        # describe (the reference's EXPLAIN shows analyzer output the
+        # same way). Compile-time diagnostics are reused when the
+        # verify_plans gate already produced them; otherwise EXPLAIN
+        # runs the passes itself — it is off the hot path by contract.
+        try:
+            from matrel_tpu import analysis
+            diags = (plan.meta or {}).get("diagnostics")
+            if diags is None:
+                diags = analysis.verify_plan(plan.optimized, self.mesh,
+                                             self.config)
+            else:
+                diags = [analysis.Diagnostic(**d) for d in diags]
+            text += "\n== Verifier ==\n" + analysis.render(diags)
+        except Exception as ex:     # verification must not fail EXPLAIN
+            text += f"\n== Verifier unavailable: {ex!r} =="
         if analyze or self.config.obs_level == "analyze":
             from matrel_tpu.obs import analyze as analyze_mod
             try:

@@ -1,0 +1,134 @@
+"""Fire-drill for the staged relay-recovery batch (VERDICT r5 Next #2).
+
+`tools/tpu_batch.sh --dry` must run the WHOLE staged capture sequence
+end-to-end on the CPU backend with rc 0, each step emitting its
+expected parseable artifact, and every write redirected away from the
+repo's committed capture history. The round-6 introduction of this
+drill immediately caught two staged tools that would have crashed in a
+real relay window (gram_sym_full / autotune_capture missing their
+sys.path setup) — which is precisely the failure mode the VERDICT said
+the first relay window must not be spent debugging.
+
+One subprocess run shared by every assertion: the batch takes ~30 s on
+the CI host and the point is the INTEGRATED sequence.
+"""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def dry_batch(tmp_path_factory):
+    art = tmp_path_factory.mktemp("batch_dry")
+    env = dict(os.environ)
+    env["MATREL_BATCH_DRY_DIR"] = str(art)
+    proc = subprocess.run(
+        ["sh", os.path.join(REPO, "tools", "tpu_batch.sh"), "--dry"],
+        capture_output=True, text=True, timeout=420, env=env)
+    records = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                pytest.fail(f"unparseable artifact line: {line[:200]}")
+    return proc, records, art
+
+
+def test_batch_exits_zero(dry_batch):
+    proc, _, _ = dry_batch
+    assert proc.returncode == 0, (proc.stdout[-1500:]
+                                  + proc.stderr[-1500:])
+
+
+def _one(records, pred, what):
+    got = [r for r in records if pred(r)]
+    assert got, f"no {what} artifact in batch stdout"
+    return got[0]
+
+
+def test_headline_bench_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records,
+               lambda r: r.get("metric")
+               == "dense_blockmatmul_tflops_per_chip"
+               and "vs_baseline" in r, "bench.py headline")
+    assert rec["value"] is not None and rec["value"] > 0
+    # Weak #5 closure rides along: the interval is recorded, and on a
+    # sub-5-ms row the escalation loop must have brought the band
+    # half-width inside the target (or exhausted its doublings)
+    iv = rec["interval"]
+    assert set(iv) >= {"median_ms", "half_width_ms", "half_width_frac",
+                       "reps", "escalations", "band_target"}
+    if iv["median_ms"] < 5.0 and iv["escalations"] < 4:
+        assert iv["half_width_frac"] <= iv["band_target"]
+
+
+def test_soak_guard_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records, lambda r: r.get("event") == "soak_tpu",
+               "soak_guard")
+    assert rec["ok"] is True, rec
+    assert rec["stage"] == "soak"
+
+
+def test_spgemm_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records,
+               lambda r: r.get("metric") == "blocksparse_spgemm_100k_1pct"
+               and "cmp_speedup" in r, "bench.py --spgemm")
+    assert rec["spgemm_full_ms"] > 0
+    assert rec["cmp_densify_ms"] > 0
+
+
+def test_bench_all_rows_artifacts(dry_batch):
+    _, records, _ = dry_batch
+    # every heavy row emits an explicit, parseable skip record — a
+    # silently-missing row would hide a crashed step
+    for name in ("bench_linreg", "bench_spmm", "bench_pagerank",
+                 "bench_pagerank_10x", "bench_cg", "bench_eigen",
+                 "bench_triangles", "bench_north_star"):
+        rec = _one(records, lambda r, n=name: r.get("metric") == n,
+                   f"bench_all {name}")
+        assert rec.get("skipped") == "dry", rec
+    chain = _one(records,
+                 lambda r: r.get("metric")
+                 == "chain_abc_10k_skewed_wallclock", "bench_all chain")
+    assert chain["value"] > 0 and "plan" in chain
+
+
+def test_sweep_and_gram_artifacts(dry_batch):
+    _, records, _ = dry_batch
+    verdict = _one(records, lambda r: "results" in r and "ok" in r,
+                   "north_star_sweep verdict")
+    assert verdict["ok"] is True
+    gram3 = _one(records, lambda r: "manual3_sym_s" in r,
+                 "gram_manual3")
+    assert gram3["rel_diff_vs_high"] < 1e-4   # numeric sanity intact
+    full = _one(records,
+                lambda r: r.get("metric") == "linreg_sym2pass_10Mx1k_s",
+                "gram_sym_full")
+    # theta of the synthetic y = X·1 fit must come back ~1 even dry
+    assert all(abs(t - 1.0) < 0.05 for t in full["theta_head"])
+    _one(records, lambda r: "side" in r and "best" in r,
+         "autotune_capture")
+
+
+def test_artifacts_redirected_out_of_repo(dry_batch):
+    _, _, art = dry_batch
+    # every side-effect landed in the dry dir, not the capture history
+    for name in ("events.jsonl", "progress.jsonl", "soaklog.jsonl",
+                 "bench_last_good.json", "cpu_baseline.json",
+                 "autotune_dry.json"):
+        assert (art / name).exists(), f"{name} not redirected"
+    events = [json.loads(l) for l in (art / "events.jsonl").open()]
+    assert any(e.get("kind") == "bench" for e in events)
+    progress = [json.loads(l) for l in (art / "progress.jsonl").open()]
+    assert any(e.get("event") == "soak_tpu" for e in progress)
+    assert any(e.get("event") == "north_star_sweep" for e in progress)

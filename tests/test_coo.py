@@ -168,6 +168,29 @@ class TestDSLIntegration:
         np.testing.assert_allclose(out.to_numpy(), want, rtol=3e-4,
                                    atol=3e-4)
 
+    def test_expanded_path_partially_sharded_vectors(self, rng, mesh8):
+        """Regression: the expanded XLA SpMV path must REPLICATE its
+        input vectors first (executor._coo_spmv_stack). A vector sliced
+        from a 2D-sharded operand arrives partially sharded (P('y',) on
+        the (2, 4) mesh) and jax 0.4.37's GSPMD partitioner miscompiles
+        the one-hot contraction over such inputs — every entry scaled
+        by exactly gx (the round-6 root cause of the 'COO DSL 2x-scale'
+        pair and fuzz[49])."""
+        from matrel_tpu import executor
+        from matrel_tpu.config import default_config
+        from matrel_tpu.core.blockmatrix import BlockMatrix
+        r, c, v = random_coo(rng, 400, 600, 5000)
+        S = COOMatrix.from_edges(r, c, v, shape=(400, 600))
+        a = rng.standard_normal((5, 400)).astype(np.float32)
+        padded = BlockMatrix.from_numpy(a, mesh=mesh8).data  # P(x, y)
+        lo = executor.Lowerer(mesh8, default_config())
+        plan = S._get_plan_t()
+        assert plan is not None
+        out = np.asarray(
+            lo._coo_spmv_stack(plan, [padded[i, :400] for i in range(5)]))
+        want = (a @ S.to_dense()).T
+        np.testing.assert_allclose(out[:600], want, rtol=3e-4, atol=3e-4)
+
     def test_right_multiply_via_dsl(self, rng):
         from matrel_tpu import execute
         from matrel_tpu.core.blockmatrix import BlockMatrix

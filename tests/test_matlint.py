@@ -1,0 +1,224 @@
+"""matlint (tools/matlint.py): fixture-based proof that every rule
+fires on its hazard, that the inline suppression syntax silences it,
+and that the repo itself lints clean — the tier-1 enforcement of
+`make lint`'s first half (tests cannot silently skip what they
+themselves run)."""
+
+import textwrap
+
+import pytest
+
+from tools import matlint
+
+
+def _lint(tmp_path, source, relpath):
+    f = tmp_path / "fixture.py"
+    f.write_text(textwrap.dedent(source))
+    return matlint.lint_file(str(f), relpath=relpath)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+class TestML001HostSync:
+    def test_fires_on_block_until_ready(self, tmp_path):
+        src = """
+            import jax
+            def lower(x):
+                out = x + 1
+                jax.block_until_ready(out)
+                return out
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/ops/custom.py")
+        assert _rules(got) == ["ML001"]
+
+    def test_fires_on_method_attribute_form(self, tmp_path):
+        src = """
+            def lower(x):
+                x.block_until_ready()
+                return x
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/executor.py")
+        assert _rules(got) == ["ML001"]
+
+    def test_asarray_in_lowerer_method(self, tmp_path):
+        src = """
+            import numpy as np
+            class MyLowerer:
+                def _eval(self, x):
+                    return np.asarray(x)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/executor.py")
+        assert _rules(got) == ["ML001"]
+
+    def test_asarray_sanctioned_under_compile_time_eval(self, tmp_path):
+        src = """
+            import jax
+            import numpy as np
+            class MyLowerer:
+                def _eval(self, m):
+                    with jax.ensure_compile_time_eval():
+                        return np.asarray(m.rows)
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/executor.py") == []
+
+    def test_out_of_scope_module_ignored(self, tmp_path):
+        src = """
+            import jax
+            def wait(x):
+                jax.block_until_ready(x)
+        """
+        # obs/ and utils/ legitimately sync (analyze mode, checkpoint)
+        assert _lint(tmp_path, src, "matrel_tpu/obs/analyze.py") == []
+
+
+class TestML002NoDensify:
+    def test_fires_in_ops_module(self, tmp_path):
+        src = """
+            def apply(S, x):
+                return S.to_dense() @ x
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/ops/spgemm.py")
+        assert _rules(got) == ["ML002"]
+
+    def test_todense_variant(self, tmp_path):
+        src = """
+            def apply(S):
+                return S.todense()
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/ops/spmm.py")
+        assert _rules(got) == ["ML002"]
+
+    def test_executor_dispatch_is_allowed(self, tmp_path):
+        # the densify FALLBACK lives in the executor where the planner
+        # prices it — only ops/ kernels are no-densify territory
+        src = """
+            def fallback(node, cfg):
+                return node.attrs["matrix"].to_dense(cfg).data
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/executor.py") == []
+
+
+class TestML003ShardMapOutSpecs:
+    def test_fires_without_out_specs(self, tmp_path):
+        src = """
+            from matrel_tpu.utils.compat import shard_map
+            def f(kernel, mesh, specs):
+                return shard_map(kernel, mesh=mesh, in_specs=specs)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/ops/new_kernel.py")
+        assert _rules(got) == ["ML003"]
+
+    def test_keyword_out_specs_clean(self, tmp_path):
+        src = """
+            from matrel_tpu.utils.compat import shard_map
+            def f(kernel, mesh, specs, P):
+                return shard_map(kernel, mesh=mesh, in_specs=specs,
+                                 out_specs=P())
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/ops/new_kernel.py") == []
+
+    def test_positional_form_clean(self, tmp_path):
+        src = """
+            def f(sm, kernel, mesh, ins, outs):
+                return sm.shard_map(kernel, mesh, ins, outs)
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/ops/new_kernel.py") == []
+
+
+class TestML004ConfigFlow:
+    def test_fires_in_package(self, tmp_path):
+        src = """
+            from matrel_tpu.config import MatrelConfig
+            def plan(node):
+                cfg = MatrelConfig()
+                return cfg.block_size
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/parallel/newpass.py")
+        assert _rules(got) == ["ML004"]
+
+    def test_harness_scripts_exempt(self, tmp_path):
+        src = """
+            from matrel_tpu.config import MatrelConfig
+            cfg = MatrelConfig(obs_level="off")
+        """
+        assert _lint(tmp_path, src, "tools/new_bench.py") == []
+        assert _lint(tmp_path, src, "bench.py") == []
+
+    def test_config_module_itself_exempt(self, tmp_path):
+        src = """
+            class MatrelConfig:
+                pass
+            _default = MatrelConfig()
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/config.py") == []
+
+
+class TestML005SpecKeyedCache:
+    def test_fires_on_spec_keyed_store(self, tmp_path):
+        src = """
+            _cache = {}
+            def put(m, v):
+                _cache[m.spec] = v
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/core/newcache.py")
+        assert _rules(got) == ["ML005"]
+
+    def test_fires_on_sharding_ctor_get(self, tmp_path):
+        src = """
+            from jax.sharding import NamedSharding
+            def lookup(memo_tbl, mesh, spec):
+                return memo_tbl.get(NamedSharding(mesh, spec))
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/core/newcache.py")
+        assert _rules(got) == ["ML005"]
+
+    def test_stable_tuple_keys_clean(self, tmp_path):
+        src = """
+            _cache = {}
+            def put(n, k, gx, gy, v):
+                _cache[(n, k, gx, gy)] = v
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/core/newcache.py") == []
+
+
+class TestSuppression:
+    def test_inline_disable_silences(self, tmp_path):
+        src = """
+            import jax
+            def lower(x):
+                jax.block_until_ready(x)  # matlint: disable=ML001 probe path
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/ops/custom.py") == []
+
+    def test_disable_is_per_code(self, tmp_path):
+        src = """
+            import jax
+            def lower(x):
+                jax.block_until_ready(x)  # matlint: disable=ML002 wrong code
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/ops/custom.py")
+        assert _rules(got) == ["ML001"]
+
+    def test_unparseable_file_reports(self, tmp_path):
+        got = _lint(tmp_path, "def broken(:\n", "matrel_tpu/ops/x.py")
+        assert _rules(got) == ["ML000"]
+
+
+def test_repo_lints_clean():
+    """`make lint`'s contract, enforced from inside tier-1: the whole
+    default scan set (package, tools, examples, bench harnesses) has
+    zero unsuppressed findings."""
+    findings = matlint.lint_paths()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_rule_catalogue_documented():
+    # every rule carries an ID and a docstring (the catalogue the docs
+    # and --list-rules render); IDs are unique
+    ids = [r.id for r in matlint.RULES]
+    assert len(ids) == len(set(ids))
+    for r in matlint.RULES:
+        assert r.id.startswith("ML") and r.__doc__
+        assert r.id in r.__doc__.strip().splitlines()[0]

@@ -7,14 +7,26 @@ covered. VERDICT r3 #4: the round-3 single-marginal capture persisted
 for, run from tpu_batch.sh whenever the relay is alive.
 """
 import json
+import os
 import sys
+
+# run as a script from anywhere (the round-6 dry fire-drill caught this
+# staged tool crashing on import — tools/ is the script dir, not the
+# repo root, so the package was never importable)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 from matrel_tpu.config import MatrelConfig, set_default_config
 from matrel_tpu.core import mesh as mesh_lib
 from matrel_tpu.parallel import autotune
 
-SIDES = (1024, 2048, 4096)
-DTYPES = ("float32", "bfloat16")
+# MATREL_AUTOTUNE_{SIDES,DTYPES,SPMV} scale the capture down for the
+# dry-batch fire-drill (tools/tpu_batch.sh --dry), which also points
+# the positional table-path arg away from the real on-chip table
+SIDES = tuple(int(s) for s in os.environ.get(
+    "MATREL_AUTOTUNE_SIDES", "1024,2048,4096").split(","))
+DTYPES = tuple(os.environ.get(
+    "MATREL_AUTOTUNE_DTYPES", "float32,bfloat16").split(","))
 
 
 def main(path: str = "autotune_v5e_1chip.json") -> None:
@@ -34,7 +46,8 @@ def main(path: str = "autotune_v5e_1chip.json") -> None:
     # itself is compact-only by the 2 GB gate)
     import numpy as np
     from matrel_tpu.core.coo import COOMatrix
-    n, m = 100_000, 1_000_000
+    n, m = (int(v) for v in os.environ.get(
+        "MATREL_AUTOTUNE_SPMV", "100000,1000000").split(","))
     rng = np.random.default_rng(0)
     A = COOMatrix.from_edges(rng.integers(0, n, m, dtype=np.int32),
                              rng.integers(0, n, m, dtype=np.int32),

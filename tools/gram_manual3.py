@@ -1,10 +1,15 @@
-"""Manual hi/lo bf16 3-pass Gram vs XLA precision=HIGH, on chip."""
+"""Manual hi/lo bf16 3-pass Gram vs XLA precision=HIGH, on chip.
+
+MATREL_GRAM3_{K,PANEL,NPANELS} scale it down for the dry-batch
+fire-drill (tools/tpu_batch.sh --dry) — same jits, same artifact."""
+import os
 import time, json
 import jax, jax.numpy as jnp
 import numpy as np
 
-k, panel = 1000, 250_000
-n_panels = 40
+k = int(os.environ.get("MATREL_GRAM3_K", 1000))
+panel = int(os.environ.get("MATREL_GRAM3_PANEL", 250_000))
+n_panels = int(os.environ.get("MATREL_GRAM3_NPANELS", 40))
 
 def timed(f, *a):
     float(f(*a))

@@ -1,11 +1,25 @@
-"""Full 10Mx1k fit_streaming with the round-3 symmetric 2-pass Gram."""
+"""Full 10Mx1k fit_streaming with the round-3 symmetric 2-pass Gram.
+
+MATREL_GRAMFULL_{N,K,PANEL} scale it down for the dry-batch
+fire-drill (tools/tpu_batch.sh --dry) — same streaming path."""
+import os
+import sys
 import time, json
+
+# run as a script from anywhere (the round-6 dry fire-drill caught this
+# staged tool crashing on import — tools/ is the script dir, not the
+# repo root, so the package was never importable)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 import jax.numpy as jnp
 import numpy as np
 from matrel_tpu.workloads.linreg import fit_streaming
 from matrel_tpu.core import mesh as mesh_lib
 
-n, k, panel = 10_000_000, 1000, 250_000
+n = int(os.environ.get("MATREL_GRAMFULL_N", 10_000_000))
+k = int(os.environ.get("MATREL_GRAMFULL_K", 1000))
+panel = int(os.environ.get("MATREL_GRAMFULL_PANEL", 250_000))
 
 def panel_fn(p):
     r = jnp.arange(panel, dtype=jnp.int32)[:, None]

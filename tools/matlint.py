@@ -1,0 +1,385 @@
+"""matlint — AST-based custom linter for this codebase's own hazard
+classes (the static-analysis layer's source-level half; the plan-level
+half is matrel_tpu/analysis/).
+
+Generic linters cannot know that a ``block_until_ready`` inside the
+executor's lowering is a query-hot-path sync regression, that a
+``to_dense`` inside a sparse dispatch module silently voids the SpGEMM
+no-densify guarantee, or that a ``shard_map`` without explicit
+``out_specs`` leaves the collective contract implicit. Each of those
+has bitten (or nearly bitten) a past round; matlint pins them.
+
+Usage:
+    python tools/matlint.py                # default scan set, rc 1 on findings
+    python tools/matlint.py path1 path2    # explicit files/dirs
+    python tools/matlint.py --list-rules   # rule catalogue
+
+Suppression: append ``# matlint: disable=ML001`` (comma-separated for
+several codes) to the line where the flagged call STARTS, with a
+justification in the same comment. Suppressions are deliberate,
+reviewable exceptions — the repo-wide run (``make lint``,
+tests/test_matlint.py) stays green only through them.
+
+Rule catalogue (each rule's class docstring is the authority):
+  ML001  host-sync call in lowering-path modules
+  ML002  to_dense/todense inside a sparse dispatch module
+  ML003  shard_map call without explicit out_specs
+  ML004  direct MatrelConfig() construction inside the package
+  ML005  cache dict keyed by sharding-spec-ish values
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import sys
+from typing import Iterator, List, Optional, Sequence
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Default scan set for ``make lint`` / the repo-clean test. tests/ is
+#: excluded by design: tests legitimately poke every hazard (poisoned
+#: to_dense spies, sync-forcing fixtures) and carry their own review.
+DEFAULT_PATHS = ("matrel_tpu", "tools", "examples", "bench.py",
+                 "bench_all.py")
+
+_SUPPRESS_RE = re.compile(r"#\s*matlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _rel(path: str) -> str:
+    try:
+        return os.path.relpath(path, REPO)
+    except ValueError:
+        return path
+
+
+def _call_name(func: ast.AST) -> str:
+    """Dotted tail of a call target: ``jax.block_until_ready`` ->
+    "jax.block_until_ready", ``x.to_dense`` -> ".to_dense"."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        base = _call_name(func.value)
+        return (base + "." if base else ".") + func.attr
+    return ""
+
+
+class Rule:
+    """One hazard class. ``applies_to`` scopes the MODULE set (the
+    hazard is contextual — the same call is fine elsewhere); ``check``
+    yields findings for one parsed file."""
+
+    id: str = "ML000"
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def check(self, tree: ast.Module, relpath: str) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+#: Modules whose code runs on (or traces into) the query hot path —
+#: the executor's lowering, the strategy kernels, the ops kernels, the
+#: IR/relational lowerings. A host sync here stalls every query.
+_LOWERING_MODULES = re.compile(
+    r"^matrel_tpu/(executor\.py|ops/|parallel/strategies\.py|"
+    r"relational/|ir/)")
+
+
+class HostSyncRule(Rule):
+    """ML001: host-synchronising calls in lowering-path modules.
+
+    ``block_until_ready``/``jax.device_get`` force a device round-trip;
+    on the query hot path that serialises the pipeline the whole
+    one-compiled-program design exists to avoid (the obs_level="off"
+    contract: zero extra syncs — tests/test_obs.py enforces it
+    dynamically for the executor, this rule pins it statically for
+    every lowering module). ``np.asarray`` inside a Lowerer method is
+    the same hazard wearing numpy clothes — on a traced value it
+    either syncs or raises — unless it sits under
+    ``jax.ensure_compile_time_eval()`` (host-side metadata work, the
+    sanctioned idiom). The ONE legitimate sync — the analyze-mode
+    op_hook in executor.py, guarded by ``self.op_hook is not None`` —
+    carries the inline suppression this rule's docstring mandates."""
+
+    id = "ML001"
+    _SYNC_TAILS = ("block_until_ready", "device_get")
+
+    def applies_to(self, relpath: str) -> bool:
+        return bool(_LOWERING_MODULES.match(relpath))
+
+    def check(self, tree, relpath):
+        # (node, inside_lowerer_class, under_compile_time_eval)
+        stack: List[tuple] = [(tree, False, False)]
+        while stack:
+            node, in_lowerer, under_cte = stack.pop()
+            if isinstance(node, ast.ClassDef):
+                in_lowerer = in_lowerer or node.name.endswith("Lowerer")
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    name = _call_name(item.context_expr.func) if \
+                        isinstance(item.context_expr, ast.Call) else ""
+                    if name.endswith("ensure_compile_time_eval"):
+                        under_cte = True
+            if isinstance(node, ast.Call):
+                name = _call_name(node.func)
+                tail = name.rsplit(".", 1)[-1]
+                if tail in self._SYNC_TAILS:
+                    yield Finding(relpath, node.lineno, self.id,
+                                  f"host sync `{name}` on a "
+                                  "lowering path — stalls every query "
+                                  "(obs_level='off' contract)")
+                elif (tail == "asarray" and in_lowerer
+                        and not under_cte
+                        and name.split(".", 1)[0] in ("np", "numpy")):
+                    yield Finding(
+                        relpath, node.lineno, self.id,
+                        "np.asarray inside a Lowerer method outside "
+                        "jax.ensure_compile_time_eval() — syncs or "
+                        "raises on traced values")
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, in_lowerer, under_cte))
+
+
+class NoDensifyRule(Rule):
+    """ML002: ``to_dense``/``todense`` inside a sparse dispatch module.
+
+    matrel_tpu/ops/ holds the kernels whose whole reason to exist is
+    NOT materialising dense forms (SpGEMM's no-densify guarantee is
+    asserted dynamically by test_spgemm's poisoned-to_dense spy; the
+    verifier's MV104 pins the dispatch side). A densify call added to
+    one of these modules is either a bug or a fallback that belongs in
+    the executor's dispatch, where the planner can see and price it."""
+
+    id = "ML002"
+    _TAILS = ("to_dense", "todense")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("matrel_tpu/ops/")
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                tail = _call_name(node.func).rsplit(".", 1)[-1]
+                if tail in self._TAILS:
+                    yield Finding(
+                        relpath, node.lineno, self.id,
+                        f"`{tail}` inside a sparse dispatch module — "
+                        "densify fallbacks belong in the executor "
+                        "dispatch where the planner prices them")
+
+
+class ShardMapOutSpecsRule(Rule):
+    """ML003: ``shard_map`` without explicit ``out_specs``.
+
+    The out_spec IS the collective contract: it decides whether the
+    runtime all-gathers, leaves shards in place, or replicates — and an
+    implicit/defaulted one makes the comm cost invisible to review and
+    to the planner's byte model. Every call must say what it emits
+    (the compat shim that forwards kwargs is exempt)."""
+
+    id = "ML003"
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath != "matrel_tpu/utils/compat.py"
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node.func).rsplit(".", 1)[-1] != "shard_map":
+                continue
+            has_kw = any(k.arg == "out_specs" for k in node.keywords)
+            # positional form: shard_map(f, mesh, in_specs, out_specs)
+            if not has_kw and len(node.args) < 4:
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    "shard_map without explicit out_specs — the "
+                    "collective contract must be stated at the call "
+                    "site")
+
+
+class ConfigFlowRule(Rule):
+    """ML004: direct ``MatrelConfig(...)`` construction inside the
+    package.
+
+    Library code must consume the config that FLOWS to it (a ``config``
+    parameter defaulting through ``default_config()``) — a fresh
+    ``MatrelConfig()`` silently discards every session/env override the
+    caller set (the round-2 class of bug where a module ran with
+    default thresholds while the session was configured otherwise).
+    Construction is for entry points: config.py itself, tests, and the
+    bench/tool harnesses outside the package."""
+
+    id = "ML004"
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("matrel_tpu/")
+                and relpath != "matrel_tpu/config.py")
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                tail = _call_name(node.func).rsplit(".", 1)[-1]
+                if tail == "MatrelConfig":
+                    yield Finding(
+                        relpath, node.lineno, self.id,
+                        "direct MatrelConfig() construction in library "
+                        "code — accept a config parameter and default "
+                        "through default_config() so session/env "
+                        "overrides flow")
+
+
+class SpecKeyedCacheRule(Rule):
+    """ML005: cache/memo dicts keyed by sharding-spec-ish values.
+
+    ``PartitionSpec``/``NamedSharding``/``Mesh`` objects (and ``.spec``
+    attributes) make treacherous dict keys: some are unhashable, others
+    hash by identity across semantically-equal instances, and a jax
+    upgrade can flip either property — turning a cache into a
+    permanent miss (rebuild storm) or, worse, an identity-aliased hit.
+    Key caches by the STABLE tuple you derive from the spec (axis
+    names, grid shape, padded dims), the way the autotune table and the
+    plan cache do."""
+
+    id = "ML005"
+    _NAME_RE = re.compile(r"(cache|memo)", re.IGNORECASE)
+    _SPEC_CTORS = ("PartitionSpec", "NamedSharding", "Mesh")
+    _SPEC_ATTRS = ("spec", "sharding")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("matrel_tpu/")
+
+    def _cacheish(self, target: ast.AST) -> bool:
+        if isinstance(target, ast.Name):
+            return bool(self._NAME_RE.search(target.id))
+        if isinstance(target, ast.Attribute):
+            return bool(self._NAME_RE.search(target.attr))
+        return False
+
+    def _specish(self, key: ast.AST) -> bool:
+        for node in ast.walk(key):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in self._SPEC_ATTRS):
+                return True
+            if isinstance(node, ast.Call):
+                tail = _call_name(node.func).rsplit(".", 1)[-1]
+                if tail in self._SPEC_CTORS:
+                    return True
+        return False
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            key = None
+            target = None
+            if isinstance(node, ast.Subscript):
+                target, key = node.value, node.slice
+            elif isinstance(node, ast.Call):
+                tail = _call_name(node.func).rsplit(".", 1)[-1]
+                if tail in ("get", "setdefault") and node.args and \
+                        isinstance(node.func, ast.Attribute):
+                    target, key = node.func.value, node.args[0]
+            if key is None or not self._cacheish(target):
+                continue
+            if self._specish(key):
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    "cache keyed by a sharding spec / mesh object — "
+                    "hashability is jax-version-dependent; key by the "
+                    "derived stable tuple instead")
+
+
+RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
+                        ShardMapOutSpecsRule(), ConfigFlowRule(),
+                        SpecKeyedCacheRule())
+
+
+def _suppressed_codes(line: str) -> set:
+    """Codes disabled on this line. Tokens after the code list are
+    justification prose (mandatory by convention, ignored by the
+    parser): ``# matlint: disable=ML001 analyze-mode op_hook``."""
+    m = _SUPPRESS_RE.search(line)
+    if not m:
+        return set()
+    return {tok for tok in re.split(r"[\s,]+", m.group(1))
+            if re.fullmatch(r"ML\d+", tok)}
+
+
+def lint_file(path: str, rules: Sequence[Rule] = RULES,
+              relpath: Optional[str] = None) -> List[Finding]:
+    """All unsuppressed findings for one file. ``relpath`` overrides
+    the repo-relative path used for rule scoping (fixture tests lint
+    temp files AS IF they lived at a package path)."""
+    rel = relpath if relpath is not None else _rel(path)
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(rel, e.lineno or 0, "ML000",
+                        f"file does not parse: {e.msg}")]
+    lines = src.splitlines()
+    out: List[Finding] = []
+    for rule in rules:
+        if not rule.applies_to(rel):
+            continue
+        for f in rule.check(tree, rel):
+            line = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+            if f.rule in _suppressed_codes(line):
+                continue
+            out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(REPO, p)
+        if os.path.isfile(full):
+            yield full
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths: Sequence[str] = DEFAULT_PATHS) -> List[Finding]:
+    out: List[Finding] = []
+    for f in iter_python_files(paths):
+        out.extend(lint_file(f))
+    return out
+
+
+def main(argv: Sequence[str]) -> int:
+    if "--list-rules" in argv:
+        for r in RULES:
+            doc = (r.__doc__ or "").strip().splitlines()[0]
+            print(f"{r.id}  {doc}")
+        return 0
+    paths = [a for a in argv if not a.startswith("-")] or list(
+        DEFAULT_PATHS)
+    findings = lint_paths(paths)
+    for f in findings:
+        print(f.render())
+    n = len(findings)
+    print(f"matlint: {n} finding(s) in scan set {tuple(paths)}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -25,8 +25,11 @@ sys.path.insert(0, REPO)
 
 
 def _append_progress(event: dict) -> None:
+    # $MATREL_PROGRESS_PATH: see tools/soak_guard.py (dry-batch redirect)
+    path = os.environ.get("MATREL_PROGRESS_PATH",
+                          os.path.join(REPO, "PROGRESS.jsonl"))
     try:
-        with open(os.path.join(REPO, "PROGRESS.jsonl"), "a") as f:
+        with open(path, "a") as f:
             f.write(json.dumps({"ts": time.time(),
                                 "event": "north_star_sweep", **event})
                     + "\n")
@@ -56,7 +59,10 @@ def main() -> int:
     from matrel_tpu.workloads.big_chain import (
         cheap_gen, north_star_flops, streaming_chain_slab)
 
-    n = 65_536
+    # $MATREL_NS_N scales the sweep down for the dry-batch fire-drill
+    # (tools/tpu_batch.sh --dry): same code path, same artifact shape,
+    # toy dims on the CPU backend
+    n = int(os.environ.get("MATREL_NS_N", 65_536))
     flops = north_star_flops(n)
     results = []
     # variants: the round-1 winner, its neighbours one step out in each
@@ -67,6 +73,9 @@ def main() -> int:
         ("tile16384_panel16384", dict(tile=16384, panel=16384)),
         ("tile4096_panel16384", dict(tile=4096, panel=16384)),
     ]
+    if n < 65_536:
+        t = max(n // 4, 128)
+        variants = [(f"dry_tile{t}_panel{t}", dict(tile=t, panel=t))]
     for name, kw in variants:
         gens = tuple(cheap_gen(s, kw["tile"]) for s in (1, 2, 3))
 

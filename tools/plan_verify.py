@@ -1,0 +1,62 @@
+"""Verifier self-check over the plan-snapshot corpus — `make lint`'s
+second half.
+
+tools/plan_snapshot.py pins WHAT the planner chooses for a fixed
+representative corpus; this tool pins that every one of those choices
+is INTERNALLY CONSISTENT: replans the same corpus on the standard
+(2, 4) test grid and runs the full static verifier
+(matrel_tpu/analysis/) over each annotated plan, requiring zero
+diagnostics. A planner change that starts stamping inadmissible
+strategies, claiming unpinned layouts, or breaking the SpGEMM stamp
+contract fails `make lint` even if no behavioural test happens to cover
+the shape — the same corpus-scale discipline, applied to invariants
+instead of plan shapes.
+
+Exit codes: 0 = every corpus plan verifies clean; 1 = diagnostics
+fired (each printed); 2 = the corpus itself failed to plan.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools import plan_snapshot  # noqa: E402 (needs REPO on sys.path)
+
+
+def main() -> int:
+    plan_snapshot._setup()
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.ir import rules
+    from matrel_tpu.parallel import planner
+    from matrel_tpu import analysis
+
+    mesh = mesh_lib.make_mesh((2, 4))
+    grid = mesh_lib.mesh_grid_shape(mesh)
+    total = 0
+    try:
+        corpus = plan_snapshot.corpus(mesh)
+    except Exception as ex:
+        print(f"corpus construction failed: {ex!r}")
+        return 2
+    for name, e in corpus:
+        try:
+            opt = planner.annotate_strategies(
+                rules.optimize(e, grid=grid, mesh=mesh), mesh)
+        except Exception as ex:
+            print(f"PLAN FAILED: {name}: {ex!r}")
+            return 2
+        diags = analysis.verify_plan(opt, mesh)
+        for d in diags:
+            print(f"DIAGNOSTIC: {name}: {d.render()}")
+        total += len(diags)
+    n = len(corpus)
+    print(f"verified {n} corpus plans: {total} diagnostic(s)")
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

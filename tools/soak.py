@@ -430,11 +430,16 @@ def _log_tally(args, n_fails, fail_heads, t_start):
            "failures": n_fails,
            "fail_heads": [str(f) for f in fail_heads],
            "wall_s": round(time.time() - t_start, 1)}
+    # $MATREL_SOAKLOG_PATH: the dry-batch fire-drill redirects the
+    # tally (toy CPU drills must not write into the committed soak
+    # evidence trail) — same contract as MATREL_PROGRESS_PATH
+    path = os.environ.get("MATREL_SOAKLOG_PATH",
+                          os.path.join(REPO, "SOAKLOG.jsonl"))
     try:
-        with open(os.path.join(REPO, "SOAKLOG.jsonl"), "a") as f:
+        with open(path, "a") as f:
             f.write(json.dumps(rec) + "\n")
     except OSError as e:
-        print(f"# could not append SOAKLOG.jsonl: {e}", file=sys.stderr)
+        print(f"# could not append {path}: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -28,7 +28,11 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PROGRESS = os.path.join(REPO, "PROGRESS.jsonl")
+# $MATREL_PROGRESS_PATH redirects the append target — the dry batch
+# fire-drill (tools/tpu_batch.sh --dry) must not write toy-scale CPU
+# records into the repo's real capture history
+PROGRESS = os.environ.get("MATREL_PROGRESS_PATH",
+                          os.path.join(REPO, "PROGRESS.jsonl"))
 
 
 def _log(event: dict) -> None:
@@ -99,11 +103,16 @@ def main() -> int:
         return 2
 
     t0 = time.time()
-    rc, tail = _run_pg([sys.executable,
-                        os.path.join(REPO, "tools", "soak.py"),
-                        args.battery, "--seeds", str(args.seeds),
-                        "--tpu"],
-                       args.soak_timeout)
+    # the dry fire-drill (tools/tpu_batch.sh --dry) soaks the CPU
+    # backend, where --tpu's non-interpret Pallas batteries cannot run
+    # ("Only interpret mode is supported on CPU backend") — drop the
+    # flag there; the harness (probe, process groups, logging) is what
+    # the drill proves
+    soak_cmd = [sys.executable, os.path.join(REPO, "tools", "soak.py"),
+                args.battery, "--seeds", str(args.seeds)]
+    if not os.environ.get("MATREL_DRY"):
+        soak_cmd.append("--tpu")
+    rc, tail = _run_pg(soak_cmd, args.soak_timeout)
     ok = rc == 0
     _log({"ok": ok, "stage": "soak", "battery": args.battery,
           "seeds": args.seeds, "rc": rc,

@@ -4,14 +4,57 @@
 # (probe-first, hard timeouts), so a relay that dies mid-batch cannot
 # hang this script. Results: stdout JSON lines per tool + structured
 # entries in PROGRESS.jsonl (soak_guard, north_star_sweep).
+#
+# --dry (or MATREL_BATCH_DRY=1): the fire-drill (VERDICT r5 Next #2).
+# Runs the SAME step sequence end-to-end on the CPU backend at toy
+# sizes, with every artifact redirected under MATREL_BATCH_DRY_DIR
+# (default /tmp/matrel_batch_dry) so a drill can never pollute the
+# real capture history (PROGRESS.jsonl, cpu_baseline.json,
+# bench_last_good.json, the on-chip autotune table, the obs event
+# log). `make tpu-batch-dry` runs it; tests/test_batch_dry.py asserts
+# each step's parseable artifact — the first real relay window is
+# spent measuring, not debugging the harness.
 set -u
 cd "$(dirname "$0")/.."
 log() { echo "$(date '+%H:%M:%S') $*"; }
+
+DRY=0
+[ "${1:-}" = "--dry" ] && DRY=1
+[ "${MATREL_BATCH_DRY:-0}" = "1" ] && DRY=1
+SEEDS=8
+AUTOTUNE_TABLE=autotune_v5e_1chip.json
+if [ "$DRY" = 1 ]; then
+    DRY_DIR="${MATREL_BATCH_DRY_DIR:-/tmp/matrel_batch_dry}"
+    mkdir -p "$DRY_DIR"
+    export JAX_PLATFORMS=cpu
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+    # artifact redirects — nothing a drill writes lands in the repo
+    export MATREL_PROGRESS_PATH="$DRY_DIR/progress.jsonl"
+    export MATREL_SOAKLOG_PATH="$DRY_DIR/soaklog.jsonl"
+    export MATREL_OBS_EVENT_LOG="$DRY_DIR/events.jsonl"
+    export MATREL_BENCH_CPU_CACHE="$DRY_DIR/cpu_baseline.json"
+    export MATREL_BENCH_LAST_GOOD="$DRY_DIR/bench_last_good.json"
+    AUTOTUNE_TABLE="$DRY_DIR/autotune_dry.json"
+    # toy sizes: same code paths, CPU-feasible scales
+    export MATREL_DRY=1
+    export MATREL_BENCH_N=512 MATREL_BENCH_REPEATS=3
+    export MATREL_BENCH_BACKOFFS="" MATREL_BENCH_DEADLINE=360
+    export MATREL_SPGEMM_N=8192 MATREL_SPGEMM_CMP_N=4096
+    export MATREL_NS_N=2048
+    export MATREL_GRAM3_K=64 MATREL_GRAM3_PANEL=4096 MATREL_GRAM3_NPANELS=2
+    export MATREL_GRAMFULL_N=200000 MATREL_GRAMFULL_K=64 \
+           MATREL_GRAMFULL_PANEL=25000
+    export MATREL_AUTOTUNE_SIDES=256 MATREL_AUTOTUNE_DTYPES=float32
+    export MATREL_AUTOTUNE_SPMV=2000,20000
+    SEEDS=2
+    log "TPU batch DRY fire-drill (CPU backend; artifacts in $DRY_DIR)"
+fi
+
 log "TPU batch start"
 log "--- bench.py (headline, BENCH row 1)"
 python bench.py
 log "--- soak_guard (on-chip oracle soak)"
-python tools/soak_guard.py --seeds 8
+python tools/soak_guard.py --seeds $SEEDS
 log "--- bench.py --spgemm (S x S tile-intersection SpGEMM row, staged this round)"
 python bench.py --spgemm
 log "--- bench_all.py (all BASELINE rows)"
@@ -23,5 +66,5 @@ python tools/gram_manual3.py
 log "--- gram_sym_full (10Mx1k linreg, symmetric 2-pass Gram, BASELINE row 3)"
 python tools/gram_sym_full.py
 log "--- autotune_capture (re-capture table under round-4 tie rules)"
-python tools/autotune_capture.py
+python tools/autotune_capture.py "$AUTOTUNE_TABLE"
 log "TPU batch done"
