@@ -31,6 +31,7 @@ codes documented in :mod:`matrel_tpu.analysis.diagnostics`):
   layout     MV102  infer_layout claims pinned by the lowering
   padding    MV103  zero-padding invariant restored after breakers
   hbm        MV105  per-device working set fits hbm_budget_bytes
+  topology   MV106  dominant collective off the slow (DCN) mesh axis
 """
 
 from __future__ import annotations
@@ -45,6 +46,7 @@ from matrel_tpu.analysis.layout_pass import check_layout_claims
 from matrel_tpu.analysis.padding_pass import check_padding_flow
 from matrel_tpu.analysis.strategy_pass import (check_spgemm_dispatch,
                                                check_strategy_stamps)
+from matrel_tpu.analysis.topology_pass import check_axis_traffic
 from matrel_tpu.config import MatrelConfig, default_config
 
 log = logging.getLogger("matrel_tpu.analysis")
@@ -58,6 +60,7 @@ PASSES = (
     ("layout", check_layout_claims),
     ("padding", check_padding_flow),
     ("hbm", check_hbm_feasibility),
+    ("topology", check_axis_traffic),
 )
 
 

@@ -15,6 +15,7 @@ Code space (stable — tests and suppressions key on them):
   MV103  zero-padding invariant broken without re-mask (error)
   MV104  SpGEMM stamp inconsistent with the dispatch   (error)
   MV105  per-device HBM working set over budget        (error)
+  MV106  dominant collective rides the slow mesh axis  (warning)
 """
 
 from __future__ import annotations

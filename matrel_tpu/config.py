@@ -117,6 +117,17 @@ class MatrelConfig:
         a v5e chip's 16 GiB; 0 disables the gate (divisibility-only
         admissibility, the pre-round-6 behaviour). The xla fallback is
         never gated — GSPMD chooses its own decomposition.
+      axis_cost_weights: per-mesh-axis relative inverse-bandwidth
+        weights for the planner's comm model (core/mesh.MeshTopology):
+        a collective leg over axis i is billed bytes × weights[i], so
+        on a hierarchical ICI/DCN mesh the slow cross-slice axis is
+        priced as expensive as it really is. The default (1.0, 1.0) is
+        behaviour-preserving (every cost bit-identical to the flat
+        model) AND doubles as "auto": when JAX exposes slice
+        boundaries (device.slice_index on multi-slice TPU), the
+        DCN-crossing axes are auto-weighted DCN_AXIS_WEIGHT. Setting
+        anything ≠ (1.0, 1.0) is the calibration hook — it overrides
+        detection (docs/TOPOLOGY.md).
     """
 
     block_size: int = 512
@@ -147,6 +158,7 @@ class MatrelConfig:
     obs_event_log: str = ""
     verify_plans: str = "off"
     hbm_budget_bytes: int = 16 << 30
+    axis_cost_weights: Tuple[float, float] = (1.0, 1.0)
 
     def __post_init__(self):
         # enablement is "anything != off", so an unvalidated typo/case
@@ -168,6 +180,18 @@ class MatrelConfig:
                 f"verify_plans must be one of 'off'/'warn'/'error', "
                 f"got {self.verify_plans!r}")
         object.__setattr__(self, "verify_plans", vp)
+        # a zero/negative weight would make an axis FREE (or negative)
+        # and silently route every collective onto it; a 3-tuple would
+        # desync from the 2D grid — reject both at construction. The
+        # normalised float tuple is what every cache key embeds.
+        w = tuple(self.axis_cost_weights)
+        if len(w) != 2 or not all(
+                isinstance(v, (int, float)) and v > 0.0 for v in w):
+            raise ValueError(
+                "axis_cost_weights must be two positive numbers "
+                f"(per mesh axis), got {self.axis_cost_weights!r}")
+        object.__setattr__(self, "axis_cost_weights",
+                           (float(w[0]), float(w[1])))
 
     def replace(self, **kw: Any) -> "MatrelConfig":
         return dataclasses.replace(self, **kw)
@@ -190,6 +214,10 @@ class MatrelConfig:
                 overrides[f.name] = raw.lower() in ("1", "true", "yes", "on")
             elif f.name == "mesh_shape":
                 parts = [int(p) for p in raw.replace("x", ",").split(",") if p]
+                overrides[f.name] = tuple(parts)
+            elif f.name == "axis_cost_weights":
+                parts = [float(p)
+                         for p in raw.replace("x", ",").split(",") if p]
                 overrides[f.name] = tuple(parts)
             else:
                 overrides[f.name] = raw

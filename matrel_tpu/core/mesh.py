@@ -10,6 +10,8 @@ and the partitioner-equivalents are ``NamedSharding`` PartitionSpecs
 
 from __future__ import annotations
 
+import dataclasses
+import functools
 import math
 from typing import Optional, Sequence, Tuple
 
@@ -71,6 +73,107 @@ def make_mesh(
 def mesh_grid_shape(mesh: Mesh) -> Tuple[int, int]:
     names = mesh.axis_names
     return mesh.shape[names[0]], mesh.shape[names[1]]
+
+
+# -- mesh topology (hierarchical ICI/DCN fabric description) ----------------
+
+#: Default relative inverse-bandwidth of a mesh axis whose hops cross a
+#: slice boundary (DCN) versus an in-slice (ICI) axis. v5e ICI sustains
+#: ~200 GB/s per link against ~25 GB/s of per-host DCN, so a byte over
+#: the cross-slice axis costs ~8 in-slice bytes of time. Order of
+#: magnitude is what matters — the planner needs "much more expensive",
+#: and ``config.axis_cost_weights`` is the calibration hook for the
+#: exact ratio of a given fabric (docs/TOPOLOGY.md).
+DCN_AXIS_WEIGHT = 8.0
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshTopology:
+    """Per-axis interconnect description of a 2D device mesh.
+
+    ``axis_weights[i]`` is the RELATIVE inverse bandwidth of mesh axis i
+    (axis_names order): the planner's comm model bills a collective leg
+    that moves data over axis i at bytes × axis_weights[i], so a
+    reduce-scatter riding a slow DCN axis stops looking as cheap as the
+    same bytes over ICI. (1.0, 1.0) is the homogeneous (single-slice)
+    mesh — every cost reduces to the flat byte model, bit-identically.
+
+    ``source`` records where the weights came from, for explain/obs:
+    "config" (explicit ``config.axis_cost_weights``), "detected"
+    (slice boundaries found via ``device.slice_index``), or "default"
+    (homogeneous — nothing configured, nothing detected).
+    """
+
+    axis_weights: Tuple[float, float] = (1.0, 1.0)
+    source: str = "default"
+
+    @property
+    def uniform(self) -> bool:
+        return self.axis_weights[0] == self.axis_weights[1]
+
+
+def detect_slice_axes(mesh: Mesh) -> Tuple[bool, bool]:
+    """Which mesh axes cross a TPU slice boundary, from the slice index
+    JAX exposes on multi-slice deployments (``device.slice_index``).
+    An axis "crosses" when any two devices adjacent along it belong to
+    different slices — hops over it ride DCN, not ICI. Devices without
+    a slice index (CPU, single-slice TPU) detect as (False, False)."""
+    devs = mesh.devices
+    ids = [[getattr(d, "slice_index", None) for d in row] for row in devs]
+    flat = [s for row in ids for s in row]
+    if any(s is None for s in flat) or len(set(flat)) <= 1:
+        return False, False
+    gx = len(ids)
+    gy = len(ids[0]) if gx else 0
+    x_cross = any(ids[i][j] != ids[i + 1][j]
+                  for i in range(gx - 1) for j in range(gy))
+    y_cross = any(ids[i][j] != ids[i][j + 1]
+                  for i in range(gx) for j in range(gy - 1))
+    return x_cross, y_cross
+
+
+def _resolve_topology(mesh: Mesh,
+                      weights: Tuple[float, float]) -> MeshTopology:
+    if weights != (1.0, 1.0):
+        return MeshTopology(weights, "config")
+    try:
+        crossings = detect_slice_axes(mesh)
+    except Exception:         # exotic device objects must not break
+        crossings = (False, False)      # planning — fall back to flat
+    if any(crossings):
+        return MeshTopology(
+            tuple(DCN_AXIS_WEIGHT if c else 1.0 for c in crossings),
+            "detected")
+    return MeshTopology((1.0, 1.0), "default")
+
+
+_resolve_topology_cached = functools.lru_cache(maxsize=64)(
+    _resolve_topology)
+
+
+def mesh_topology(mesh: Mesh, config=None) -> MeshTopology:
+    """The MeshTopology governing cost models on this mesh: an explicit
+    ``config.axis_cost_weights`` ≠ (1.0, 1.0) wins (the calibration
+    hook — a measured DCN/ICI ratio beats the built-in default), else
+    slice-boundary detection weights each DCN-crossing axis
+    DCN_AXIS_WEIGHT, else the homogeneous default. Never raises: the
+    planner consults this on every matmul (and the session on every
+    query, cache hits included), so resolution is memoised per
+    (mesh, configured weights) — the O(devices) slice scan runs once
+    per mesh, not once per matmul."""
+    from matrel_tpu.config import default_config
+    cfg = config or default_config()
+    w = tuple(cfg.axis_cost_weights)
+    try:
+        return _resolve_topology_cached(mesh, w)
+    except TypeError:         # unhashable mesh stand-ins (tests)
+        return _resolve_topology(mesh, w)
+
+
+def axis_weights(mesh: Mesh, config=None) -> Tuple[float, float]:
+    """Shorthand for ``mesh_topology(mesh, config).axis_weights`` — the
+    (wx, wy) every weighted costing path consumes."""
+    return mesh_topology(mesh, config).axis_weights
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -65,12 +65,21 @@ def optimal_order(operands: List[MatExpr],
         return operands[0], 0.0
     lays = _operand_layouts(operands, mesh if gx * gy > 1 else None,
                             config)
+    # topology weights (core/mesh.MeshTopology): with a mesh in hand the
+    # DP's comm term bills each strategy's legs per axis, so the order
+    # that keeps traffic off a slow DCN axis wins; grid-only callers
+    # (and single-device grids) stay on the flat model
+    weights = (1.0, 1.0)
+    if mesh is not None and gx * gy > 1:
+        from matrel_tpu.core import mesh as mesh_lib
+        weights = mesh_lib.axis_weights(mesh, config)
     if n >= 3:
         from matrel_tpu.utils import native
         dims = [op.shape[0] for op in operands] + [operands[-1].shape[1]]
         dens = [op.density for op in operands]
         codes = [stats.LAYOUT_CODES[l] for l in lays]
-        res = native.chain_dp(dims, dens, grid=grid, layouts=codes)
+        res = native.chain_dp(dims, dens, grid=grid, layouts=codes,
+                              weights=weights)
         if res is not None:
             splits, cost = res
 
@@ -97,6 +106,7 @@ def optimal_order(operands: List[MatExpr],
                 step, lay = stats.chain_step_cost_layout(
                     el.shape[0], el.shape[1], er.shape[1],
                     el.density, er.density, gx, gy, ll, lr,
+                    weights=weights,
                 )
                 total = cl + cr + step
                 if cand is None or total < cand[0]:

@@ -75,14 +75,16 @@ LAYOUT_CODES = {"2d": 0, "row": 1, "col": 2, "rep": 3, "other": 4}
 
 def comm_proxy_layout(n: int, k: int, m: int, da: float, db: float,
                       gx: int, gy: int, itemsize: int = 4,
-                      la: str = "2d", lb: str = "2d"
+                      la: str = "2d", lb: str = "2d",
+                      weights: tuple = (1.0, 1.0)
                       ) -> tuple:
-    """(cheapest per-device ICI bytes, output layout of the argmin
+    """(cheapest per-device ICI cost, output layout of the argmin
     strategy) for an (n×k)·(k×m) multiply on a gx×gy mesh — the chain
-    DP's comm term, now PER-LAYOUT (round 5: the DP can see that a
-    replicated/1D-sharded operand makes one parenthesisation's
-    broadcast free, and it tracks the layout each interval's result
-    would have).
+    DP's comm term, PER-LAYOUT (round 5) and now TOPOLOGY-WEIGHTED
+    (round 7: ``weights`` are the per-axis inverse-bandwidth weights of
+    core/mesh.MeshTopology, so the DP ranks parenthesisations by what
+    their collectives cost on a hierarchical ICI/DCN mesh, not by flat
+    bytes).
 
     Delegates to planner.comm_cost per strategy (ONE Python source of
     truth for the per-layout closed forms — review r5; the only copy is
@@ -99,7 +101,7 @@ def comm_proxy_layout(n: int, k: int, m: int, da: float, db: float,
     for strat, out_lay in (("bmm_right", "row"), ("bmm_left", "col"),
                            ("cpmm", "2d"), ("rmm", "2d")):
         c = planner.comm_cost(strat, n, k, m, da, db, gx, gy,
-                              itemsize, la, lb)
+                              itemsize, la, lb, weights=weights)
         if best is None or c < best:
             best, lay = c, out_lay
     return best, lay
@@ -123,10 +125,13 @@ def chain_step_cost(n: int, k: int, m: int, da: float, db: float,
 
 
 def chain_step_cost_layout(n: int, k: int, m: int, da: float, db: float,
-                           gx: int, gy: int, la: str, lb: str) -> tuple:
-    """(step cost, output layout): chain_step_cost with per-layout comm
-    terms — the layout-aware DP's step (round 5)."""
-    comm, lay = comm_proxy_layout(n, k, m, da, db, gx, gy, la=la, lb=lb)
+                           gx: int, gy: int, la: str, lb: str,
+                           weights: tuple = (1.0, 1.0)) -> tuple:
+    """(step cost, output layout): chain_step_cost with per-layout,
+    topology-weighted comm terms — the layout-aware DP's step (round 5;
+    weights round 7)."""
+    comm, lay = comm_proxy_layout(n, k, m, da, db, gx, gy, la=la, lb=lb,
+                                  weights=weights)
     return (matmul_cost(n, k, m, da, db)
             + COMM_FLOPS_PER_BYTE * comm), lay
 

@@ -62,6 +62,17 @@ def summarize(events: List[dict]) -> dict:
                 s["flops"] += d["flops"]
             if isinstance(d.get("est_ici_bytes"), (int, float)):
                 s["est_ici_bytes"] += d["est_ici_bytes"]
+            # per-axis comm bytes (planner.matmul_decisions round 7):
+            # rolled up per strategy so a regression that shifts
+            # traffic onto the slow DCN axis is visible in the event
+            # log even when the total stays flat
+            ab = d.get("est_axis_bytes")
+            if (isinstance(ab, (list, tuple)) and len(ab) == 2
+                    and all(isinstance(v, (int, float)) for v in ab)):
+                s["est_axis_bytes_x"] = (s.get("est_axis_bytes_x", 0.0)
+                                         + ab[0])
+                s["est_axis_bytes_y"] = (s.get("est_axis_bytes_y", 0.0)
+                                         + ab[1])
             # SpGEMM dispatch records carry estimated savings vs the
             # densify fallback (planner.matmul_decisions) — rolled up
             # so `make obs-report` shows the win per strategy
@@ -119,6 +130,11 @@ def render_summary(events: List[dict]) -> str:
             line = (f"{name:<12}{d['count']:>8}"
                     f"{d['flops'] / 1e9:>10.2f}"
                     f"{d['est_ici_bytes'] / 2**20:>13.2f}")
+            if ("est_axis_bytes_x" in d) or ("est_axis_bytes_y" in d):
+                line += (f"  axes x/y: "
+                         f"{d.get('est_axis_bytes_x', 0.0) / 2**20:.2f}/"
+                         f"{d.get('est_axis_bytes_y', 0.0) / 2**20:.2f}"
+                         f" MiB")
             if d.get("est_saved_flops") or d.get("est_saved_hbm_bytes"):
                 line += (f"  saved: {d.get('est_saved_flops', 0) / 1e9:.2f}"
                          f" GFLOPs / "

@@ -45,14 +45,24 @@ def _table_path(config: Optional[MatrelConfig] = None) -> str:
     return cfg.autotune_table_path or _DEFAULT_TABLE
 
 
-def _table_key(side: int, gx: int, gy: int, dtype: str) -> str:
+def _table_key(side: int, gx: int, gy: int, dtype: str,
+               weights: Tuple[float, float] = (1.0, 1.0)) -> str:
     # backend is part of the key, mirroring _spmv_key's rationale
     # (advisor r4): a shared table must never serve one backend's
     # winner to the other — a persisted CPU-mesh winner has nothing to
     # say about Mosaic. Old un-suffixed entries simply never hit; they
     # linger in the JSON (persist rewrites the whole table) but are
     # inert — delete the file to reclaim the bytes.
-    return f"{side}|{gx}x{gy}|{dtype}|{jax.default_backend()}"
+    #
+    # Non-uniform topology weights (core/mesh.MeshTopology) suffix the
+    # key too: a winner measured on (or planned for) a hierarchical
+    # ICI/DCN mesh must never collide with the homogeneous mesh's row
+    # for the same grid shape. Uniform weights keep the historical
+    # 4-field format, so existing tables stay live.
+    key = f"{side}|{gx}x{gy}|{dtype}|{jax.default_backend()}"
+    if weights != (1.0, 1.0):
+        key += f"|w{weights[0]:g}x{weights[1]:g}"
+    return key
 
 
 def load_table(path: str) -> Dict[str, dict]:
@@ -80,14 +90,17 @@ def _current_key_format(key: str) -> bool:
     """Does a persisted key match the CURRENT (backend-suffixed) key
     formats? Matmul keys are ``side|gxXgy|dtype|backend`` (4 fields);
     SpMV keys ``spmv|backend|rows x cols|nb|cap|blk|grid`` (7 fields).
-    Legacy un-suffixed entries (one field short) and anything unknown
-    read as stale."""
+    Either may carry one extra trailing ``w<wx>x<wy>`` field — the
+    topology-weight suffix of a non-uniform mesh. Legacy un-suffixed
+    entries (one field short) and anything unknown read as stale."""
     if not isinstance(key, str):
         return False
-    n = key.count("|") + 1
-    if key.startswith("spmv|"):
-        return n == 7
-    return n == 4
+    fields = key.split("|")
+    n = len(fields)
+    base = 7 if key.startswith("spmv|") else 4
+    if n == base:
+        return True
+    return n == base + 1 and fields[-1].startswith("w")
 
 
 _TABLE_CACHE: Dict[str, Tuple[float, Dict[str, dict]]] = {}
@@ -252,7 +265,8 @@ def autotune_matmul(n: int, k: int, m: int,
     mesh = mesh or mesh_lib.make_mesh(cfg.mesh_shape, cfg.mesh_axis_names)
     side = max(n, k, m)
     gx, gy = mesh_lib.mesh_grid_shape(mesh)
-    key = (side, gx, gy, str(dtype), jax.default_backend())
+    wts = mesh_lib.axis_weights(mesh, cfg)
+    key = (side, gx, gy, str(dtype), jax.default_backend(), wts)
     if key in _CACHE:
         _maybe_persist_cached(cfg, key)
         return _CACHE[key]
@@ -285,7 +299,8 @@ def autotune_matmul(n: int, k: int, m: int,
         # table explicitly — a one-off measurement call (the original
         # API contract, also the CLI) must not drop a hidden JSON file
         # into the working directory as a side effect
-        _persist(_table_path(cfg), _table_key(side, gx, gy, str(dtype)),
+        _persist(_table_path(cfg),
+                 _table_key(side, gx, gy, str(dtype), wts),
                  best, results)
     return best, results
 
@@ -315,12 +330,12 @@ def _maybe_persist_cached(config: Optional[MatrelConfig],
     cfg = config or default_config()
     if not (cfg.autotune or cfg.autotune_table_path):
         return
-    side, gx, gy, dtype, _backend = key
+    side, gx, gy, dtype, _backend, wts = key
     best, results = _CACHE[key]
     if not results:
         return
     path = _table_path(cfg)
-    tkey = _table_key(side, gx, gy, dtype)
+    tkey = _table_key(side, gx, gy, dtype, wts)
     if tkey not in _load_table_cached(path):
         _persist(path, tkey, best, results)
 
@@ -344,12 +359,13 @@ def lookup_or_measure(n: int, k: int, m: int, mesh,
     if min(n, k, m) * 4 < side:
         return None
     gx, gy = mesh_lib.mesh_grid_shape(mesh)
-    key = (side, gx, gy, str(dtype), jax.default_backend())
+    wts = mesh_lib.axis_weights(mesh, cfg)
+    key = (side, gx, gy, str(dtype), jax.default_backend(), wts)
     if key in _CACHE:
         _maybe_persist_cached(cfg, key)
         return _CACHE[key][0]
     entry = _load_table_cached(_table_path(cfg)).get(
-        _table_key(side, gx, gy, str(dtype)))
+        _table_key(side, gx, gy, str(dtype), wts))
     if isinstance(entry, dict) and entry.get("times"):
         # a persisted TIE (best null) is a measurement too: cache it and
         # let the model decide — do NOT re-measure every compile
@@ -382,14 +398,20 @@ SPMV_EXPANDED_BUDGET_BYTES = 2 * 1024 ** 3
 SPMV_VARIANTS = ("compact", "expanded")
 
 
-def _spmv_key(plan, gx: int, gy: int) -> str:
+def _spmv_key(plan, gx: int, gy: int,
+              weights: Tuple[float, float] = (1.0, 1.0)) -> str:
     # backend is part of the key: the compact/expanded trade-off FLIPS
     # between real Mosaic (compact wins, BASELINE row 5) and CPU
     # interpret mode (expanded wins ~20x) — a shared table must never
-    # serve one backend's winner to the other
+    # serve one backend's winner to the other. Non-uniform topology
+    # weights suffix the key like _table_key's matmul rows: the sharded
+    # executors' gather bills differ on a hierarchical mesh.
     nb, cap = plan.src8.shape if hasattr(plan.src8, "shape") else (0, 0)
-    return (f"spmv|{jax.default_backend()}|{plan.n_rows}x{plan.n_cols}"
-            f"|nb{nb}|cap{cap}|blk{plan.block}|{gx}x{gy}")
+    key = (f"spmv|{jax.default_backend()}|{plan.n_rows}x{plan.n_cols}"
+           f"|nb{nb}|cap{cap}|blk{plan.block}|{gx}x{gy}")
+    if weights != (1.0, 1.0):
+        key += f"|w{weights[0]:g}x{weights[1]:g}"
+    return key
 
 
 def measure_spmv_variant(variant: str, plan, mesh,
@@ -447,7 +469,7 @@ def lookup_or_measure_spmv(plan, mesh,
     result sets resolve to None and are never fake winners."""
     cfg = config or default_config()
     gx, gy = mesh_lib.mesh_grid_shape(mesh)
-    key = _spmv_key(plan, gx, gy)
+    key = _spmv_key(plan, gx, gy, mesh_lib.axis_weights(mesh, cfg))
     if key in _SPMV_CACHE:
         return _SPMV_CACHE[key]
     entry = _load_table_cached(_table_path(cfg)).get(key)

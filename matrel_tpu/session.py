@@ -160,6 +160,14 @@ class MatrelSession:
         outcome exposed, so compute() can emit hit/miss events without
         a second key computation."""
         key, pins = _plan_key(e)
+        wts = mesh_lib.axis_weights(self.mesh, self.config)
+        if wts != (1.0, 1.0):
+            # topology weights change which strategies get stamped, so
+            # weighted and unweighted plans must never share a cache
+            # entry (the detection path can flip weights without any
+            # config field changing — the expression key alone is not
+            # enough). Unweighted keys keep the historical format.
+            key = f"axisw:{wts[0]:g}x{wts[1]:g}|{key}"
         plan = self._plan_cache.get(key)
         if plan is not None:
             self._plan_cache.move_to_end(key)

@@ -126,6 +126,29 @@ def load() -> Optional[ctypes.CDLL]:
         except AttributeError as e:
             log.debug("native layout-aware chain-dp unavailable: %s", e)
             lib._matrel_has_dp_layout = False
+        try:
+            # topology-weighted DP binds separately for the same
+            # stale-lib tolerance reason
+            lib.matrel_chain_dp_topo.restype = ctypes.c_int
+            lib.matrel_chain_dp_topo.argtypes = [
+                ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.c_double,
+                ctypes.c_int32,
+                ctypes.c_double,
+                ctypes.c_double,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.POINTER(ctypes.c_double),
+            ]
+            lib._matrel_has_dp_topo = True
+        except AttributeError as e:
+            log.debug("native topology-weighted chain-dp unavailable: %s",
+                      e)
+            lib._matrel_has_dp_topo = False
         _lib = lib
         try:
             # Ingestion symbols bind separately so a stale prebuilt lib
@@ -184,15 +207,18 @@ def chain_dp(dims: Sequence[int], densities: Sequence[float],
              grid: Tuple[int, int] = (1, 1),
              comm_weight: Optional[float] = None,
              itemsize: int = 4,
-             layouts: Optional[Sequence[int]] = None
+             layouts: Optional[Sequence[int]] = None,
+             weights: Optional[Tuple[float, float]] = None
              ) -> Optional[Tuple[np.ndarray, float]]:
     """Run the native interval DP. dims has n+1 entries; densities n.
     With grid != (1,1) the step cost adds the comm term (ir/stats.py::
     chain_step_cost semantics); non-trivial ``layouts`` (int codes,
-    ir/stats.py::LAYOUT_CODES) make it layout-aware. Returns (split
-    table [n,n] int32, total cost) or None if the native path is
-    unavailable — including a stale prebuilt lib lacking the needed
-    symbol (the caller's pure-Python DP then decides)."""
+    ir/stats.py::LAYOUT_CODES) make it layout-aware, and non-uniform
+    per-axis ``weights`` (core/mesh.MeshTopology) make it
+    topology-aware. Returns (split table [n,n] int32, total cost) or
+    None if the native path is unavailable — including a stale prebuilt
+    lib lacking the needed symbol (the caller's pure-Python DP then
+    decides)."""
     lib = load()
     if lib is None or not getattr(lib, "_matrel_has_dp", False):
         return None
@@ -204,15 +230,31 @@ def chain_dp(dims: Sequence[int], densities: Sequence[float],
     splits = np.zeros((n, n), dtype=np.int32)
     cost = ctypes.c_double(0.0)
     gx, gy = grid
+    weighted = weights is not None and tuple(weights) != (1.0, 1.0)
     if gx * gy > 1:
         if comm_weight is None:
             from matrel_tpu.ir.stats import COMM_FLOPS_PER_BYTE
             comm_weight = COMM_FLOPS_PER_BYTE
-        if layouts is not None and any(layouts):
+        if layouts is not None and len(layouts) != n:
+            raise ValueError("layouts must have one entry per operand")
+        if weighted:
+            # topology weights change the comm term for EVERY layout
+            # (including all-2d), so the topo symbol is required — a
+            # stale lib degrades to the pure-Python weighted DP rather
+            # than silently pricing a flat fabric
+            if not getattr(lib, "_matrel_has_dp_topo", False):
+                return None
+            lays_arr = np.ascontiguousarray(
+                layouts if layouts is not None else [0] * n,
+                dtype=np.int8)
+            rc = lib.matrel_chain_dp_topo(
+                n, dims_arr, dens_arr, lays_arr, int(gx), int(gy),
+                float(comm_weight), int(itemsize), float(weights[0]),
+                float(weights[1]), splits.reshape(-1),
+                ctypes.byref(cost))
+        elif layouts is not None and any(layouts):
             if not getattr(lib, "_matrel_has_dp_layout", False):
                 return None
-            if len(layouts) != n:
-                raise ValueError("layouts must have one entry per operand")
             lays_arr = np.ascontiguousarray(layouts, dtype=np.int8)
             rc = lib.matrel_chain_dp_layout(
                 n, dims_arr, dens_arr, lays_arr, int(gx), int(gy),

@@ -37,25 +37,42 @@ constexpr int8_t kLayRep = 3;
 // 4 ("other") behaves as 2d in every formula below.
 
 // Per-device bytes to re-lay an operand into the canonical P(x, y)
-// tiling (cpmm/summa input). Mirrors ir/stats.py comm_proxy_layout's
-// to2d helper.
+// tiling (cpmm/summa input), weighted by the topology weight of the
+// single mesh axis the gather rides (row-sharded gathers along y,
+// col-sharded along x). Mirrors planner._to_2d_reshard/_to_2d_axis.
 double to_2d_reshard(double bytes, int8_t lay, double gx, double gy,
-                     double p) {
+                     double p, double wx, double wy) {
   if (lay == kLayRep) return 0.0;
-  if (lay == kLayRow) return (bytes / p) * (1.0 - 1.0 / gy);
-  if (lay == kLayCol) return (bytes / p) * (1.0 - 1.0 / gx);
+  if (lay == kLayRow) return (bytes / p) * (1.0 - 1.0 / gy) * wy;
+  if (lay == kLayCol) return (bytes / p) * (1.0 - 1.0 / gx) * wx;
   return 0.0;
 }
 
-// Per-device ICI bytes of the cheapest MM strategy for (n×k)·(k×m) on a
-// gx×gy mesh, given operand layouts; *out_lay receives the layout the
-// argmin strategy emits (bmm_r → row, bmm_l → col, cpmm/rmm → 2d). MUST
-// mirror ir/stats.py::comm_proxy_layout (planner.comm_cost's per-layout
-// forms, no admissibility gates) INCLUDING the tie-break order — the
-// equivalence is asserted by tests/test_native.py.
+// Weighted cost of a FULL-MESH replication of src bytes from an even
+// p-way shard: hierarchical two-stage split, the expensive axis riding
+// the small first stage (min over stage orders); uniform weights keep
+// the flat closed form's float arithmetic. Mirrors
+// planner._split_full_mesh exactly.
+double split_full_mesh(double src, double gx, double gy, double p,
+                       double wx, double wy) {
+  if (wx == wy) return src * (p - 1.0) / p * wx;
+  double cost_yf = wy * src * (gy - 1.0) / p + wx * src * (gx - 1.0) / gx;
+  double cost_xf = wx * src * (gx - 1.0) / p + wy * src * (gy - 1.0) / gy;
+  return cost_yf <= cost_xf ? cost_yf : cost_xf;
+}
+
+// Per-device weighted interconnect cost of the cheapest MM strategy for
+// (n×k)·(k×m) on a gx×gy mesh, given operand layouts and per-axis
+// topology weights (wx, wy); *out_lay receives the layout the argmin
+// strategy emits (bmm_r → row, bmm_l → col, cpmm/rmm → 2d). MUST
+// mirror ir/stats.py::comm_proxy_layout (planner.comm_cost's
+// per-layout, per-axis forms, no admissibility gates) INCLUDING the
+// tie-break order — the equivalence is asserted by tests/test_native.py
+// over weighted grids.
 double comm_proxy_layout(double n, double k, double m, double da, double db,
                          double gx, double gy, double itemsize,
-                         int8_t la, int8_t lb, int8_t* out_lay) {
+                         int8_t la, int8_t lb, double wx, double wy,
+                         int8_t* out_lay) {
   double p = gx * gy;
   if (p <= 1.0) {
     *out_lay = kLay2d;
@@ -65,18 +82,23 @@ double comm_proxy_layout(double n, double k, double m, double da, double db,
   double b_b = k * m * itemsize * db;
   double c_b = n * m * itemsize;
   double bmm_r =
-      (lb == kLayRep ? 0.0 : b_b * (p - 1.0) / p) +
-      (la == kLayRow || la == kLayRep ? 0.0
-                                      : (a_b / p) * (1.0 - 1.0 / gy));
+      (lb == kLayRep ? 0.0 : split_full_mesh(b_b, gx, gy, p, wx, wy)) +
+      (la == kLayRow || la == kLayRep
+           ? 0.0
+           : (a_b / p) * (1.0 - 1.0 / gy) * wy);
   double bmm_l =
-      (la == kLayRep ? 0.0 : a_b * (p - 1.0) / p) +
-      (lb == kLayCol || lb == kLayRep ? 0.0
-                                      : (b_b / p) * (1.0 - 1.0 / gx));
-  double cpmm = to_2d_reshard(a_b, la, gx, gy, p) +
-                (lb == kLayRep ? 0.0 : (b_b / gy) * (gx - 1.0) / gx) +
-                (c_b / gx) * (gy - 1.0) / gy;
-  double rmm = (la == kLayRep ? 0.0 : (a_b / gx) * (gy - 1.0) / gy) +
-               (lb == kLayRep ? 0.0 : (b_b / gy) * (gx - 1.0) / gx);
+      (la == kLayRep ? 0.0 : split_full_mesh(a_b, gx, gy, p, wx, wy)) +
+      (lb == kLayCol || lb == kLayRep
+           ? 0.0
+           : (b_b / p) * (1.0 - 1.0 / gx) * wx);
+  double cpmm = to_2d_reshard(a_b, la, gx, gy, p, wx, wy) +
+                (lb == kLayRep ? 0.0
+                               : (b_b / gy) * (gx - 1.0) / gx * wx) +
+                (c_b / gx) * (gy - 1.0) / gy * wy;
+  double rmm = (la == kLayRep ? 0.0
+                              : (a_b / gx) * (gy - 1.0) / gy * wy) +
+               (lb == kLayRep ? 0.0
+                              : (b_b / gy) * (gx - 1.0) / gx * wx);
   double best = bmm_r;
   int8_t lay = kLayRow;
   if (bmm_l < best) { best = bmm_l; lay = kLayCol; }
@@ -88,8 +110,8 @@ double comm_proxy_layout(double n, double k, double m, double da, double db,
 
 int chain_dp_impl(int32_t n, const int64_t* dims, const double* dens,
                   const int8_t* lays, double gx, double gy,
-                  double comm_weight, double itemsize, int32_t* split_out,
-                  double* cost_out) {
+                  double comm_weight, double itemsize, double wx,
+                  double wy, int32_t* split_out, double* cost_out) {
   if (n <= 0 || dims == nullptr || dens == nullptr || split_out == nullptr ||
       cost_out == nullptr)
     return 1;
@@ -124,7 +146,8 @@ int chain_dp_impl(int32_t n, const int64_t* dims, const double* dens,
           step += comm_weight *
                   comm_proxy_layout(rows, mid, colsj, dl, dr, gx, gy,
                                     itemsize, layout[i * n + s],
-                                    layout[(s + 1) * n + j], &out_lay);
+                                    layout[(s + 1) * n + j], wx, wy,
+                                    &out_lay);
         double total = cost[i * n + s] + cost[(s + 1) * n + j] + step;
         if (best < 0.0 || total < best) {
           best = total;
@@ -155,8 +178,8 @@ extern "C" {
 // returns 0 on success, nonzero on bad input
 int matrel_chain_dp(int32_t n, const int64_t* dims, const double* dens,
                     int32_t* split_out, double* cost_out) {
-  return chain_dp_impl(n, dims, dens, nullptr, 1.0, 1.0, 0.0, 4.0,
-                       split_out, cost_out);
+  return chain_dp_impl(n, dims, dens, nullptr, 1.0, 1.0, 0.0, 4.0, 1.0,
+                       1.0, split_out, cost_out);
 }
 
 // Comm-aware variant: step cost additionally pays
@@ -170,7 +193,8 @@ int matrel_chain_dp_comm(int32_t n, const int64_t* dims, const double* dens,
   if (gx <= 0 || gy <= 0 || itemsize <= 0) return 1;
   return chain_dp_impl(n, dims, dens, nullptr, static_cast<double>(gx),
                        static_cast<double>(gy), comm_weight,
-                       static_cast<double>(itemsize), split_out, cost_out);
+                       static_cast<double>(itemsize), 1.0, 1.0, split_out,
+                       cost_out);
 }
 
 // Layout-aware variant (round 5): lays is n int8 layout codes
@@ -185,7 +209,27 @@ int matrel_chain_dp_layout(int32_t n, const int64_t* dims,
   if (gx <= 0 || gy <= 0 || itemsize <= 0 || lays == nullptr) return 1;
   return chain_dp_impl(n, dims, dens, lays, static_cast<double>(gx),
                        static_cast<double>(gy), comm_weight,
-                       static_cast<double>(itemsize), split_out, cost_out);
+                       static_cast<double>(itemsize), 1.0, 1.0, split_out,
+                       cost_out);
+}
+
+// Topology-aware variant (round 7): wx/wy are the per-mesh-axis
+// inverse-bandwidth weights (core/mesh.MeshTopology — 1.0 = ICI
+// baseline, a DCN-crossing axis ≫ 1), so the comm term bills each
+// strategy's collective legs on the axis they actually ride. Weights
+// (1.0, 1.0) reproduce matrel_chain_dp_layout bit-identically.
+int matrel_chain_dp_topo(int32_t n, const int64_t* dims,
+                         const double* dens, const int8_t* lays,
+                         int32_t gx, int32_t gy, double comm_weight,
+                         int32_t itemsize, double wx, double wy,
+                         int32_t* split_out, double* cost_out) {
+  if (gx <= 0 || gy <= 0 || itemsize <= 0 || lays == nullptr ||
+      wx <= 0.0 || wy <= 0.0)
+    return 1;
+  return chain_dp_impl(n, dims, dens, lays, static_cast<double>(gx),
+                       static_cast<double>(gy), comm_weight,
+                       static_cast<double>(itemsize), wx, wy, split_out,
+                       cost_out);
 }
 
 }  // extern "C"

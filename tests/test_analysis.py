@@ -361,3 +361,117 @@ def test_plan_verify_selfcheck_green():
     zero diagnostics."""
     from tools import plan_verify
     assert plan_verify.main() == 0
+
+
+class TestTopologyPass:
+    """MV106 (round 7): the slow-axis collective smell on a weighted
+    mesh — fires on hand-stamped plans, never on the planner's own
+    output, and costs nothing on a homogeneous mesh."""
+
+    W_CFG = None  # built per-test (fixtures need mesh8)
+
+    def _wcfg(self):
+        return MatrelConfig(axis_cost_weights=(1.0, 8.0))
+
+    def _stamped_slow(self, mesh):
+        # replicated B makes the broadcast alternative FREE, so the
+        # hand-stamped rmm (whose A all-gather rides y, the slow axis)
+        # is a gy-fold weighted-bytes regression; the node sits under
+        # an outer matmul so no root-reshard context muddies the gap
+        import dataclasses
+        base = BlockMatrix.from_numpy(np.zeros((8, 8), np.float32),
+                                      mesh=mesh)
+        brep = BlockMatrix.from_numpy(np.zeros((8, 8), np.float32),
+                                      mesh=mesh, spec=P(None, None))
+
+        def fab(src, n, m):
+            return E.leaf(dataclasses.replace(src, shape=(n, m)))
+
+        inner = E.matmul(fab(base, 8192, 2048),
+                         fab(brep, 2048, 4096)).with_attrs(
+            strategy="rmm", strategy_source="override")
+        return E.matmul(inner, fab(base, 4096, 64))
+
+    def test_mv106_fires_on_hand_stamped_slow_axis_plan(self, mesh8):
+        cfg = self._wcfg()
+        ann = planner.annotate_strategies(self._stamped_slow(mesh8),
+                                          mesh8, cfg)
+        diags = analysis.verify_plan(ann, mesh8, cfg)
+        mv106 = [d for d in diags if d.code == "MV106"]
+        assert mv106 and all(d.severity == "warning" for d in mv106)
+        assert "bmm_right" in mv106[0].message
+
+    def test_mv106_quiet_on_planner_output(self, rng, mesh8):
+        # the planner minimises the same weighted bill — a fresh
+        # annotation can never be >=2x off its own argmin
+        cfg = self._wcfg()
+        X = _dense(rng, 256, 64, mesh8)
+        e = X.expr().t().multiply(X.expr()).multiply(
+            _dense(rng, 64, 32, mesh8).expr())
+        diags = analysis.verify_plan(_annotated(e, mesh8, cfg), mesh8,
+                                     cfg)
+        assert "MV106" not in _codes(diags)
+
+    def test_mv106_free_on_uniform_mesh(self, mesh8):
+        # the same hand-stamped plan on a homogeneous mesh: no slow
+        # axis exists, the pass yields nothing (rmm vs free-broadcast
+        # bmm is a plain cost miss, not a topology smell)
+        cfg = MatrelConfig()
+        ann = planner.annotate_strategies(self._stamped_slow(mesh8),
+                                          mesh8, cfg)
+        diags = analysis.verify_plan(ann, mesh8, cfg)
+        assert "MV106" not in _codes(diags)
+
+    def test_mv106_respects_root_exposure(self, mesh8):
+        # the pass mirrors the planner's root context: a bmm
+        # alternative AT the plan root pays the canonical-output
+        # re-lay the executor really performs there
+        # (_root_reshard_cost x exposure). The SAME stamped multiply
+        # is flagged as an interior node (exposure 0 — bmm_right is
+        # 4x cheaper) but NOT at the root, where the big output's
+        # y-axis re-lay collapses the alternative's margin below 2x —
+        # context-free pricing would false-positive every root plan.
+        import dataclasses
+        cfg = self._wcfg()
+        base = BlockMatrix.from_numpy(np.zeros((8, 8), np.float32),
+                                      mesh=mesh8)
+        brep = BlockMatrix.from_numpy(np.zeros((8, 8), np.float32),
+                                      mesh=mesh8, spec=P(None, None))
+        stamped = E.matmul(
+            E.leaf(dataclasses.replace(base, shape=(8192, 2048))),
+            E.leaf(dataclasses.replace(brep, shape=(2048, 4096)))
+        ).with_attrs(strategy="rmm", strategy_source="override")
+        at_root = analysis.verify_plan(stamped, mesh8, cfg)
+        assert "MV106" not in _codes(at_root)
+        interior = E.matmul(stamped, E.leaf(dataclasses.replace(
+            base, shape=(4096, 64))))
+        diags = analysis.verify_plan(
+            planner.annotate_strategies(interior, mesh8, cfg), mesh8,
+            cfg)
+        assert "MV106" in _codes(diags)
+
+    def test_mv106_exempts_measured_stamps(self, mesh8, tmp_path):
+        # an autotune wall-clock winner legitimately overrules the
+        # byte model (that is the point of measuring) — flagging it
+        # would warn on every fresh annotation of an autotune-enabled
+        # weighted session (review r7)
+        import json
+        from matrel_tpu.parallel import autotune
+        cfg = self._wcfg().replace(
+            autotune=True,
+            autotune_table_path=str(tmp_path / "t.json"))
+        key = autotune._table_key(2048, 2, 4, "float32", (1.0, 8.0))
+        json.dump({key: {"best": "rmm", "times": {"rmm": 1e-6,
+                                                  "cpmm": 1.0}}},
+                  open(str(tmp_path / "t.json"), "w"))
+        autotune._CACHE.clear()
+        rng = np.random.default_rng(3)
+        a = _dense(rng, 2048, 2048, mesh8)
+        b = _dense(rng, 2048, 2048, mesh8)
+        inner = E.matmul(a.expr(), b.expr())
+        outer = E.matmul(inner, _dense(rng, 2048, 64, mesh8).expr())
+        ann = planner.annotate_strategies(outer, mesh8, cfg)
+        autotune._CACHE.clear()
+        assert ann.children[0].attrs["strategy_source"] == "measured"
+        diags = analysis.verify_plan(ann, mesh8, cfg)
+        assert "MV106" not in _codes(diags)

@@ -82,3 +82,29 @@ class TestPallasGates:
         assert resolve_interpret(None, MatrelConfig()) is False
         assert resolve_interpret(False, cfg_on) is False   # explicit wins
         assert resolve_interpret(True, MatrelConfig()) is True
+
+
+class TestAxisCostWeights:
+    """Round 7 topology knob: validated at construction (a zero weight
+    silently makes an axis free — worse than a crash), env-parseable in
+    both mesh_shape spellings, normalised to a float tuple (the form
+    every cache key embeds)."""
+
+    def test_default_and_normalisation(self):
+        assert MatrelConfig().axis_cost_weights == (1.0, 1.0)
+        w = MatrelConfig(axis_cost_weights=(1, 8)).axis_cost_weights
+        assert w == (1.0, 8.0)
+        assert all(isinstance(v, float) for v in w)
+
+    @pytest.mark.parametrize("bad", [(0.0, 1.0), (1.0, -2.0),
+                                     (1.0,), (1.0, 2.0, 3.0),
+                                     ("a", 1.0)])
+    def test_invalid_rejected(self, bad):
+        with pytest.raises((ValueError, TypeError)):
+            MatrelConfig(axis_cost_weights=bad)
+
+    def test_env_both_spellings(self, monkeypatch):
+        monkeypatch.setenv("MATREL_AXIS_COST_WEIGHTS", "1,8")
+        assert MatrelConfig.from_env().axis_cost_weights == (1.0, 8.0)
+        monkeypatch.setenv("MATREL_AXIS_COST_WEIGHTS", "1.5x32")
+        assert MatrelConfig.from_env().axis_cost_weights == (1.5, 32.0)

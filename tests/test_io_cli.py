@@ -612,3 +612,68 @@ class TestAutotuneOneVariantGate:
         entry = autotune.load_table(path)[
             autotune._table_key(32, 2, 4, "float32")]
         assert entry["best"] is None and entry["times"]
+
+
+class TestWeightedTableKeys:
+    """Round 7 cache-key hygiene: weighted (topology) measurements get
+    their own autotune-table rows; load_table's prune keeps both the
+    historical 4/7-field keys AND the new w-suffixed forms while still
+    dropping true legacy entries."""
+
+    def test_weighted_key_formats_survive_prune(self, tmp_path):
+        import json
+        from matrel_tpu.parallel import autotune
+        uk = autotune._table_key(64, 2, 4, "float32")
+        wk = autotune._table_key(64, 2, 4, "float32", (1.0, 8.0))
+        assert wk == uk + "|w1x8" and wk != uk
+        path = str(tmp_path / "t.json")
+        legacy_mm = "64|2x4|float32"          # pre-backend-suffix
+        legacy_spmv = "spmv|cpu|100x100|nb1|cap8|blk128"
+        spmv_w = legacy_spmv + "|2x4|w1x8"    # current 7-field + weights
+        json.dump({uk: {"best": "rmm", "times": {"rmm": 1.0}},
+                   wk: {"best": "bmm_right", "times": {"bmm_right": 1.0}},
+                   legacy_mm: {"best": "cpmm", "times": {}},
+                   legacy_spmv: {"best": "compact", "times": {}},
+                   spmv_w: {"best": "expanded", "times": {}}},
+                  open(path, "w"))
+        t = autotune.load_table(path)
+        assert set(t) == {uk, wk, spmv_w}
+
+    def test_weighted_mesh_reads_its_own_row(self, mesh8, tmp_path,
+                                             monkeypatch):
+        # a winner measured on the flat mesh must NOT serve a weighted
+        # session (and vice versa): lookup under weights misses the
+        # unweighted row and returns the weighted one
+        import json
+        from matrel_tpu.config import MatrelConfig
+        from matrel_tpu.parallel import autotune
+        path = str(tmp_path / "t.json")
+        json.dump(
+            {autotune._table_key(64, 2, 4, "float32"):
+                 {"best": "rmm", "times": {"rmm": 1e-6, "cpmm": 1.0}},
+             autotune._table_key(64, 2, 4, "float32", (1.0, 8.0)):
+                 {"best": "cpmm",
+                  "times": {"rmm": 1.0, "cpmm": 1e-6}}},
+            open(path, "w"))
+        autotune._CACHE.clear()
+        flat = autotune.lookup_or_measure(
+            64, 64, 64, mesh8, "float32",
+            MatrelConfig(autotune=True, autotune_table_path=path))
+        weighted = autotune.lookup_or_measure(
+            64, 64, 64, mesh8, "float32",
+            MatrelConfig(autotune=True, autotune_table_path=path,
+                         axis_cost_weights=(1.0, 8.0)))
+        autotune._CACHE.clear()
+        assert (flat, weighted) == ("rmm", "cpmm")
+
+    def test_spmv_key_weight_suffix(self, mesh8):
+        import types
+        from matrel_tpu.parallel import autotune
+        plan = types.SimpleNamespace(
+            src8=np.zeros((2, 8), np.int32), n_rows=100, n_cols=100,
+            block=128)
+        k0 = autotune._spmv_key(plan, 2, 4)
+        kw = autotune._spmv_key(plan, 2, 4, (2.0, 1.0))
+        assert kw == k0 + "|w2x1"
+        assert autotune._current_key_format(k0)
+        assert autotune._current_key_format(kw)

@@ -439,3 +439,60 @@ class TestInstrumentationGuard:
         assert out.stdout.strip() == "False"
         [rec] = read_events(str(tmp_path / "ev.jsonl"))
         assert rec["kind"] == "bench"
+
+
+class TestAxisBytesRollup:
+    """Round 7: per-axis comm bytes (planner.matmul_decisions'
+    est_axis_bytes) roll up per strategy in history --summary, so a
+    regression shifting traffic onto the slow DCN axis shows in the
+    event log even when the flat total holds."""
+
+    def _seed(self, tmp_path):
+        log = EventLog(str(tmp_path / "ax.jsonl"))
+        for i in range(2):
+            log.emit("query", {
+                "query_id": f"q{i}", "source": "dsl", "cache": "miss",
+                "execute_ms": 1.0, "out_shape": [4, 4],
+                "plan_cache": {"plans": 1, "evicted": 0},
+                "matmuls": [
+                    {"uid": 1, "strategy": "rmm", "flops": 1e9,
+                     "est_ici_bytes": 3.0 * 2 ** 20,
+                     "est_axis_bytes": [1.0 * 2 ** 20, 2.0 * 2 ** 20],
+                     "axis_weights": [1.0, 8.0]},
+                    # legacy record without the field: must not crash
+                    {"uid": 2, "strategy": "cpmm", "flops": 1e9,
+                     "est_ici_bytes": 2.0 ** 20}]})
+        return log.path
+
+    def test_summarize_accumulates_per_axis(self, tmp_path):
+        from matrel_tpu.obs.history import summarize
+        s = summarize(read_events(self._seed(tmp_path)))
+        rmm = s["strategies"]["rmm"]
+        assert rmm["est_axis_bytes_x"] == pytest.approx(2.0 * 2 ** 20)
+        assert rmm["est_axis_bytes_y"] == pytest.approx(4.0 * 2 ** 20)
+        assert "est_axis_bytes_x" not in s["strategies"]["cpmm"]
+
+    def test_render_shows_axis_column(self, tmp_path):
+        from matrel_tpu.obs.history import render_summary
+        out = render_summary(read_events(self._seed(tmp_path)))
+        assert "axes x/y: 2.00/4.00 MiB" in out
+
+    def test_weighted_query_event_carries_axis_bytes(self, tmp_path,
+                                                     mesh8, rng):
+        # end to end: an observed weighted session writes decisions
+        # with the per-axis decomposition into the event log
+        from matrel_tpu.session import MatrelSession
+        cfg = MatrelConfig(obs_level="on",
+                           obs_event_log=str(tmp_path / "q.jsonl"),
+                           axis_cost_weights=(1.0, 8.0))
+        sess = MatrelSession(mesh=mesh8, config=cfg)
+        a = sess.from_numpy(
+            rng.standard_normal((64, 32)).astype(np.float32))
+        b = sess.from_numpy(
+            rng.standard_normal((32, 16)).astype(np.float32))
+        sess.compute(a.expr().multiply(b.expr()))
+        (ev,) = [e for e in read_events(cfg.obs_event_log)
+                 if e["kind"] == "query"]
+        (d,) = ev["matmuls"]
+        assert len(d["est_axis_bytes"]) == 2
+        assert d["axis_weights"] == [1.0, 8.0]

@@ -251,6 +251,37 @@ def soak_sharded(n_trials: int, base: int, tol: float):
                 got = np.asarray(spmv_lib.spmv_sharded(plan_s, x, mesh))
                 np.testing.assert_allclose(got / scale, want / scale,
                                            rtol=tol, atol=tol)
+
+            # topology-weighted planning (round 7): random per-axis
+            # weights re-route strategy choices — whatever the weighted
+            # pick, execution must stay oracle-exact, and the verifier
+            # (incl. MV106's slow-axis pass) must find nothing to flag
+            # on the planner's own output
+            from matrel_tpu import analysis
+            from matrel_tpu.config import MatrelConfig
+            from matrel_tpu.executor import execute
+            from matrel_tpu.parallel import planner as pl
+            wcfg = MatrelConfig(
+                axis_cost_weights=(float(rng.choice([1.0, 2.0, 16.0])),
+                                   float(rng.choice([1.0, 8.0, 32.0]))),
+                comm_alpha_bytes=float(rng.choice([0.0, 200_000.0])))
+            wn = int(rng.integers(2, 9)) * 8
+            wk = int(rng.integers(2, 9)) * 8
+            wm = int(rng.integers(2, 9)) * 8
+            wa = rng.standard_normal((wn, wk)).astype(np.float32)
+            wb = rng.standard_normal((wk, wm)).astype(np.float32)
+            wc = rng.standard_normal((wm, wn)).astype(np.float32)
+            wexpr = (BlockMatrix.from_numpy(wa, mesh=mesh).expr()
+                     .multiply(BlockMatrix.from_numpy(wb, mesh=mesh)
+                               .expr())
+                     .multiply(BlockMatrix.from_numpy(wc, mesh=mesh)
+                               .expr()))
+            wann = pl.annotate_strategies(wexpr, mesh, wcfg)
+            diags = analysis.verify_plan(wann, mesh, wcfg)
+            assert not [d for d in diags if d.code == "MV106"], diags
+            got_w = execute(wann, mesh, wcfg).to_numpy()
+            np.testing.assert_allclose(got_w, wa @ wb @ wc,
+                                       rtol=5e-3, atol=5e-3)
         except Exception as ex:  # noqa: BLE001
             fails.append(("sharded", trial, type(ex).__name__,
                           str(ex)[:150]))

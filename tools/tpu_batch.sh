@@ -59,6 +59,8 @@ log "--- bench.py --spgemm (S x S tile-intersection SpGEMM row, staged this roun
 python bench.py --spgemm
 log "--- bench_all.py (all BASELINE rows)"
 python bench_all.py
+log "--- topology_flip (ICI/DCN-weighted planner flip proof, staged this round)"
+python tools/topology_flip.py
 log "--- north_star_sweep (VERDICT #10 residual)"
 python tools/north_star_sweep.py
 log "--- gram_manual3 (symmetric-Gram microbench, BASELINE row 3 support)"
