@@ -59,6 +59,15 @@ def bench_spgemm(mesh, cfg):
     return {"metric": "blocksparse_spgemm_100k_1pct", **payload}
 
 
+def bench_serve(mesh, cfg):
+    """Repeated-traffic serving QPS (matrel_tpu/serve/): mixed query
+    stream, {result cache off/on} x {sequential/micro-batched} — the
+    cross-query amortization row (see bench.measure_serve)."""
+    import bench
+    payload = bench.measure_serve()
+    return {"metric": "serve_repeated_traffic_qps", **payload}
+
+
 def bench_chain(mesh, cfg):
     import jax.numpy as jnp
     import jax
@@ -358,11 +367,11 @@ def main():
     # step order, the JSON contract and the harness glue, not the
     # numbers.
     dry = bool(os.environ.get("MATREL_DRY"))
-    dry_rows = (bench_dense_4k, bench_chain, bench_spgemm)
+    dry_rows = (bench_dense_4k, bench_chain, bench_spgemm, bench_serve)
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
-               bench_spgemm, bench_pagerank, bench_pagerank_10x,
-               bench_cg, bench_eigen, bench_triangles,
-               bench_north_star):
+               bench_spgemm, bench_serve, bench_pagerank,
+               bench_pagerank_10x, bench_cg, bench_eigen,
+               bench_triangles, bench_north_star):
         if dry and fn not in dry_rows:
             print(json.dumps({"metric": fn.__name__, "skipped": "dry"}),
                   flush=True)

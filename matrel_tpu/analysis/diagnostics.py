@@ -16,6 +16,7 @@ Code space (stable — tests and suppressions key on them):
   MV104  SpGEMM stamp inconsistent with the dispatch   (error)
   MV105  per-device HBM working set over budget        (error)
   MV106  dominant collective rides the slow mesh axis  (warning)
+  MV107  result-cache stamp disagrees with the cache   (warning)
 """
 
 from __future__ import annotations

@@ -117,6 +117,37 @@ class MatrelConfig:
         a v5e chip's 16 GiB; 0 disables the gate (divisibility-only
         admissibility, the pre-round-6 behaviour). The xla fallback is
         never gated — GSPMD chooses its own decomposition.
+      result_cache_max_bytes: byte budget for the session's cross-query
+        MATERIALIZED-RESULT cache (matrel_tpu/serve/result_cache.py —
+        the MatFast persist/RDD-cache analogue): executed query results
+        are kept on device keyed by the CANONICAL STRUCTURAL plan key
+        (session._plan_key — never id()-keyed, the ML005 hazard class),
+        so a repeated query answers without compiling or executing and
+        a query CONTAINING a previously-executed subplan enters
+        planning with that subtree replaced by an already-laid-out
+        leaf (infer_layout/comm_cost credit the reuse). LRU eviction
+        past the budget; a catalog rebind invalidates every dependent
+        entry. 0 (the default) disables the cache entirely and is
+        bit-identical to the uncached behaviour — plans, results and
+        the plan-snapshot corpus unchanged.
+      result_cache_max_entries: entry-count bound on the result cache
+        (LRU, like plan_cache_max_plans). The byte budget counts each
+        entry's RESULT array, but an entry's pins also keep the
+        query's INPUT matrices alive (the plan cache's pinning
+        contract) — tiny results over huge ad-hoc inputs could
+        otherwise retain unbounded device memory while staying "within
+        budget". The count bound caps that retention.
+      serve_max_batch: micro-batched admission width — the most queries
+        ``session.submit``'s admission loop coalesces into one
+        MultiPlan (one fusion/CSE domain, shared leaf transfers).
+        ``session.run_many`` batches whatever it is handed; this knob
+        bounds only the async pipeline's coalescing.
+      serve_max_inflight: bound on dispatched-but-unsynced batches the
+        async pipeline keeps in flight. JAX's async dispatch lets the
+        host optimize/verify/trace query N+1 while the device executes
+        query N; past this depth the admission loop blocks on the
+        oldest batch so host planning never runs unboundedly ahead of
+        the device.
       axis_cost_weights: per-mesh-axis relative inverse-bandwidth
         weights for the planner's comm model (core/mesh.MeshTopology):
         a collective leg over axis i is billed bytes × weights[i], so
@@ -154,6 +185,10 @@ class MatrelConfig:
     autotune: bool = False
     autotune_table_path: str = ""
     autotune_max_dim: int = 8192
+    result_cache_max_bytes: int = 0
+    result_cache_max_entries: int = 256
+    serve_max_batch: int = 8
+    serve_max_inflight: int = 2
     obs_level: str = "off"
     obs_event_log: str = ""
     verify_plans: str = "off"
@@ -180,6 +215,20 @@ class MatrelConfig:
                 f"verify_plans must be one of 'off'/'warn'/'error', "
                 f"got {self.verify_plans!r}")
         object.__setattr__(self, "verify_plans", vp)
+        # a zero/negative admission width or in-flight bound would
+        # deadlock the serve pipeline's coalescing loop (it always
+        # admits at least the query it popped) — reject at construction
+        if self.result_cache_max_entries < 1:
+            raise ValueError(
+                f"result_cache_max_entries must be >= 1, "
+                f"got {self.result_cache_max_entries!r}")
+        if self.serve_max_batch < 1:
+            raise ValueError(
+                f"serve_max_batch must be >= 1, got {self.serve_max_batch!r}")
+        if self.serve_max_inflight < 1:
+            raise ValueError(
+                f"serve_max_inflight must be >= 1, "
+                f"got {self.serve_max_inflight!r}")
         # a zero/negative weight would make an axis FREE (or negative)
         # and silently route every collective onto it; a 3-tuple would
         # desync from the 2D grid — reject both at construction. The

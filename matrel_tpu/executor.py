@@ -984,7 +984,11 @@ class CompiledPlan:
 class MultiPlan:
     """Several optimized roots compiled into ONE XLA program (one fusion
     and CSE domain, one dispatch) — the analogue of a multi-action Spark
-    job sharing its lineage."""
+    job sharing its lineage. Parity with :class:`CompiledPlan`: rebound
+    leaves can be donated (``donate=True``), and the session caches
+    compiled MultiPlans in its plan cache alongside single plans
+    (``extra_args`` carries the hoisted payloads the byte budget
+    accounts)."""
 
     jitted: Callable
     leaf_order: List[MatExpr]
@@ -992,21 +996,44 @@ class MultiPlan:
     mesh: Mesh
     config: MatrelConfig
     extra_args: List = dataclasses.field(default_factory=list)
+    _donating: Dict[tuple, Callable] = dataclasses.field(
+        default_factory=dict)
     meta: Dict = dataclasses.field(default_factory=dict)
 
-    def run(self, bindings: Optional[Dict[int, BlockMatrix]] = None
-            ) -> Tuple[BlockMatrix, ...]:
+    def run(self, bindings: Optional[Dict[int, BlockMatrix]] = None,
+            donate: bool = False) -> Tuple[BlockMatrix, ...]:
+        """Execute with current or rebound leaves. ``donate=True``
+        hands REBOUND leaf buffers to XLA (input/output aliasing —
+        the same contract as CompiledPlan.run: donated BlockMatrices
+        must not be used afterwards)."""
         arrays = []
-        for l in self.leaf_order:
-            m = (bindings or {}).get(l.uid, l.attrs["matrix"])
+        donated = []
+        for i, l in enumerate(self.leaf_order):
+            bound = (bindings or {}).get(l.uid)
+            if bound is not None:
+                donated.append(i)
+            m = bound if bound is not None else l.attrs["matrix"]
             arrays.append(m.data)
-        outs = self.jitted(*arrays, *self.extra_args)
+        if donate and donated and self.config.donate_intermediates:
+            outs = self._donating_fn(tuple(donated))(*arrays,
+                                                     *self.extra_args)
+        else:
+            outs = self.jitted(*arrays, *self.extra_args)
         return tuple(
             BlockMatrix.from_array(
                 out, root.shape, self.mesh,
                 padding.canonical_spec(tuple(out.shape), self.mesh),
                 nnz=root.nnz)
             for out, root in zip(outs, self.optimized))
+
+    def _donating_fn(self, key: tuple):
+        """Cached donating variant (key = sorted donated argument
+        positions) — CompiledPlan's idiom."""
+        jfn = self._donating.get(key)
+        if jfn is None:
+            jfn = jax.jit(self.jitted.__wrapped__, donate_argnums=key)
+            self._donating[key] = jfn
+        return jfn
 
 
 def _verify_plans(opts, mesh, cfg) -> Optional[List[dict]]:
@@ -1302,6 +1329,23 @@ def plan_matmul_decisions(plan) -> List[dict]:
             d for o in roots
             for d in planner.matmul_decisions(o, plan.mesh, plan.config)]
     return meta["matmuls"]
+
+
+def multiplan_root_decisions(plan: MultiPlan) -> List[List[dict]]:
+    """Per-ROOT planner-decision records for a MultiPlan, aligned with
+    ``plan.optimized`` — the per-root obs feed (session.run_many emits
+    one query event per root, each carrying its OWN matmuls instead of
+    the batch aggregate). Lazily derived and cached in ``plan.meta``
+    like :func:`plan_matmul_decisions`, so the obs-off batch path pays
+    nothing."""
+    meta = plan.meta
+    if meta is None:
+        return [[] for _ in plan.optimized]
+    if "matmuls_per_root" not in meta:
+        meta["matmuls_per_root"] = [
+            planner.matmul_decisions(o, plan.mesh, plan.config)
+            for o in plan.optimized]
+    return meta["matmuls_per_root"]
 
 
 def execute(expr: MatExpr, mesh: Optional[Mesh] = None,

@@ -2,7 +2,9 @@
 
 One line per event, append-only, schema-versioned. ``MatrelSession``
 emits one ``query`` record per run (plus one ``verify`` record when the
-static plan verifier is on — mode, diagnostic count, codes);
+static plan verifier is on — mode, diagnostic count, codes) and one
+``serve`` record per micro-batched admission (batch size, queue waits,
+result-cache state — session.run_many / the submit pipeline);
 ``bench.py`` emits ``bench`` records and ``tools/soak_guard.py``
 ``soak`` records into the same file, so one log replays the whole
 history of a host (the history-server input — ``python -m matrel_tpu

@@ -90,6 +90,8 @@ def summarize(events: List[dict]) -> dict:
         "queries": len(qs),
         "cache_hits": hits,
         "cache_hit_rate": round(hits / len(qs), 3) if qs else None,
+        "rc_hits": sum(1 for e in qs if e.get("cache") == "rc_hit"),
+        "serve": _summarize_serve(events),
         "execute_ms_total": round(sum(exec_ms), 3),
         "execute_ms_mean": (round(sum(exec_ms) / len(exec_ms), 3)
                             if exec_ms else None),
@@ -106,6 +108,48 @@ def summarize(events: List[dict]) -> dict:
     }
 
 
+def _pctile(sorted_vals: List[float], q: float):
+    """Nearest-rank percentile over an already-sorted list (the
+    metrics-registry convention), None when empty."""
+    if not sorted_vals:
+        return None
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def _summarize_serve(events: List[dict]) -> dict:
+    """Roll up ``serve`` records (session.run_many / the submit
+    pipeline — one per micro-batched admission) into the serving
+    headline numbers: QPS over the batches' own wall clocks, the
+    result-cache hit ratio, and queue-latency percentiles."""
+    sv = [e for e in events if e.get("kind") == "serve"]
+    queries = sum(int(e.get("batch_size") or 0) for e in sv)
+    wall_ms = sum(float(e.get("wall_ms") or 0.0) for e in sv)
+    waits = sorted(
+        float(w) for e in sv for w in (e.get("queue_wait_ms") or ())
+        if isinstance(w, (int, float)))
+    # hit ratio from PER-RECORD deltas (rc_hits/batch_size), summed
+    # over the whole log like every other roll-up here — the snapshot
+    # counters inside "result_cache" are session-lifetime cumulative,
+    # so reading only the last record's would discard every earlier
+    # session's behaviour in a multi-session log (and mix in non-serve
+    # sess.run() consults). The last snapshot still rides along for
+    # the eviction/invalidation display.
+    rc_hits = sum(int(e.get("rc_hits") or 0) for e in sv)
+    rc = sv[-1].get("result_cache", {}) if sv else {}
+    return {
+        "batches": len(sv),
+        "queries": queries,
+        "qps": (round(queries / (wall_ms / 1e3), 2) if wall_ms > 0
+                else None),
+        "rc_hit_ratio": (round(rc_hits / queries, 3) if queries
+                         else None),
+        "queue_wait_p50_ms": _pctile(waits, 0.50),
+        "queue_wait_p95_ms": _pctile(waits, 0.95),
+        "result_cache": rc,
+    }
+
+
 def render_summary(events: List[dict]) -> str:
     s = summarize(events)
     lines = [
@@ -119,6 +163,18 @@ def render_summary(events: List[dict]) -> str:
         + (f" ({s['verify_diagnostics']} diagnostic(s))"
            if s["verify_diagnostics"] else ""),
     ]
+    sv = s.get("serve") or {}
+    if sv.get("batches"):
+        lines.append(
+            f"serve: {sv['batches']} batch(es), {sv['queries']} "
+            f"queries, QPS {_fmt(sv['qps'])}, result-cache hit ratio "
+            f"{_fmt(sv['rc_hit_ratio'], 3)}, queue wait p50/p95 "
+            f"{_fmt(sv['queue_wait_p50_ms'])}/"
+            f"{_fmt(sv['queue_wait_p95_ms'])} ms"
+            + (f" (rc evicted: {sv['result_cache'].get('evicted', 0)}, "
+               f"invalidated: "
+               f"{sv['result_cache'].get('invalidated', 0)})"
+               if sv.get("result_cache") else ""))
     if s["strategies"]:
         lines.append("")
         header = (f"{'strategy':<12}{'matmuls':>8}{'GFLOPs':>10}"

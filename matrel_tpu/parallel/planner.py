@@ -1178,6 +1178,14 @@ def matmul_decisions(root: MatExpr, mesh: Mesh,
                "strategy": n.attrs.get("strategy", "xla"),
                "source": n.attrs.get("strategy_source", "unknown"),
                "flops": 2.0 * nn * kk * mm}
+        # result-cache reuse (serve/): an operand that entered planning
+        # as a materialized-result leaf never re-pays its subplan — the
+        # decision record says which side(s) got that credit, so the
+        # obs roll-up can attribute layout credits to cache reuse
+        rc_ops = [bool(c.kind == "leaf" and c.attrs.get("result_cache"))
+                  for c in n.children]
+        if any(rc_ops):
+            rec["rc_operands"] = rc_ops
         if _spgemm_matmul(n, cfg):
             # the S×S tile-intersection dispatch: record the estimated
             # FLOPs/HBM bytes it avoids vs the densify fallback — the

@@ -1,0 +1,27 @@
+"""Serving-oriented throughput layer (matrel_tpu/serve/).
+
+The reference gets its headline wins from in-memory reuse of
+distributed intermediates — the Spark ``persist``/RDD-cache discipline
+MatFast (ICDE 2017) is built on. This package is the TPU rebuild's
+serving analogue, three coordinated pieces the session wires together:
+
+  result_cache  cross-query materialized-result cache: executed query
+                results kept on device, keyed by the CANONICAL
+                STRUCTURAL plan key (session._plan_key — never id()-
+                keyed), byte-budgeted LRU, catalog-rebind invalidation
+                (``config.result_cache_max_bytes``; 0 = off,
+                bit-identical to the uncached behaviour).
+  pipeline      micro-batched admission + async execution:
+                ``session.submit`` returns a future; an admission loop
+                coalesces concurrent queries into one MultiPlan and
+                overlaps host planning of batch N+1 with device
+                execution of batch N, bounded by
+                ``config.serve_max_inflight``.
+
+``session.run_many`` is the synchronous batch surface (one MultiPlan,
+session-plan-cached); ``session.submit`` the asynchronous one. See
+docs/SERVING.md for cache semantics, invalidation rules and the QPS
+methodology.
+"""
+
+from matrel_tpu.serve.result_cache import CacheEntry, ResultCache  # noqa: F401

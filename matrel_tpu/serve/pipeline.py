@@ -1,0 +1,154 @@
+"""Micro-batched admission + async execution pipeline.
+
+``session.submit(expr)`` returns a ``concurrent.futures.Future``; one
+admission worker per session drains the submission queue, coalesces up
+to ``config.serve_max_batch`` concurrent queries into ONE MultiPlan
+(one fusion/CSE domain, shared leaf transfers — ``session.run_many``)
+and dispatches it WITHOUT waiting for device completion: JAX's async
+dispatch returns arrays whose values are still materialising, so the
+worker immediately starts optimize/verify/trace of the next batch while
+the device executes this one — the MPMD overlap-dispatch-with-execution
+discipline, host-side.
+
+The overlap is BOUNDED: past ``config.serve_max_inflight``
+dispatched-but-unsynced batches the worker blocks on the oldest, so
+host planning never runs unboundedly ahead of the device (an unbounded
+queue would pile un-materialised results — and their HBM — without
+backpressure).
+
+Futures resolve with the BlockMatrix as soon as its batch is
+DISPATCHED (the array is usable immediately; touching its values
+blocks until the device delivers them — ordinary JAX semantics).
+Compile/planning errors fail every future of their batch.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+log = logging.getLogger("matrel_tpu.serve")
+
+
+class ServePipeline:
+    """One session's admission queue + worker thread (daemon, started
+    on first submit). Not a pool: queries of one session share its
+    plan/result caches, so one worker keeps every cache consult
+    race-free while the caller's thread stays free to submit."""
+
+    def __init__(self, session):
+        self.session = session
+        self.max_batch = session.config.serve_max_batch
+        self.max_inflight = session.config.serve_max_inflight
+        self._q: "queue.Queue" = queue.Queue()
+        self._inflight: "collections.deque" = collections.deque()
+        self._worker: threading.Thread = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+
+    # -- public surface ----------------------------------------------------
+
+    def submit(self, expr) -> Future:
+        """Enqueue one query; returns its future."""
+        fut: Future = Future()
+        self._q.put((expr, fut, time.perf_counter()))
+        self._ensure_worker()
+        return fut
+
+    def drain(self) -> None:
+        """Block until every submitted query is dispatched AND every
+        dispatched batch has materialised on device."""
+        self._q.join()
+        while self._inflight:
+            try:
+                outs = self._inflight.popleft()
+            except IndexError:      # worker synced it concurrently
+                break
+            _sync(outs)
+
+    def close(self) -> None:
+        """Stop the worker after the queue drains."""
+        self.drain()
+        self._stop.set()
+
+    @property
+    def inflight_depth(self) -> int:
+        return len(self._inflight)
+
+    # -- worker ------------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._stop.clear()
+                self._worker = threading.Thread(
+                    target=self._run, name="matrel-serve", daemon=True)
+                self._worker.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            pulled = [first]
+            while len(pulled) < self.max_batch:
+                try:
+                    pulled.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            # transition each future to RUNNING; a future the caller
+            # cancelled while queued drops out here (and can no longer
+            # be cancelled mid-flight) — set_result on a cancelled
+            # future would raise InvalidStateError and kill the worker,
+            # stranding every sibling future of the batch
+            batch = [it for it in pulled
+                     if it[1].set_running_or_notify_cancel()]
+            t_admit = time.perf_counter()
+            waits_ms = [round((t_admit - t_enq) * 1e3, 3)
+                        for _, _, t_enq in batch]
+            try:
+                if batch:
+                    outs = self.session.run_many(
+                        [e for e, _, _ in batch],
+                        _queue_wait_ms=waits_ms,
+                        _inflight_depth=len(self._inflight))
+                else:
+                    outs = []
+            except Exception as ex:  # noqa: BLE001 — any planning/
+                # compile failure fails every future of the batch; the
+                # worker survives to serve the next one
+                for _, fut, _ in batch:
+                    if not fut.done():
+                        fut.set_exception(ex)
+            else:
+                for (_, fut, _), out in zip(batch, outs):
+                    if not fut.done():
+                        fut.set_result(out)
+                if outs:
+                    self._inflight.append(outs)
+                while len(self._inflight) > self.max_inflight:
+                    # backpressure: sync the OLDEST dispatched batch
+                    # before admitting more host-side planning
+                    try:
+                        _sync(self._inflight.popleft())
+                    except IndexError:
+                        break
+            finally:
+                for _ in pulled:
+                    self._q.task_done()
+
+
+def _sync(outs) -> None:
+    for o in outs:
+        try:
+            o.data.block_until_ready()
+        except Exception:  # a device-side error surfaces at the
+            # consumer's own touch of the array; the pipeline only
+            # needed the backpressure
+            log.warning("serve: in-flight batch sync failed",
+                        exc_info=True)

@@ -1,0 +1,174 @@
+"""Cross-query materialized-result cache — the MatFast persist/RDD-cache
+analogue (ICDE 2017 §"in-memory reuse of distributed intermediates").
+
+Entries map the CANONICAL STRUCTURAL plan key of an executed expression
+(``session._plan_key`` — the same key the compiled-plan cache uses) to
+the BlockMatrix it produced. Keying discipline matters: the key is the
+structural string, never a sharding spec or a bare ``id()`` (the ML005
+hazard class — spec objects hash by identity across jax versions, and a
+recycled id would alias two distinct queries). Every object the key
+references by id() rides the entry's ``pins`` tuple, so an address can
+never be garbage-collected and reused into a false hit — the plan
+cache's pinning contract, applied here.
+
+Invalidation: each entry records the id() set of every source matrix it
+was computed from (``dep_ids``, transitively through entries it itself
+consumed). A catalog rebind invalidates every entry whose deps
+intersect the rebound matrix. Dep ids are only ever compared against
+LIVE catalog objects (the session calls ``invalidate_deps(id(old))``
+with ``old`` in hand), so a recycled address can at worst invalidate a
+valid entry — the safe direction — never keep a stale one.
+
+Eviction: byte-budgeted LRU over the DEVICE bytes each cached result
+pins (its padded array). A result larger than the whole budget is
+never inserted. Thread-safe — the async serve pipeline's worker and
+the caller's thread share one cache.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import FrozenSet, Optional, Tuple
+
+import numpy as np
+
+from matrel_tpu.core.blockmatrix import BlockMatrix
+
+
+def result_nbytes(result: BlockMatrix) -> int:
+    """Device bytes a cached result pins: its PADDED array. Computed
+    from shape/dtype — jax 0.9 arrays may lack .nbytes."""
+    try:
+        return int(np.prod(result.data.shape)) * np.dtype(
+            result.data.dtype).itemsize
+    except (AttributeError, TypeError):
+        return 0
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """One cached query result.
+
+    key_hash: short digest of the structural key — the stable name obs
+      records and MV107 stamps carry (the full key embeds id()s and is
+      meaningless across sessions).
+    result: the executed BlockMatrix (device-resident).
+    pins: every object the structural key references by id() — held so
+      no keyed address can be recycled into a false hit.
+    dep_ids: id() of every source matrix this result depends on,
+      transitively through consumed cache entries — the
+      catalog-rebind invalidation set.
+    layout: planner layout vocabulary ("2d"/"row"/"col"/"rep"/"other")
+      of the result's spec at insertion — what a substituted leaf
+      claims to the planner, and what MV107 re-checks.
+    dtype: canonical numpy dtype name of the result at insertion.
+    nbytes: device bytes the entry pins (eviction accounting).
+    """
+
+    key_hash: str
+    result: BlockMatrix
+    pins: Tuple
+    dep_ids: FrozenSet[int]
+    layout: str
+    dtype: str
+    nbytes: int
+
+
+class ResultCache:
+    """Byte-budgeted LRU over :class:`CacheEntry`, structurally keyed.
+
+    ``lookup`` is the ROOT-level consult (counts hit/miss — the ratio
+    serve events and ``result_cache_info()`` report); ``probe`` is the
+    interior-substitution consult (counts hits only — a miss there just
+    means the walk recurses, not that a query missed the cache).
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, CacheEntry]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.interior_hits = 0
+        self.evicted = 0
+        self.invalidated = 0
+
+    def lookup(self, key: str) -> Optional[CacheEntry]:
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return ent
+
+    def probe(self, key: str) -> Optional[CacheEntry]:
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return None
+            self._entries.move_to_end(key)
+            self.interior_hits += 1
+            return ent
+
+    def put(self, key: str, entry: CacheEntry, max_bytes: int,
+            max_entries: int = 0) -> bool:
+        """Insert (or refresh) an entry, evicting least-recently-used
+        entries past ``max_bytes`` — and past ``max_entries`` when > 0:
+        the byte budget counts each entry's RESULT, but the pins tuple
+        also keeps the query's INPUT matrices alive, so tiny results
+        over huge ad-hoc inputs could otherwise retain unbounded device
+        memory while staying "within budget"; the count bound caps
+        that. Returns False when the entry alone exceeds the whole byte
+        budget (never inserted — it would evict everything and then
+        itself be the next eviction)."""
+        if entry.nbytes > max_bytes:
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            while self._entries and (
+                    self._bytes > max_bytes
+                    or (max_entries > 0
+                        and len(self._entries) > max_entries)):
+                _, dropped = self._entries.popitem(last=False)
+                self._bytes -= dropped.nbytes
+                self.evicted += 1
+            self._bytes = max(self._bytes, 0)
+            return True
+
+    def invalidate_deps(self, matrix_ids) -> int:
+        """Drop every entry whose dep set intersects ``matrix_ids``
+        (id() values of LIVE matrices — see module docstring for why
+        this comparison is safe). Returns the number dropped."""
+        ids = frozenset(matrix_ids)
+        with self._lock:
+            stale = [k for k, e in self._entries.items()
+                     if e.dep_ids & ids]
+            for k in stale:
+                self._bytes -= self._entries.pop(k).nbytes
+            self.invalidated += len(stale)
+            self._bytes = max(self._bytes, 0)
+            return len(stale)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def info(self) -> dict:
+        """``plan_cache_info``-style observability snapshot."""
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "bytes": self._bytes,
+                    "hits": self.hits,
+                    "misses": self.misses,
+                    "interior_hits": self.interior_hits,
+                    "evicted": self.evicted,
+                    "invalidated": self.invalidated}

@@ -16,10 +16,11 @@ import hashlib
 import itertools
 import logging
 import os
+import threading
 import time
 import types
 from collections import OrderedDict
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 from jax.sharding import Mesh
@@ -29,6 +30,8 @@ from matrel_tpu.config import MatrelConfig, default_config
 from matrel_tpu.core import mesh as mesh_lib
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.ir.expr import MatExpr, as_expr
+from matrel_tpu.serve.result_cache import (CacheEntry, ResultCache,
+                                           result_nbytes)
 
 log = logging.getLogger("matrel_tpu")
 
@@ -56,6 +59,14 @@ class MatrelSession:
         self._plan_cache_bytes = 0
         self._plan_cache_evicted = 0
         self._event_log = None      # lazily built (obs_level != "off")
+        # serving layer (matrel_tpu/serve/): cross-query result cache
+        # (inert until config.result_cache_max_bytes > 0) and the async
+        # submit pipeline (worker built on first submit). The lock
+        # keeps the plan cache consistent when the pipeline's admission
+        # worker and the caller's thread compile concurrently.
+        self._result_cache = ResultCache()
+        self._serve = None
+        self._compile_lock = threading.RLock()
 
     # -- builder (MatfastSession.builder().getOrCreate() analogue) ---------
 
@@ -102,7 +113,16 @@ class MatrelSession:
     # -- catalog (matrix tables, SQL-facing names) -------------------------
 
     def register(self, name: str, matrix: BlockMatrix) -> None:
+        old = self.catalog.get(name)
         self.catalog[name] = matrix
+        if old is not None and old is not matrix:
+            # catalog REBIND: every cached result computed from the old
+            # binding is stale the moment the name means something else
+            # — drop them (and their pinned device bytes) now, not at
+            # some later false hit. Dep sets are transitive, so results
+            # built FROM cached intermediates of the old binding drop
+            # too. Safe when the cache is off/empty (no-op).
+            self._result_cache.invalidate_deps({id(old)})
 
     def table(self, name: str) -> BlockMatrix:
         return self.catalog[name]
@@ -132,7 +152,12 @@ class MatrelSession:
         if got is None:
             return []
         _step, mats, _arrays, _state = got
-        self.catalog.update(mats)
+        # through register(), not a bare dict update: an overwritten
+        # name is a catalog REBIND, and cached results computed from
+        # the old binding must invalidate here exactly as they do for
+        # an explicit register() (serve/result_cache.py contract)
+        for name in sorted(mats):
+            self.register(name, mats[name])
         return sorted(mats)
 
     # -- constructors bound to this session's mesh/config ------------------
@@ -160,31 +185,74 @@ class MatrelSession:
         outcome exposed, so compute() can emit hit/miss events without
         a second key computation."""
         key, pins = _plan_key(e)
+        key = self._axisw_prefix() + key
+        with self._compile_lock:
+            plan = self._plan_cache.get(key)
+            if plan is not None:
+                self._plan_cache.move_to_end(key)
+                return plan, True, key
+            plan = executor_lib.compile_expr(e, self.mesh, self.config)
+            # pin every id()-keyed object on the cached plan: a garbage-
+            # collected object's address can be REUSED by CPython, and a
+            # later distinct object at the recycled address would falsely
+            # hit this entry. Pinning the expr alone is not enough — a
+            # REBOUND module global referenced by a predicate is no longer
+            # reachable from the expr, so its old value is pinned
+            # explicitly via the collected pins list.
+            plan._cache_pin = (e, pins)
+            self._plan_cache[key] = plan
+            self._plan_cache_bytes += _plan_bytes(plan)
+            self._evict_plans()
+            return plan, False, key
+
+    def _axisw_prefix(self) -> str:
+        """Topology weights change which strategies get stamped, so
+        weighted and unweighted plans must never share a cache entry
+        (the detection path can flip weights without any config field
+        changing — the expression key alone is not enough). Unweighted
+        keys keep the historical format (empty prefix)."""
         wts = mesh_lib.axis_weights(self.mesh, self.config)
-        if wts != (1.0, 1.0):
-            # topology weights change which strategies get stamped, so
-            # weighted and unweighted plans must never share a cache
-            # entry (the detection path can flip weights without any
-            # config field changing — the expression key alone is not
-            # enough). Unweighted keys keep the historical format.
-            key = f"axisw:{wts[0]:g}x{wts[1]:g}|{key}"
-        plan = self._plan_cache.get(key)
-        if plan is not None:
-            self._plan_cache.move_to_end(key)
-            return plan, True, key
-        plan = executor_lib.compile_expr(e, self.mesh, self.config)
-        # pin every id()-keyed object on the cached plan: a garbage-
-        # collected object's address can be REUSED by CPython, and a
-        # later distinct object at the recycled address would falsely
-        # hit this entry. Pinning the expr alone is not enough — a
-        # REBOUND module global referenced by a predicate is no longer
-        # reachable from the expr, so its old value is pinned
-        # explicitly via the collected pins list.
-        plan._cache_pin = (e, pins)
-        self._plan_cache[key] = plan
-        self._plan_cache_bytes += _plan_bytes(plan)
-        self._evict_plans()
-        return plan, False, key
+        if wts == (1.0, 1.0):
+            return ""
+        return f"axisw:{wts[0]:g}x{wts[1]:g}|"
+
+    def _compile_multi_entry(self, roots: List[MatExpr]
+                             ) -> Tuple["executor_lib.MultiPlan", bool,
+                                        List[str]]:
+        """(multiplan, cache_hit, per-root keys) — the MultiPlan twin
+        of :meth:`_compile_entry`. Compiled MultiPlans participate in
+        the SAME session plan cache (one LRU, one byte budget — their
+        hoisted payloads pin HBM exactly like single plans'), keyed on
+        the SORTED unique root keys plus the axis-weight prefix, so a
+        batch resubmitted in any order (or with duplicate roots) hits
+        instead of recompiling every call. The cached plan remembers
+        its root-key order (``_root_keys``) so callers can map outputs
+        back to their own root order."""
+        keyed = []
+        pins_all: list = []
+        for e in roots:
+            k, p = _plan_key(e)
+            keyed.append(k)
+            pins_all.extend(p)
+        uniq: "OrderedDict[str, MatExpr]" = OrderedDict()
+        for k, e in zip(keyed, roots):
+            uniq.setdefault(k, e)
+        skeys = sorted(uniq)
+        mkey = ("multi:" + self._axisw_prefix()
+                + "||".join(skeys))
+        with self._compile_lock:
+            plan = self._plan_cache.get(mkey)
+            if plan is not None:
+                self._plan_cache.move_to_end(mkey)
+                return plan, True, keyed
+            plan = executor_lib.compile_exprs(
+                [uniq[k] for k in skeys], self.mesh, self.config)
+            plan._cache_pin = (tuple(uniq[k] for k in skeys), pins_all)
+            plan._root_keys = tuple(skeys)
+            self._plan_cache[mkey] = plan
+            self._plan_cache_bytes += _plan_bytes(plan)
+            self._evict_plans()
+            return plan, False, keyed
 
     def _evict_plans(self) -> None:
         """Drop least-recently-used plans past the config bounds. The
@@ -209,6 +277,127 @@ class MatrelSession:
                 "hoisted_bytes": self._plan_cache_bytes,
                 "evicted": self._plan_cache_evicted}
 
+    # -- cross-query result cache (matrel_tpu/serve/) ----------------------
+
+    def _rc_enabled(self) -> bool:
+        return self.config.result_cache_max_bytes > 0
+
+    def result_cache_info(self) -> dict:
+        """``plan_cache_info``-style surface for the materialized-result
+        cache: entries, pinned device bytes, hit/miss/interior-hit,
+        eviction and invalidation counts."""
+        info = self._result_cache.info()
+        info["max_bytes"] = self.config.result_cache_max_bytes
+        info["max_entries"] = self.config.result_cache_max_entries
+        return info
+
+    def _rc_admit(self, e: MatExpr):
+        """One result-cache admission for a query: (entry-or-None,
+        root key, pins, possibly-substituted expr). ONE structural walk
+        (_plan_key_spans) serves both the root-level consult — a hit
+        answers without compiling or executing anything — and, on a
+        miss, every interior probe of the substitution pass."""
+        parts, pins, spans = _plan_key_spans(e)
+        key = "|".join(parts)
+        ent = self._result_cache.lookup(key)
+        if ent is not None:
+            return ent, key, pins, e
+        return None, key, pins, self._rc_substitute(e, parts, spans)
+
+    def _rc_leaf(self, ent: CacheEntry) -> MatExpr:
+        """Lift a cache entry into planning as an already-laid-out
+        LEAF: ``infer_layout`` reads the cached result's real
+        PartitionSpec and ``comm_cost`` credits the reuse — the whole
+        subplan it replaces is never re-priced, never re-executed. The
+        ``result_cache`` stamp records what the cache promised (layout/
+        dtype at insertion) so the MV107 pass can prove the plan and
+        the cache still agree, plus the transitive dep ids consumers
+        fold into their own invalidation sets."""
+        from matrel_tpu.ir import expr as expr_mod
+        return expr_mod.leaf(ent.result).with_attrs(result_cache={
+            "key_hash": ent.key_hash,
+            "layout": ent.layout,
+            "dtype": ent.dtype,
+            "deps": sorted(ent.dep_ids),
+        })
+
+    def _rc_substitute(self, e: MatExpr, parts: Optional[list] = None,
+                       spans: Optional[dict] = None) -> MatExpr:
+        """Replace every cached INTERIOR subexpression with its result
+        leaf (top-down; a hit stops the descent — everything under it
+        is already paid for). The root is the caller's business
+        (:meth:`_rc_admit`). ``parts``/``spans`` come from the
+        admission's single ``_plan_key_spans`` walk, so each interior
+        probe is a slice join, not a fresh subtree walk; a bare call
+        (tests, external callers) computes its own."""
+        if not e.children:
+            return e
+        if parts is None or spans is None:
+            parts, _pins, spans = _plan_key_spans(e)
+        new_children = []
+        changed = False
+        for c in e.children:
+            if not c.children and c.kind in ("leaf", "sparse_leaf",
+                                             "coo_leaf"):
+                new_children.append(c)
+                continue
+            s, t = spans[c.uid]
+            ent = self._result_cache.probe("|".join(parts[s:t]))
+            if ent is not None:
+                new_children.append(self._rc_leaf(ent))
+                changed = True
+                continue
+            nc = self._rc_substitute(c, parts, spans)
+            changed = changed or (nc is not c)
+            new_children.append(nc)
+        return e.with_children(tuple(new_children)) if changed else e
+
+    def _rc_deps(self, e: MatExpr) -> frozenset:
+        """id() of every SOURCE matrix a query's result depends on —
+        ordinary leaves contribute their matrix, result-cache leaves
+        their recorded (transitive) dep set, so invalidating a rebound
+        catalog matrix cascades through derived entries."""
+        deps: set = set()
+
+        def walk(n: MatExpr):
+            if n.kind == "leaf":
+                rc = n.attrs.get("result_cache")
+                if rc is not None:
+                    deps.update(rc["deps"])
+                else:
+                    deps.add(id(n.attrs["matrix"]))
+                return
+            if n.kind in ("sparse_leaf", "coo_leaf"):
+                deps.add(id(n.attrs["matrix"]))
+                return
+            for c in n.children:
+                walk(c)
+
+        walk(e)
+        return frozenset(deps)
+
+    def _rc_insert(self, key: str, pins: list, executed: MatExpr,
+                   out: BlockMatrix) -> None:
+        """Cache one executed query result under its structural key.
+        ``executed`` is the (possibly substituted) tree that actually
+        ran — its leaves name the dep matrices; ``pins`` are the key's
+        id()-referenced objects (kept alive with the entry so the key
+        can never falsely hit a recycled address)."""
+        from matrel_tpu.parallel import planner
+        from matrel_tpu.ir import expr as expr_mod
+        ent = CacheEntry(
+            key_hash=hashlib.sha1(key.encode()).hexdigest()[:16],
+            result=out,
+            pins=tuple(pins),
+            dep_ids=self._rc_deps(executed),
+            layout=planner._layout_of(expr_mod.leaf(out), self.mesh),
+            dtype=str(np.dtype(out.dtype)),
+            nbytes=result_nbytes(out),
+        )
+        self._result_cache.put(key, ent,
+                               self.config.result_cache_max_bytes,
+                               self.config.result_cache_max_entries)
+
     # -- observability (obs/ — the SparkListener analogue) ------------------
 
     def _obs_enabled(self) -> bool:
@@ -223,14 +412,24 @@ class MatrelSession:
 
     def _emit_query_event(self, e: MatExpr, plan, hit: bool, key: str,
                           execute_ms: float, first_execution: bool,
-                          out: BlockMatrix) -> None:
+                          out: BlockMatrix, matmuls=None,
+                          rule_hits=None, batch=None) -> None:
         """One event-log record + metrics-registry updates per query run.
         Assembled entirely OUTSIDE jitted code, from data the compile
         path already produced (plan.meta) — the only device sync the obs
-        path adds is the one execute-time block in compute()."""
+        path adds is the one execute-time block in compute().
+
+        ``matmuls``/``rule_hits`` override the plan-level derivations
+        for batched (MultiPlan) roots: each root's record carries ITS
+        matmul decisions, and rewrite-rule hits are attributed to one
+        root only so history's roll-up never double-counts a compile.
+        ``batch`` tags records produced by one micro-batched admission
+        (``{"size": N, "index": i}``; execute_ms is then the batch
+        wall amortised per root)."""
         from matrel_tpu.obs.metrics import REGISTRY
         meta = plan.meta or {}
-        matmuls = executor_lib.plan_matmul_decisions(plan)
+        if matmuls is None:
+            matmuls = executor_lib.plan_matmul_decisions(plan)
         sql_hash = getattr(e, "_sql_hash", None)
         record = {
             "query_id": f"q{os.getpid()}-{next(_query_seq)}",
@@ -245,7 +444,9 @@ class MatrelSession:
             # records carry {} and history's roll-up counts real
             # optimizer work (optimize_ms/trace_ms DO repeat on hits —
             # they describe the plan, "cache" says no compile ran)
-            "rule_hits": {} if hit else meta.get("rule_hits", {}),
+            "rule_hits": (rule_hits if rule_hits is not None
+                          else ({} if hit else meta.get("rule_hits",
+                                                        {}))),
             "matmuls": matmuls,
             "execute_ms": round(execute_ms, 3),
             "first_execution": first_execution,
@@ -253,6 +454,10 @@ class MatrelSession:
             "out_nnz": out.nnz,
             "plan_cache": self.plan_cache_info(),
         }
+        if batch is not None:
+            record["batch"] = batch
+        if self._rc_enabled():
+            record["result_cache"] = self._result_cache.info()
         self._obs_event_log().emit("query", record)
         REGISTRY.counter("query.count").inc()
         REGISTRY.counter("plan_cache.hit" if hit
@@ -311,14 +516,58 @@ class MatrelSession:
             self.mesh, self.config)
         return analysis.verify_plan(opt, self.mesh, self.config)
 
-    def compute(self, expr: MatExpr) -> BlockMatrix:
-        e = as_expr(expr)
-        if not self._obs_enabled():
-            # the production path: zero event assembly, zero extra
-            # device syncs (the obs_level="off" contract bench.py
-            # relies on)
-            return self.compile(e).run()
-        plan, hit, key = self._compile_entry(e)
+    def _emit_rc_hit_event(self, e: MatExpr, key: str,
+                           out: BlockMatrix) -> None:
+        """Query record for a WHOLE-query result-cache hit: nothing
+        compiled, nothing executed — the record says so (``cache:
+        "rc_hit"``, no matmuls, zero execute) and carries the cache
+        snapshot the hit came from."""
+        from matrel_tpu.obs.metrics import REGISTRY
+        sql_hash = getattr(e, "_sql_hash", None)
+        self._obs_event_log().emit("query", {
+            "query_id": f"q{os.getpid()}-{next(_query_seq)}",
+            "source": "sql" if sql_hash else "dsl",
+            "source_hash": sql_hash
+            or hashlib.sha1(key.encode()).hexdigest()[:16],
+            "root_kind": e.kind,
+            "cache": "rc_hit",
+            "optimize_ms": None,
+            "trace_ms": None,
+            "rule_hits": {},
+            "matmuls": [],
+            "execute_ms": 0.0,
+            "first_execution": False,
+            "out_shape": list(out.shape),
+            "out_nnz": out.nnz,
+            "plan_cache": self.plan_cache_info(),
+            "result_cache": self._result_cache.info(),
+        })
+        REGISTRY.counter("query.count").inc()
+        REGISTRY.counter("result_cache.hit").inc()
+
+    def _emit_serve_event(self, record: dict) -> None:
+        """One ``serve`` record per micro-batched admission (obs on
+        only): batch size, queue-wait per query, result-cache state,
+        in-flight depth — the roll-up ``history --summary`` turns into
+        QPS / hit ratio / queue-latency percentiles."""
+        from matrel_tpu.obs.metrics import REGISTRY
+        record = dict(record)
+        record["result_cache"] = self._result_cache.info()
+        self._obs_event_log().emit("serve", record)
+        REGISTRY.counter("serve.batches").inc()
+        REGISTRY.counter("serve.queries").inc(
+            record.get("batch_size", 0))
+        for w in record.get("queue_wait_ms") or ():
+            REGISTRY.histogram("serve.queue_wait_ms").observe(w)
+        REGISTRY.gauge("result_cache.entries").set(
+            record["result_cache"]["entries"])
+        REGISTRY.gauge("result_cache.bytes").set(
+            record["result_cache"]["bytes"])
+
+    def _run_observed(self, e: MatExpr, plan, hit: bool, key: str
+                      ) -> BlockMatrix:
+        """Execute one compiled plan with the obs timing/emission
+        wrapper (the obs-on half of compute())."""
         first = not getattr(plan, "_obs_executed", False)
         t0 = time.perf_counter()
         out = plan.run()
@@ -335,8 +584,161 @@ class MatrelSession:
             log.warning("obs: query event dropped", exc_info=True)
         return out
 
+    def compute(self, expr: MatExpr) -> BlockMatrix:
+        e = as_expr(expr)
+        rc = self._rc_enabled()
+        if not rc and not self._obs_enabled():
+            # the production path: zero event assembly, zero extra
+            # device syncs, zero cache-key walks beyond the plan
+            # cache's own (the obs_level="off" /
+            # result_cache_max_bytes=0 contract bench.py relies on)
+            return self.compile(e).run()
+        key = pins = None
+        if rc:
+            ent, key, pins, e = self._rc_admit(e)
+            if ent is not None:
+                # repeated query: answered from the materialized-result
+                # cache — no optimize, no trace, no device work
+                if self._obs_enabled():
+                    try:
+                        self._emit_rc_hit_event(e, key, ent.result)
+                    except Exception:
+                        log.warning("obs: query event dropped",
+                                    exc_info=True)
+                return ent.result
+        plan, hit, pkey = self._compile_entry(e)
+        if self._obs_enabled():
+            out = self._run_observed(e, plan, hit, pkey)
+        else:
+            out = plan.run()
+        if rc:
+            self._rc_insert(key, pins, e, out)
+        return out
+
     # alias: the reference's Dataset actions read as "run the query"
     run = compute
+
+    # -- micro-batched admission + async pipeline (serve/) -----------------
+
+    def run_many(self, exprs, _queue_wait_ms=None,
+                 _inflight_depth: int = 0) -> List[BlockMatrix]:
+        """Execute several queries as ONE micro-batched admission: the
+        batch compiles into a single MultiPlan (one fusion and CSE
+        domain, shared leaf transfers — duplicate roots dedupe on their
+        structural key) that participates in the session plan cache, so
+        a recurring batch recompiles nothing. With the result cache on,
+        whole-query hits never reach the batch at all and interior hits
+        enter planning as already-laid-out leaves. Results come back in
+        input order.
+
+        The underscore parameters are the serve pipeline's channel for
+        queue-wait/in-flight observability; direct callers leave them
+        alone."""
+        es = [as_expr(x) for x in exprs]
+        if not es:
+            return []
+        rc = self._rc_enabled()
+        obs = self._obs_enabled()
+        t_batch = time.perf_counter()
+        results: dict = {}
+        rc_meta: dict = {}
+        pend: list = []
+        for i, e in enumerate(es):
+            if rc:
+                ent, key, pins, e = self._rc_admit(e)
+                if ent is not None:
+                    results[i] = ent.result
+                    if obs:
+                        try:
+                            self._emit_rc_hit_event(e, key, ent.result)
+                        except Exception:
+                            log.warning("obs: query event dropped",
+                                        exc_info=True)
+                    continue
+                rc_meta[i] = (key, pins)
+            pend.append((i, e))
+        execute_ms = 0.0
+        plan_hit = None
+        if pend:
+            plan, plan_hit, keys = self._compile_multi_entry(
+                [e for _, e in pend])
+            pos = {k: j for j, k in enumerate(plan._root_keys)}
+            t0 = time.perf_counter()
+            outs = plan.run()
+            if obs:
+                for o in outs:
+                    o.data.block_until_ready()
+                execute_ms = (time.perf_counter() - t0) * 1e3
+            first = not getattr(plan, "_obs_executed", False)
+            plan._obs_executed = True
+            for j, ((i, e), k) in enumerate(zip(pend, keys)):
+                out = outs[pos[k]]
+                results[i] = out
+                if rc:
+                    key, pins = rc_meta[i]
+                    self._rc_insert(key, pins, e, out)
+                if obs:
+                    try:
+                        per_root = executor_lib.multiplan_root_decisions(
+                            plan)
+                        self._emit_query_event(
+                            e, plan, bool(plan_hit), k,
+                            execute_ms / max(len(pend), 1), first, out,
+                            matmuls=per_root[pos[k]],
+                            # one root carries the batch's compile-time
+                            # rule hits; the rest {} — the roll-up sums
+                            rule_hits=({} if (j > 0 or plan_hit)
+                                       else (plan.meta or {}).get(
+                                           "rule_hits", {})),
+                            batch={"size": len(es), "index": i})
+                    except Exception:
+                        log.warning("obs: query event dropped",
+                                    exc_info=True)
+            if obs:
+                try:
+                    self._emit_verify_event(plan)
+                except Exception:
+                    log.warning("obs: verify event dropped",
+                                exc_info=True)
+        if obs:
+            try:
+                self._emit_serve_event({
+                    "batch_size": len(es),
+                    "executed": len(pend),
+                    "rc_hits": len(es) - len(pend),
+                    "plan_cache_hit": plan_hit,
+                    "queue_wait_ms": _queue_wait_ms,
+                    "inflight_depth": _inflight_depth,
+                    "execute_ms": round(execute_ms, 3),
+                    "wall_ms": round(
+                        (time.perf_counter() - t_batch) * 1e3, 3),
+                })
+            except Exception:
+                log.warning("obs: serve event dropped", exc_info=True)
+        return [results[i] for i in range(len(es))]
+
+    def submit(self, expr):
+        """Asynchronous query admission: returns a
+        ``concurrent.futures.Future`` resolving to the BlockMatrix.
+        Concurrent submissions coalesce into micro-batches
+        (``config.serve_max_batch``) and JAX's async dispatch overlaps
+        device execution with host planning of the next batch, bounded
+        by ``config.serve_max_inflight`` (serve/pipeline.py)."""
+        if self._serve is None:
+            from matrel_tpu.serve.pipeline import ServePipeline
+            # under the lock: two concurrent FIRST submissions must not
+            # each build a pipeline — the loser's would be orphaned
+            # (invisible to serve_drain/close, its queue never drained)
+            with self._compile_lock:
+                if self._serve is None:
+                    self._serve = ServePipeline(self)
+        return self._serve.submit(as_expr(expr))
+
+    def serve_drain(self) -> None:
+        """Block until every submitted query has been dispatched and
+        every in-flight batch has materialised."""
+        if self._serve is not None:
+            self._serve.drain()
 
     def explain(self, expr: MatExpr, physical: bool = True,
                 analyze: bool = False) -> str:
@@ -552,34 +954,50 @@ def _attr_token(v, pins: list, seen: frozenset = frozenset()) -> str:
     return f"obj:{type(v).__name__}:{id(v)}"
 
 
-def _plan_key(e: MatExpr) -> Tuple[str, list]:
-    """(key, pins): pins is every object the key references by id() —
-    matrices, raw callables, their id-keyed globals/cells. The caller
-    must keep pins alive as long as the key maps to a cached plan."""
-    parts = []
+def _plan_key_spans(e: MatExpr) -> Tuple[list, list, dict]:
+    """(parts, pins, spans) in ONE walk. ``"|".join(parts)`` is the
+    root's structural key; ``spans[uid] = (start, end)`` slices
+    ``parts`` so that ``"|".join(parts[start:end])`` is EXACTLY the
+    standalone key of that subtree (the emission is pre-order with a
+    closing part, so a subtree's parts are one contiguous run). This
+    is what lets the result cache probe every interior node of a query
+    without re-walking each subtree through ``_attr_token`` — O(nodes)
+    key work per admission instead of O(nodes x depth)."""
+    parts: list = []
     pins: list = []
+    spans: dict = {}
 
     def walk(n: MatExpr):
+        start = len(parts)
         if n.kind == "leaf":
             m = n.attrs["matrix"]
             pins.append(m)
             parts.append(f"leaf:{id(m)}:{m.shape}:{m.spec}")
-            return
-        if n.kind in ("sparse_leaf", "coo_leaf"):
+        elif n.kind in ("sparse_leaf", "coo_leaf"):
             # sparse payloads are captured as CONSTANTS in the compiled
             # program — the cache key must carry the matrix identity or two
             # same-shaped sparse matrices would share one plan
             m = n.attrs["matrix"]
             pins.append(m)
             parts.append(f"{n.kind}:{id(m)}:{m.shape}")
-            return
-        attrs = {k: _attr_token(v, pins) for k, v in sorted(n.attrs.items())}
-        parts.append(f"{n.kind}:{n.shape}:{attrs}(")
-        for c in n.children:
-            walk(c)
-        parts.append(")")
+        else:
+            attrs = {k: _attr_token(v, pins)
+                     for k, v in sorted(n.attrs.items())}
+            parts.append(f"{n.kind}:{n.shape}:{attrs}(")
+            for c in n.children:
+                walk(c)
+            parts.append(")")
+        spans[n.uid] = (start, len(parts))
 
     walk(e)
+    return parts, pins, spans
+
+
+def _plan_key(e: MatExpr) -> Tuple[str, list]:
+    """(key, pins): pins is every object the key references by id() —
+    matrices, raw callables, their id-keyed globals/cells. The caller
+    must keep pins alive as long as the key maps to a cached plan."""
+    parts, pins, _spans = _plan_key_spans(e)
     return "|".join(parts), pins
 
 

@@ -297,6 +297,48 @@ class TestHBMFeasibility:
             if d.code == "MV105"] == []
 
 
+class TestResultCachePass:
+    """MV107: a plan consuming a materialized-result-cache entry must
+    agree with what the cache recorded at substitution (serve/)."""
+
+    def test_mv107_stale_layout_and_dtype_stamp(self, rng, mesh8):
+        B = _dense(rng, 32, 32, mesh8)
+        cached = _dense(rng, 32, 32, mesh8)
+        # a stamp surviving past invalidation: claims a replicated f64
+        # result while the leaf really lies canonically-sharded f32
+        stale = E.leaf(cached).with_attrs(result_cache={
+            "key_hash": "deadbeef", "layout": "rep",
+            "dtype": "float64", "deps": []})
+        diags = analysis.verify_plan(
+            _annotated(stale.multiply(B.expr()), mesh8), mesh8)
+        mv107 = [d for d in diags if d.code == "MV107"]
+        assert len(mv107) == 2          # one layout, one dtype finding
+        assert all(d.severity == "warning" for d in mv107)
+        assert any("layout" in d.message for d in mv107)
+        assert any("dtype" in d.message for d in mv107)
+
+    def test_mv107_quiet_on_live_substitution(self, rng, mesh8):
+        # the session's own substitution stamps truthfully — clean
+        from matrel_tpu.session import MatrelSession
+        sess = MatrelSession(mesh=mesh8, config=MatrelConfig(
+            result_cache_max_bytes=64 << 20))
+        X = _dense(rng, 64, 16, mesh8)
+        gram = X.expr().t().multiply(X.expr())
+        sess.run(gram)
+        B = _dense(rng, 16, 16, mesh8)
+        substituted = sess._rc_substitute(gram.multiply(B.expr()))
+        assert any(c.attrs.get("result_cache")
+                   for c in substituted.children)
+        diags = analysis.verify_plan(_annotated(substituted, mesh8),
+                                     mesh8)
+        assert [d for d in diags if d.code == "MV107"] == []
+
+    def test_mv107_unstamped_leaves_ignored(self, rng, mesh8):
+        e = _dense(rng, 32, 32, mesh8).expr().t()
+        diags = analysis.verify_plan(_annotated(e, mesh8), mesh8)
+        assert [d for d in diags if d.code == "MV107"] == []
+
+
 class TestWiring:
     # strategy_override bypasses BOTH the cost model and the
     # admissibility gate (choose_strategy_ex returns it first), so a

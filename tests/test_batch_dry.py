@@ -87,6 +87,25 @@ def test_spgemm_row_artifact(dry_batch):
     assert rec["cmp_densify_ms"] > 0
 
 
+def test_serve_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records,
+               lambda r: r.get("metric") == "serve_repeated_traffic_qps"
+               and "speedup" in r, "bench.py --serve")
+    # the acceptance number: result cache + micro-batched admission
+    # must run the repeated-traffic stream at >= 2x the QPS of today's
+    # sequential uncached session.run loop, on the CPU backend
+    assert rec["speedup"] is not None and rec["speedup"] >= 2.0, rec
+    assert rec["seq_uncached_qps"] > 0
+    assert rec["batched_cached_qps"] > rec["seq_uncached_qps"]
+    for name in ("seq_uncached", "seq_cached", "batched_uncached",
+                 "batched_cached"):
+        cfg = rec["configs"][name]
+        assert cfg["qps"] > 0
+        assert set(cfg) >= {"median_ms", "half_width_ms",
+                            "half_width_frac", "replays"}
+
+
 def test_bench_all_rows_artifacts(dry_batch):
     _, records, _ = dry_batch
     # every heavy row emits an explicit, parseable skip record — a

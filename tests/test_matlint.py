@@ -183,6 +183,33 @@ class TestML005SpecKeyedCache:
         assert _lint(tmp_path, src, "matrel_tpu/core/newcache.py") == []
 
 
+class TestML005ResultCacheKeying:
+    """The serve/ result cache's keying contract (ISSUE 5): entries
+    key by the canonical STRUCTURAL plan key. A spec- or sharding-
+    keyed variant is exactly the ML005 hazard — the fixture proves the
+    rule would catch that regression, and the real module must scan
+    clean."""
+
+    def test_spec_keyed_result_cache_fixture_fires(self, tmp_path):
+        src = """
+            class ResultCache:
+                def __init__(self):
+                    self._entry_cache = {}
+                def put(self, out, v):
+                    self._entry_cache[out.sharding] = v
+        """
+        got = _lint(tmp_path, src,
+                    "matrel_tpu/serve/result_cache.py")
+        assert _rules(got) == ["ML005"]
+
+    def test_real_result_cache_is_ml005_clean(self):
+        import os
+        got = matlint.lint_file(
+            os.path.join(matlint.REPO, "matrel_tpu", "serve",
+                         "result_cache.py"))
+        assert [f for f in got if f.rule == "ML005"] == []
+
+
 class TestSuppression:
     def test_inline_disable_silences(self, tmp_path):
         src = """

@@ -337,6 +337,81 @@ def soak_routed(n_trials: int, base: int, tol: float,
     return fails
 
 
+def soak_serve(n_trials: int, base: int, tol: float):
+    """Serving-layer battery: a random query stream (with heavy
+    repetition, so the result cache and the MultiPlan plan cache both
+    get real traffic) served through session.run_many / session.run
+    with the cross-query result cache ON must match the numpy oracle
+    QUERY-FOR-QUERY — reuse may never change an answer. Mid-stream a
+    catalog rebind exercises invalidation under load."""
+    import numpy as np
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.session import MatrelSession
+
+    mesh = mesh_lib.make_mesh()
+    fails = []
+    for trial in range(n_trials):
+        rng = np.random.default_rng(base + trial)
+        try:
+            n = int(rng.choice([16, 24, 32]))
+            mats_np = [rng.standard_normal((n, n)).astype(np.float32)
+                       for _ in range(3)]
+            mats = [BlockMatrix.from_numpy(a, mesh=mesh)
+                    for a in mats_np]
+
+            def rand_query(depth=0):
+                """(expr, numpy oracle) pairs over the shared mats."""
+                kind = int(rng.integers(0, 6 if depth < 2 else 3))
+                if kind in (0, 1, 2) or depth >= 2:
+                    i = int(rng.integers(0, len(mats)))
+                    return mats[i].expr(), mats_np[i]
+                a, na = rand_query(depth + 1)
+                b, nb = rand_query(depth + 1)
+                if kind == 3:
+                    return a.multiply(b), na @ nb
+                if kind == 4:
+                    return a.add(b), na + nb
+                s = float(rng.uniform(-2, 2))
+                return a.multiply_scalar(s).t(), (na * s).T
+
+            pool = [rand_query() for _ in range(int(rng.integers(3, 7)))]
+            stream = [pool[int(rng.integers(0, len(pool)))]
+                      for _ in range(3 * len(pool))]
+            sess = MatrelSession(mesh=mesh, config=MatrelConfig(
+                result_cache_max_bytes=32 << 20))
+            sess.register("t0", mats[0])
+            i = 0
+            rebound = False
+            while i < len(stream):
+                if rng.random() < 0.5:
+                    bs = int(rng.integers(1, 5))
+                    chunk = stream[i:i + bs]
+                    outs = sess.run_many([e for e, _ in chunk])
+                else:
+                    chunk = stream[i:i + 1]
+                    outs = [sess.run(chunk[0][0])]
+                for (e, want), out in zip(chunk, outs):
+                    scale = max(float(np.abs(want).max()), 1.0)
+                    np.testing.assert_allclose(
+                        out.to_numpy() / scale, want / scale,
+                        rtol=tol, atol=tol)
+                i += len(chunk)
+                if not rebound and i >= len(stream) // 2:
+                    # rebind under load: dependent entries must drop.
+                    # Crossed-midpoint flag, not equality — variable
+                    # chunk sizes jump over any exact index, and an
+                    # equality check would silently skip the very
+                    # behaviour this battery claims to soak
+                    sess.register("t0", mats[1])
+                    rebound = True
+        except Exception as ex:  # noqa: BLE001
+            fails.append(("serve", trial, type(ex).__name__,
+                          str(ex)[:150]))
+    return fails
+
+
 def soak_checkpoint(n_trials: int, base: int, tol: float):
     """Randomized checkpoint/restore: matrices with random specs, sparse
     tile stacks, loop state — restored values AND shardings must match;
@@ -400,7 +475,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("battery",
                    choices=["fuzz", "deep", "spmv", "sharded", "routed",
-                            "ckpt", "all"])
+                            "ckpt", "serve", "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -421,6 +496,8 @@ def main():
     if args.battery in ("ckpt", "all"):
         fails += soak_checkpoint(max(args.seeds // 5, 5), args.base,
                                  1e-6)
+    if args.battery in ("serve", "all"):
+        fails += soak_serve(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("sharded", "all"):
         fails += soak_sharded(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("routed", "all"):
