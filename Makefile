@@ -16,7 +16,11 @@
 # native     — build the C++ optimizer/ingestion core
 # bench      — the driver's headline metric (TPU; wedge-safe)
 # obs-report — aggregate the repo's query/bench/soak event log
-#              (.matrel_events.jsonl — the history-server analogue)
+#              (.matrel_events.jsonl — the history-server analogue),
+#              then the round-9 smokes over the same log: the
+#              cost-model drift audit (history --drift) and a
+#              chrome-trace export of the tracing spans. Point it at a
+#              dry-drill log with OBS_LOG=/tmp/matrel_batch_dry/events.jsonl
 
 PY ?= python
 SEEDS ?= 10
@@ -60,3 +64,6 @@ tpu-batch-dry:
 
 obs-report:
 	$(PY) -m matrel_tpu history --summary --log $(OBS_LOG)
+	$(PY) -m matrel_tpu history --drift --log $(OBS_LOG)
+	$(PY) -m matrel_tpu trace --export chrome --log $(OBS_LOG) \
+		--out $(OBS_LOG).chrome.json

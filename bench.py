@@ -689,12 +689,10 @@ def _store_last_good(tflops: float) -> None:
         pass
 
 
-def _emit_bench_event(record: dict) -> None:
-    """Append this run to the obs/ event log (the same JSONL file the
-    session's query records land in — "bench" kind), so BENCH_*.json
-    trajectories gain per-phase breakdowns via
-    `python -m matrel_tpu history --summary`. Harness-level: runs in
-    the PARENT process after measurement, so it cannot perturb the
+def _emit_obs_event(kind: str, record: dict) -> None:
+    """Append one record to the obs/ event log (the same JSONL file
+    the session's query records land in). Harness-level: runs in the
+    PARENT process after measurement, so it cannot perturb the
     measured hot path. obs/events.py is loaded by FILE PATH — importing
     the matrel_tpu package would pull jax into this parent, which is
     deliberately kept backend-free (relay-wedge safety). Never fails
@@ -706,9 +704,28 @@ def _emit_bench_event(record: dict) -> None:
             os.path.join(_HERE, "matrel_tpu", "obs", "events.py"))
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        mod.emit_tool_event("bench", record, anchor_dir=_HERE)
+        mod.emit_tool_event(kind, record, anchor_dir=_HERE)
     except Exception as e:  # obs must never fail the bench
-        print(f"# bench event not logged: {e}", file=sys.stderr)
+        print(f"# {kind} event not logged: {e}", file=sys.stderr)
+
+
+def _emit_bench_event(record: dict) -> None:
+    """One "bench" record per successful run, so BENCH_*.json
+    trajectories gain per-phase breakdowns via
+    `python -m matrel_tpu history --summary`."""
+    _emit_obs_event("bench", record)
+
+
+def _emit_bench_error(metric: str, error: str, extra: dict = None,
+                      last_good: dict = None) -> None:
+    """Final-failure trail: a DISTINCT ``bench_error`` event carrying
+    the error tail and the last-known-good record, so `history
+    --summary` surfaces the failure per metric — today it lives only
+    in the BENCH_*.json tail string (the relay-wedge null-row class)."""
+    record = {"metric": metric, "error": error[-500:],
+              "last_known_good": last_good}
+    record.update(extra or {})
+    _emit_obs_event("bench_error", record)
 
 
 def main() -> None:
@@ -774,13 +791,15 @@ def main() -> None:
         return
 
     # Final failure: still one parseable JSON line, rc 0 — the harness
-    # records the structured error instead of a stack trace.
+    # records the structured error instead of a stack trace, and a
+    # DISTINCT bench_error event (error tail + last-known-good) so the
+    # history roll-up shows the failure per metric.
     last = _load_last_good()
-    _emit_bench_event({
-        "metric": "dense_blockmatmul_tflops_per_chip", "value": None,
-        "n": N, "dtype": DTYPE, "attempts": 1 + len(errors),
-        "error": "; ".join(errors)[-500:],
-        "wall_s": round(time.monotonic() - t_start, 1)})
+    _emit_bench_error(
+        "dense_blockmatmul_tflops_per_chip", "; ".join(errors),
+        extra={"n": N, "dtype": DTYPE, "attempts": 1 + len(errors),
+               "wall_s": round(time.monotonic() - t_start, 1)},
+        last_good=last)
     print(json.dumps({
         "metric": "dense_blockmatmul_tflops_per_chip",
         "value": None,
@@ -802,9 +821,10 @@ def main_serve() -> None:
     record = {"metric": "serve_repeated_traffic_qps"}
     if ok and isinstance(payload, dict):
         record.update(payload)
+        _emit_bench_event(dict(record))
     else:
         record.update({"value": None, "error": str(payload)[:500]})
-    _emit_bench_event(dict(record))
+        _emit_bench_error(record["metric"], str(payload))
     print(json.dumps(record))
 
 
@@ -818,9 +838,10 @@ def main_spgemm() -> None:
     record = {"metric": "blocksparse_spgemm_100k_1pct"}
     if ok and isinstance(payload, dict):
         record.update(payload)
+        _emit_bench_event(dict(record))
     else:
         record.update({"value": None, "error": str(payload)[:500]})
-    _emit_bench_event(dict(record))
+        _emit_bench_error(record["metric"], str(payload))
     print(json.dumps(record))
 
 

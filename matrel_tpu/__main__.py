@@ -7,9 +7,15 @@ Commands:
   sql "<query>" [--table name=path.npy ...]   one-shot SQL query
   autotune N [K M]      time every matmul strategy for the given dims
   pagerank PATH         PageRank over a .mtx adjacency or src,dst CSV
-  history [--last N] [--summary] [--log PATH]
+  history [--last N] [--summary] [--drift] [--log PATH]
                         aggregate a query event log (the history-server
-                        analogue; log written when MATREL_OBS_LEVEL=on)
+                        analogue; log written when MATREL_OBS_LEVEL=on);
+                        --drift runs the cost-model drift auditor
+                        (obs/drift.py) over the same log
+  trace --export chrome [--log PATH] [--out PATH] [--last N]
+                        render the log's tracing spans as a
+                        Chrome/Perfetto trace_event JSON (load in
+                        https://ui.perfetto.dev)
 """
 
 from __future__ import annotations
@@ -79,6 +85,12 @@ def cmd_history(args):
     sys.exit(history.main(args))
 
 
+def cmd_trace(args):
+    import sys
+    from matrel_tpu.obs import trace
+    sys.exit(trace.main(args))
+
+
 def cmd_pagerank(args):
     import numpy as np
     from matrel_tpu import io as mio
@@ -138,7 +150,32 @@ def main(argv=None):
     hi.add_argument("--log", default=None,
                     help="event-log path (default: the obs default, "
                          ".matrel_events.jsonl)")
+    hi.add_argument("--drift", action="store_true",
+                    help="cost-model drift audit: estimated vs "
+                         "measured calibration per strategy/shape "
+                         "class/backend, rank-order flags, persisted "
+                         "table update")
+    hi.add_argument("--drift-table", default=None,
+                    help="calibration-table path (default: "
+                         "config.drift_table_path, else "
+                         ".matrel_drift.json)")
+    hi.add_argument("--no-save", action="store_true",
+                    help="with --drift: report only, don't update the "
+                         "persisted calibration table")
     hi.set_defaults(fn=cmd_history)
+    tr = sub.add_parser("trace")
+    tr.add_argument("--export", default="chrome",
+                    help="output format (chrome: trace_event JSON for "
+                         "Perfetto / chrome://tracing)")
+    tr.add_argument("--log", default=None,
+                    help="event-log path (same resolution as history)")
+    tr.add_argument("--out", default=None,
+                    help="output path (default: <log>.chrome.json; "
+                         "'-' for stdout)")
+    tr.add_argument("--last", type=int, default=None,
+                    help="keep only the last N root spans (+ their "
+                         "descendants)")
+    tr.set_defaults(fn=cmd_trace)
     pr = sub.add_parser("pagerank")
     pr.add_argument("path", help=".mtx adjacency or 'src,dst' CSV edges")
     pr.add_argument("--rounds", type=int, default=30)

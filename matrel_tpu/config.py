@@ -101,6 +101,24 @@ class MatrelConfig:
       obs_event_log: JSONL event-log path (the Spark event-log
         analogue). Empty → ".matrel_events.jsonl" in the working
         directory. Read it back with ``python -m matrel_tpu history``.
+      obs_flight_recorder: capacity of the in-memory flight-recorder
+        ring (obs/trace.py) — the last N span/event records, kept
+        INDEPENDENTLY of ``obs_level`` (an always-cheap deque append;
+        no I/O, no event assembly) and dumped to a JSON artifact on
+        VerificationError / compile failure / serve-batch failure or
+        an explicit ``session.dump_flight_recorder()``, so a field
+        failure leaves a post-mortem trail instead of one error
+        string. 0 (the default) disables the recorder entirely — with
+        ``obs_level="off"`` the query path then creates no span
+        objects at all (the bench contract, test-enforced).
+      obs_flight_recorder_path: dump-artifact path for the flight
+        recorder. Empty → ".matrel_flight.json" in the working
+        directory.
+      drift_table_path: JSON file for the cost-model drift auditor's
+        persisted calibration table (obs/drift.py — per-(strategy,
+        shape-class, backend) measured-vs-estimated ratios,
+        maintained by ``history --drift``). Empty →
+        ".matrel_drift.json" next to the autotune table's default.
       verify_plans: static plan verification (matrel_tpu/analysis/ —
         the pre-execution invariant checker). "off" (default: zero
         verifier work on the compile path), "warn" (run every pass
@@ -191,6 +209,9 @@ class MatrelConfig:
     serve_max_inflight: int = 2
     obs_level: str = "off"
     obs_event_log: str = ""
+    obs_flight_recorder: int = 0
+    obs_flight_recorder_path: str = ""
+    drift_table_path: str = ""
     verify_plans: str = "off"
     hbm_budget_bytes: int = 16 << 30
     axis_cost_weights: Tuple[float, float] = (1.0, 1.0)
@@ -215,6 +236,13 @@ class MatrelConfig:
                 f"verify_plans must be one of 'off'/'warn'/'error', "
                 f"got {self.verify_plans!r}")
         object.__setattr__(self, "verify_plans", vp)
+        # a negative ring capacity would silently build a deque with
+        # maxlen=None — an UNBOUNDED recorder, the opposite of the
+        # always-cheap contract — reject it at construction
+        if self.obs_flight_recorder < 0:
+            raise ValueError(
+                f"obs_flight_recorder must be >= 0 (ring capacity; "
+                f"0 disables), got {self.obs_flight_recorder!r}")
         # a zero/negative admission width or in-flight bound would
         # deadlock the serve pipeline's coalescing loop (it always
         # admits at least the query it popped) — reject at construction

@@ -29,6 +29,7 @@ from matrel_tpu.core import mesh as mesh_lib, padding
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.ir import expr as expr_mod, rules
 from matrel_tpu.ir.expr import MatExpr, leaves as expr_leaves
+from matrel_tpu.obs import trace as trace_lib
 from matrel_tpu.parallel import planner, strategies
 from matrel_tpu.utils.profiling import annotate
 
@@ -139,7 +140,7 @@ class Lowerer:
                     label += ":" + node.attrs.get("strategy", "xla")
                 if self.op_hook is not None:
                     child_time.append(0.0)
-                    t0 = time.perf_counter()
+                    t0 = time.perf_counter()  # matlint: disable=ML006 analyze-mode op_hook measurement — lands in analyze events
                 with annotate(f"matrel.{label}"):
                     out = self._eval(node, ev, leaf_arrays, leaf_pos)
                 if self.op_hook is not None:
@@ -148,7 +149,7 @@ class Lowerer:
                     # compile_expr leaves it None; obs/analyze.py sets
                     # it for eager per-op wall-clocking)
                     jax.block_until_ready(out)  # matlint: disable=ML001 analyze-mode op_hook
-                    dt = time.perf_counter() - t0
+                    dt = time.perf_counter() - t0  # matlint: disable=ML006 analyze-mode op_hook measurement
                     spent_in_children = child_time.pop()
                     if child_time:
                         child_time[-1] += dt
@@ -1073,14 +1074,18 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
     for e in exprs:
         _check_one_mesh(e, mesh)
     grid = mesh_lib.mesh_grid_shape(mesh)
-    t0 = time.perf_counter()
     rule_hits: Dict[str, int] = {}
-    opts = tuple(planner.annotate_strategies(
-        rules.optimize(e, cfg, grid=grid, mesh=mesh, counts=rule_hits),
-        mesh, cfg)
-        for e in exprs)
-    optimize_ms = (time.perf_counter() - t0) * 1e3
-    verify_diags = _verify_plans(opts, mesh, cfg)
+    # phase(): timed ALWAYS (meta needs the durations on the obs-off
+    # path too), emitted as parent-linked spans only when a tracer is
+    # active — the pre-span perf_counter pairs, one mechanism
+    with trace_lib.phase("plan.optimize", roots=len(exprs)) as sp_opt:
+        opts = tuple(planner.annotate_strategies(
+            rules.optimize(e, cfg, grid=grid, mesh=mesh,
+                           counts=rule_hits),
+            mesh, cfg)
+            for e in exprs)
+    with trace_lib.phase("plan.verify"):
+        verify_diags = _verify_plans(opts, mesh, cfg)
     leaf_order = []
     seen = set()
     for o in opts:
@@ -1092,10 +1097,10 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
     if cfg.autotune:
         low.spmv_choice = _autotune_spmv_choices(opts, mesh, cfg)
     fn = low.lower_multi(opts, leaf_order)
-    t1 = time.perf_counter()
-    fn, extra = _hoist_large_consts(fn, _example_avals(leaf_order))
-    meta = {"optimize_ms": round(optimize_ms, 3),
-            "trace_ms": round((time.perf_counter() - t1) * 1e3, 3),
+    with trace_lib.phase("plan.trace") as sp_tr:
+        fn, extra = _hoist_large_consts(fn, _example_avals(leaf_order))
+    meta = {"optimize_ms": round(sp_opt.dur_ms, 3),
+            "trace_ms": round(sp_tr.dur_ms, 3),
             "rule_hits": rule_hits}
     if verify_diags is not None:
         meta["diagnostics"] = verify_diags
@@ -1288,24 +1293,25 @@ def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
         mesh = lvs[0].attrs["matrix"].mesh if lvs else mesh_lib.make_mesh(
             cfg.mesh_shape, cfg.mesh_axis_names)
     _check_one_mesh(expr, mesh)
-    t0 = time.perf_counter()
     rule_hits: Dict[str, int] = {}
-    opt = rules.optimize(expr, cfg,
-                         grid=mesh_lib.mesh_grid_shape(mesh), mesh=mesh,
-                         counts=rule_hits)
-    opt = planner.annotate_strategies(opt, mesh, cfg)
-    optimize_ms = (time.perf_counter() - t0) * 1e3
-    verify_diags = _verify_plans((opt,), mesh, cfg)
+    # phase spans: same mechanism (and meta fields) as compile_exprs
+    with trace_lib.phase("plan.optimize") as sp_opt:
+        opt = rules.optimize(expr, cfg,
+                             grid=mesh_lib.mesh_grid_shape(mesh),
+                             mesh=mesh, counts=rule_hits)
+        opt = planner.annotate_strategies(opt, mesh, cfg)
+    with trace_lib.phase("plan.verify"):
+        verify_diags = _verify_plans((opt,), mesh, cfg)
     leaf_order = expr_leaves(opt)
     low = Lowerer(mesh, cfg)
     if cfg.autotune:
         low.spmv_choice = _autotune_spmv_choices((opt,), mesh, cfg)
     fn = low.lower(opt, leaf_order)
-    t1 = time.perf_counter()
-    fn, extra = _hoist_large_consts(fn, _example_avals(leaf_order))
+    with trace_lib.phase("plan.trace") as sp_tr:
+        fn, extra = _hoist_large_consts(fn, _example_avals(leaf_order))
     jitted = jax.jit(fn)
-    meta = {"optimize_ms": round(optimize_ms, 3),
-            "trace_ms": round((time.perf_counter() - t1) * 1e3, 3),
+    meta = {"optimize_ms": round(sp_opt.dur_ms, 3),
+            "trace_ms": round(sp_tr.dur_ms, 3),
             "rule_hits": rule_hits}
     if verify_diags is not None:
         meta["diagnostics"] = verify_diags

@@ -20,16 +20,33 @@ surfaces). This package is the TPU rebuild's equivalent, three layers:
   planner's estimates, and ``python -m matrel_tpu history`` aggregates
   an event-log file (the history-server analogue).
 
+Tier 2 (round 9) adds the runtime-behaviour surfaces on top:
+
+- :mod:`matrel_tpu.obs.trace` — structured tracing spans (parent-linked
+  ``span`` records through admission → plan → verify → trace →
+  execute; ``python -m matrel_tpu trace --export chrome`` renders them
+  as a Perfetto timeline) and the bounded in-memory flight recorder
+  (``config.obs_flight_recorder``) dumped as a post-mortem artifact on
+  verification/compile/serve failures.
+- :mod:`matrel_tpu.obs.drift` — the cost-model drift auditor
+  (``history --drift``): estimated bytes/FLOPs joined to measured
+  per-op times, calibration ratios persisted per (strategy,
+  shape-class, backend), rank-order disagreements flagged.
+
 Instrumentation is off-hot-path by contract: event assembly happens
 outside jitted code, per-op timing only under ``analyze=True``, and with
-``config.obs_level == "off"`` (the default) the query path takes zero
-extra syncs and appends zero events.
+``config.obs_level == "off"`` (the default) plus the flight recorder
+off, the query path takes zero extra syncs, appends zero events and
+creates zero span objects.
 """
 
 from matrel_tpu.obs.events import EventLog, SCHEMA_VERSION, read_events
 from matrel_tpu.obs.metrics import MetricsRegistry, REGISTRY
+from matrel_tpu.obs.trace import (FlightRecorder, Span, Tracer,
+                                  chrome_trace, span)
 
 __all__ = [
-    "EventLog", "MetricsRegistry", "REGISTRY", "SCHEMA_VERSION",
-    "read_events",
+    "EventLog", "FlightRecorder", "MetricsRegistry", "REGISTRY",
+    "SCHEMA_VERSION", "Span", "Tracer", "chrome_trace", "read_events",
+    "span",
 ]

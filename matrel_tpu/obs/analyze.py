@@ -139,8 +139,19 @@ def render(plan, per_op: Dict[int, Tuple[str, float]],
     return "\n".join(lines)
 
 
-def explain_analyzed(plan) -> str:
-    """The full analyze block for ``session.explain(analyze=True)``."""
-    per_op, _eager = measure_per_op(plan)
-    fused = measure_fused(plan)
-    return render(plan, per_op, fused)
+def analyze_record(plan, per_op: Dict[int, Tuple[str, float]],
+                   fused_s: float) -> dict:
+    """The ``analyze`` event-log record: the measured per-op tree
+    joined (by uid) to the plan's decision records — the cost-model
+    drift auditor's highest-fidelity sample source (obs/drift.py reads
+    these back to calibrate estimated bytes/FLOPs against measured
+    per-op milliseconds, per strategy / shape class / backend)."""
+    from matrel_tpu import executor as executor_lib
+    return {
+        "backend": jax.default_backend(),
+        "fused_ms": round(fused_s * 1e3, 3),
+        "per_op": [{"uid": uid, "label": label,
+                    "ms": round(seconds * 1e3, 4)}
+                   for uid, (label, seconds) in sorted(per_op.items())],
+        "matmuls": executor_lib.plan_matmul_decisions(plan),
+    }

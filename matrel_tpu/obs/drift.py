@@ -1,0 +1,334 @@
+"""Cost-model drift auditor — estimate vs. measurement, systematically.
+
+The whole MatFast/MatRel thesis is cost-model-driven plan selection
+(PAPER.md [P2]); MV106 checks the model against ITSELF (a stamped plan
+vs the model's own cheaper alternative). This module is the EMPIRICAL
+complement: it joins each matmul decision's estimated weighted
+bytes/FLOPs (``planner.matmul_decisions`` — already in every query and
+``analyze`` event) against measured per-op milliseconds
+(``explain(analyze=True)``'s per-op tree, and single-matmul queries'
+``execute_ms``), maintains per-(strategy, shape-class, backend)
+calibration ratios in a JSON table persisted next to the autotune
+tables, and flags strategy pairs whose ESTIMATED rank-order disagrees
+with MEASURED rank-order — the "the model said cpmm was cheaper and it
+was 3× slower" regression that otherwise only shows up as a slowly
+rotting autotune table.
+
+Shape classes are power-of-two buckets of max(n, k, m) — the same
+granularity the autotune table keys measurements by, so a calibration
+ratio and an autotune row describe the same population.
+
+``python -m matrel_tpu history --drift`` is the CLI surface;
+``make obs-report`` runs it over the repo log.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional
+
+#: Table schema version (bump on reader-visible change, like events.py).
+TABLE_SCHEMA = 1
+
+#: Default table name — lives beside .matrel_autotune.json by the same
+#: cwd-relative convention.
+DEFAULT_TABLE = ".matrel_drift.json"
+
+#: Measured must be at least this multiple SLOWER than a higher-
+#: estimate alternative before the rank-order flag fires: estimates
+#: are models and measurements are noisy; a bare inversion inside the
+#: noise band would flag every near-tie.
+RANK_FLAG_MARGIN = 1.25
+
+#: Bounded per-key ratio memory in the persisted table (the metrics
+#: registry's reservoir discipline: aggregatable, never unbounded).
+_RECENT_MAX = 32
+
+
+def table_path(config=None) -> str:
+    """Config value → concrete path ('' → the default name)."""
+    if config is None:
+        from matrel_tpu.config import default_config
+        config = default_config()
+    return config.drift_table_path or DEFAULT_TABLE
+
+
+def shape_class(dims) -> str:
+    """Power-of-two bucket of max(n, k, m) — '<=1024' style classes so
+    a 900×1000×1024 and a 1024³ multiply calibrate together (the
+    autotune table's side-bucket granularity)."""
+    top = max(int(d) for d in dims) if dims else 1
+    return f"<={1 << max(0, math.ceil(math.log2(max(top, 1))))}"
+
+
+def _strategy_key(d: dict) -> str:
+    """Decision record → calibration strategy name. Pure-strategy
+    matmuls use the stamped strategy; sparse/COO dispatches (which
+    bypass the byte model) audit under their dispatch name so SpGEMM's
+    est_saved_flops drift is visible without polluting strategy rows."""
+    disp = d.get("dispatch")
+    if disp:
+        return f"dispatch:{disp}"
+    return d.get("strategy", "?")
+
+
+def _est_bytes(d: dict):
+    """The quantity the planner's ranking actually minimised for this
+    decision: weighted cost on a non-uniform mesh, raw ICI bytes
+    otherwise. None for dispatch records (no byte model)."""
+    w = d.get("est_weighted_cost")
+    if isinstance(w, (int, float)):
+        return float(w)
+    b = d.get("est_ici_bytes")
+    return float(b) if isinstance(b, (int, float)) else None
+
+
+def iter_samples(events: List[dict]):
+    """(strategy, shape_class, backend, flops, est_bytes, measured_ms,
+    source) samples from an event log.
+
+    Two measurement sources, in decreasing fidelity:
+    - ``analyze`` records: per-op EXCLUSIVE milliseconds joined to the
+      decision by uid — the matmul's own time.
+    - single-matmul ``query`` records: execute_ms attributed to the one
+      matmul (includes pipeline overhead; still rank-usable within a
+      backend). Batched roots and rc hits are excluded — their
+      execute_ms is amortised/zero by construction.
+    """
+    for e in events:
+        kind = e.get("kind")
+        backend = e.get("backend") or "?"
+        if kind == "analyze":
+            per_op = {p.get("uid"): p for p in (e.get("per_op") or ())
+                      if isinstance(p, dict)}
+            for d in e.get("matmuls") or ():
+                op = per_op.get(d.get("uid"))
+                if op is None or not isinstance(op.get("ms"),
+                                                (int, float)):
+                    continue
+                yield _sample(d, float(op["ms"]), backend, "analyze")
+        elif kind == "query":
+            mm = e.get("matmuls") or ()
+            ms = e.get("execute_ms")
+            if (len(mm) == 1 and e.get("cache") != "rc_hit"
+                    and not e.get("batch")
+                    and isinstance(ms, (int, float)) and ms > 0):
+                yield _sample(mm[0], float(ms), backend, "query")
+
+
+def _sample(d: dict, ms: float, backend: str, source: str) -> dict:
+    return {"strategy": _strategy_key(d),
+            "class": shape_class(d.get("dims") or ()),
+            "backend": backend,
+            "flops": float(d.get("flops") or 0.0),
+            "est_bytes": _est_bytes(d),
+            "ms": ms,
+            "source": source}
+
+
+def _median(vals: List[float]):
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[len(s) // 2]
+
+
+def calibrate(samples: List[dict]) -> Dict[str, dict]:
+    """Per-(strategy, shape-class, backend) calibration rows:
+
+    - ``ms_per_gflop``: median measured ms per estimated GFLOP — the
+      compute-side calibration (a strategy whose ratio drifts up is
+      losing MXU efficiency the FLOPs model can't see).
+    - ``ms_per_est_mib``: median measured ms per estimated MiB moved —
+      the comm-side calibration (None when the model estimated zero
+      bytes, e.g. replicated-operand bmm). Divergence ACROSS strategies
+      in one class is the drift signal: the model prices their bytes on
+      one scale, so honest estimates give similar ratios.
+    """
+    acc: Dict[str, dict] = {}
+    for s in samples:
+        key = f"{s['strategy']}|{s['class']}|{s['backend']}"
+        row = acc.setdefault(key, {"strategy": s["strategy"],
+                                   "class": s["class"],
+                                   "backend": s["backend"],
+                                   "count": 0, "_gf": [], "_mib": [],
+                                   "_ms": []})
+        row["count"] += 1
+        row["_ms"].append(s["ms"])
+        if s["flops"] > 0:
+            row["_gf"].append(s["ms"] / (s["flops"] / 1e9))
+        eb = s["est_bytes"]
+        if eb is not None and eb > 0:
+            row["_mib"].append(s["ms"] / (eb / 2 ** 20))
+    for row in acc.values():
+        row["ms_median"] = round(_median(row.pop("_ms")), 4)
+        gf = _median(row.pop("_gf"))
+        mib = _median(row.pop("_mib"))
+        row["ms_per_gflop"] = round(gf, 5) if gf is not None else None
+        row["ms_per_est_mib"] = (round(mib, 5) if mib is not None
+                                 else None)
+    return acc
+
+
+def rank_flags(samples: List[dict]) -> List[dict]:
+    """Strategy pairs whose estimated and measured rank-orders
+    DISAGREE within one (shape-class, backend) population: the model
+    estimated strictly fewer bytes for A than B, but A measured at
+    least RANK_FLAG_MARGIN× slower. The empirical complement of MV106
+    (which can only compare the model against itself)."""
+    groups: Dict[tuple, Dict[str, dict]] = {}
+    for s in samples:
+        if s["est_bytes"] is None:
+            continue            # dispatch records have no byte ranking
+        g = groups.setdefault((s["class"], s["backend"]), {})
+        row = g.setdefault(s["strategy"], {"_ms": [], "_est": []})
+        row["_ms"].append(s["ms"])
+        row["_est"].append(s["est_bytes"])
+    flags: List[dict] = []
+    for (cls, backend), g in sorted(groups.items()):
+        if len(g) < 2:
+            continue
+        meds = {name: (_median(row["_est"]), _median(row["_ms"]),
+                       len(row["_ms"]))
+                for name, row in g.items()}
+        names = sorted(meds)
+        for a in names:
+            for b in names:
+                if a == b:
+                    continue
+                est_a, ms_a, n_a = meds[a]
+                est_b, ms_b, n_b = meds[b]
+                if (est_a < est_b and ms_b > 0
+                        and ms_a >= RANK_FLAG_MARGIN * ms_b):
+                    flags.append({
+                        "class": cls, "backend": backend,
+                        "model_prefers": a, "measured_prefers": b,
+                        "est_bytes": [est_a, est_b],
+                        "measured_ms": [round(ms_a, 4),
+                                        round(ms_b, 4)],
+                        "samples": [n_a, n_b],
+                        "slowdown": round(ms_a / ms_b, 2),
+                    })
+    return flags
+
+
+# ---------------------------------------------------------------------------
+# Persistence — the calibration table next to the autotune tables
+# ---------------------------------------------------------------------------
+
+
+def load_table(path: str) -> dict:
+    """Persisted table or a fresh empty one. Corrupt/absent/foreign-
+    schema files read as empty (the autotune load_table contract)."""
+    try:
+        with open(path) as f:
+            t = json.load(f)
+    except (OSError, ValueError):
+        t = None
+    if (not isinstance(t, dict)
+            or t.get("schema") != TABLE_SCHEMA
+            or not isinstance(t.get("entries"), dict)):
+        return {"schema": TABLE_SCHEMA, "entries": {}}
+    return t
+
+
+def update_table(path: str, calib: Dict[str, dict]) -> dict:
+    """Merge one log's calibration rows into the persisted table
+    (count-weighted blend of the ratios, bounded recent-ratio memory)
+    and rewrite it atomically. Always writes — an empty log still
+    stamps ``updated``, so `make obs-report` leaves a parseable
+    artifact either way."""
+    table = load_table(path)
+    entries = table["entries"]
+    for key, row in calib.items():
+        old = entries.get(key)
+        new = {k: row[k] for k in ("strategy", "class", "backend",
+                                   "count", "ms_median",
+                                   "ms_per_gflop", "ms_per_est_mib")}
+        if old is not None:
+            n_old = int(old.get("count") or 0)
+            n_new = row["count"]
+            for f in ("ms_per_gflop", "ms_per_est_mib"):
+                ov, nv = old.get(f), row[f]
+                if ov is not None and nv is not None:
+                    new[f] = round((ov * n_old + nv * n_new)
+                                   / max(n_old + n_new, 1), 5)
+                elif nv is None:
+                    new[f] = ov
+            new["count"] = n_old + n_new
+            recent = list(old.get("recent") or [])
+        else:
+            recent = []
+        if row["ms_per_gflop"] is not None:
+            recent.append(row["ms_per_gflop"])
+        new["recent"] = recent[-_RECENT_MAX:]
+        entries[key] = new
+    table["updated"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(table, f, indent=1)
+    os.replace(tmp, path)
+    return table
+
+
+# ---------------------------------------------------------------------------
+# Report — `history --drift`
+# ---------------------------------------------------------------------------
+
+
+def report(events: List[dict],
+           table_path_str: Optional[str] = None,
+           persist: bool = True) -> str:
+    """The drift-audit text: calibration rows, rank-order flags, and
+    (when ``persist``) the table merge."""
+    samples = list(iter_samples(events))
+    calib = calibrate(samples)
+    flags = rank_flags(samples)
+    lines = [f"drift audit: {len(samples)} sample(s) "
+             f"({sum(1 for s in samples if s['source'] == 'analyze')} "
+             f"analyze, "
+             f"{sum(1 for s in samples if s['source'] == 'query')} "
+             f"query) -> {len(calib)} calibration row(s)"]
+    if calib:
+        header = (f"{'strategy':<18}{'class':<10}{'backend':<9}"
+                  f"{'n':>4}{'med ms':>10}{'ms/GFLOP':>12}"
+                  f"{'ms/est MiB':>12}")
+        lines += ["", header, "-" * len(header)]
+        for key in sorted(calib):
+            r = calib[key]
+            lines.append(
+                f"{r['strategy']:<18}{r['class']:<10}"
+                f"{r['backend']:<9}{r['count']:>4}"
+                f"{r['ms_median']:>10.3f}"
+                + (f"{r['ms_per_gflop']:>12.4f}"
+                   if r["ms_per_gflop"] is not None else f"{'-':>12}")
+                + (f"{r['ms_per_est_mib']:>12.4f}"
+                   if r["ms_per_est_mib"] is not None
+                   else f"{'-':>12}"))
+    if flags:
+        lines.append("")
+        for fl in flags:
+            lines.append(
+                f"DRIFT {fl['class']} {fl['backend']}: model prefers "
+                f"{fl['model_prefers']} "
+                f"(est {fl['est_bytes'][0]:.3g} < "
+                f"{fl['est_bytes'][1]:.3g} bytes) but it measured "
+                f"{fl['slowdown']}x slower than "
+                f"{fl['measured_prefers']} "
+                f"({fl['measured_ms'][0]} vs {fl['measured_ms'][1]} "
+                f"ms; n={fl['samples']})")
+    else:
+        lines.append("rank-order: estimates agree with measurement "
+                     "(no flags)")
+    if persist:
+        path = table_path_str or table_path()
+        try:
+            table = update_table(path, calib)
+            lines.append(f"calibration table: {path} "
+                         f"({len(table['entries'])} entries)")
+        except OSError as e:     # auditing must not fail on a bad disk
+            lines.append(f"calibration table NOT persisted: {e}")
+    return "\n".join(lines)

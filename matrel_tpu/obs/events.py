@@ -5,10 +5,15 @@ emits one ``query`` record per run (plus one ``verify`` record when the
 static plan verifier is on — mode, diagnostic count, codes) and one
 ``serve`` record per micro-batched admission (batch size, queue waits,
 result-cache state — session.run_many / the submit pipeline);
-``bench.py`` emits ``bench`` records and ``tools/soak_guard.py``
-``soak`` records into the same file, so one log replays the whole
-history of a host (the history-server input — ``python -m matrel_tpu
-history`` aggregates it).
+``bench.py`` emits ``bench`` records (``bench_error`` on a final probe
+failure, carrying the error tail and last-known-good) and
+``tools/soak_guard.py`` ``soak`` records into the same file, so one log
+replays the whole history of a host (the history-server input —
+``python -m matrel_tpu history`` aggregates it). Round 9 adds ``span``
+records (parent-linked tracing scopes, obs/trace.py — exported to
+Chrome/Perfetto by ``python -m matrel_tpu trace``) and ``analyze``
+records (measured per-op trees joined to decision records — the drift
+auditor's feed, obs/drift.py).
 
 Writing discipline mirrors the repo's other append-only logs
 (PROGRESS.jsonl, SOAKLOG.jsonl): a single ``write()`` of one line per

@@ -95,17 +95,71 @@ def summarize(events: List[dict]) -> dict:
         "execute_ms_total": round(sum(exec_ms), 3),
         "execute_ms_mean": (round(sum(exec_ms) / len(exec_ms), 3)
                             if exec_ms else None),
+        "phase_quantiles": _phase_quantiles(qs),
         "plan_cache": last_cache,
         "strategies": strategies,
         "rule_hits": rule_hits,
         "bench_runs": sum(1 for e in events if e.get("kind") == "bench"),
+        "bench_errors": _last_bench_errors(events),
         "soak_runs": sum(1 for e in events if e.get("kind") == "soak"),
+        "span_count": sum(1 for e in events if e.get("kind") == "span"),
         "verify_runs": sum(1 for e in events
                            if e.get("kind") == "verify"),
         "verify_diagnostics": sum(
             int(e.get("count", 0)) for e in events
             if e.get("kind") == "verify"),
     }
+
+
+#: Per-query phase fields the quantile roll-up covers.
+_PHASE_FIELDS = ("optimize_ms", "trace_ms", "execute_ms")
+
+
+def _phase_quantiles(qs: List[dict]) -> Dict[str, dict]:
+    """p50/p95 of optimize/trace/execute milliseconds PER QUERY KIND
+    (root_kind) — the serve roll-up's nearest-rank helper applied to
+    the query phases, so a latency regression in one query shape is
+    visible instead of drowning in the global mean. Cache-hit records
+    repeat their plan's compile-time optimize/trace values by design
+    (the numbers describe the plan that ran); execute_ms is always
+    this run's own."""
+    by_kind: Dict[str, Dict[str, list]] = {}
+    for e in qs:
+        kind = str(e.get("root_kind") or "?")
+        rows = by_kind.setdefault(kind,
+                                  {f: [] for f in _PHASE_FIELDS})
+        for f in _PHASE_FIELDS:
+            v = e.get(f)
+            if isinstance(v, (int, float)):
+                rows[f].append(float(v))
+    out: Dict[str, dict] = {}
+    for kind, rows in by_kind.items():
+        entry: dict = {"count": max(len(rows[f])
+                                    for f in _PHASE_FIELDS)}
+        for f in _PHASE_FIELDS:
+            vals = sorted(rows[f])
+            entry[f] = {"p50": _pctile(vals, 0.50),
+                        "p95": _pctile(vals, 0.95)}
+        out[kind] = entry
+    return out
+
+
+def _last_bench_errors(events: List[dict]) -> Dict[str, dict]:
+    """Most recent ``bench_error`` record per metric — the relay-wedge
+    trail bench.py leaves when a probe fails (today that failure lives
+    only in the BENCH_*.json tail string; here the roll-up surfaces
+    it next to the successful runs)."""
+    out: Dict[str, dict] = {}
+    for e in events:
+        if e.get("kind") != "bench_error":
+            continue
+        out[str(e.get("metric") or "?")] = {
+            "ts": e.get("ts"),
+            "error": str(e.get("error") or "")[:300],
+            "attempts": e.get("attempts"),
+            "last_known_good": e.get("last_known_good"),
+        }
+    return out
 
 
 def _pctile(sorted_vals: List[float], q: float):
@@ -161,8 +215,28 @@ def render_summary(events: List[dict]) -> str:
         f"other events: bench={s['bench_runs']} soak={s['soak_runs']} "
         f"verify={s['verify_runs']}"
         + (f" ({s['verify_diagnostics']} diagnostic(s))"
-           if s["verify_diagnostics"] else ""),
+           if s["verify_diagnostics"] else "")
+        + (f" spans={s['span_count']}" if s.get("span_count") else ""),
     ]
+    for metric, err in sorted((s.get("bench_errors") or {}).items()):
+        lkg = err.get("last_known_good") or {}
+        lines.append(
+            f"LAST BENCH ERROR [{metric}]: {err['error']}"
+            + (f" (last known good: {lkg.get('tflops', lkg)})"
+               if lkg else ""))
+    pq = s.get("phase_quantiles") or {}
+    if pq:
+        lines.append("")
+        header = (f"{'query kind':<14}{'n':>5}"
+                  f"{'opt p50/p95':>16}{'trace p50/p95':>16}"
+                  f"{'exec p50/p95':>16}")
+        lines += [header, "-" * len(header)]
+        for kind in sorted(pq):
+            q = pq[kind]
+            cells = "".join(
+                f"{_fmt(q[f]['p50'])}/{_fmt(q[f]['p95'])}".rjust(16)
+                for f in ("optimize_ms", "trace_ms", "execute_ms"))
+            lines.append(f"{kind:<14}{q['count']:>5}{cells} ms")
     sv = s.get("serve") or {}
     if sv.get("batches"):
         lines.append(
@@ -212,11 +286,20 @@ def main(args) -> int:
     import os
     path = resolve_path(args.log or os.environ.get("MATREL_OBS_EVENT_LOG"))
     events = read_events(path)
-    if not events:
+    if not events and not getattr(args, "drift", False):
         print(f"no events in {path}")
         return 0
     print(f"# {len(events)} event(s) in {path}")
-    if args.summary:
+    if getattr(args, "drift", False):
+        # the cost-model drift auditor (obs/drift.py): calibration
+        # ratios + rank-order flags, table persisted next to the
+        # autotune tables
+        from matrel_tpu.obs import drift
+        print(drift.report(
+            events,
+            table_path_str=getattr(args, "drift_table", None),
+            persist=not getattr(args, "no_save", False)))
+    elif args.summary:
         print(render_summary(events))
     else:
         print(render_queries(events, last=args.last))

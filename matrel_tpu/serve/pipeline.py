@@ -31,6 +31,8 @@ import threading
 import time
 from concurrent.futures import Future
 
+from matrel_tpu.obs import trace as trace_lib
+
 log = logging.getLogger("matrel_tpu.serve")
 
 
@@ -55,7 +57,9 @@ class ServePipeline:
     def submit(self, expr) -> Future:
         """Enqueue one query; returns its future."""
         fut: Future = Future()
-        self._q.put((expr, fut, time.perf_counter()))
+        # enqueue timestamp, not a measurement: its delta lands in the
+        # serve event record as queue_wait_ms
+        self._q.put((expr, fut, time.perf_counter()))  # matlint: disable=ML006 queue-wait timestamp — lands in the serve event record
         self._ensure_worker()
         return fut
 
@@ -108,20 +112,37 @@ class ServePipeline:
             # stranding every sibling future of the batch
             batch = [it for it in pulled
                      if it[1].set_running_or_notify_cancel()]
-            t_admit = time.perf_counter()
+            t_admit = time.perf_counter()  # matlint: disable=ML006 queue-wait timestamp — lands in the serve event record
             waits_ms = [round((t_admit - t_enq) * 1e3, 3)
                         for _, _, t_enq in batch]
             try:
                 if batch:
-                    outs = self.session.run_many(
-                        [e for e, _, _ in batch],
-                        _queue_wait_ms=waits_ms,
-                        _inflight_depth=len(self._inflight))
+                    # worker-thread tracer activation: the admission
+                    # span is the serve trail's root — run_many's
+                    # batch/plan/execute spans parent-link under it,
+                    # so a chrome export shows queue bubbles next to
+                    # compile/execute overlap
+                    with trace_lib.activate(
+                            getattr(self.session, "_tracer", None)), \
+                            trace_lib.span(
+                                "serve.admit", batch=len(batch),
+                                inflight=len(self._inflight),
+                                max_wait_ms=(max(waits_ms)
+                                             if waits_ms else 0.0)):
+                        outs = self.session.run_many(
+                            [e for e, _, _ in batch],
+                            _queue_wait_ms=waits_ms,
+                            _inflight_depth=len(self._inflight))
                 else:
                     outs = []
             except Exception as ex:  # noqa: BLE001 — any planning/
                 # compile failure fails every future of the batch; the
                 # worker survives to serve the next one
+                dump = getattr(self.session, "_flight_auto_dump", None)
+                if dump is not None:
+                    # the post-mortem trail for a failed serve batch
+                    # (no-op when the flight recorder is off)
+                    dump(ex, reason="serve_batch_failure")
                 for _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(ex)

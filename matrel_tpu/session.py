@@ -30,6 +30,7 @@ from matrel_tpu.config import MatrelConfig, default_config
 from matrel_tpu.core import mesh as mesh_lib
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.ir.expr import MatExpr, as_expr
+from matrel_tpu.obs import trace as trace_lib
 from matrel_tpu.serve.result_cache import (CacheEntry, ResultCache,
                                            result_nbytes)
 
@@ -67,6 +68,17 @@ class MatrelSession:
         self._result_cache = ResultCache()
         self._serve = None
         self._compile_lock = threading.RLock()
+        # obs tier 2 (obs/trace.py): the flight-recorder ring is
+        # independent of obs_level (always-cheap post-mortem trail);
+        # the tracer exists iff ANY span consumer does — with neither,
+        # compute()'s fast path never creates a span object at all
+        fr_cap = self.config.obs_flight_recorder
+        self._flight = (trace_lib.FlightRecorder(fr_cap)
+                        if fr_cap > 0 else None)
+        self._tracer = (trace_lib.Tracer(self._obs_emit)
+                        if (self._flight is not None
+                            or self.config.obs_level != "off")
+                        else None)
 
     # -- builder (MatfastSession.builder().getOrCreate() analogue) ---------
 
@@ -191,7 +203,16 @@ class MatrelSession:
             if plan is not None:
                 self._plan_cache.move_to_end(key)
                 return plan, True, key
-            plan = executor_lib.compile_expr(e, self.mesh, self.config)
+            try:
+                plan = executor_lib.compile_expr(e, self.mesh,
+                                                 self.config)
+            except Exception as ex:
+                # post-mortem trail BEFORE the error propagates: a
+                # VerificationError / compile failure in the field
+                # leaves the flight-recorder artifact, not just the
+                # exception string (no-op when the recorder is off)
+                self._flight_auto_dump(ex)
+                raise
             # pin every id()-keyed object on the cached plan: a garbage-
             # collected object's address can be REUSED by CPython, and a
             # later distinct object at the recycled address would falsely
@@ -245,8 +266,12 @@ class MatrelSession:
             if plan is not None:
                 self._plan_cache.move_to_end(mkey)
                 return plan, True, keyed
-            plan = executor_lib.compile_exprs(
-                [uniq[k] for k in skeys], self.mesh, self.config)
+            try:
+                plan = executor_lib.compile_exprs(
+                    [uniq[k] for k in skeys], self.mesh, self.config)
+            except Exception as ex:
+                self._flight_auto_dump(ex)   # same trail as the
+                raise                        # single-plan entry
             plan._cache_pin = (tuple(uniq[k] for k in skeys), pins_all)
             plan._root_keys = tuple(skeys)
             self._plan_cache[mkey] = plan
@@ -410,6 +435,57 @@ class MatrelSession:
             self._event_log = EventLog(path)
         return self._event_log
 
+    def _obs_emit(self, kind: str, record: dict) -> None:
+        """The ONE emission funnel for session events AND finished
+        spans: JSONL event log when obs is on, flight-recorder ring
+        when configured — each independently (flight recording with
+        obs off keeps spans in memory only; the ring then holds the
+        bare record stamped the way the log would have)."""
+        full = None
+        if self._obs_enabled():
+            full = self._obs_event_log().emit(kind, record)
+        if self._flight is not None:
+            if full is None:
+                from matrel_tpu.obs.events import SCHEMA_VERSION
+                full = {"schema": SCHEMA_VERSION,
+                        "ts": round(time.time(), 3), "kind": kind}  # matlint: disable=ML006 record timestamp — mirrors EventLog.emit's stamp for ring-only records
+                full.update(record)
+            self._flight.add(full)
+
+    # -- flight recorder (obs/trace.py — post-mortem ring) ------------------
+
+    def dump_flight_recorder(self, path: Optional[str] = None,
+                             reason: str = "explicit",
+                             error: Optional[str] = None
+                             ) -> Optional[str]:
+        """Write the flight-recorder ring as a JSON artifact and return
+        its path (None when the recorder is off). The automatic dump
+        sites (VerificationError, compile failure, serve-batch
+        failure) route through here too."""
+        if self._flight is None:
+            return None
+        p = (path or self.config.obs_flight_recorder_path
+             or trace_lib.DEFAULT_FLIGHT_PATH)
+        return self._flight.dump(p, reason, error=error)
+
+    def _flight_auto_dump(self, ex: BaseException,
+                          reason: Optional[str] = None) -> None:
+        """Best-effort dump on a failure path — a post-mortem artifact
+        must never mask (or replace) the original exception."""
+        if self._flight is None:
+            return
+        if reason is None:
+            from matrel_tpu.analysis import VerificationError
+            reason = ("verification_error"
+                      if isinstance(ex, VerificationError)
+                      else "compile_failure")
+        try:
+            p = self.dump_flight_recorder(reason=reason,
+                                          error=repr(ex)[:500])
+            log.warning("flight recorder dumped to %s (%s)", p, reason)
+        except Exception:
+            log.warning("flight recorder dump failed", exc_info=True)
+
     def _emit_query_event(self, e: MatExpr, plan, hit: bool, key: str,
                           execute_ms: float, first_execution: bool,
                           out: BlockMatrix, matmuls=None,
@@ -458,7 +534,12 @@ class MatrelSession:
             record["batch"] = batch
         if self._rc_enabled():
             record["result_cache"] = self._result_cache.info()
-        self._obs_event_log().emit("query", record)
+        import jax
+        # backend rides every query record so the drift auditor can
+        # calibrate per backend (a CPU ms and a TPU ms must never
+        # blend into one ratio)
+        record["backend"] = jax.default_backend()
+        self._obs_emit("query", record)
         REGISTRY.counter("query.count").inc()
         REGISTRY.counter("plan_cache.hit" if hit
                          else "plan_cache.miss").inc()
@@ -490,7 +571,7 @@ class MatrelSession:
         if diags is None:
             return        # verifier was off when this plan compiled
         from matrel_tpu.obs.metrics import REGISTRY
-        self._obs_event_log().emit("verify", {
+        self._obs_emit("verify", {
             "mode": self.config.verify_plans,
             "count": len(diags),
             "errors": sum(1 for d in diags if d["severity"] == "error"),
@@ -524,7 +605,7 @@ class MatrelSession:
         snapshot the hit came from."""
         from matrel_tpu.obs.metrics import REGISTRY
         sql_hash = getattr(e, "_sql_hash", None)
-        self._obs_event_log().emit("query", {
+        self._obs_emit("query", {
             "query_id": f"q{os.getpid()}-{next(_query_seq)}",
             "source": "sql" if sql_hash else "dsl",
             "source_hash": sql_hash
@@ -553,7 +634,7 @@ class MatrelSession:
         from matrel_tpu.obs.metrics import REGISTRY
         record = dict(record)
         record["result_cache"] = self._result_cache.info()
-        self._obs_event_log().emit("serve", record)
+        self._obs_emit("serve", record)
         REGISTRY.counter("serve.batches").inc()
         REGISTRY.counter("serve.queries").inc(
             record.get("batch_size", 0))
@@ -569,10 +650,13 @@ class MatrelSession:
         """Execute one compiled plan with the obs timing/emission
         wrapper (the obs-on half of compute())."""
         first = not getattr(plan, "_obs_executed", False)
-        t0 = time.perf_counter()
-        out = plan.run()
-        out.data.block_until_ready()
-        execute_ms = (time.perf_counter() - t0) * 1e3
+        # phase(): the one timing mechanism — the duration lands in the
+        # query record AND (tracer active here) as an "execute" span
+        with trace_lib.phase("query.execute",
+                             cache="hit" if hit else "miss") as sp:
+            out = plan.run()
+            out.data.block_until_ready()
+        execute_ms = sp.dur_ms
         plan._obs_executed = True
         try:
             self._emit_query_event(e, plan, hit, key, execute_ms, first,
@@ -587,15 +671,28 @@ class MatrelSession:
     def compute(self, expr: MatExpr) -> BlockMatrix:
         e = as_expr(expr)
         rc = self._rc_enabled()
-        if not rc and not self._obs_enabled():
+        if (not rc and not self._obs_enabled()
+                and self._tracer is None):
             # the production path: zero event assembly, zero extra
-            # device syncs, zero cache-key walks beyond the plan
-            # cache's own (the obs_level="off" /
-            # result_cache_max_bytes=0 contract bench.py relies on)
+            # device syncs, zero span objects, zero cache-key walks
+            # beyond the plan cache's own (the obs_level="off" /
+            # result_cache_max_bytes=0 / flight-recorder-off contract
+            # bench.py relies on)
             return self.compile(e).run()
+        # per-thread tracer activation: executor compile phases and
+        # every span below parent-link into this query's trail
+        with trace_lib.activate(self._tracer), \
+                trace_lib.span("query", root_kind=e.kind):
+            return self._compute_observed(e, rc)
+
+    def _compute_observed(self, e: MatExpr, rc: bool) -> BlockMatrix:
+        """compute() behind the fast-path gate: result-cache admission,
+        compile, execute — each scoped by a tracing span."""
         key = pins = None
         if rc:
-            ent, key, pins, e = self._rc_admit(e)
+            with trace_lib.span("rc.probe") as sp:
+                ent, key, pins, e = self._rc_admit(e)
+                sp.set(hit=ent is not None)
             if ent is not None:
                 # repeated query: answered from the materialized-result
                 # cache — no optimize, no trace, no device work
@@ -606,11 +703,15 @@ class MatrelSession:
                         log.warning("obs: query event dropped",
                                     exc_info=True)
                 return ent.result
-        plan, hit, pkey = self._compile_entry(e)
+        with trace_lib.span("plan"):
+            plan, hit, pkey = self._compile_entry(e)
         if self._obs_enabled():
             out = self._run_observed(e, plan, hit, pkey)
         else:
-            out = plan.run()
+            # flight-recorder-only tier: the span marks DISPATCH (JAX
+            # async — deliberately no added sync; always-cheap)
+            with trace_lib.span("query.execute"):
+                out = plan.run()
         if rc:
             self._rc_insert(key, pins, e, out)
         return out
@@ -639,13 +740,22 @@ class MatrelSession:
             return []
         rc = self._rc_enabled()
         obs = self._obs_enabled()
-        t_batch = time.perf_counter()
+        with trace_lib.activate(self._tracer), \
+                trace_lib.span("serve.batch", size=len(es)) as sp_batch:
+            return self._run_many_observed(es, rc, obs, sp_batch,
+                                           _queue_wait_ms,
+                                           _inflight_depth)
+
+    def _run_many_observed(self, es, rc, obs, sp_batch, _queue_wait_ms,
+                           _inflight_depth) -> List[BlockMatrix]:
         results: dict = {}
         rc_meta: dict = {}
         pend: list = []
         for i, e in enumerate(es):
             if rc:
-                ent, key, pins, e = self._rc_admit(e)
+                with trace_lib.span("rc.probe", index=i) as sp:
+                    ent, key, pins, e = self._rc_admit(e)
+                    sp.set(hit=ent is not None)
                 if ent is not None:
                     results[i] = ent.result
                     if obs:
@@ -660,15 +770,21 @@ class MatrelSession:
         execute_ms = 0.0
         plan_hit = None
         if pend:
-            plan, plan_hit, keys = self._compile_multi_entry(
-                [e for _, e in pend])
+            with trace_lib.span("plan", roots=len(pend)):
+                plan, plan_hit, keys = self._compile_multi_entry(
+                    [e for _, e in pend])
             pos = {k: j for j, k in enumerate(plan._root_keys)}
-            t0 = time.perf_counter()
-            outs = plan.run()
+            # the batch's execute span: under obs the sync happens
+            # INSIDE it (dur = device wall); flight-recorder-only runs
+            # mark dispatch without adding a sync
+            with trace_lib.span("serve.execute",
+                                executed=len(pend)) as sp_ex:
+                outs = plan.run()
+                if obs:
+                    for o in outs:
+                        o.data.block_until_ready()
             if obs:
-                for o in outs:
-                    o.data.block_until_ready()
-                execute_ms = (time.perf_counter() - t0) * 1e3
+                execute_ms = sp_ex.dur_ms or 0.0
             first = not getattr(plan, "_obs_executed", False)
             plan._obs_executed = True
             for j, ((i, e), k) in enumerate(zip(pend, keys)):
@@ -710,8 +826,7 @@ class MatrelSession:
                     "queue_wait_ms": _queue_wait_ms,
                     "inflight_depth": _inflight_depth,
                     "execute_ms": round(execute_ms, 3),
-                    "wall_ms": round(
-                        (time.perf_counter() - t_batch) * 1e3, 3),
+                    "wall_ms": round(sp_batch.elapsed_ms() or 0.0, 3),
                 })
             except Exception:
                 log.warning("obs: serve event dropped", exc_info=True)
@@ -793,7 +908,20 @@ class MatrelSession:
         if analyze or self.config.obs_level == "analyze":
             from matrel_tpu.obs import analyze as analyze_mod
             try:
-                text += "\n" + analyze_mod.explain_analyzed(plan)
+                per_op, _eager = analyze_mod.measure_per_op(plan)
+                fused = analyze_mod.measure_fused(plan)
+                text += "\n" + analyze_mod.render(plan, per_op, fused)
+                if self._obs_enabled():
+                    # the drift auditor's highest-fidelity feed: the
+                    # measured per-op tree joined to the SAME plan's
+                    # decision records, one `analyze` event per run
+                    try:
+                        self._obs_emit("analyze",
+                                       analyze_mod.analyze_record(
+                                           plan, per_op, fused))
+                    except Exception:
+                        log.warning("obs: analyze event dropped",
+                                    exc_info=True)
             except Exception as ex:   # analysis must not fail EXPLAIN
                 text += f"\n== Analysis unavailable: {ex!r} =="
         return text

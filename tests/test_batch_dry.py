@@ -137,6 +137,32 @@ def test_topology_flip_artifact(dry_batch):
     assert rec["slow_axis_bytes"] > rec["fast_axis_bytes"]
 
 
+def test_flight_drill_artifact(dry_batch):
+    _, records, art = dry_batch
+    rec = _one(records,
+               lambda r: r.get("metric") == "flight_recorder_drill",
+               "flight_drill")
+    # the obs tier-2 acceptance, end to end on the dry log: the serve
+    # batch ran, the compile failure left a parseable flight artifact,
+    # the chrome export has parent-linked admission/compile/execute
+    # spans, and the drift audit produced calibration rows
+    assert rec["ok"] is True, rec
+    assert rec["batch_ok"] is True
+    assert rec["compile_failure_dumped"] is True
+    assert rec["chrome_events"] > 0 and rec["parent_linked"] > 0
+    assert {"serve.admit", "serve.batch", "plan.optimize",
+            "serve.execute"} <= set(rec["span_names"])
+    assert rec["drift_rows"] >= 1
+    # the flight-recorder artifact itself parses and carries records
+    flight = json.loads((art / "flight.json").read_text())
+    assert flight["kind"] == "flight_recorder"
+    assert flight["reason"] == "compile_failure"
+    assert flight["records"]
+    # the drift calibration table parses too
+    table = json.loads((art / "drift.json").read_text())
+    assert table["schema"] == 1 and table["entries"]
+
+
 def test_sweep_and_gram_artifacts(dry_batch):
     _, records, _ = dry_batch
     verdict = _one(records, lambda r: "results" in r and "ok" in r,
@@ -159,7 +185,7 @@ def test_artifacts_redirected_out_of_repo(dry_batch):
     # every side-effect landed in the dry dir, not the capture history
     for name in ("events.jsonl", "progress.jsonl", "soaklog.jsonl",
                  "bench_last_good.json", "cpu_baseline.json",
-                 "autotune_dry.json"):
+                 "autotune_dry.json", "flight.json", "drift.json"):
         assert (art / name).exists(), f"{name} not redirected"
     events = [json.loads(l) for l in (art / "events.jsonl").open()]
     assert any(e.get("kind") == "bench" for e in events)

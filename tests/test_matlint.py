@@ -210,6 +210,75 @@ class TestML005ResultCacheKeying:
         assert [f for f in got if f.rule == "ML005"] == []
 
 
+class TestML006RawTiming:
+    """Raw wall-clock timing in library modules (ISSUE 6): timing
+    belongs in spans/StepTimer so the measurement lands in the event
+    log where history / the chrome exporter / the drift auditor can
+    read it — a bare perf_counter pair dies in a local variable."""
+
+    def test_fires_on_perf_counter(self, tmp_path):
+        src = """
+            import time
+            def run(plan):
+                t0 = time.perf_counter()
+                out = plan.run()
+                dt = time.perf_counter() - t0
+                return out, dt
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/session.py")
+        assert _rules(got) == ["ML006"]
+        assert len(got) == 2                      # both call sites
+
+    def test_fires_on_time_time_and_bare_import(self, tmp_path):
+        src = """
+            import time
+            from time import perf_counter
+            def run():
+                a = time.time()
+                b = perf_counter()
+                return a, b
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/pipeline.py")
+        assert _rules(got) == ["ML006"] and len(got) == 2
+
+    def test_obs_and_profiling_and_autotune_exempt(self, tmp_path):
+        src = """
+            import time
+            def measure():
+                return time.perf_counter()
+        """
+        # the sanctioned timing homes: the obs layer itself, the
+        # StepTimer module, and the autotune measurement subsystem
+        for rel in ("matrel_tpu/obs/trace.py",
+                    "matrel_tpu/utils/profiling.py",
+                    "matrel_tpu/parallel/autotune.py"):
+            assert _lint(tmp_path, src, rel) == []
+
+    def test_out_of_package_ignored(self, tmp_path):
+        src = """
+            import time
+            def bench():
+                return time.time()
+        """
+        # bench harnesses / tools are entry points, not library code
+        assert _lint(tmp_path, src, "bench.py") == []
+
+    def test_suppression_with_justification(self, tmp_path):
+        src = """
+            import time
+            def admit(q):
+                q.put(time.perf_counter())  # matlint: disable=ML006 queue-wait timestamp
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/serve/pipeline.py") == []
+
+    def test_unrelated_time_methods_not_flagged(self, tmp_path):
+        src = """
+            def fmt(dt):
+                return dt.time()            # datetime.time(), not timing
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/io.py") == []
+
+
 class TestSuppression:
     def test_inline_disable_silences(self, tmp_path):
         src = """

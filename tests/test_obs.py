@@ -171,7 +171,8 @@ class TestSessionEvents:
         sess = _session(mesh8, tmp_path)
         sess.run(chain3)
         sess.run(chain3)
-        recs = read_events(sess.config.obs_event_log)
+        recs = read_events(sess.config.obs_event_log,
+                           kinds=("query",))
         assert len(recs) == 2                  # exactly one per run
         first, second = recs
         assert first["cache"] == "miss" and second["cache"] == "hit"
@@ -226,7 +227,8 @@ class TestSessionEvents:
         sess = _session(mesh8, tmp_path)
         sess.run(chain3)
         sess.run(chain3)
-        miss, hit = read_events(sess.config.obs_event_log)
+        miss, hit = read_events(sess.config.obs_event_log,
+                                kinds=("query",))
         assert miss["rule_hits"].get("chain_dp") == 1
         assert hit["rule_hits"] == {}
         assert REGISTRY.snapshot()["counters"]["optimizer.rule.chain_dp"] \
@@ -246,7 +248,8 @@ class TestSessionEvents:
             rng.standard_normal((16, 16)).astype(np.float32), mesh=mesh8)
         sess.register("A", a)
         sess.run(sess.sql("SELECT A * A FROM A"))
-        [rec] = read_events(sess.config.obs_event_log)
+        [rec] = read_events(sess.config.obs_event_log,
+                            kinds=("query",))
         assert rec["source"] == "sql"
         assert len(rec["source_hash"]) == 16
 
@@ -256,7 +259,8 @@ class TestSessionEvents:
             m = BlockMatrix.from_numpy(
                 rng.standard_normal((8, 8)).astype(np.float32), mesh=mesh8)
             sess.run(m.expr().t())
-        recs = read_events(sess.config.obs_event_log)
+        recs = read_events(sess.config.obs_event_log,
+                           kinds=("query",))
         assert recs[-1]["plan_cache"]["evicted"] == 2
         assert sess.plan_cache_info()["evicted"] == 2
 
@@ -439,6 +443,482 @@ class TestInstrumentationGuard:
         assert out.stdout.strip() == "False"
         [rec] = read_events(str(tmp_path / "ev.jsonl"))
         assert rec["kind"] == "bench"
+
+
+class TestTracingSpans:
+    """Round 9 tentpole: parent-linked span records through admission →
+    plan → verify → trace → execute, in the same schema-versioned log."""
+
+    def test_query_spans_with_parent_links(self, mesh8, tmp_path,
+                                           chain3):
+        sess = _session(mesh8, tmp_path)
+        sess.run(chain3)
+        spans = [e for e in read_events(sess.config.obs_event_log)
+                 if e["kind"] == "span"]
+        names = {s["name"] for s in spans}
+        assert {"query", "plan", "plan.optimize", "plan.verify",
+                "plan.trace", "query.execute"} <= names
+        by_id = {s["span_id"]: s for s in spans}
+        # every compile phase parent-links (transitively) to the query
+        # root span — the chrome exporter's nesting source of truth
+        root = next(s for s in spans if s["name"] == "query")
+        assert root["parent_id"] is None
+        for name in ("plan.optimize", "query.execute"):
+            s = next(x for x in spans if x["name"] == name)
+            seen = set()
+            while s["parent_id"] is not None:
+                assert s["parent_id"] in by_id
+                assert s["span_id"] not in seen
+                seen.add(s["span_id"])
+                s = by_id[s["parent_id"]]
+            assert s["name"] == "query"
+        for s in spans:
+            assert s["schema"] == SCHEMA_VERSION
+            assert isinstance(s["dur_ms"], (int, float))
+            assert isinstance(s["t0"], (int, float))
+
+    def test_serve_batch_spans(self, mesh8, tmp_path, chain3, rng):
+        sess = _session(mesh8, tmp_path)
+        a = BlockMatrix.from_numpy(
+            rng.standard_normal((16, 16)).astype(np.float32),
+            mesh=mesh8)
+        sess.run_many([chain3, a.expr().t(), a.expr()])
+        spans = [e for e in read_events(sess.config.obs_event_log)
+                 if e["kind"] == "span"]
+        batch = next(s for s in spans if s["name"] == "serve.batch")
+        assert batch["attrs"]["size"] == 3
+        execute = next(s for s in spans if s["name"] == "serve.execute")
+        # execute nests under the batch (possibly through "plan")
+        by_id = {s["span_id"]: s for s in spans}
+        p = execute
+        while p["parent_id"] is not None:
+            p = by_id[p["parent_id"]]
+        assert p["span_id"] == batch["span_id"]
+
+    def test_chrome_export_round_trip(self, mesh8, tmp_path, chain3):
+        from matrel_tpu.obs.trace import chrome_trace
+        sess = _session(mesh8, tmp_path)
+        sess.run_many([chain3])
+        events = read_events(sess.config.obs_event_log)
+        doc = json.loads(json.dumps(chrome_trace(events)))
+        assert doc["traceEvents"]
+        ids = set()
+        for ev in doc["traceEvents"]:
+            assert ev["ph"] == "X"
+            assert ev["dur"] >= 0 and ev["ts"] > 0
+            assert {"pid", "tid", "name", "args"} <= set(ev)
+            ids.add(ev["args"]["span_id"])
+        # parent links survive the export (the Perfetto args payload)
+        assert any(ev["args"].get("parent_id") in ids
+                   for ev in doc["traceEvents"])
+
+    def test_chrome_export_last_filters_roots(self, tmp_path):
+        from matrel_tpu.obs.trace import chrome_trace
+        log = EventLog(str(tmp_path / "sp.jsonl"))
+        for root in (1, 4):
+            log.emit("span", {"name": "query", "span_id": root,
+                              "parent_id": None, "t0": 100.0 + root,
+                              "dur_ms": 5.0, "pid": 1, "tid": 1})
+            log.emit("span", {"name": "plan", "span_id": root + 1,
+                              "parent_id": root, "t0": 100.0 + root,
+                              "dur_ms": 2.0, "pid": 1, "tid": 1})
+        doc = chrome_trace(read_events(log.path), last=1)
+        got = {ev["args"]["span_id"] for ev in doc["traceEvents"]}
+        assert got == {4, 5}            # last root + its child only
+
+    def test_chrome_export_last_keys_by_pid(self, tmp_path):
+        """Span-id sequences restart per PROCESS; a shared log mixes
+        pids by design, so the --last closure must never pull an
+        unrelated process's identically-numbered spans."""
+        from matrel_tpu.obs.trace import chrome_trace
+        log = EventLog(str(tmp_path / "sp.jsonl"))
+        for pid, t0 in ((111, 100.0), (222, 200.0)):
+            log.emit("span", {"name": "query", "span_id": 1,
+                              "parent_id": None, "t0": t0,
+                              "dur_ms": 5.0, "pid": pid, "tid": 1})
+            log.emit("span", {"name": "plan", "span_id": 2,
+                              "parent_id": 1, "t0": t0,
+                              "dur_ms": 2.0, "pid": pid, "tid": 1})
+        doc = chrome_trace(read_events(log.path), last=1)
+        assert {ev["pid"] for ev in doc["traceEvents"]} == {222}
+        assert len(doc["traceEvents"]) == 2
+
+    def test_trace_cli(self, mesh8, tmp_path, chain3):
+        import subprocess
+        import sys
+        sess = _session(mesh8, tmp_path)
+        sess.run(chain3)
+        out_path = str(tmp_path / "trace.chrome.json")
+        out = subprocess.run(
+            [sys.executable, "-m", "matrel_tpu", "trace", "--export",
+             "chrome", "--log", sess.config.obs_event_log,
+             "--out", out_path],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        status = json.loads(out.stdout.strip().splitlines()[-1])
+        assert status["spans"] > 0
+        with open(out_path) as f:
+            doc = json.load(f)
+        assert len(doc["traceEvents"]) == status["spans"]
+
+
+class TestFlightRecorder:
+    """The always-cheap post-mortem ring: independent of obs_level,
+    dumped on failures or on demand."""
+
+    def test_records_spans_with_obs_off(self, mesh8, tmp_path, chain3):
+        sess = _session(mesh8, tmp_path, level="off",
+                        obs_flight_recorder=64,
+                        obs_flight_recorder_path=str(
+                            tmp_path / "flight.json"))
+        sess.run(chain3)
+        # no event log (obs off) — but the ring holds the span trail
+        assert not (tmp_path / "events.jsonl").exists()
+        assert len(sess._flight) > 0
+        names = {r["name"] for r in sess._flight.snapshot()
+                 if r.get("kind") == "span"}
+        assert {"query", "plan.optimize", "query.execute"} <= names
+
+    def test_ring_is_bounded(self, mesh8, tmp_path, chain3):
+        sess = _session(mesh8, tmp_path, level="off",
+                        obs_flight_recorder=4)
+        for _ in range(3):
+            sess.run(chain3)
+        assert len(sess._flight) == 4          # last N only
+
+    def test_explicit_dump_round_trip(self, mesh8, tmp_path, chain3):
+        sess = _session(mesh8, tmp_path, obs_flight_recorder=64,
+                        obs_flight_recorder_path=str(
+                            tmp_path / "flight.json"))
+        sess.run(chain3)
+        p = sess.dump_flight_recorder()
+        assert p == str(tmp_path / "flight.json")
+        with open(p) as f:
+            art = json.load(f)
+        assert art["schema"] == SCHEMA_VERSION
+        assert art["kind"] == "flight_recorder"
+        assert art["reason"] == "explicit"
+        assert art["capacity"] == 64
+        kinds = {r.get("kind") for r in art["records"]}
+        assert "span" in kinds and "query" in kinds  # obs on: both flow
+
+    def test_dump_disabled_returns_none(self, mesh8, tmp_path, chain3):
+        sess = _session(mesh8, tmp_path)       # recorder off (default)
+        sess.run(chain3)
+        assert sess._flight is None
+        assert sess.dump_flight_recorder() is None
+
+    def test_dump_on_compile_failure(self, mesh8, tmp_path, chain3,
+                                     monkeypatch):
+        from matrel_tpu import executor as executor_lib
+        sess = _session(mesh8, tmp_path, obs_flight_recorder=64,
+                        obs_flight_recorder_path=str(
+                            tmp_path / "flight.json"))
+        sess.run(chain3)                       # populate the ring
+
+        def boom(*a, **k):
+            raise RuntimeError("lowering exploded")
+
+        monkeypatch.setattr(executor_lib, "compile_expr", boom)
+        with pytest.raises(RuntimeError, match="lowering exploded"):
+            sess.run(chain3.t())               # distinct key → compile
+        with open(tmp_path / "flight.json") as f:
+            art = json.load(f)
+        assert art["reason"] == "compile_failure"
+        assert "lowering exploded" in art["error"]
+        assert art["records"]                  # the trail, not a bare
+                                               # error string
+
+    def test_dump_on_verification_error(self, mesh8, tmp_path, chain3,
+                                        monkeypatch):
+        from matrel_tpu import executor as executor_lib
+        from matrel_tpu.analysis import VerificationError
+        sess = _session(mesh8, tmp_path, obs_flight_recorder=64,
+                        obs_flight_recorder_path=str(
+                            tmp_path / "flight.json"))
+
+        def boom(*a, **k):
+            raise VerificationError([])
+
+        monkeypatch.setattr(executor_lib, "compile_expr", boom)
+        with pytest.raises(VerificationError):
+            sess.run(chain3)
+        with open(tmp_path / "flight.json") as f:
+            art = json.load(f)
+        assert art["reason"] == "verification_error"
+
+    def test_dump_on_serve_batch_failure(self, mesh8, tmp_path, chain3,
+                                         monkeypatch):
+        from matrel_tpu import executor as executor_lib
+        sess = _session(mesh8, tmp_path, obs_flight_recorder=64,
+                        obs_flight_recorder_path=str(
+                            tmp_path / "flight.json"))
+
+        def boom(*a, **k):
+            raise RuntimeError("batch compile died")
+
+        monkeypatch.setattr(executor_lib, "compile_exprs", boom)
+        fut = sess.submit(chain3)
+        with pytest.raises(RuntimeError, match="batch compile died"):
+            fut.result(timeout=30)
+        sess.serve_drain()
+        with open(tmp_path / "flight.json") as f:
+            art = json.load(f)
+        assert art["reason"] == "serve_batch_failure"
+
+
+class TestObsOffServePath:
+    """obs_level="off" + flight recorder off on the serve repeated-
+    traffic path: zero events, zero span OBJECTS (the structural twin
+    of TestObsOffContract's zero-sync guard — PR 5's QPS must not pay
+    for tier 2)."""
+
+    def test_repeated_serve_path_creates_no_spans(self, mesh8, tmp_path,
+                                                  chain3, rng,
+                                                  monkeypatch):
+        from matrel_tpu.obs import trace as trace_lib
+        sess = _session(mesh8, tmp_path, level="off",
+                        result_cache_max_bytes=1 << 26)
+        assert sess._tracer is None and sess._flight is None
+        a = BlockMatrix.from_numpy(
+            rng.standard_normal((16, 16)).astype(np.float32),
+            mesh=mesh8)
+        stream = [chain3, a.expr().t()]
+        sess.run_many(stream)                  # warm: compiles once
+        emits = []
+        monkeypatch.setattr(EventLog, "emit",
+                            lambda self, *args, **kw: emits.append(args))
+
+        def no_spans(*a, **k):
+            raise AssertionError(
+                "span object constructed on the off-path serve loop")
+
+        monkeypatch.setattr(trace_lib.Span, "__init__", no_spans)
+        outs = sess.run_many(stream)           # repeated traffic:
+        assert len(outs) == 2                  # rc/plan-cache hits only
+        assert emits == []
+
+
+class TestAnalyzeEvent:
+    """explain(analyze=True) with obs on emits one `analyze` record —
+    the drift auditor's measured-vs-estimated feed."""
+
+    def test_analyze_record_joins_per_op_to_decisions(self, mesh8,
+                                                      tmp_path, chain3):
+        sess = _session(mesh8, tmp_path)
+        sess.explain(chain3, analyze=True)
+        recs = [e for e in read_events(sess.config.obs_event_log)
+                if e["kind"] == "analyze"]
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["backend"] == "cpu"
+        assert rec["fused_ms"] > 0
+        uids = {p["uid"] for p in rec["per_op"]}
+        assert len(rec["matmuls"]) == 2
+        for d in rec["matmuls"]:
+            assert d["uid"] in uids            # the drift join key
+        for p in rec["per_op"]:
+            assert isinstance(p["ms"], (int, float))
+
+    def test_no_analyze_event_when_obs_off(self, mesh8, tmp_path,
+                                           chain3):
+        sess = _session(mesh8, tmp_path, level="off")
+        sess.explain(chain3, analyze=True)
+        assert not (tmp_path / "events.jsonl").exists()
+
+
+class TestDriftAuditor:
+    """obs/drift.py: calibration ratios + the rank-order flag (the
+    empirical complement of MV106) from a recorded log."""
+
+    def _analyze_event(self, log, strategy, est_bytes, ms,
+                       dims=(1024, 1024, 1024), uid=7):
+        log.emit("analyze", {
+            "backend": "cpu", "fused_ms": ms,
+            "per_op": [{"uid": uid, "label": f"matmul:{strategy}",
+                        "ms": ms}],
+            "matmuls": [{"uid": uid, "strategy": strategy,
+                         "dims": list(dims),
+                         "flops": 2.0 * dims[0] * dims[1] * dims[2],
+                         "est_ici_bytes": est_bytes}]})
+
+    def _seed_miscalibrated(self, tmp_path):
+        """cpmm estimated 4x CHEAPER than rmm but measured 3x SLOWER —
+        the seeded drift the auditor must flag."""
+        log = EventLog(str(tmp_path / "drift.jsonl"))
+        for _ in range(3):
+            self._analyze_event(log, "cpmm", est_bytes=1.0 * 2 ** 20,
+                                ms=30.0)
+            self._analyze_event(log, "rmm", est_bytes=4.0 * 2 ** 20,
+                                ms=10.0)
+        return log.path
+
+    def test_calibration_rows(self, tmp_path):
+        from matrel_tpu.obs import drift
+        events = read_events(self._seed_miscalibrated(tmp_path))
+        samples = list(drift.iter_samples(events))
+        assert len(samples) == 6
+        calib = drift.calibrate(samples)
+        row = calib["cpmm|<=1024|cpu"]
+        assert row["count"] == 3
+        assert row["ms_median"] == 30.0
+        assert row["ms_per_est_mib"] == pytest.approx(30.0)
+        assert row["ms_per_gflop"] == pytest.approx(
+            30.0 / (2.0 * 1024 ** 3 / 1e9))
+
+    def test_rank_order_flag_fires_on_seeded_drift(self, tmp_path):
+        from matrel_tpu.obs import drift
+        events = read_events(self._seed_miscalibrated(tmp_path))
+        flags = drift.rank_flags(list(drift.iter_samples(events)))
+        assert len(flags) == 1
+        fl = flags[0]
+        assert fl["model_prefers"] == "cpmm"
+        assert fl["measured_prefers"] == "rmm"
+        assert fl["slowdown"] == pytest.approx(3.0)
+
+    def test_agreeing_log_raises_no_flag(self, tmp_path):
+        from matrel_tpu.obs import drift
+        log = EventLog(str(tmp_path / "ok.jsonl"))
+        self._analyze_event(log, "cpmm", est_bytes=1.0 * 2 ** 20,
+                            ms=10.0)
+        self._analyze_event(log, "rmm", est_bytes=4.0 * 2 ** 20,
+                            ms=30.0)
+        flags = drift.rank_flags(list(drift.iter_samples(
+            read_events(log.path))))
+        assert flags == []
+
+    def test_query_samples_filtered(self, tmp_path):
+        """Single-matmul query records feed the auditor; batched roots
+        and rc hits (amortised / zero execute) must not."""
+        from matrel_tpu.obs import drift
+        log = EventLog(str(tmp_path / "q.jsonl"))
+        base = {"source": "dsl", "out_shape": [4, 4], "backend": "cpu",
+                "plan_cache": {},
+                "matmuls": [{"uid": 1, "strategy": "rmm",
+                             "dims": [64, 64, 64], "flops": 5e5,
+                             "est_ici_bytes": 1024.0}]}
+        log.emit("query", dict(base, cache="miss", execute_ms=5.0))
+        log.emit("query", dict(base, cache="rc_hit", execute_ms=0.0))
+        log.emit("query", dict(base, cache="hit", execute_ms=5.0,
+                               batch={"size": 4, "index": 0}))
+        samples = list(drift.iter_samples(read_events(log.path)))
+        assert len(samples) == 1 and samples[0]["source"] == "query"
+
+    def test_table_persist_and_merge(self, tmp_path):
+        from matrel_tpu.obs import drift
+        events = read_events(self._seed_miscalibrated(tmp_path))
+        calib = drift.calibrate(list(drift.iter_samples(events)))
+        path = str(tmp_path / "table.json")
+        t1 = drift.update_table(path, calib)
+        assert t1["entries"]["cpmm|<=1024|cpu"]["count"] == 3
+        t2 = drift.update_table(path, calib)     # second session merges
+        assert t2["entries"]["cpmm|<=1024|cpu"]["count"] == 6
+        with open(path) as f:                    # artifact parses
+            on_disk = json.load(f)
+        assert on_disk["schema"] == drift.TABLE_SCHEMA
+        # corrupt table reads as empty, never an error
+        with open(path, "w") as f:
+            f.write("{nope")
+        assert drift.load_table(path)["entries"] == {}
+
+    def test_history_drift_cli(self, tmp_path, capsys):
+        from matrel_tpu.obs import history
+        path = self._seed_miscalibrated(tmp_path)
+        args = type("A", (), {
+            "log": path, "summary": False, "last": None, "drift": True,
+            "drift_table": str(tmp_path / "table.json"),
+            "no_save": False})()
+        assert history.main(args) == 0
+        out = capsys.readouterr().out
+        assert "DRIFT" in out and "model prefers cpmm" in out
+        assert "calibration table" in out
+        assert (tmp_path / "table.json").exists()
+
+    def test_end_to_end_session_feeds_auditor(self, mesh8, tmp_path,
+                                              chain3):
+        """A recorded session (analyze + plain queries) must yield
+        calibration rows through the real pipeline."""
+        from matrel_tpu.obs import drift
+        sess = _session(mesh8, tmp_path)
+        sess.explain(chain3, analyze=True)
+        events = read_events(sess.config.obs_event_log)
+        report = drift.report(events, persist=False)
+        assert "calibration row" in report
+        assert len(drift.calibrate(
+            list(drift.iter_samples(events)))) >= 1
+
+
+class TestBenchErrorEvent:
+    """Satellite: a failed bench probe leaves a DISTINCT bench_error
+    record (error tail + last-known-good) the summary surfaces."""
+
+    def test_emit_bench_error(self, tmp_path, monkeypatch):
+        import bench
+        path = str(tmp_path / "ev.jsonl")
+        monkeypatch.setenv("MATREL_OBS_EVENT_LOG", path)
+        bench._emit_bench_error(
+            "dense_blockmatmul_tflops_per_chip",
+            "probe timed out after 180s (relay wedge?)",
+            extra={"attempts": 4},
+            last_good={"tflops": 184.2, "when": "2026-07-30"})
+        [rec] = read_events(path)
+        assert rec["kind"] == "bench_error"
+        assert rec["attempts"] == 4
+        assert rec["last_known_good"]["tflops"] == 184.2
+
+    def test_summary_surfaces_last_error_per_metric(self, tmp_path):
+        from matrel_tpu.obs.history import render_summary, summarize
+        log = EventLog(str(tmp_path / "ev.jsonl"))
+        log.emit("bench", {"metric": "m1", "value": 10.0})
+        log.emit("bench_error", {"metric": "m1", "error": "older"})
+        log.emit("bench_error", {"metric": "m1", "error": "wedge #2",
+                                 "last_known_good": {"tflops": 99.0}})
+        events = read_events(log.path)
+        s = summarize(events)
+        assert s["bench_errors"]["m1"]["error"] == "wedge #2"  # last
+        text = render_summary(events)
+        assert "LAST BENCH ERROR [m1]: wedge #2" in text
+        assert "99.0" in text
+
+
+class TestPhaseQuantiles:
+    """Satellite: history --summary p50/p95 for optimize/trace/execute
+    per query kind, via the serve roll-up's nearest-rank helper."""
+
+    def _seed(self, tmp_path):
+        log = EventLog(str(tmp_path / "ev.jsonl"))
+        for i in range(10):
+            log.emit("query", {
+                "query_id": f"m{i}", "root_kind": "matmul",
+                "cache": "miss", "optimize_ms": float(i + 1),
+                "trace_ms": 2.0 * (i + 1),
+                "execute_ms": 10.0 * (i + 1),
+                "out_shape": [4, 4], "plan_cache": {}, "matmuls": []})
+        log.emit("query", {
+            "query_id": "a0", "root_kind": "agg", "cache": "miss",
+            "optimize_ms": 7.0, "trace_ms": None, "execute_ms": 3.0,
+            "out_shape": [1, 1], "plan_cache": {}, "matmuls": []})
+        return log.path
+
+    def test_quantiles_per_kind(self, tmp_path):
+        from matrel_tpu.obs.history import summarize
+        s = summarize(read_events(self._seed(tmp_path)))
+        pq = s["phase_quantiles"]
+        mm = pq["matmul"]
+        assert mm["count"] == 10
+        # nearest-rank over [1..10]: p50 -> 6th value, p95 -> 10th
+        assert mm["optimize_ms"]["p50"] == 6.0
+        assert mm["optimize_ms"]["p95"] == 10.0
+        assert mm["execute_ms"]["p95"] == 100.0
+        agg = pq["agg"]
+        assert agg["execute_ms"]["p50"] == 3.0
+        assert agg["trace_ms"]["p50"] is None   # Nones dropped, not 0
+
+    def test_render_shows_phase_table(self, tmp_path):
+        from matrel_tpu.obs.history import render_summary
+        out = render_summary(read_events(self._seed(tmp_path)))
+        assert "opt p50/p95" in out
+        assert "matmul" in out and "agg" in out
 
 
 class TestAxisBytesRollup:

@@ -26,6 +26,7 @@ Rule catalogue (each rule's class docstring is the authority):
   ML003  shard_map call without explicit out_specs
   ML004  direct MatrelConfig() construction inside the package
   ML005  cache dict keyed by sharding-spec-ish values
+  ML006  raw wall-clock timing in library code outside obs/
 """
 
 from __future__ import annotations
@@ -302,9 +303,52 @@ class SpecKeyedCacheRule(Rule):
                     "derived stable tuple instead")
 
 
+class RawTimingRule(Rule):
+    """ML006: raw ``time.perf_counter()``/``time.time()``/
+    ``time.monotonic()`` calls in library modules outside
+    ``matrel_tpu/obs/`` and ``utils/profiling.py``.
+
+    Timing that matters belongs in the observability layer: a span
+    (``obs.trace.span``/``phase``) or a ``StepTimer`` step, so the
+    measurement lands in the event log where ``history``, the chrome
+    exporter and the drift auditor can read it — a bare perf_counter
+    pair produces a number that dies in a local variable (or worse, a
+    print). The round-9 conversion moved every hot-path timing onto
+    spans; this rule keeps new code from regressing to private
+    stopwatches. ``parallel/autotune.py`` is scoped out wholesale —
+    it is the measurement subsystem, its wall-clocks ARE its output
+    and persist to the autotune table (the ML001 precedent: scope
+    encodes where the hazard is contextual). The two remaining
+    legitimate exceptions (the analyze-mode op_hook, the serve
+    queue-wait timestamps — both of which land their numbers in the
+    event log) carry inline suppressions with their justification."""
+
+    id = "ML006"
+    _DOTTED = ("time.perf_counter", "time.time", "time.monotonic")
+    _BARE = ("perf_counter", "monotonic")
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("matrel_tpu/")
+                and not relpath.startswith("matrel_tpu/obs/")
+                and relpath not in ("matrel_tpu/utils/profiling.py",
+                                    "matrel_tpu/parallel/autotune.py"))
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            if name in self._DOTTED or name in self._BARE:
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    f"raw `{name}()` timing in library code — route "
+                    "through obs.trace.span()/phase() or StepTimer so "
+                    "the measurement lands in the event log")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
-                        SpecKeyedCacheRule())
+                        SpecKeyedCacheRule(), RawTimingRule())
 
 
 def _suppressed_codes(line: str) -> set:

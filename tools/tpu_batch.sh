@@ -32,6 +32,8 @@ if [ "$DRY" = 1 ]; then
     export MATREL_PROGRESS_PATH="$DRY_DIR/progress.jsonl"
     export MATREL_SOAKLOG_PATH="$DRY_DIR/soaklog.jsonl"
     export MATREL_OBS_EVENT_LOG="$DRY_DIR/events.jsonl"
+    export MATREL_OBS_FLIGHT_RECORDER_PATH="$DRY_DIR/flight.json"
+    export MATREL_DRIFT_TABLE_PATH="$DRY_DIR/drift.json"
     export MATREL_BENCH_CPU_CACHE="$DRY_DIR/cpu_baseline.json"
     export MATREL_BENCH_LAST_GOOD="$DRY_DIR/bench_last_good.json"
     AUTOTUNE_TABLE="$DRY_DIR/autotune_dry.json"
@@ -65,6 +67,8 @@ log "--- bench_all.py (all BASELINE rows)"
 python bench_all.py
 log "--- topology_flip (ICI/DCN-weighted planner flip proof, staged this round)"
 python tools/topology_flip.py
+log "--- flight_drill (obs tier 2: flight recorder + chrome trace + drift smoke, staged this round)"
+python tools/flight_drill.py
 log "--- north_star_sweep (VERDICT #10 residual)"
 python tools/north_star_sweep.py
 log "--- gram_manual3 (symmetric-Gram microbench, BASELINE row 3 support)"
