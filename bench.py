@@ -76,6 +76,36 @@ def flops(n: int) -> float:
     return 2.0 * n * n * n
 
 
+def bf16_safe_chain_step(A, B):
+    """The ONE overflow-guarded chained bench step, shared by every row
+    that feeds a product back into the next multiply (the headline row,
+    the --precision tier rows): (C·B)·(2/N), NOT C·B.
+
+    With uniform[0,1) entries the bare product grows ~N/2× per multiply
+    (Perron eigenvalue N·mean), overflowing bf16 to inf well before the
+    45th repeat and turning the forced fetch into nan (round-2 VERDICT
+    weakness 4). The rescale fuses into the matmul epilogue (N² FLOPs
+    vs 2N³ — timing unaffected) and makes the step's dominant
+    eigenvalue 2·mean(B) ≈ 1, so the chain converges along the Perron
+    direction with O(1) entries and the fetch doubles as a correctness
+    canary (``check_chain_canary``). A and B are BlockMatrix; B must be
+    square (the chain feeds C back in as A)."""
+    n = B.shape[0]
+    return A.expr().multiply(B.expr()).multiply_scalar(2.0 / n)
+
+
+def check_chain_canary(canary) -> None:
+    """The guard's other half: mean|entry| of the final chain product
+    must be finite and O(1). inf/nan (overflow, garbage results) or a
+    collapsed/exploded scale means the multiply chain computed wrong
+    values and the timing is meaningless — fail the measure child
+    loudly so the harness reports a structured error, not a silent
+    wrong number."""
+    if not (np.isfinite(canary) and 1e-3 < canary < 1e3):
+        raise RuntimeError(
+            f"chain correctness canary out of band: mean|C| = {canary!r}")
+
+
 def measure_cpu_baseline() -> float:
     """numpy (BLAS) matmul TFLOPS on this host — the local[*] stand-in."""
     a = np.random.default_rng(0).standard_normal((N, N)).astype(np.float32)
@@ -144,15 +174,9 @@ def measure_tpu() -> dict:
     A = BlockMatrix.random((N, N), mesh=mesh, seed=0, dtype=DTYPE)
     B = BlockMatrix.random((N, N), mesh=mesh, seed=1, dtype=DTYPE)
     phases["setup_s"] = round(time.perf_counter() - t_phase, 3)
-    # the chained step computes (C·B)·(2/N), NOT C·B: with uniform[0,1)
-    # entries the product grows ~N/2× per multiply (Perron eigenvalue
-    # N·mean), overflowing bf16 to inf well before the 45th repeat and
-    # turning the forced fetch into nan (round-2 VERDICT weakness 4).
-    # The rescale fuses into the matmul epilogue (N² FLOPs vs 2N³ —
-    # timing unaffected) and makes the step's dominant eigenvalue
-    # 2·mean(B) ≈ 1, so the chain converges along the Perron direction
-    # with O(1) entries and the fetch doubles as a correctness canary.
-    step_expr = A.expr().multiply(B.expr()).multiply_scalar(2.0 / N)
+    # the ONE overflow-guarded chained step (bf16_safe_chain_step):
+    # rescaled so repeated accumulation cannot overflow bf16 to inf
+    step_expr = bf16_safe_chain_step(A, B)
     t_phase = time.perf_counter()
     plan = compile_expr(step_expr, mesh)
     a_leaf = plan.leaf_order[0]
@@ -203,14 +227,7 @@ def measure_tpu() -> dict:
             break
         reps *= 2
         escalations += 1
-    # canary: mean|entry| of the final chain product. The rescaled chain
-    # keeps it O(1); inf/nan (overflow, garbage results) or a collapsed/
-    # exploded scale means the multiply chain computed wrong values and
-    # the timing is meaningless — fail the measure child loudly so the
-    # harness reports a structured error, not a silent wrong number.
-    if not (np.isfinite(canary) and 1e-3 < canary < 1e3):
-        raise RuntimeError(
-            f"chain correctness canary out of band: mean|C| = {canary!r}")
+    check_chain_canary(canary)   # shared guard: see bf16_safe_chain_step
     phases["measure_s"] = round(time.perf_counter() - t_phase, 3)
     n_chips = max(1, len(mesh.devices.ravel()))
     interval = {
@@ -310,6 +327,136 @@ def measure_spgemm() -> dict:
     out["cmp_speedup"] = round(
         out["cmp_densify_ms"] / max(out["cmp_spgemm_ms"], 1e-9), 2)
     return out
+
+
+def measure_precision() -> dict:
+    """Precision-tier sweep (the ROADMAP item-3 acceptance row): the
+    dense flagship multiply at f32 vs bf16×1 vs bf16×3 vs int32, each
+    through the FULL stack under its explicit-dtype SLA, with a
+    measured max-abs-error column against an f64 numpy oracle and the
+    documented per-tier bound (planner.tier_error_bound) asserted
+    alongside. On CPU the MXU-rate win cannot show in wall-clock — the
+    row instead proves the SLA chooser picks tiers the cost model says
+    it should ("fast"→bf16x1, "high"→bf16x3, "exact"+integral→int32)
+    and that every tier's error sits inside its documented bound; the
+    TPU TFLOPS column lands via the staged tools/tpu_batch.sh step.
+
+    Float tiers time the SAME overflow-guarded chained step as the
+    headline row (bf16_safe_chain_step + check_chain_canary — the one
+    shared guard); the int32 tier times independent runs (an integer
+    chain cannot carry the 2/N rescale without leaving the integer
+    domain, and unrescaled integer products overflow int32 by design).
+    """
+    import jax
+    import jax.numpy as jnp
+    from matrel_tpu.config import MatrelConfig, set_default_config
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.executor import compile_expr
+    from matrel_tpu.parallel import planner
+
+    set_default_config(MatrelConfig(obs_level="off"))
+    mesh = mesh_lib.make_mesh()
+    n = _env_int("MATREL_PRECISION_N", 2048)
+    reps = _env_int("MATREL_PRECISION_REPEATS", 8)
+    n_chips = max(1, len(mesh.devices.ravel()))
+    rng = np.random.default_rng(0)
+    a = rng.random((n, n), dtype=np.float32)
+    b = rng.random((n, n), dtype=np.float32)
+    ai = rng.integers(0, 4, (n, n)).astype(np.float32)
+    bi = rng.integers(0, 4, (n, n)).astype(np.float32)
+    A = BlockMatrix.from_numpy(a, mesh=mesh)
+    B = BlockMatrix.from_numpy(b, mesh=mesh)
+    Ai = BlockMatrix.from_numpy(ai, mesh=mesh, integral=True)
+    Bi = BlockMatrix.from_numpy(bi, mesh=mesh, integral=True)
+    oracle = a.astype(np.float64) @ b.astype(np.float64)
+    oracle_i = ai.astype(np.int64) @ bi.astype(np.int64)
+    fetch = jax.jit(lambda x: jnp.mean(jnp.abs(x.astype(jnp.float32))))
+
+    def tier_error(cfg, Pa, Pb, want):
+        plan = compile_expr(Pa.expr().multiply(Pb.expr()), mesh, cfg)
+        got = plan.run().to_numpy().astype(np.float64)
+        stamped = plan.optimized.attrs.get("precision_tier")
+        return float(np.abs(got - want).max()), stamped
+
+    def time_chained(cfg):
+        plan = compile_expr(bf16_safe_chain_step(A, B), mesh, cfg)
+        a_leaf = plan.leaf_order[0]
+        step = plan.bound_runner(rebind_uids=(a_leaf.uid,))
+
+        def chained(r):
+            cur = step(A.data)
+            for _ in range(r - 1):
+                cur = step(cur)
+            return float(np.asarray(fetch(cur)))
+
+        chained(2)                       # warm both programs
+        lo, hi = 3, 3 + reps
+        ests = []
+        canary = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            chained(lo)
+            t_lo = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            canary = chained(hi)
+            t_hi = time.perf_counter() - t0
+            ests.append(max((t_hi - t_lo) / (hi - lo), 1e-9))
+        check_chain_canary(canary)       # the shared overflow guard
+        return sorted(ests)[1]
+
+    rows = []
+    all_ok = True
+    for tier, sla in (("f32", "float32"), ("bf16x1", "bfloat16"),
+                      ("bf16x3", "bf16x3"), ("int32", "int32")):
+        cfg = MatrelConfig(obs_level="off", precision_sla=sla)
+        integer = tier == "int32"
+        Pa, Pb = (Ai, Bi) if integer else (A, B)
+        want = oracle_i.astype(np.float64) if integer else oracle
+        amax = float(np.abs(ai if integer else a).max())
+        bmax = float(np.abs(bi if integer else b).max())
+        err, stamped = tier_error(cfg, Pa, Pb, want)
+        if integer:
+            plan = compile_expr(Ai.expr().multiply(Bi.expr()), mesh,
+                                cfg)
+
+            def run_once(p=plan):
+                float(np.asarray(fetch(p.run().data)))
+
+            dt = _median_s(run_once, reps=3)
+        else:
+            dt = time_chained(cfg)
+        bound = planner.tier_error_bound(tier, n, amax, bmax)
+        # int tiers are EXACT: the bound is literal zero
+        ok = err <= bound if bound > 0 else err == 0.0
+        all_ok = all_ok and ok
+        rows.append({
+            "tier": tier, "sla": sla, "stamped_tier": stamped,
+            "est_passes": planner.TIER_PASSES[tier],
+            "median_ms": round(dt * 1e3, 3),
+            "tflops_per_chip": round(flops(n) / dt / 1e12 / n_chips,
+                                     3),
+            "max_abs_err": err,
+            "err_bound": bound,
+            "within_bound": ok,
+        })
+    # the SLA chooser's picks on the flagship shape — the CPU-visible
+    # half of the acceptance: the cost model must route each named SLA
+    # to the tier its pass/byte billing says is cheapest-satisfying
+    choices = {}
+    for sla, Pa, Pb in (("exact", A, B), ("high", A, B),
+                        ("fast", A, B), ("exact_int", Ai, Bi)):
+        cfg = MatrelConfig(obs_level="off",
+                           precision_sla=sla.replace("_int", ""))
+        ann = planner.annotate_strategies(
+            Pa.expr().multiply(Pb.expr()), mesh, cfg)
+        choices[sla] = ann.attrs.get("precision_tier")
+    chooser_ok = (choices.get("exact") == "f32"
+                  and choices.get("high") == "bf16x3"
+                  and choices.get("fast") == "bf16x1"
+                  and choices.get("exact_int") == "int32")
+    return {"n": n, "rows": rows, "sla_choices": choices,
+            "chooser_ok": chooser_ok, "all_within_bound": all_ok}
 
 
 def measure_serve() -> dict:
@@ -828,6 +975,24 @@ def main_serve() -> None:
     print(json.dumps(record))
 
 
+def main_precision() -> None:
+    """Wedge-safe precision-tier row capture (tools/tpu_batch.sh step):
+    probe, then the measurement child under a hard timeout; one
+    parseable JSON line either way, rc 0 — same contract as the
+    headline metric."""
+    ok, payload = _run_child("probe", PROBE_TIMEOUT_S)
+    if ok:
+        ok, payload = _run_child("precision", MEASURE_TIMEOUT_S)
+    record = {"metric": "precision_tier_sweep"}
+    if ok and isinstance(payload, dict):
+        record.update(payload)
+        _emit_bench_event(dict(record))
+    else:
+        record.update({"value": None, "error": str(payload)[:500]})
+        _emit_bench_error(record["metric"], str(payload))
+    print(json.dumps(record))
+
+
 def main_spgemm() -> None:
     """Wedge-safe SpGEMM row capture (tools/tpu_batch.sh step): probe,
     then the measurement child under a hard timeout; one parseable JSON
@@ -855,10 +1020,14 @@ if __name__ == "__main__":
         print(json.dumps(measure_spgemm()))
     elif "--_serve" in sys.argv:
         print(json.dumps(measure_serve()))
+    elif "--_precision" in sys.argv:
+        print(json.dumps(measure_precision()))
     elif "--spgemm" in sys.argv:
         main_spgemm()
     elif "--serve" in sys.argv:
         main_serve()
+    elif "--precision" in sys.argv:
+        main_precision()
     elif "--cpu-rows" in sys.argv:
         # host-only (no jax, relay-safe): BASELINE rows 2-6 + the
         # SpGEMM row's CPU reference column, cached in cpu_baseline.json

@@ -68,6 +68,15 @@ def bench_serve(mesh, cfg):
     return {"metric": "serve_repeated_traffic_qps", **payload}
 
 
+def bench_precision(mesh, cfg):
+    """Precision-tier sweep: f32 vs bf16x1 vs bf16x3 vs int32 on the
+    dense flagship multiply, TFLOPS + measured max-abs-error vs an f64
+    oracle per tier (see bench.measure_precision)."""
+    import bench
+    payload = bench.measure_precision()
+    return {"metric": "precision_tier_sweep", **payload}
+
+
 def bench_chain(mesh, cfg):
     import jax.numpy as jnp
     import jax
@@ -367,11 +376,12 @@ def main():
     # step order, the JSON contract and the harness glue, not the
     # numbers.
     dry = bool(os.environ.get("MATREL_DRY"))
-    dry_rows = (bench_dense_4k, bench_chain, bench_spgemm, bench_serve)
+    dry_rows = (bench_dense_4k, bench_chain, bench_spgemm, bench_serve,
+                bench_precision)
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
-               bench_spgemm, bench_serve, bench_pagerank,
-               bench_pagerank_10x, bench_cg, bench_eigen,
-               bench_triangles, bench_north_star):
+               bench_spgemm, bench_serve, bench_precision,
+               bench_pagerank, bench_pagerank_10x, bench_cg,
+               bench_eigen, bench_triangles, bench_north_star):
         if dry and fn not in dry_rows:
             print(json.dumps({"metric": fn.__name__, "skipped": "dry"}),
                   flush=True)

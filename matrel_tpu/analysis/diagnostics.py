@@ -17,6 +17,8 @@ Code space (stable — tests and suppressions key on them):
   MV105  per-device HBM working set over budget        (error)
   MV106  dominant collective rides the slow mesh axis  (warning)
   MV107  result-cache stamp disagrees with the cache   (warning)
+  MV108  precision tier violates the query's accuracy
+         SLA, or int tier on unprovable operands       (error)
 """
 
 from __future__ import annotations

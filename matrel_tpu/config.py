@@ -166,6 +166,25 @@ class MatrelConfig:
         query N; past this depth the admission loop blocks on the
         oldest batch so host planning never runs unboundedly ahead of
         the device.
+      precision_sla: the session-default per-query accuracy SLA for
+        precision-tiered matmul execution (parallel/planner.py tier
+        chooser; docs/PRECISION.md). "default" (the default) disables
+        tiering entirely — no tier is ever stamped and every lowering
+        is bit-identical to the pre-tier engine (plan snapshots
+        unchanged). The named SLAs: "exact" (no accuracy loss vs
+        today's f32/HIGHEST path; integer-shaped workloads route to
+        the exact int32 MXU path), "high" (~f32 accuracy allowed —
+        the bf16 k-pass split-summation tier, arXiv:2112.09017),
+        "fast" (single-pass bf16 MXU rate; documented bf16 error
+        bound). An explicit dtype ("float32", "bfloat16", "bf16x3",
+        "int32", "int8") pins the tier directly. Per-query override:
+        ``session.run(expr, precision=...)`` (also run_many/submit,
+        and SQL's ``... PRECISION 'fast'`` clause).
+      precision_enable_bf16: allow the bf16 tiers (bf16x1/bf16x3) in
+        the SLA chooser. Off → "high"/"fast" degrade to f32. Explicit
+        dtype SLAs bypass the gate (an explicit ask is an ask).
+      precision_enable_int: same gate for the integer-exact tiers
+        (int32/int8).
       axis_cost_weights: per-mesh-axis relative inverse-bandwidth
         weights for the planner's comm model (core/mesh.MeshTopology):
         a collective leg over axis i is billed bytes × weights[i], so
@@ -215,6 +234,9 @@ class MatrelConfig:
     verify_plans: str = "off"
     hbm_budget_bytes: int = 16 << 30
     axis_cost_weights: Tuple[float, float] = (1.0, 1.0)
+    precision_sla: str = "default"
+    precision_enable_bf16: bool = True
+    precision_enable_int: bool = True
 
     def __post_init__(self):
         # enablement is "anything != off", so an unvalidated typo/case
@@ -269,6 +291,13 @@ class MatrelConfig:
                 f"(per mesh axis), got {self.axis_cost_weights!r}")
         object.__setattr__(self, "axis_cost_weights",
                            (float(w[0]), float(w[1])))
+        # the SLA vocabulary gates NUMERICS, not just performance: an
+        # unvalidated typo ("fasst") would silently run the default
+        # path while the caller believes a bound was requested — or
+        # worse, a misspelled "exact" would tier DOWN. Reject at
+        # construction (case-insensitive, "bf16" normalised).
+        object.__setattr__(self, "precision_sla",
+                           normalize_sla(self.precision_sla))
 
     def replace(self, **kw: Any) -> "MatrelConfig":
         return dataclasses.replace(self, **kw)
@@ -308,6 +337,29 @@ class MatrelConfig:
         if unknown:
             raise KeyError(f"unknown MatrelConfig keys: {sorted(unknown)}")
         return cfg.replace(**dict(d))
+
+
+#: The per-query accuracy-SLA vocabulary (docs/PRECISION.md): named
+#: levels plus the explicit-dtype spellings that pin one tier.
+PRECISION_SLAS = ("default", "exact", "high", "fast",
+                  "float32", "bfloat16", "bf16x3", "int32", "int8")
+
+
+def normalize_sla(sla) -> str:
+    """Validate + normalise one precision-SLA value (config field or
+    per-query ``precision=`` argument). None → "default"."""
+    if sla is None:
+        return "default"
+    s = str(sla).lower().strip()
+    if s in ("bf16", "bfloat16"):
+        s = "bfloat16"
+    if s == "f32":
+        s = "float32"
+    if s not in PRECISION_SLAS:
+        raise ValueError(
+            f"precision SLA must be one of {PRECISION_SLAS} (or 'bf16'/"
+            f"'f32' aliases), got {sla!r}")
+    return s
 
 
 _default_config = MatrelConfig.from_env()

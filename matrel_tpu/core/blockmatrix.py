@@ -50,6 +50,20 @@ class BlockMatrix:
       nnz: estimated number of structural nonzeros in the logical region,
         or None for "assume dense".
       block_size: logical tile edge for cost-model granularity.
+      integral: every entry is an exact integer representable in f32 —
+        the static fact the precision-tier planner's integer-exactness
+        inference reads (ir/stats.infer_integral), so an "exact"
+        accuracy SLA can route integer-shaped workloads (adjacency
+        matrices, counts, boolean joins) onto the exact int32/int8 MXU
+        paths. Auto-detected by from_numpy for integer/bool sources;
+        declare it explicitly for integer-valued float data.
+      int_abs_max: max|entry| of an integral matrix, recorded at
+        construction (from_numpy computes it for integral sources) —
+        the magnitude half of the exactness proof: the planner only
+        auto-picks an int tier when the accumulated product provably
+        fits the int32 accumulator (ir/stats.integral_abs_bound), so
+        "exact" can never silently wrap. None = unproven (the chooser
+        conservatively keeps f32).
     """
 
     data: Array
@@ -58,6 +72,8 @@ class BlockMatrix:
     spec: P
     nnz: Optional[int] = None
     block_size: int = 512
+    integral: bool = False
+    int_abs_max: Optional[float] = None
 
     # -- basic properties ---------------------------------------------------
 
@@ -100,8 +116,20 @@ class BlockMatrix:
         dtype: Any = None,
         config: Optional[MatrelConfig] = None,
         nnz: Optional[int] = None,
+        integral: Optional[bool] = None,
     ) -> "BlockMatrix":
         cfg = config or default_config()
+        if integral is None:
+            # integer/bool sources are integer-valued by construction;
+            # float sources need the caller's word (checking every
+            # entry would cost an O(n) host pass per construction)
+            integral = bool(np.issubdtype(arr.dtype, np.integer)
+                            or arr.dtype == np.bool_)
+        # magnitude proof for the int-tier overflow gate — one O(n)
+        # host max, noise next to the device_put copy, only for the
+        # (rare) integral sources that can use it
+        int_abs_max = (float(np.abs(arr).max()) if integral and arr.size
+                       else (0.0 if integral else None))
         if arr.ndim == 1:
             arr = arr.reshape(-1, 1)
         if arr.ndim != 2:
@@ -120,7 +148,8 @@ class BlockMatrix:
             padded = np.asarray(arr, dtype=dtype)
         data = jax.device_put(padded, NamedSharding(mesh, spec))
         return cls(data=data, shape=shape, mesh=mesh, spec=spec, nnz=nnz,
-                   block_size=cfg.block_size)
+                   block_size=cfg.block_size, integral=bool(integral),
+                   int_abs_max=int_abs_max)
 
     @classmethod
     def from_array(

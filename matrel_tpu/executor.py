@@ -138,6 +138,9 @@ class Lowerer:
                 label = node.kind
                 if node.kind == "matmul":
                     label += ":" + node.attrs.get("strategy", "xla")
+                    tier = node.attrs.get("precision_tier")
+                    if tier is not None:    # tiered lowering: the
+                        label += f"@{tier}"  # per-op label says so
                 if self.op_hook is not None:
                     child_time.append(0.0)
                     t0 = time.perf_counter()  # matlint: disable=ML006 analyze-mode op_hook measurement — lands in analyze events
@@ -529,6 +532,11 @@ class Lowerer:
             gram = ("AtA", r)
         elif r.kind == "transpose" and self._same_operand(r.children[0], l):
             gram = ("AAt", l)
+        # a stamped precision tier OWNS the matmul's numerics — the
+        # config-level matmul_precision="high" gram shortcut must not
+        # second-guess it (the tier path below emits its own passes)
+        if node.attrs.get("precision_tier") is not None:
+            gram = None
         if gram is not None and self.config.matmul_precision == "high":
             side, base = gram
             x = ev(base)
@@ -554,6 +562,20 @@ class Lowerer:
                 return symmetric_gram(x, mm).astype(jnp.float32)
         a, b = ev(node.children[0]), ev(node.children[1])
         strategy = node.attrs.get("strategy", "xla")
+        tier = node.attrs.get("precision_tier")
+        if tier is not None and tier != "f32":
+            # precision-tiered execution (ops/precision.py): the
+            # multi-pass decomposition runs every pass through the SAME
+            # stamped strategy recipe, so tiering composes with the
+            # distribution plan. Dispatch stays at this one site — the
+            # annotate() wrapper above already labels it. The tier owns
+            # the output dtype (int tiers keep their exact int32
+            # accumulator; bf16 tiers return the f32 accumulation), so
+            # the keep_input_dtype cast below does not apply.
+            from matrel_tpu.ops import precision as precision_lib
+            mm = lambda p, q: strategies.run_matmul(
+                strategy, p, q, self.mesh, self.config)
+            return precision_lib.tiered_matmul(tier, a, b, mm)
         out = strategies.run_matmul(strategy, a, b, self.mesh, self.config)
         if (self.config.keep_input_dtype and a.dtype == b.dtype
                 and out.dtype != a.dtype):
@@ -1037,6 +1059,39 @@ class MultiPlan:
         return jfn
 
 
+def _precision_meta(opts, cfg) -> Optional[Dict]:
+    """Plan-level precision metadata for ``plan.meta`` (obs events /
+    explain): the query SLA, the stamped tier census, and the
+    documented worst-case relative error bound over every tiered
+    matmul (TIER_EPS · k — the bound bench/soak assert against). None
+    under the "default" SLA, so the default compile path pays zero
+    extra walks (the bit-identity contract)."""
+    if cfg.precision_sla == "default":
+        return None
+    from matrel_tpu.parallel import planner as planner_mod
+    tiers: Dict[str, int] = {}
+    bound = [0.0]
+    seen: set = set()
+
+    def walk(n: MatExpr):
+        if n.uid in seen:
+            return
+        seen.add(n.uid)
+        for c in n.children:
+            walk(c)
+        t = n.attrs.get("precision_tier")
+        if n.kind == "matmul" and t is not None:
+            tiers[t] = tiers.get(t, 0) + 1
+            eps = planner_mod.TIER_EPS.get(t)
+            if eps:
+                bound[0] = max(bound[0], eps * n.children[0].shape[1])
+
+    for o in opts:
+        walk(o)
+    return {"sla": cfg.precision_sla, "tiers": tiers,
+            "est_rel_err_bound": bound[0]}
+
+
 def _verify_plans(opts, mesh, cfg) -> Optional[List[dict]]:
     """Run the static verifier (matrel_tpu/analysis/) over annotated
     roots when ``config.verify_plans`` asks for it — PRE-execution,
@@ -1104,6 +1159,9 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
             "rule_hits": rule_hits}
     if verify_diags is not None:
         meta["diagnostics"] = verify_diags
+    prec_meta = _precision_meta(opts, cfg)
+    if prec_meta is not None:
+        meta["precision"] = prec_meta
     return MultiPlan(jitted=jax.jit(fn), leaf_order=leaf_order,
                      optimized=opts, mesh=mesh, config=cfg,
                      extra_args=extra, meta=meta)
@@ -1315,6 +1373,9 @@ def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
             "rule_hits": rule_hits}
     if verify_diags is not None:
         meta["diagnostics"] = verify_diags
+    prec_meta = _precision_meta((opt,), cfg)
+    if prec_meta is not None:
+        meta["precision"] = prec_meta
     return CompiledPlan(jitted=jitted, leaf_order=leaf_order, optimized=opt,
                         mesh=mesh, config=cfg, extra_args=extra, meta=meta)
 

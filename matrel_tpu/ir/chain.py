@@ -73,7 +73,16 @@ def optimal_order(operands: List[MatExpr],
     if mesh is not None and gx * gy > 1:
         from matrel_tpu.core import mesh as mesh_lib
         weights = mesh_lib.axis_weights(mesh, config)
-    if n >= 3:
+    # precision tier (round 8): under a non-default SLA the query's
+    # MACs retire at the tier's MXU rate, so the comm term weighs
+    # relatively more — the DP's FLOP side scales by the tier factor
+    # (planner.sla_compute_factor; 1.0 under "default", bit-identical).
+    # The native DP mirror predates tiers, so scaled requests run the
+    # Python DP — degrade to the reference implementation, never to
+    # dishonest pricing (the weighted-topology precedent).
+    from matrel_tpu.parallel import planner as _planner   # lazy: no cycle
+    flop_scale = _planner.sla_compute_factor(config)
+    if n >= 3 and flop_scale == 1.0:
         from matrel_tpu.utils import native
         dims = [op.shape[0] for op in operands] + [operands[-1].shape[1]]
         dens = [op.density for op in operands]
@@ -106,7 +115,7 @@ def optimal_order(operands: List[MatExpr],
                 step, lay = stats.chain_step_cost_layout(
                     el.shape[0], el.shape[1], er.shape[1],
                     el.density, er.density, gx, gy, ll, lr,
-                    weights=weights,
+                    weights=weights, flop_scale=flop_scale,
                 )
                 total = cl + cr + step
                 if cand is None or total < cand[0]:

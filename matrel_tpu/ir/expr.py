@@ -505,6 +505,8 @@ def pretty(e: MatExpr, indent: int = 0, mesh=None,
         extra = f" strategy={e.attrs['strategy']}"
         if "strategy_source" in e.attrs:
             extra += f"[{e.attrs['strategy_source']}]"
+        if "precision_tier" in e.attrs:
+            extra += f" precision={e.attrs['precision_tier']}"
     elif e.kind in ("join_rows", "join_cols") and "replicate" in e.attrs:
         extra = f" replicate={e.attrs['replicate']}"
     elif e.kind == "join_value":

@@ -60,6 +60,193 @@ def matmul_cost(
     return 2.0 * n * k * m * da * db
 
 
+HBM_FLOPS_PER_BYTE = 120.0
+"""Blend factor converting HBM bytes into f32-FLOP-equivalents for the
+precision-tier cost model (planner.tier_matmul_cost): a v5e chip
+retires ~98e12 f32-class FLOP/s against ~819 GB/s of HBM, so ~120 f32
+FLOPs buy the time of one HBM byte. Order-of-magnitude, like
+COMM_FLOPS_PER_BYTE below — the term makes bandwidth-bound shapes rank
+half-width bf16 operand traffic honestly against pass counts."""
+
+
+def integral_abs_bound(node, memo: dict = None):
+    """Conservative upper bound on max|entry| of a provably-integral
+    expression, or None when no bound can be proven. The magnitude
+    half of the integer-exactness story: :func:`infer_integral` proves
+    entries are integers, this proves HOW BIG — the int-tier chooser
+    only auto-picks int32 when the accumulated product
+    k·bound(A)·bound(B) provably fits the int32 accumulator, so
+    "exact" can never silently wrap (the review-round overflow hole).
+    Leaf bounds come from ``BlockMatrix.int_abs_max`` (recorded by
+    from_numpy for integral sources); anything unproven is None and
+    the chooser conservatively keeps f32. Duck-typed like
+    infer_integral; pass a shared ``memo`` to amortise across a
+    planning pass."""
+    if memo is None:
+        memo = {}
+
+    def walk(n):
+        key = ("bound", n.uid)
+        if key in memo:
+            return memo[key]
+        memo[key] = got = _bound(n)
+        return got
+
+    def _mix(vals, fn):
+        if any(v is None for v in vals):
+            return None
+        return float(fn(vals))
+
+    def _bound(n):
+        k = n.kind
+        if k in ("leaf", "sparse_leaf", "coo_leaf"):
+            v = getattr(n.attrs.get("matrix"), "int_abs_max", None)
+            return float(v) if v is not None else None
+        if k in ("transpose", "select_index", "select_block", "vec"):
+            return walk(n.children[0])
+        if k == "select_value":
+            return _mix([walk(n.children[0]),
+                         abs(float(n.attrs.get("fill", 0.0)))], max)
+        if k == "matmul":
+            ba, bb = walk(n.children[0]), walk(n.children[1])
+            if ba is None or bb is None:
+                return None
+            return float(n.children[0].shape[1]) * ba * bb
+        if k == "elemwise":
+            op = n.attrs.get("op")
+            vals = [walk(c) for c in n.children]
+            if op in ("add", "sub"):
+                return _mix(vals, sum)
+            if op == "mul":
+                return _mix(vals, lambda v: v[0] * v[1])
+            if op in ("min", "max"):
+                return _mix(vals, max)
+            return None
+        if k == "scalar":
+            op, v = n.attrs["op"], abs(float(n.attrs["value"]))
+            b = walk(n.children[0])
+            if b is None:
+                return None
+            if op == "add":
+                return b + v
+            if op == "mul":
+                return b * v
+            if op == "pow" and v >= 1:
+                return b ** v
+            return None
+        if k == "agg":
+            kind, axis = n.attrs["agg"], n.attrs["axis"]
+            c = n.children[0]
+            b = walk(c)
+            if kind == "count":
+                return float(max(c.shape[0] * c.shape[1], 1))
+            if b is None:
+                return None
+            if kind in ("max", "min"):
+                return b
+            if kind == "sum":
+                terms = {"row": c.shape[1], "col": c.shape[0],
+                         "all": c.shape[0] * c.shape[1],
+                         "diag": min(c.shape)}[axis]
+                return float(terms) * b
+            return None
+        if k == "rank1":
+            ba, bu, bv = (walk(c) for c in n.children)
+            if None in (ba, bu, bv):
+                return None
+            return ba + bu * bv
+        if k == "join_index":
+            mk = n.attrs.get("merge_kind")
+            vals = [walk(c) for c in n.children]
+            if mk == "add":
+                return _mix(vals, sum)
+            if mk == "mul":
+                return _mix(vals, lambda v: v[0] * v[1])
+            if mk in ("left", "right"):
+                return _mix(vals, max)
+            return None
+        return None
+
+    return walk(node)
+
+
+def infer_integral(node, memo: dict = None) -> bool:
+    """Is this expression provably INTEGER-VALUED (every entry an exact
+    integer representable in f32)? The static inference that lets an
+    "exact" precision SLA route integer-shaped workloads (triangle
+    counting, PageRank iteration counts, boolean semiring joins) onto
+    the exact int32/int8 MXU tiers instead of conservatively pinning
+    f32 (docs/PRECISION.md). Duck-typed over MatExpr (kind/children/
+    attrs) — expr.py imports this module, not vice versa.
+
+    Conservative by construction: False whenever exactness cannot be
+    proven, so a float workload can never be silently truncated. Leaf
+    integrality comes from ``BlockMatrix.integral`` (auto-detected for
+    integer/bool numpy sources, or declared by the caller). Pass a
+    shared ``memo`` dict to amortise the walk across a planning pass
+    (the infer_dtype precedent — per-node fresh memos made deep-chain
+    annotation O(nodes²), review r8). The memo is shared with
+    :func:`integral_abs_bound` (distinct key spaces)."""
+    if memo is None:
+        memo = {}
+
+    def walk(n) -> bool:
+        key = ("int", n.uid)
+        got = memo.get(key)
+        if got is None:
+            memo[key] = got = _integral(n)
+        return got
+
+    def _integral(n) -> bool:
+        k = n.kind
+        if k in ("leaf", "sparse_leaf", "coo_leaf"):
+            return bool(getattr(n.attrs.get("matrix"), "integral",
+                                False))
+        if k in ("transpose", "select_index", "select_block", "vec"):
+            return walk(n.children[0])
+        if k == "select_value":
+            # non-matching entries become the fill value
+            fill = float(n.attrs.get("fill", 0.0))
+            return fill.is_integer() and walk(n.children[0])
+        if k == "matmul":
+            # a bf16-tiered product of integers is NOT integer-valued:
+            # the bf16 passes round (the tier is stamped bottom-up
+            # before any consumer asks, so the claim is read here)
+            if n.attrs.get("precision_tier") in ("bf16x1", "bf16x3"):
+                return False
+            return all(walk(c) for c in n.children)
+        if k == "elemwise":
+            if n.attrs.get("op") == "div":
+                return False
+            return all(walk(c) for c in n.children)
+        if k == "scalar":
+            op, v = n.attrs["op"], float(n.attrs["value"])
+            if op in ("add", "mul"):
+                return v.is_integer() and walk(n.children[0])
+            if op == "pow":
+                return v.is_integer() and v >= 1 and walk(n.children[0])
+            return False
+        if k == "agg":
+            kind = n.attrs["agg"]
+            if kind == "count":
+                return True          # nonzero counts are integers
+            if kind in ("sum", "max", "min"):
+                return walk(n.children[0])
+            return False             # avg divides
+        if k == "rank1":
+            return all(walk(c) for c in n.children)
+        if k in ("join_index", "join_rows", "join_cols", "join_value"):
+            # structured merges are closed over integers; callables are
+            # black boxes
+            if n.attrs.get("merge_kind") in ("left", "right", "add",
+                                             "mul"):
+                return all(walk(c) for c in n.children)
+            return False
+        return False
+
+    return walk(node)
+
+
 COMM_FLOPS_PER_BYTE = 1000.0
 """Blend factor converting ICI bytes into FLOP-equivalents for the
 chain DP's step cost: a v5e chip retires ~200e12 bf16 FLOP/s against
@@ -126,13 +313,19 @@ def chain_step_cost(n: int, k: int, m: int, da: float, db: float,
 
 def chain_step_cost_layout(n: int, k: int, m: int, da: float, db: float,
                            gx: int, gy: int, la: str, lb: str,
-                           weights: tuple = (1.0, 1.0)) -> tuple:
+                           weights: tuple = (1.0, 1.0),
+                           flop_scale: float = 1.0) -> tuple:
     """(step cost, output layout): chain_step_cost with per-layout,
     topology-weighted comm terms — the layout-aware DP's step (round 5;
-    weights round 7)."""
+    weights round 7). ``flop_scale`` (round 8) is the precision tier's
+    relative MXU time per MAC (planner.sla_compute_factor): a "fast"
+    bf16 query retires its FLOPs faster, so the comm term weighs
+    relatively MORE and the DP may legitimately prefer a different
+    parenthesisation. 1.0 (the default, and every "default"-SLA query)
+    is bit-identical to the pre-tier step cost."""
     comm, lay = comm_proxy_layout(n, k, m, da, db, gx, gy, la=la, lb=lb,
                                   weights=weights)
-    return (matmul_cost(n, k, m, da, db)
+    return (matmul_cost(n, k, m, da, db) * flop_scale
             + COMM_FLOPS_PER_BYTE * comm), lay
 
 

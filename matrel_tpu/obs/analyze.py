@@ -113,6 +113,11 @@ def render(plan, per_op: Dict[int, Tuple[str, float]],
         line = f"{pad}{n.kind}{extra} shape={n.shape}{ms}"
         d = decisions.get(n.uid)
         if d is not None:
+            if d.get("precision_tier"):
+                # chosen precision tier + the pass count the cost
+                # model billed (docs/PRECISION.md)
+                line += (f" tier={d['precision_tier']}"
+                         f"x{d.get('est_passes', '?')}")
             if d.get("est_ici_bytes") is not None:
                 line += (f" est_ici={_fmt_bytes(d['est_ici_bytes'])}"
                          f" flops={d['flops']:.3g}")

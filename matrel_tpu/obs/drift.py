@@ -68,11 +68,23 @@ def _strategy_key(d: dict) -> str:
     """Decision record → calibration strategy name. Pure-strategy
     matmuls use the stamped strategy; sparse/COO dispatches (which
     bypass the byte model) audit under their dispatch name so SpGEMM's
-    est_saved_flops drift is visible without polluting strategy rows."""
+    est_saved_flops drift is visible without polluting strategy rows.
+
+    A stamped precision tier joins the key (``rmm@bf16x3``): tiered
+    passes retire MACs at a different MXU rate, so a bf16 ms_per_gflop
+    blended into the f32 row — or a bf16 sample ranked against an f32
+    one — would poison both the calibration and the rank-order flags.
+    Untier records keep the historical bare-strategy key, so existing
+    persisted tables merge unchanged."""
     disp = d.get("dispatch")
     if disp:
-        return f"dispatch:{disp}"
-    return d.get("strategy", "?")
+        key = f"dispatch:{disp}"
+    else:
+        key = d.get("strategy", "?")
+    tier = d.get("precision_tier")
+    if tier:
+        key += f"@{tier}"
+    return key
 
 
 def _est_bytes(d: dict):
@@ -123,6 +135,11 @@ def _sample(d: dict, ms: float, backend: str, source: str) -> dict:
     return {"strategy": _strategy_key(d),
             "class": shape_class(d.get("dims") or ()),
             "backend": backend,
+            # the tier is ALSO a population dimension of its own:
+            # rank_flags groups on it, so a bf16 sample is never
+            # rank-compared against an f32 one (their ms/byte ratios
+            # differ by the MXU-rate gap, not by model drift)
+            "tier": d.get("precision_tier") or "",
             "flops": float(d.get("flops") or 0.0),
             "est_bytes": _est_bytes(d),
             "ms": ms,
@@ -183,12 +200,15 @@ def rank_flags(samples: List[dict]) -> List[dict]:
     for s in samples:
         if s["est_bytes"] is None:
             continue            # dispatch records have no byte ranking
-        g = groups.setdefault((s["class"], s["backend"]), {})
+        # tier joins the population key: rank-order is only meaningful
+        # between strategies executing at the SAME precision tier
+        g = groups.setdefault(
+            (s["class"], s["backend"], s.get("tier") or ""), {})
         row = g.setdefault(s["strategy"], {"_ms": [], "_est": []})
         row["_ms"].append(s["ms"])
         row["_est"].append(s["est_bytes"])
     flags: List[dict] = []
-    for (cls, backend), g in sorted(groups.items()):
+    for (cls, backend, _tier), g in sorted(groups.items()):
         if len(g) < 2:
             continue
         meds = {name: (_median(row["_est"]), _median(row["_ms"]),

@@ -52,8 +52,19 @@ def summarize(events: List[dict]) -> dict:
                if isinstance(e.get("execute_ms"), (int, float))]
     strategies: Dict[str, dict] = {}
     rule_hits: Dict[str, int] = {}
+    tiers: Dict[str, dict] = {}
     for e in qs:
         for d in e.get("matmuls", []):
+            # precision-tier roll-up (round 8): chosen tier + the pass
+            # counts the cost model billed, so a tier-selection
+            # regression (an "exact" stream suddenly running bf16)
+            # surfaces in `history --summary`
+            t = d.get("precision_tier")
+            if t:
+                row = tiers.setdefault(t, {"count": 0, "passes": 0})
+                row["count"] += 1
+                if isinstance(d.get("est_passes"), int):
+                    row["passes"] += d["est_passes"]
             s = strategies.setdefault(
                 d.get("strategy", "?"),
                 {"count": 0, "flops": 0.0, "est_ici_bytes": 0.0})
@@ -98,6 +109,7 @@ def summarize(events: List[dict]) -> dict:
         "phase_quantiles": _phase_quantiles(qs),
         "plan_cache": last_cache,
         "strategies": strategies,
+        "precision_tiers": tiers,
         "rule_hits": rule_hits,
         "bench_runs": sum(1 for e in events if e.get("kind") == "bench"),
         "bench_errors": _last_bench_errors(events),
@@ -271,6 +283,11 @@ def render_summary(events: List[dict]) -> str:
                          f"{d.get('est_saved_hbm_bytes', 0) / 2**20:.1f}"
                          f" MiB HBM")
             lines.append(line)
+    if s.get("precision_tiers"):
+        lines.append("")
+        lines.append("precision tiers: " + ", ".join(
+            f"{t}={d['count']} ({d['passes']} passes)"
+            for t, d in sorted(s["precision_tiers"].items())))
     if s["rule_hits"]:
         lines.append("")
         lines.append("rewrite-rule hits: " + ", ".join(

@@ -559,6 +559,14 @@ def infer_dtype(node: MatExpr, config: Optional[MatrelConfig] = None,
                  "select_index", "select_block"):
             return walk(n.children[0])
         if k == "matmul":
+            # a stamped integer tier keeps its int32 accumulator as the
+            # RESULT dtype (the exact integer algebra flows to
+            # consumers — aggregates, further int-tier products —
+            # without a lossy f32 round-trip); bf16 tiers accumulate
+            # f32 and store the f32 input dtype, same as the default
+            # lowering, so only the int tiers change the answer here
+            if n.attrs.get("precision_tier") in ("int32", "int8"):
+                return np.dtype("int32")
             da, db = walk(n.children[0]), walk(n.children[1])
             if da is None or db is None:
                 return None
@@ -589,6 +597,268 @@ def infer_dtype(node: MatExpr, config: Optional[MatrelConfig] = None,
         return None
 
     return walk(node)
+
+
+# -- precision tiers (round 8: per-query accuracy SLAs) --------------------
+#
+# Precision is a first-class planner dimension (ROADMAP open item 3;
+# "Large Scale Distributed Linear Algebra With TPUs", arXiv:2112.09017):
+# the MXU's native numeric format is bf16, and f32-class accuracy is
+# RECOVERABLE from bf16 passes by splitting each f32 operand into bf16
+# slices (hi = bf16(x), lo = bf16(x − hi)) and accumulating the
+# significant cross-products in f32 — keeping hi·hi + hi·lo + lo·hi
+# (3 MXU passes) drops only the ~2^-16-relative lo·lo term. Integer-
+# shaped workloads (triangle counts, PageRank iteration counts, boolean
+# semiring joins) are EXACT on the int paths. The chooser below picks
+# the cheapest tier that satisfies the query's SLA; the lowering
+# (executor._matmul → ops/precision.py) emits the multi-pass
+# decomposition; the vocabulary/cost tables here are the one source of
+# truth for the cost model, matmul_decisions, and MV108.
+
+#: Tier vocabulary. "f32" = today's single full-precision product
+#: (config.matmul_precision, i.e. XLA's 6-pass bf16 emulation on TPU);
+#: "bf16x1" = one native bf16 MXU pass; "bf16x3" = the 3-pass
+#: split-summation correction (~f32 accuracy); "int32"/"int8" =
+#: integer-exact MXU paths (int32 accumulate).
+PRECISION_TIERS = ("f32", "bf16x1", "bf16x3", "int32", "int8")
+
+#: MXU passes a tier's lowering emits per matmul — the est pass count
+#: matmul_decisions records. f32 counts XLA's HIGHEST-precision 6-pass
+#: bf16 emulation of an f32 dot on the MXU (the TPU cost model the
+#: planner targets; on CPU backends f32 is one native pass and the
+#: numbers are a modelling convention, not a measurement).
+TIER_PASSES = {"f32": 6, "bf16x1": 1, "bf16x3": 3, "int32": 1,
+               "int8": 1}
+
+#: Relative MXU time per MAC (f32-single-pass-rate units): bf16 passes
+#: run at 2× the f32-class rate, so time = passes / 2 for the bf16
+#: tiers; int8 runs at 4× (the int8 MXU path); int32 is conservatively
+#: f32-class. This is the "3× the MACs at 2× the MXU rate" billing —
+#: the model prices real pass counts, never a free speedup.
+TIER_COMPUTE_UNITS = {"f32": 3.0, "bf16x1": 0.5, "bf16x3": 1.5,
+                      "int32": 1.0, "int8": 0.25}
+
+#: HBM bytes per operand element a tier's lowering reads: bf16x1
+#: streams half-width operands; bf16x3 keeps BOTH bf16 slices resident
+#: (hi + lo = 4 B — the split halves the per-pass bytes, not the
+#: total); int8 quarters them.
+TIER_ITEMSIZE = {"f32": 4, "bf16x1": 2, "bf16x3": 4, "int32": 4,
+                 "int8": 1}
+
+#: Documented per-MAC relative error bound of each tier (docs/
+#: PRECISION.md): max-abs error of an (n,k)x(k,m) product is bounded by
+#: TIER_EPS[tier] · k · max|A| · max|B|. The int tiers are EXACT for
+#: integer-valued operands whose products/sums fit int32 (and, for the
+#: f32-stored result, 2^24).
+TIER_EPS = {"f32": 2.0 ** -20, "bf16x1": 2.0 ** -8,
+            "bf16x3": 2.0 ** -15, "int32": 0.0, "int8": 0.0}
+
+#: Explicit-dtype SLA spellings → the tier they pin.
+_DTYPE_SLA_TIER = {"float32": "f32", "bfloat16": "bf16x1",
+                   "bf16x3": "bf16x3", "int32": "int32", "int8": "int8"}
+
+
+def tier_matmul_cost(tier: str, n: int, k: int, m: int,
+                     da: float = 1.0, db: float = 1.0) -> float:
+    """Estimated execution cost of one (n×k)·(k×m) multiply at a
+    precision tier, in f32-FLOP-equivalents: the REAL per-pass MAC work
+    (sparsity-credited, scaled by the tier's relative MXU time) plus
+    the per-tier HBM operand/output traffic in FLOP-equivalents. This
+    is the quantity the SLA chooser ranks tiers by — a 3-pass bf16
+    multiply is billed 1.5× the single-pass f32-rate MACs at half the
+    per-pass operand bytes, not assumed free."""
+    from matrel_tpu.ir import stats
+    compute = (stats.matmul_cost(n, k, m, da, db)
+               * TIER_COMPUTE_UNITS[tier])
+    isz = TIER_ITEMSIZE[tier]
+    hbm = (n * k * max(da, 0.0) + k * m * max(db, 0.0)) * isz \
+        + n * m * 4.0                     # result stored full-width
+    return compute + stats.HBM_FLOPS_PER_BYTE * hbm
+
+
+def tier_error_bound(tier: str, k: int, amax: float = 1.0,
+                     bmax: float = 1.0) -> float:
+    """Documented max-abs error bound of a k-deep product at a tier
+    (TIER_EPS closed form) — shared by bench.py --precision and the
+    soak battery so the asserted bound IS the documented one."""
+    return TIER_EPS[tier] * float(k) * float(amax) * float(bmax)
+
+
+def sla_allowed_tiers(sla: str, integral: bool,
+                      config: Optional[MatrelConfig] = None) -> tuple:
+    """Tiers admissible under an SLA for a dense float-f32 matmul whose
+    operands are (``integral``=True) provably integer-valued. The SLA
+    is an accuracy FLOOR — every allowed tier meets or beats it:
+
+      exact  f32 always; int tiers when integral (integer-exact).
+      high   + bf16x3 (~f32 accuracy at bf16 MXU rate).
+      fast   + bf16x1 (documented bf16 bound).
+      <dtype> exactly the pinned tier (bypasses the enable gates:
+              an explicit ask is an ask).
+
+    Tier enable flags (config.precision_enable_bf16/_int) drop their
+    families from the NAMED levels; "default" returns () — nothing is
+    ever stamped, the pre-tier lowering runs bit-identically.
+    """
+    cfg = config or default_config()
+    if sla == "default":
+        return ()
+    pinned = _DTYPE_SLA_TIER.get(sla)
+    if pinned is not None:
+        return (pinned,)
+    tiers = ["f32"]
+    if cfg.precision_enable_int and integral:
+        tiers.append("int32")
+    if cfg.precision_enable_bf16:
+        if sla in ("high", "fast"):
+            tiers.append("bf16x3")
+        if sla == "fast":
+            tiers.append("bf16x1")
+    return tuple(tiers)
+
+
+def sla_compute_factor(config: Optional[MatrelConfig] = None) -> float:
+    """Relative MXU time per MAC of the tier a dense float matmul would
+    run at under the session SLA, vs the default lowering — the
+    ``flop_scale`` the chain DP's step cost uses so parenthesisation
+    ranks honestly when the query's FLOPs retire at bf16 rate
+    (ir/chain.optimal_order; 1.0 under "default", bit-identical)."""
+    cfg = config or default_config()
+    tiers = sla_allowed_tiers(cfg.precision_sla, False, cfg)
+    if not tiers:
+        return 1.0
+    best = min(tiers, key=lambda t: TIER_COMPUTE_UNITS[t])
+    return TIER_COMPUTE_UNITS[best] / TIER_COMPUTE_UNITS["f32"]
+
+
+#: Largest accumulated |value| the int32 tiers may provably reach: the
+#: int32 accumulator's range. The chooser only auto-picks an int tier
+#: when k*bound(A)*bound(B) (stats.integral_abs_bound) fits -- "exact"
+#: must never silently wrap (review r8).
+INT32_ACC_MAX = float(2 ** 31 - 1)
+
+
+def int_tier_fits(node: MatExpr, tier: str,
+                  integral_memo: Optional[dict] = None) -> bool:
+    """Is an int tier PROVABLY overflow-free for this matmul? The
+    accumulated product is bounded by k*bound(A)*bound(B)
+    (stats.integral_abs_bound); int8 additionally needs each operand's
+    entries to fit the int8 cast. Unknown bounds -> False (the chooser
+    conservatively keeps f32; an unprovable explicit int pin is MV108's
+    business). Shared by the chooser and the MV108 pass so gate and
+    verifier cannot disagree."""
+    from matrel_tpu.ir import stats
+    a, b = node.children
+    ba = stats.integral_abs_bound(a, integral_memo)
+    bb = stats.integral_abs_bound(b, integral_memo)
+    if ba is None or bb is None:
+        return False
+    if tier == "int8" and (ba > 127.0 or bb > 127.0):
+        return False
+
+    def exact_operand(child, bound) -> bool:
+        # a FLOAT-computed integral operand is only exactly integer
+        # while it fits f32's contiguous-integer range (2^24); an
+        # int-tiered product carries int32 exactness instead
+        if child.attrs.get("precision_tier") in ("int32", "int8"):
+            return bound <= INT32_ACC_MAX
+        return bound <= 2.0 ** 24
+
+    if not (exact_operand(a, ba) and exact_operand(b, bb)):
+        return False
+    return a.shape[1] * ba * bb <= INT32_ACC_MAX
+
+
+def choose_precision_tier(node: MatExpr,
+                          config: Optional[MatrelConfig] = None,
+                          dtype_memo: Optional[dict] = None,
+                          integral_memo: Optional[dict] = None
+                          ) -> Optional[str]:
+    """The tier one matmul node will execute at under the session SLA,
+    or None for the default (untier) lowering. None whenever the node
+    is not a dense product the tier lowering owns:
+
+    - "default" SLA: nothing is ever stamped (bit-identity contract);
+    - sparse/COO dispatches (SpGEMM, SpMV, SpMM): their kernels own
+      their numerics (bf16-split passes, f32 accumulate) already;
+    - statically-unknown operand dtypes: no claim without proof;
+    - non-f32 floats (bf16 leaves): already at MXU-native width.
+
+    Integer algebra stays closed: when BOTH operands are provably
+    integer-valued (integer dtype from an inner int-tier product, OR an
+    integral f32 leaf -- any mix), the exact int32 tier continues,
+    gated by the int32-accumulator overflow proof (int_tier_fits) --
+    an unprovable magnitude keeps f32, never a silent wrap. Explicit
+    int dtype SLAs pin their tier on integer data (the caller's
+    claim); a float pin on integer data stamps nothing (the untier
+    promotion runs).
+
+    Among the SLA's admissible tiers (sla_allowed_tiers) the cheapest
+    by tier_matmul_cost wins, deterministic ties by vocabulary order.
+    ``integral_memo`` amortises the integrality/magnitude walks across
+    a planning pass (the dtype-memo precedent -- review r8).
+    """
+    import numpy as np
+    cfg = config or default_config()
+    sla = cfg.precision_sla
+    if sla == "default" or node.kind != "matmul":
+        return None
+    a, b = node.children
+    if _spgemm_matmul(node, cfg) or any(
+            c.kind in ("sparse_leaf", "coo_leaf") for c in node.children):
+        return None
+    da = infer_dtype(a, cfg, dtype_memo)
+    db = infer_dtype(b, cfg, dtype_memo)
+    if da is None or db is None:
+        return None
+    da, db = np.dtype(da), np.dtype(db)
+    f32 = np.dtype("float32")
+
+    def _ok(d):
+        return d == f32 or np.issubdtype(d, np.integer)
+
+    if not (_ok(da) and _ok(db)):
+        return None
+    from matrel_tpu.ir import stats
+    pinned = _DTYPE_SLA_TIER.get(sla)
+    any_int_dtype = (np.issubdtype(da, np.integer)
+                     or np.issubdtype(db, np.integer))
+    if any_int_dtype:
+        # integer-dtype operands ARE integral (inner int-tier
+        # products); a mixed f32 side must prove its own integrality
+        # for the exact algebra to continue
+        integral = all(
+            np.issubdtype(d, np.integer)
+            or stats.infer_integral(c, integral_memo)
+            for d, c in ((da, a), (db, b)))
+        if pinned in ("int32", "int8"):
+            return pinned            # explicit ask: the caller's claim
+        if pinned is not None:
+            return None              # float pin on int data: untier
+        if integral and cfg.precision_enable_int \
+                and int_tier_fits(node, "int32", integral_memo):
+            return "int32"
+        return None
+    integral = stats.infer_integral(node, integral_memo)
+    tiers = sla_allowed_tiers(sla, integral, cfg)
+    # the overflow proof gates the AUTO int pick; an explicit int pin
+    # stays (MV108 warns/errors on unprovable or overflowing stamps)
+    if pinned is None:
+        tiers = tuple(t for t in tiers
+                      if t not in ("int32", "int8")
+                      or int_tier_fits(node, t, integral_memo))
+    if not tiers:
+        return None
+    n, k = a.shape
+    m = b.shape[1]
+    dens_a = a.density if a.density is not None else 1.0
+    dens_b = b.density if b.density is not None else 1.0
+    best, best_cost = None, None
+    for t in tiers:
+        c = tier_matmul_cost(t, n, k, m, dens_a, dens_b)
+        if best_cost is None or c < best_cost:
+            best, best_cost = t, c
+    return best
 
 
 def strategy_hbm_bytes(strategy: str, pn: int, pk: int, pm: int,
@@ -854,6 +1124,13 @@ def choose_strategy_ex(node: MatExpr, mesh: Mesh,
     # one mirror of that); unknown dtypes assume f32
     dt_out = infer_dtype(node, cfg, dtype_memo)
     isz = np.dtype(dt_out).itemsize if dt_out is not None else 4
+    # a stamped precision tier changes the operand WIDTH the strategy's
+    # working set is built from (bf16x1 replicates half the bytes, so
+    # plans the f32 budget refuses become feasible; int8 a quarter) —
+    # the gate must see the tier's real itemsize, not the f32 one
+    tier = node.attrs.get("precision_tier")
+    if tier in TIER_ITEMSIZE:
+        isz = TIER_ITEMSIZE[tier]
     cands = {s: c for s, c in cands.items()
              if admissible(s, pn, pk, pm, gx, gy, itemsize=isz,
                            hbm_budget_bytes=cfg.hbm_budget_bytes)}
@@ -1105,7 +1382,8 @@ def annotate_strategies(e: MatExpr, mesh: Mesh,
                         _layout_memo: Optional[dict] = None,
                         _consumer_hint: Optional[str] = None,
                         _root_scale: float = 1.0,
-                        _root_swap: bool = False) -> MatExpr:
+                        _root_swap: bool = False,
+                        _integral_memo: Optional[dict] = None) -> MatExpr:
     """Bottom-up pass stamping attrs['strategy'] on every matmul node
     and attrs['replicate'] on every row/col index join. One dtype memo
     and one layout memo are threaded through the whole pass and seeded
@@ -1118,14 +1396,27 @@ def annotate_strategies(e: MatExpr, mesh: Mesh,
     its lowering really pays there (_root_reshard_cost)."""
     memo = {} if _dtype_memo is None else _dtype_memo
     lmemo = {} if _layout_memo is None else _layout_memo
+    imemo = {} if _integral_memo is None else _integral_memo
     hints = _child_layout_hints(e, mesh, config, dtype_memo=memo)
     swap = _root_swap != (e.kind == "transpose")   # odd transposes flip
     new_children = tuple(
         annotate_strategies(c, mesh, config, memo, lmemo, h,
-                            _child_root_scale(e, i, _root_scale), swap)
+                            _child_root_scale(e, i, _root_scale), swap,
+                            imemo)
         for i, (c, h) in enumerate(zip(e.children, hints)))
     if any(nc is not oc for nc, oc in zip(new_children, e.children)):
         e = e.with_children(new_children)
+    if e.kind == "matmul" and "precision_tier" not in e.attrs:
+        # tier BEFORE strategy: the strategy choice's HBM-feasibility
+        # gate reads the stamped tier's operand itemsize. Under the
+        # "default" SLA choose_precision_tier returns None and nothing
+        # is stamped — the bit-identity contract (plan snapshots
+        # unchanged, zero new attrs). The shared integral memo keeps
+        # the integrality/magnitude walks O(nodes) over deep chains.
+        tier = choose_precision_tier(e, config, dtype_memo=memo,
+                                     integral_memo=imemo)
+        if tier is not None:
+            e = e.with_attrs(precision_tier=tier)
     if e.kind == "matmul" and "strategy" not in e.attrs:
         strat, source = choose_strategy_ex(e, mesh, config,
                                            dtype_memo=memo,
@@ -1178,6 +1469,19 @@ def matmul_decisions(root: MatExpr, mesh: Mesh,
                "strategy": n.attrs.get("strategy", "xla"),
                "source": n.attrs.get("strategy_source", "unknown"),
                "flops": 2.0 * nn * kk * mm}
+        tier = n.attrs.get("precision_tier")
+        if tier is not None:
+            # the chosen precision tier + what it really costs/promises
+            # (obs events, explain(analyze=True), history --summary,
+            # the drift auditor's tier-keyed calibration rows)
+            rec["precision_tier"] = tier
+            rec["est_passes"] = TIER_PASSES.get(tier)
+            rec["est_tier_cost"] = tier_matmul_cost(
+                tier, nn, kk, mm,
+                a.density if a.density is not None else 1.0,
+                b.density if b.density is not None else 1.0) \
+                if tier in TIER_COMPUTE_UNITS else None
+            rec["est_rel_err"] = TIER_EPS.get(tier)
         # result-cache reuse (serve/): an operand that entered planning
         # as a materialized-result leaf never re-pays its subplan — the
         # decision record says which side(s) got that credit, so the

@@ -55,6 +55,12 @@ def _acc_dtype(a, b):
     # accumulate bf16 inputs in f32 on the MXU
     if a.dtype == jnp.bfloat16 or b.dtype == jnp.bfloat16:
         return jnp.float32
+    # integer inputs accumulate at least int32 (the MXU's int8×int8→
+    # int32 contract; an int8 accumulator would wrap on the first k>1
+    # contraction) — the precision-tier int paths rely on this
+    if (jnp.issubdtype(a.dtype, jnp.integer)
+            and jnp.issubdtype(b.dtype, jnp.integer)):
+        return jnp.result_type(a.dtype, b.dtype, jnp.int32)
     return jnp.result_type(a.dtype, b.dtype)
 
 

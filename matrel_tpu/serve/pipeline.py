@@ -54,12 +54,15 @@ class ServePipeline:
 
     # -- public surface ----------------------------------------------------
 
-    def submit(self, expr) -> Future:
-        """Enqueue one query; returns its future."""
+    def submit(self, expr, sla: str = "default") -> Future:
+        """Enqueue one query; returns its future. ``sla`` is the
+        query's precision SLA — the admission worker only coalesces
+        same-SLA queries into one MultiPlan (one planning config per
+        batch; mixed SLAs run as separate sub-batches)."""
         fut: Future = Future()
         # enqueue timestamp, not a measurement: its delta lands in the
         # serve event record as queue_wait_ms
-        self._q.put((expr, fut, time.perf_counter()))  # matlint: disable=ML006 queue-wait timestamp — lands in the serve event record
+        self._q.put((expr, fut, time.perf_counter(), sla))  # matlint: disable=ML006 queue-wait timestamp — lands in the serve event record
         self._ensure_worker()
         return fut
 
@@ -105,6 +108,10 @@ class ServePipeline:
                     pulled.append(self._q.get_nowait())
                 except queue.Empty:
                     break
+            # normalise legacy 3-tuple entries (pre-SLA white-box
+            # callers enqueue (expr, fut, t_enq)) to the 4-tuple shape
+            pulled = [it if len(it) > 3 else (*it, "default")
+                      for it in pulled]
             # transition each future to RUNNING; a future the caller
             # cancelled while queued drops out here (and can no longer
             # be cancelled mid-flight) — set_result on a cancelled
@@ -113,55 +120,69 @@ class ServePipeline:
             batch = [it for it in pulled
                      if it[1].set_running_or_notify_cancel()]
             t_admit = time.perf_counter()  # matlint: disable=ML006 queue-wait timestamp — lands in the serve event record
-            waits_ms = [round((t_admit - t_enq) * 1e3, 3)
-                        for _, _, t_enq in batch]
+            # same-SLA sub-batches, admission order preserved: one
+            # MultiPlan compiles under ONE planning config, so a
+            # "fast" submission must never ride an "exact" query's
+            # batch (precision SLAs are per query, not per batch)
+            groups: "collections.OrderedDict" = collections.OrderedDict()
+            for it in batch:
+                groups.setdefault(it[3], []).append(it)
             try:
-                if batch:
-                    # worker-thread tracer activation: the admission
-                    # span is the serve trail's root — run_many's
-                    # batch/plan/execute spans parent-link under it,
-                    # so a chrome export shows queue bubbles next to
-                    # compile/execute overlap
-                    with trace_lib.activate(
-                            getattr(self.session, "_tracer", None)), \
-                            trace_lib.span(
-                                "serve.admit", batch=len(batch),
-                                inflight=len(self._inflight),
-                                max_wait_ms=(max(waits_ms)
-                                             if waits_ms else 0.0)):
-                        outs = self.session.run_many(
-                            [e for e, _, _ in batch],
-                            _queue_wait_ms=waits_ms,
-                            _inflight_depth=len(self._inflight))
-                else:
-                    outs = []
-            except Exception as ex:  # noqa: BLE001 — any planning/
-                # compile failure fails every future of the batch; the
-                # worker survives to serve the next one
-                dump = getattr(self.session, "_flight_auto_dump", None)
-                if dump is not None:
-                    # the post-mortem trail for a failed serve batch
-                    # (no-op when the flight recorder is off)
-                    dump(ex, reason="serve_batch_failure")
-                for _, fut, _ in batch:
-                    if not fut.done():
-                        fut.set_exception(ex)
-            else:
-                for (_, fut, _), out in zip(batch, outs):
-                    if not fut.done():
-                        fut.set_result(out)
-                if outs:
-                    self._inflight.append(outs)
-                while len(self._inflight) > self.max_inflight:
-                    # backpressure: sync the OLDEST dispatched batch
-                    # before admitting more host-side planning
-                    try:
-                        _sync(self._inflight.popleft())
-                    except IndexError:
-                        break
+                for sla, part in groups.items():
+                    self._admit_group(sla, part, t_admit)
             finally:
                 for _ in pulled:
                     self._q.task_done()
+
+    def _admit_group(self, sla: str, batch: list,
+                     t_admit: float) -> None:
+        """Run one same-SLA sub-batch through session.run_many and
+        resolve its futures; a planning/compile failure fails only
+        THIS group's futures and the worker survives."""
+        waits_ms = [round((t_admit - t_enq) * 1e3, 3)
+                    for _, _, t_enq, _ in batch]
+        try:
+            # worker-thread tracer activation: the admission
+            # span is the serve trail's root — run_many's
+            # batch/plan/execute spans parent-link under it,
+            # so a chrome export shows queue bubbles next to
+            # compile/execute overlap
+            with trace_lib.activate(
+                    getattr(self.session, "_tracer", None)), \
+                    trace_lib.span(
+                        "serve.admit", batch=len(batch),
+                        inflight=len(self._inflight),
+                        max_wait_ms=(max(waits_ms)
+                                     if waits_ms else 0.0)):
+                outs = self.session.run_many(
+                    [e for e, _, _, _ in batch],
+                    precision=sla,
+                    _queue_wait_ms=waits_ms,
+                    _inflight_depth=len(self._inflight))
+        except Exception as ex:  # noqa: BLE001 — any planning/
+            # compile failure fails every future of the batch; the
+            # worker survives to serve the next one
+            dump = getattr(self.session, "_flight_auto_dump", None)
+            if dump is not None:
+                # the post-mortem trail for a failed serve batch
+                # (no-op when the flight recorder is off)
+                dump(ex, reason="serve_batch_failure")
+            for _, fut, _, _ in batch:
+                if not fut.done():
+                    fut.set_exception(ex)
+        else:
+            for (_, fut, _, _), out in zip(batch, outs):
+                if not fut.done():
+                    fut.set_result(out)
+            if outs:
+                self._inflight.append(outs)
+            while len(self._inflight) > self.max_inflight:
+                # backpressure: sync the OLDEST dispatched batch
+                # before admitting more host-side planning
+                try:
+                    _sync(self._inflight.popleft())
+                except IndexError:
+                    break
 
 
 def _sync(outs) -> None:

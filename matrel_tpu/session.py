@@ -26,7 +26,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from matrel_tpu import executor as executor_lib
-from matrel_tpu.config import MatrelConfig, default_config
+from matrel_tpu.config import MatrelConfig, default_config, normalize_sla
 from matrel_tpu.core import mesh as mesh_lib
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.ir.expr import MatExpr, as_expr
@@ -188,16 +188,44 @@ class MatrelSession:
 
     # -- actions ------------------------------------------------------------
 
-    def compile(self, expr: MatExpr) -> executor_lib.CompiledPlan:
-        return self._compile_entry(as_expr(expr))[0]
+    def compile(self, expr: MatExpr,
+                precision: Optional[str] = None
+                ) -> executor_lib.CompiledPlan:
+        e = as_expr(expr)
+        return self._compile_entry(e, sla=self._resolve_sla(precision,
+                                                            e))[0]
 
-    def _compile_entry(self, e: MatExpr
+    # -- precision SLA resolution (docs/PRECISION.md) ----------------------
+
+    def _resolve_sla(self, precision, e: Optional[MatExpr] = None) -> str:
+        """One query's effective precision SLA: the explicit
+        ``precision=`` argument beats a SQL ``PRECISION '...'`` clause
+        (stamped out-of-band by sql.parse_sql) beats the session
+        default (config.precision_sla)."""
+        if precision is not None:
+            return normalize_sla(precision)
+        sql_sla = getattr(e, "_sql_precision", None) if e is not None \
+            else None
+        if sql_sla is not None:
+            return sql_sla            # parse_sql already normalised
+        return self.config.precision_sla
+
+    def _sla_config(self, sla: str) -> MatrelConfig:
+        """The config a query at this SLA compiles under — the session
+        config itself when they agree (the common case: no dataclass
+        churn on the hot path)."""
+        if sla == self.config.precision_sla:
+            return self.config
+        return self.config.replace(precision_sla=sla)
+
+    def _compile_entry(self, e: MatExpr, sla: Optional[str] = None
                        ) -> Tuple[executor_lib.CompiledPlan, bool, str]:
         """(plan, cache_hit, key) — the compile path with its cache
         outcome exposed, so compute() can emit hit/miss events without
         a second key computation."""
+        sla = sla if sla is not None else self.config.precision_sla
         key, pins = _plan_key(e)
-        key = self._axisw_prefix() + key
+        key = self._axisw_prefix() + _prec_prefix(sla) + key
         with self._compile_lock:
             plan = self._plan_cache.get(key)
             if plan is not None:
@@ -205,7 +233,7 @@ class MatrelSession:
                 return plan, True, key
             try:
                 plan = executor_lib.compile_expr(e, self.mesh,
-                                                 self.config)
+                                                 self._sla_config(sla))
             except Exception as ex:
                 # post-mortem trail BEFORE the error propagates: a
                 # VerificationError / compile failure in the field
@@ -237,7 +265,8 @@ class MatrelSession:
             return ""
         return f"axisw:{wts[0]:g}x{wts[1]:g}|"
 
-    def _compile_multi_entry(self, roots: List[MatExpr]
+    def _compile_multi_entry(self, roots: List[MatExpr],
+                             sla: Optional[str] = None
                              ) -> Tuple["executor_lib.MultiPlan", bool,
                                         List[str]]:
         """(multiplan, cache_hit, per-root keys) — the MultiPlan twin
@@ -249,6 +278,7 @@ class MatrelSession:
         instead of recompiling every call. The cached plan remembers
         its root-key order (``_root_keys``) so callers can map outputs
         back to their own root order."""
+        sla = sla if sla is not None else self.config.precision_sla
         keyed = []
         pins_all: list = []
         for e in roots:
@@ -259,7 +289,7 @@ class MatrelSession:
         for k, e in zip(keyed, roots):
             uniq.setdefault(k, e)
         skeys = sorted(uniq)
-        mkey = ("multi:" + self._axisw_prefix()
+        mkey = ("multi:" + self._axisw_prefix() + _prec_prefix(sla)
                 + "||".join(skeys))
         with self._compile_lock:
             plan = self._plan_cache.get(mkey)
@@ -268,7 +298,8 @@ class MatrelSession:
                 return plan, True, keyed
             try:
                 plan = executor_lib.compile_exprs(
-                    [uniq[k] for k in skeys], self.mesh, self.config)
+                    [uniq[k] for k in skeys], self.mesh,
+                    self._sla_config(sla))
             except Exception as ex:
                 self._flight_auto_dump(ex)   # same trail as the
                 raise                        # single-plan entry
@@ -316,18 +347,25 @@ class MatrelSession:
         info["max_entries"] = self.config.result_cache_max_entries
         return info
 
-    def _rc_admit(self, e: MatExpr):
+    def _rc_admit(self, e: MatExpr, prefix: str = ""):
         """One result-cache admission for a query: (entry-or-None,
         root key, pins, possibly-substituted expr). ONE structural walk
         (_plan_key_spans) serves both the root-level consult — a hit
         answers without compiling or executing anything — and, on a
-        miss, every interior probe of the substitution pass."""
+        miss, every interior probe of the substitution pass.
+
+        ``prefix`` carries the query's precision-tier isolation
+        (_prec_prefix): every consult, interior probe AND insertion
+        keys under it, so a ``"fast"`` entry can never answer an
+        ``"exact"`` query (or vice versa) — accuracy SLAs partition
+        the cache, they do not share it."""
         parts, pins, spans = _plan_key_spans(e)
-        key = "|".join(parts)
+        key = prefix + "|".join(parts)
         ent = self._result_cache.lookup(key)
         if ent is not None:
             return ent, key, pins, e
-        return None, key, pins, self._rc_substitute(e, parts, spans)
+        return None, key, pins, self._rc_substitute(e, parts, spans,
+                                                    prefix)
 
     def _rc_leaf(self, ent: CacheEntry) -> MatExpr:
         """Lift a cache entry into planning as an already-laid-out
@@ -347,14 +385,17 @@ class MatrelSession:
         })
 
     def _rc_substitute(self, e: MatExpr, parts: Optional[list] = None,
-                       spans: Optional[dict] = None) -> MatExpr:
+                       spans: Optional[dict] = None,
+                       prefix: str = "") -> MatExpr:
         """Replace every cached INTERIOR subexpression with its result
         leaf (top-down; a hit stops the descent — everything under it
         is already paid for). The root is the caller's business
         (:meth:`_rc_admit`). ``parts``/``spans`` come from the
         admission's single ``_plan_key_spans`` walk, so each interior
         probe is a slice join, not a fresh subtree walk; a bare call
-        (tests, external callers) computes its own."""
+        (tests, external callers) computes its own. ``prefix`` is the
+        admission's precision-tier isolation prefix — interior probes
+        only ever hit entries computed under the SAME SLA."""
         if not e.children:
             return e
         if parts is None or spans is None:
@@ -367,12 +408,13 @@ class MatrelSession:
                 new_children.append(c)
                 continue
             s, t = spans[c.uid]
-            ent = self._result_cache.probe("|".join(parts[s:t]))
+            ent = self._result_cache.probe(
+                prefix + "|".join(parts[s:t]))
             if ent is not None:
                 new_children.append(self._rc_leaf(ent))
                 changed = True
                 continue
-            nc = self._rc_substitute(c, parts, spans)
+            nc = self._rc_substitute(c, parts, spans, prefix)
             changed = changed or (nc is not c)
             new_children.append(nc)
         return e.with_children(tuple(new_children)) if changed else e
@@ -668,8 +710,14 @@ class MatrelSession:
             log.warning("obs: query event dropped", exc_info=True)
         return out
 
-    def compute(self, expr: MatExpr) -> BlockMatrix:
+    def compute(self, expr: MatExpr,
+                precision: Optional[str] = None) -> BlockMatrix:
+        """Execute one query. ``precision`` is the per-query accuracy
+        SLA ("exact"/"high"/"fast"/explicit dtype — docs/PRECISION.md);
+        None defers to a SQL PRECISION clause, then
+        ``config.precision_sla``."""
         e = as_expr(expr)
+        sla = self._resolve_sla(precision, e)
         rc = self._rc_enabled()
         if (not rc and not self._obs_enabled()
                 and self._tracer is None):
@@ -678,20 +726,22 @@ class MatrelSession:
             # beyond the plan cache's own (the obs_level="off" /
             # result_cache_max_bytes=0 / flight-recorder-off contract
             # bench.py relies on)
-            return self.compile(e).run()
+            return self._compile_entry(e, sla=sla)[0].run()
         # per-thread tracer activation: executor compile phases and
         # every span below parent-link into this query's trail
         with trace_lib.activate(self._tracer), \
                 trace_lib.span("query", root_kind=e.kind):
-            return self._compute_observed(e, rc)
+            return self._compute_observed(e, rc, sla)
 
-    def _compute_observed(self, e: MatExpr, rc: bool) -> BlockMatrix:
+    def _compute_observed(self, e: MatExpr, rc: bool,
+                          sla: Optional[str] = None) -> BlockMatrix:
         """compute() behind the fast-path gate: result-cache admission,
         compile, execute — each scoped by a tracing span."""
+        sla = sla if sla is not None else self.config.precision_sla
         key = pins = None
         if rc:
             with trace_lib.span("rc.probe") as sp:
-                ent, key, pins, e = self._rc_admit(e)
+                ent, key, pins, e = self._rc_admit(e, _prec_prefix(sla))
                 sp.set(hit=ent is not None)
             if ent is not None:
                 # repeated query: answered from the materialized-result
@@ -704,7 +754,7 @@ class MatrelSession:
                                     exc_info=True)
                 return ent.result
         with trace_lib.span("plan"):
-            plan, hit, pkey = self._compile_entry(e)
+            plan, hit, pkey = self._compile_entry(e, sla=sla)
         if self._obs_enabled():
             out = self._run_observed(e, plan, hit, pkey)
         else:
@@ -721,7 +771,8 @@ class MatrelSession:
 
     # -- micro-batched admission + async pipeline (serve/) -----------------
 
-    def run_many(self, exprs, _queue_wait_ms=None,
+    def run_many(self, exprs, precision: Optional[str] = None,
+                 _queue_wait_ms=None,
                  _inflight_depth: int = 0) -> List[BlockMatrix]:
         """Execute several queries as ONE micro-batched admission: the
         batch compiles into a single MultiPlan (one fusion and CSE
@@ -732,29 +783,40 @@ class MatrelSession:
         enter planning as already-laid-out leaves. Results come back in
         input order.
 
+        ``precision`` is the batch-level accuracy SLA — ONE MultiPlan
+        means one planning config, so the whole batch shares it (the
+        serve pipeline groups mixed-SLA submissions into same-SLA
+        batches before calling here).
+
         The underscore parameters are the serve pipeline's channel for
         queue-wait/in-flight observability; direct callers leave them
         alone."""
         es = [as_expr(x) for x in exprs]
         if not es:
             return []
+        sla = (normalize_sla(precision) if precision is not None
+               else self.config.precision_sla)
         rc = self._rc_enabled()
         obs = self._obs_enabled()
         with trace_lib.activate(self._tracer), \
                 trace_lib.span("serve.batch", size=len(es)) as sp_batch:
             return self._run_many_observed(es, rc, obs, sp_batch,
                                            _queue_wait_ms,
-                                           _inflight_depth)
+                                           _inflight_depth, sla)
 
     def _run_many_observed(self, es, rc, obs, sp_batch, _queue_wait_ms,
-                           _inflight_depth) -> List[BlockMatrix]:
+                           _inflight_depth,
+                           sla: Optional[str] = None
+                           ) -> List[BlockMatrix]:
+        sla = sla if sla is not None else self.config.precision_sla
         results: dict = {}
         rc_meta: dict = {}
         pend: list = []
         for i, e in enumerate(es):
             if rc:
                 with trace_lib.span("rc.probe", index=i) as sp:
-                    ent, key, pins, e = self._rc_admit(e)
+                    ent, key, pins, e = self._rc_admit(
+                        e, _prec_prefix(sla))
                     sp.set(hit=ent is not None)
                 if ent is not None:
                     results[i] = ent.result
@@ -772,7 +834,7 @@ class MatrelSession:
         if pend:
             with trace_lib.span("plan", roots=len(pend)):
                 plan, plan_hit, keys = self._compile_multi_entry(
-                    [e for _, e in pend])
+                    [e for _, e in pend], sla=sla)
             pos = {k: j for j, k in enumerate(plan._root_keys)}
             # the batch's execute span: under obs the sync happens
             # INSIDE it (dur = device wall); flight-recorder-only runs
@@ -832,13 +894,16 @@ class MatrelSession:
                 log.warning("obs: serve event dropped", exc_info=True)
         return [results[i] for i in range(len(es))]
 
-    def submit(self, expr):
+    def submit(self, expr, precision: Optional[str] = None):
         """Asynchronous query admission: returns a
         ``concurrent.futures.Future`` resolving to the BlockMatrix.
         Concurrent submissions coalesce into micro-batches
         (``config.serve_max_batch``) and JAX's async dispatch overlaps
         device execution with host planning of the next batch, bounded
-        by ``config.serve_max_inflight`` (serve/pipeline.py)."""
+        by ``config.serve_max_inflight`` (serve/pipeline.py).
+        ``precision`` rides each submission: the admission worker only
+        coalesces SAME-SLA queries into one MultiPlan, so a "fast"
+        neighbour can never change an "exact" query's numerics."""
         if self._serve is None:
             from matrel_tpu.serve.pipeline import ServePipeline
             # under the lock: two concurrent FIRST submissions must not
@@ -847,7 +912,8 @@ class MatrelSession:
             with self._compile_lock:
                 if self._serve is None:
                     self._serve = ServePipeline(self)
-        return self._serve.submit(as_expr(expr))
+        e = as_expr(expr)
+        return self._serve.submit(e, self._resolve_sla(precision, e))
 
     def serve_drain(self) -> None:
         """Block until every submitted query has been dispatched and
@@ -856,7 +922,8 @@ class MatrelSession:
             self._serve.drain()
 
     def explain(self, expr: MatExpr, physical: bool = True,
-                analyze: bool = False) -> str:
+                analyze: bool = False,
+                precision: Optional[str] = None) -> str:
         """Logical, optimized AND physical plan text. With ``physical``
         (default) the expression is compiled (cached — a following
         compute() reuses the plan), so the optimized section carries
@@ -882,7 +949,7 @@ class MatrelSession:
         from matrel_tpu.ir.expr import pretty
         head = "== Logical plan ==\n" + pretty(e)
         try:
-            plan = self.compile(e)
+            plan = self.compile(e, precision=precision)
             text = head + "\n" + plan.explain()
         except Exception as ex:  # EXPLAIN must not fail on exotic plans
             # fall back to the PRE-COMPUTED logical text only: when the
@@ -898,8 +965,11 @@ class MatrelSession:
             from matrel_tpu import analysis
             diags = (plan.meta or {}).get("diagnostics")
             if diags is None:
+                # the PLAN's config, not the session's: a per-query
+                # precision SLA must be verified against the SLA the
+                # plan was actually compiled under (MV108)
                 diags = analysis.verify_plan(plan.optimized, self.mesh,
-                                             self.config)
+                                             plan.config)
             else:
                 diags = [analysis.Diagnostic(**d) for d in diags]
             text += "\n== Verifier ==\n" + analysis.render(diags)
@@ -938,6 +1008,15 @@ class MatrelSession:
         ``analyze=True`` appends the measured per-op tree (EXPLAIN
         ANALYZE)."""
         return self.explain(self.sql(query), analyze=analyze)
+
+
+def _prec_prefix(sla: str) -> str:
+    """Cache-key prefix isolating precision tiers (the axisw-prefix
+    idiom): plan-cache AND result-cache keys for a non-default SLA
+    never collide with default-SLA keys or with each other, so a
+    ``"fast"`` plan/result can never answer an ``"exact"`` query.
+    "default" keeps the historical key format (empty prefix)."""
+    return "" if sla == "default" else f"prec:{sla}|"
 
 
 def _plan_bytes(plan: executor_lib.CompiledPlan) -> int:

@@ -12,6 +12,10 @@ Grammar (Python-expression syntax, parsed via ``ast`` — no eval):
         [FROM t1, t2, ...]        -- restricts AND validates the visible
                                      tables against the session catalog
         [WHERE <pred over v>]     -- sugar for select(<expr>, "<pred>")
+        [PRECISION '<sla>']       -- per-query accuracy SLA ("exact"/
+                                     "high"/"fast"/explicit dtype) for
+                                     precision-tiered execution
+                                     (docs/PRECISION.md)
     <expr> :=
         A * B            matrix multiply        A + B | A - B  elementwise
         A .* B | A % B   element multiply       A / B          elementwise
@@ -385,6 +389,26 @@ def parse_sql(query: str, session) -> E.MatExpr:
     if q[:6].lower() == "select" and len(q) > 6 and q[6].isspace():
         q = q[6:].strip()
     q = _lex_elemmul(q)
+    # trailing PRECISION '<sla>' clause — the SQL face of the per-query
+    # accuracy SLA (session.run's precision= argument; docs/
+    # PRECISION.md): stripped FIRST since it follows WHERE in the
+    # statement. Quoted or bare spellings both accepted.
+    prec_sla = None
+    pi = _find_keyword(q, "precision")
+    if pi >= 0:
+        prec_src = q[pi + len("precision"):].strip()
+        if prec_src[:1] in "'\"" and prec_src[:1] == prec_src[-1:] \
+                and len(prec_src) >= 2:
+            prec_src = prec_src[1:-1].strip()
+        if not prec_src:
+            raise SqlError("PRECISION requires an SLA value "
+                           "('exact'/'high'/'fast'/explicit dtype)")
+        from matrel_tpu.config import normalize_sla
+        try:
+            prec_sla = normalize_sla(prec_src)
+        except ValueError as ex:
+            raise SqlError(str(ex)) from ex
+        q = q[:pi]
     where_src = None
     wi = _find_keyword(q, "where")
     if wi >= 0:
@@ -422,4 +446,10 @@ def parse_sql(query: str, session) -> E.MatExpr:
         object.__setattr__(
             expr, "_sql_hash",
             hashlib.sha1(query.strip().encode()).hexdigest()[:16])
+        if prec_sla is not None:
+            # out-of-band like _sql_hash: session._resolve_sla reads it
+            # (an explicit run(precision=...) argument still wins) and
+            # applies the tier-isolating cache prefix — an attrs entry
+            # would redundantly split the plan cache a second way
+            object.__setattr__(expr, "_sql_precision", prec_sla)
     return expr

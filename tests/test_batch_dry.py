@@ -106,6 +106,28 @@ def test_serve_row_artifact(dry_batch):
                             "half_width_frac", "replays"}
 
 
+def test_precision_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records,
+               lambda r: r.get("metric") == "precision_tier_sweep"
+               and "rows" in r, "bench.py --precision")
+    # all four tier rows, each with its TFLOPS and max-abs-error
+    # columns, every measured error inside its documented bound, and
+    # the SLA chooser routing each named level to the tier the cost
+    # model's pass/byte billing says it should
+    tiers = [row["tier"] for row in rec["rows"]]
+    assert tiers == ["f32", "bf16x1", "bf16x3", "int32"], tiers
+    for row in rec["rows"]:
+        assert row["stamped_tier"] == row["tier"], row
+        assert row["tflops_per_chip"] > 0
+        assert "max_abs_err" in row and "err_bound" in row
+        assert row["within_bound"] is True, row
+    int_row = rec["rows"][-1]
+    assert int_row["max_abs_err"] == 0.0          # int path is EXACT
+    assert rec["chooser_ok"] is True, rec["sla_choices"]
+    assert rec["all_within_bound"] is True
+
+
 def test_bench_all_rows_artifacts(dry_batch):
     _, records, _ = dry_batch
     # every heavy row emits an explicit, parseable skip record — a

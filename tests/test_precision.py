@@ -1,0 +1,656 @@
+"""Precision-tiered execution (round 8; docs/PRECISION.md).
+
+Covers the full SLA surface: threading through run/run_many/submit/SQL,
+the tier chooser and its closed-form cost model, infer_dtype/integral
+propagation, the multi-pass lowerings vs f64 oracles, MV108 fixtures,
+result-cache tier-key isolation, drift-auditor tier keying, and the
+default-config bit-identity contract (no stamps, no behaviour change —
+the plan-snapshot corpus is asserted separately by
+test_plan_snapshots)."""
+
+import numpy as np
+import pytest
+
+from matrel_tpu.config import MatrelConfig, normalize_sla
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.executor import compile_expr
+from matrel_tpu.ir import stats
+from matrel_tpu.ir import expr as E
+from matrel_tpu.parallel import planner
+
+
+def _float_pair(mesh, rng, n=48, k=40, m=32):
+    a = rng.uniform(-1.0, 1.0, (n, k)).astype(np.float32)
+    b = rng.uniform(-1.0, 1.0, (k, m)).astype(np.float32)
+    return (a, b, BlockMatrix.from_numpy(a, mesh=mesh),
+            BlockMatrix.from_numpy(b, mesh=mesh))
+
+
+def _int_pair(mesh, rng, n=48, k=40, m=32):
+    a = rng.integers(-3, 4, (n, k))
+    b = rng.integers(-3, 4, (k, m))
+    return (a, b, BlockMatrix.from_numpy(a, mesh=mesh),
+            BlockMatrix.from_numpy(b, mesh=mesh))
+
+
+def _stamped_tier(plan):
+    tiers = set()
+
+    def walk(n):
+        t = n.attrs.get("precision_tier")
+        if n.kind == "matmul" and t is not None:
+            tiers.add(t)
+        for c in n.children:
+            walk(c)
+
+    roots = (plan.optimized if isinstance(plan.optimized, tuple)
+             else (plan.optimized,))
+    for r in roots:
+        walk(r)
+    return tiers
+
+
+# ---------------------------------------------------------------------------
+# SLA vocabulary + config
+# ---------------------------------------------------------------------------
+
+
+def test_normalize_sla_vocabulary():
+    assert normalize_sla(None) == "default"
+    assert normalize_sla("Fast") == "fast"
+    assert normalize_sla("bf16") == "bfloat16"
+    assert normalize_sla("f32") == "float32"
+    with pytest.raises(ValueError):
+        normalize_sla("fasst")
+
+
+def test_config_rejects_bad_sla():
+    with pytest.raises(ValueError):
+        MatrelConfig(precision_sla="speedy")
+    assert MatrelConfig(precision_sla="FAST").precision_sla == "fast"
+
+
+# ---------------------------------------------------------------------------
+# Cost model — exact closed-form unit checks
+# ---------------------------------------------------------------------------
+
+
+def test_tier_cost_closed_forms():
+    n, k, m = 64, 128, 32
+    macs = 2.0 * n * k * m
+    for tier in planner.PRECISION_TIERS:
+        units = planner.TIER_COMPUTE_UNITS[tier]
+        isz = planner.TIER_ITEMSIZE[tier]
+        want = (macs * units
+                + stats.HBM_FLOPS_PER_BYTE
+                * ((n * k + k * m) * isz + n * m * 4.0))
+        assert planner.tier_matmul_cost(tier, n, k, m) == want
+
+    # density credit rides the MAC term AND the operand bytes
+    want = (macs * 0.5 * 0.25 * planner.TIER_COMPUTE_UNITS["bf16x1"]
+            + stats.HBM_FLOPS_PER_BYTE
+            * ((n * k * 0.5 + k * m * 0.25) * 2 + n * m * 4.0))
+    assert planner.tier_matmul_cost("bf16x1", n, k, m, 0.5,
+                                    0.25) == want
+
+
+def test_pass_count_billing():
+    # the billing the ISSUE names: 3 passes at 2x the MXU rate = 1.5x
+    # the single-pass f32-rate MAC time; the 6-pass f32 emulation = 3x
+    assert planner.TIER_PASSES["bf16x3"] == 3
+    assert planner.TIER_COMPUTE_UNITS["bf16x3"] == pytest.approx(
+        planner.TIER_PASSES["bf16x3"] / 2.0)
+    assert planner.TIER_COMPUTE_UNITS["f32"] == pytest.approx(
+        planner.TIER_PASSES["f32"] / 2.0)
+    # per-tier HBM bytes: bf16x1 streams half-width operands
+    assert planner.TIER_ITEMSIZE["bf16x1"] == 2
+    assert planner.TIER_ITEMSIZE["int8"] == 1
+
+
+def test_sla_allowed_tiers_and_chooser(mesh8, rng):
+    cfg = lambda **kw: MatrelConfig(**kw)
+    assert planner.sla_allowed_tiers("default", False) == ()
+    assert planner.sla_allowed_tiers("exact", False) == ("f32",)
+    assert set(planner.sla_allowed_tiers("exact", True)) == {"f32",
+                                                             "int32"}
+    assert "bf16x3" in planner.sla_allowed_tiers("high", False)
+    assert "bf16x1" not in planner.sla_allowed_tiers("high", False)
+    assert "bf16x1" in planner.sla_allowed_tiers("fast", False)
+    assert planner.sla_allowed_tiers("bfloat16", False) == ("bf16x1",)
+    # enable flags prune the named levels
+    off = cfg(precision_enable_bf16=False, precision_sla="fast")
+    assert planner.sla_allowed_tiers("fast", False, off) == ("f32",)
+    # ...but an explicit dtype ask bypasses them
+    assert planner.sla_allowed_tiers("bfloat16", False,
+                                     off) == ("bf16x1",)
+
+    _, _, A, B = _float_pair(mesh8, rng)
+    e = A.expr().multiply(B.expr())
+    assert planner.choose_precision_tier(
+        e, cfg(precision_sla="fast")) == "bf16x1"
+    assert planner.choose_precision_tier(
+        e, cfg(precision_sla="high")) == "bf16x3"
+    assert planner.choose_precision_tier(
+        e, cfg(precision_sla="exact")) == "f32"
+    assert planner.choose_precision_tier(e, cfg()) is None
+    _, _, Ai, Bi = _int_pair(mesh8, rng)
+    ei = Ai.expr().multiply(Bi.expr())
+    assert planner.choose_precision_tier(
+        ei, cfg(precision_sla="exact")) == "int32"
+    assert planner.choose_precision_tier(
+        ei, cfg(precision_sla="exact",
+                precision_enable_int=False)) == "f32"
+
+
+def test_sla_compute_factor():
+    assert planner.sla_compute_factor(MatrelConfig()) == 1.0
+    fast = planner.sla_compute_factor(
+        MatrelConfig(precision_sla="fast"))
+    assert fast == pytest.approx(0.5 / 3.0)
+    high = planner.sla_compute_factor(
+        MatrelConfig(precision_sla="high"))
+    assert high == pytest.approx(1.5 / 3.0)
+
+
+def test_chain_step_flop_scale_closed_form():
+    base, lay = stats.chain_step_cost_layout(8, 8, 8, 1.0, 1.0, 2, 4,
+                                             "2d", "2d")
+    scaled, lay2 = stats.chain_step_cost_layout(
+        8, 8, 8, 1.0, 1.0, 2, 4, "2d", "2d", flop_scale=0.5)
+    comm = base - stats.matmul_cost(8, 8, 8)
+    assert lay == lay2
+    assert scaled == pytest.approx(stats.matmul_cost(8, 8, 8) * 0.5
+                                   + comm)
+
+
+# ---------------------------------------------------------------------------
+# Integral inference + dtype threading
+# ---------------------------------------------------------------------------
+
+
+def test_infer_integral_rules(mesh8, rng):
+    _, _, Ai, Bi = _int_pair(mesh8, rng, n=16, k=16, m=16)
+    _, _, A, _ = _float_pair(mesh8, rng, n=16, k=16, m=16)
+    ei = Ai.expr().multiply(Bi.expr())
+    assert stats.infer_integral(ei)
+    assert stats.infer_integral(ei.t())
+    assert stats.infer_integral(ei.add(Bi.expr().t().t()))  # shapes ok
+    assert stats.infer_integral(ei.multiply_scalar(3.0))
+    assert not stats.infer_integral(ei.multiply_scalar(0.5))
+    assert not stats.infer_integral(ei.divide(Bi.expr()))
+    assert stats.infer_integral(E.agg(ei, "sum", "row"))
+    assert stats.infer_integral(E.agg(A.expr(), "count", "row"))
+    assert not stats.infer_integral(E.agg(ei, "avg", "row"))
+    assert not stats.infer_integral(A.expr().multiply(Bi.expr()))
+    # declared integral float data counts
+    Af = BlockMatrix.from_numpy(
+        np.ones((16, 16), np.float32), mesh=mesh8, integral=True)
+    assert stats.infer_integral(Af.expr())
+
+
+def test_from_numpy_integral_detection(mesh8):
+    assert BlockMatrix.from_numpy(np.ones((8, 8), np.int64),
+                                  mesh=mesh8).integral
+    assert BlockMatrix.from_numpy(np.ones((8, 8), bool),
+                                  mesh=mesh8).integral
+    assert not BlockMatrix.from_numpy(np.ones((8, 8), np.float32),
+                                      mesh=mesh8).integral
+
+
+def test_infer_dtype_threads_int_tier(mesh8, rng):
+    ai, bi, Ai, Bi = _int_pair(mesh8, rng)
+    cfg = MatrelConfig(precision_sla="exact")
+    ann = planner.annotate_strategies(
+        Ai.expr().multiply(Bi.expr()), mesh8, cfg)
+    assert ann.attrs["precision_tier"] == "int32"
+    assert planner.infer_dtype(ann, cfg) == np.dtype("int32")
+    # the int32 result dtype flows through a consuming aggregate
+    agg = E.agg(ann, "sum", "all")
+    assert planner.infer_dtype(agg, cfg) == np.dtype("int32")
+
+
+def test_integral_abs_bound_rules(mesh8, rng):
+    ai, bi, Ai, Bi = _int_pair(mesh8, rng, n=16, k=16, m=16)
+    ba = float(np.abs(ai).max())
+    bb = float(np.abs(bi).max())
+    assert Ai.int_abs_max == ba                 # recorded by from_numpy
+    assert stats.integral_abs_bound(Ai.expr()) == ba
+    ei = Ai.expr().multiply(Bi.expr())
+    assert stats.integral_abs_bound(ei) == 16 * ba * bb
+    assert stats.integral_abs_bound(ei.add(Ai.expr())) == \
+        16 * ba * bb + ba
+    assert stats.integral_abs_bound(ei.multiply_scalar(2.0)) == \
+        2 * 16 * ba * bb
+    assert stats.integral_abs_bound(E.agg(ei, "sum", "row")) == \
+        16 * (16 * ba * bb)
+    # a declared-integral matrix WITHOUT a recorded magnitude: no bound
+    Af = BlockMatrix(data=Ai.data, shape=Ai.shape, mesh=mesh8,
+                     spec=Ai.spec, integral=True)
+    assert stats.integral_abs_bound(Af.expr()) is None
+
+
+def test_int_tier_overflow_gate(mesh8):
+    """Auto int32 only when the accumulated product provably fits the
+    int32 accumulator — "exact" must never silently wrap."""
+    from matrel_tpu import analysis
+    big = np.full((64, 64), 100_000, dtype=np.int64)
+    A = BlockMatrix.from_numpy(big, mesh=mesh8)   # 64*1e10 >> 2^31
+    cfg = MatrelConfig(precision_sla="exact")
+    e = A.expr().multiply(A.expr())
+    assert not planner.int_tier_fits(e, "int32")
+    assert planner.choose_precision_tier(e, cfg) == "f32"   # not int32
+    ann = _annotated(A.expr().multiply(A.expr()), mesh8, cfg)
+    assert ann.attrs["precision_tier"] == "f32"
+    # a hand-stamped int32 with PROVABLE overflow is an MV108 error,
+    # even under the explicit int SLA (provably wrong is wrong)
+    for sla in ("exact", "int32"):
+        c = MatrelConfig(precision_sla=sla)
+        bad = ann.with_attrs(precision_tier="int32")
+        diags = [d for d in analysis.verify_plan(bad, mesh8, c)
+                 if d.code == "MV108"]
+        assert diags and diags[0].severity == "error", sla
+        assert "accumulator" in diags[0].message
+    # int8 additionally needs the CAST to fit: entries of 200 overflow
+    # int8 even though 64*200*200 fits int32
+    mid = np.full((64, 64), 200, dtype=np.int64)
+    M = BlockMatrix.from_numpy(mid, mesh=mesh8)
+    em = M.expr().multiply(M.expr())
+    assert planner.int_tier_fits(em, "int32")
+    assert not planner.int_tier_fits(em, "int8")
+
+
+def test_pinned_sla_honored_on_integer_operands(mesh8, rng):
+    """An inner int-tier product (int32 dtype) feeding another matmul:
+    explicit int pins are honored, float pins stamp nothing, and the
+    named SLAs continue the exact int32 algebra (closure) — including
+    the mixed int32 × integral-f32-leaf case."""
+    ai, bi, Ai, Bi = _int_pair(mesh8, rng, n=16, k=16, m=16)
+    ci = rng.integers(-2, 3, (16, 16))
+    Ci = BlockMatrix.from_numpy(ci, mesh=mesh8)
+    for sla, want in (("exact", "int32"), ("high", "int32"),
+                      ("fast", "bf16x1"),    # "fast" prefers bf16x1
+                      ("int8", "int8"), ("int32", "int32")):
+        cfg = MatrelConfig(precision_sla=sla)
+        ann = _annotated(
+            Ai.expr().multiply(Bi.expr()).multiply(Ci.expr()),
+            mesh8, cfg)
+        inner = next(c for c in ann.children if c.kind == "matmul")
+        # under the int SLAs the inner product is int-tiered; its
+        # int32 dtype flows to the outer matmul, whose other operand
+        # is an integral f32 LEAF — the mixed case the closure rule
+        # exists for. Under "fast" the chooser legitimately prefers
+        # bf16x1 (cheapest satisfying tier) — and the bf16-tiered
+        # inner product is then NOT integral, so the outer must not
+        # claim int exactness either
+        assert inner.attrs.get("precision_tier") == want, sla
+        assert ann.attrs.get("precision_tier") == want, sla
+        if want == "bf16x1":
+            assert not stats.infer_integral(inner)
+    # a float pin on INTEGER-dtype data stamps nothing (untier
+    # promotion runs) — reachable via a hand-stamped int inner
+    inner = _annotated(Ai.expr().multiply(Bi.expr()), mesh8,
+                       MatrelConfig(precision_sla="exact"))
+    mixed = E.matmul(inner, Ci.expr())
+    for pin in ("float32", "bfloat16", "bf16x3"):
+        assert planner.choose_precision_tier(
+            mixed, MatrelConfig(precision_sla=pin)) is None, pin
+    assert planner.choose_precision_tier(
+        mixed, MatrelConfig(precision_sla="int8")) == "int8"
+    assert planner.choose_precision_tier(
+        mixed, MatrelConfig(precision_sla="exact")) == "int32"
+    # end to end: the whole integral chain is EXACT under "exact"
+    plan = compile_expr(
+        Ai.expr().multiply(Bi.expr()).multiply(Ci.expr()), mesh8,
+        MatrelConfig(precision_sla="exact"))
+    got = plan.run().to_numpy()
+    assert got.dtype == np.int32
+    assert np.array_equal(got, ai @ bi @ ci)
+
+
+# ---------------------------------------------------------------------------
+# Lowering numerics — tiers vs f64 oracles
+# ---------------------------------------------------------------------------
+
+
+def test_tier_numerics_vs_oracle(mesh8, rng):
+    a, b, A, B = _float_pair(mesh8, rng)
+    want = a.astype(np.float64) @ b.astype(np.float64)
+    k = a.shape[1]
+    errs = {}
+    for sla, tier in (("exact", "f32"), ("high", "bf16x3"),
+                      ("fast", "bf16x1")):
+        cfg = MatrelConfig(precision_sla=sla)
+        plan = compile_expr(A.expr().multiply(B.expr()), mesh8, cfg)
+        assert _stamped_tier(plan) == {tier}
+        got = plan.run().to_numpy().astype(np.float64)
+        err = float(np.abs(got - want).max())
+        assert err <= planner.tier_error_bound(tier, k, 1.0, 1.0), \
+            (tier, err)
+        errs[tier] = err
+    # the tiers are really different numerics: bf16x1 is coarser than
+    # bf16x3 is coarser than f32 (strict on random data)
+    assert errs["bf16x1"] > errs["bf16x3"] >= errs["f32"]
+
+
+def test_int_tier_exact_and_int8(mesh8, rng):
+    ai, bi, Ai, Bi = _int_pair(mesh8, rng)
+    for sla in ("exact", "int32"):
+        plan = compile_expr(Ai.expr().multiply(Bi.expr()), mesh8,
+                            MatrelConfig(precision_sla=sla))
+        got = plan.run().to_numpy()
+        assert got.dtype == np.int32
+        assert np.array_equal(got, ai @ bi)
+    # explicit int8: inputs fit int8, accumulation is int32 (a k-deep
+    # product of ±3 entries overflows int8 immediately — _acc_dtype's
+    # integer contract)
+    plan8 = compile_expr(Ai.expr().multiply(Bi.expr()), mesh8,
+                         MatrelConfig(precision_sla="int8"))
+    assert _stamped_tier(plan8) == {"int8"}
+    got8 = plan8.run().to_numpy()
+    assert np.array_equal(got8, ai @ bi)
+
+
+def test_tier_composes_with_strategies(mesh_square, rng):
+    """Tiered passes run through the stamped shard_map recipe — force
+    each strategy and check the bf16x3 result still meets its bound."""
+    a, b, A, B = _float_pair(mesh_square, rng, n=32, k=32, m=32)
+    want = a.astype(np.float64) @ b.astype(np.float64)
+    for strat in ("bmm_right", "cpmm", "rmm", "summa", "xla"):
+        cfg = MatrelConfig(precision_sla="bf16x3",
+                           strategy_override=strat)
+        plan = compile_expr(A.expr().multiply(B.expr()), mesh_square,
+                            cfg)
+        got = plan.run().to_numpy().astype(np.float64)
+        err = float(np.abs(got - want).max())
+        assert err <= planner.tier_error_bound("bf16x3", 32, 1.0, 1.0), \
+            (strat, err)
+
+
+def test_gram_shortcut_defers_to_tier(mesh8, rng):
+    """matmul_precision="high" triggers the symmetric-gram shortcut;
+    a stamped tier owns the numerics instead — the composition must
+    still satisfy the tier bound."""
+    a, _, A, _ = _float_pair(mesh8, rng, n=40, k=24, m=24)
+    want = a.T.astype(np.float64) @ a.astype(np.float64)
+    cfg = MatrelConfig(precision_sla="bf16x3",
+                       matmul_precision="high")
+    plan = compile_expr(A.expr().t().multiply(A.expr()), mesh8, cfg)
+    got = plan.run().to_numpy().astype(np.float64)
+    err = float(np.abs(got - want).max())
+    assert err <= planner.tier_error_bound("bf16x3", a.shape[0],
+                                           1.0, 1.0), err
+
+
+# ---------------------------------------------------------------------------
+# SLA threading — run / run_many / submit / SQL
+# ---------------------------------------------------------------------------
+
+
+def _session(mesh, **cfg_kw):
+    from matrel_tpu.session import MatrelSession
+    return MatrelSession(mesh=mesh, config=MatrelConfig(**cfg_kw))
+
+
+def test_run_threads_precision(mesh8, rng):
+    ai, bi, Ai, Bi = _int_pair(mesh8, rng)
+    sess = _session(mesh8)
+    q = Ai.expr().multiply(Bi.expr())
+    out_default = sess.run(q)
+    assert out_default.dtype == np.float32       # untier lowering
+    out_exact = sess.run(q, precision="exact")
+    assert out_exact.dtype == np.int32           # int tier executed
+    assert np.array_equal(out_exact.to_numpy(), ai @ bi)
+    # the two SLAs compiled under DIFFERENT plan-cache keys
+    assert sess.plan_cache_info()["plans"] == 2
+
+
+def test_run_many_and_submit_thread_precision(mesh8, rng):
+    ai, bi, Ai, Bi = _int_pair(mesh8, rng)
+    sess = _session(mesh8)
+    q = Ai.expr().multiply(Bi.expr())
+    outs = sess.run_many([q, q], precision="exact")
+    for o in outs:
+        assert o.dtype == np.int32
+        assert np.array_equal(o.to_numpy(), ai @ bi)
+    # submit: mixed SLAs in one pipeline — per-query numerics hold
+    # (the worker groups same-SLA queries into separate MultiPlans)
+    f_exact = sess.submit(q, precision="exact")
+    f_default = sess.submit(q)
+    exact = f_exact.result(timeout=60)
+    default = f_default.result(timeout=60)
+    sess.serve_drain()
+    assert exact.dtype == np.int32
+    assert default.dtype == np.float32
+    assert np.array_equal(exact.to_numpy(), ai @ bi)
+
+
+def test_sql_precision_clause(mesh8, rng):
+    ai, bi, Ai, Bi = _int_pair(mesh8, rng)
+    sess = _session(mesh8)
+    sess.register("a", Ai)
+    sess.register("b", Bi)
+    e = sess.sql("SELECT a * b FROM a, b PRECISION 'exact'")
+    assert getattr(e, "_sql_precision") == "exact"
+    out = sess.run(e)
+    assert out.dtype == np.int32
+    assert np.array_equal(out.to_numpy(), ai @ bi)
+    # explicit run argument beats the clause
+    out2 = sess.run(e, precision="default")
+    assert out2.dtype == np.float32
+    # bad SLA raises SqlError at parse time
+    from matrel_tpu.sql import SqlError
+    with pytest.raises(SqlError):
+        sess.sql("SELECT a * b FROM a, b PRECISION 'warp'")
+
+
+# ---------------------------------------------------------------------------
+# Result-cache tier isolation
+# ---------------------------------------------------------------------------
+
+
+def test_result_cache_tier_key_isolation(mesh8, rng):
+    ai, bi, Ai, Bi = _int_pair(mesh8, rng)
+    sess = _session(mesh8, result_cache_max_bytes=32 << 20)
+    q = Ai.expr().multiply(Bi.expr())
+    fast = sess.run(q, precision="fast")
+    assert fast.dtype == np.float32             # bf16x1 ran
+    info0 = sess.result_cache_info()
+    assert info0["entries"] == 1
+    # an "exact" probe of the SAME structural query must MISS the
+    # "fast" entry and recompute exactly
+    exact = sess.run(q, precision="exact")
+    assert exact.dtype == np.int32
+    assert np.array_equal(exact.to_numpy(), ai @ bi)
+    info1 = sess.result_cache_info()
+    assert info1["entries"] == 2                # separate entries
+    # repeated same-SLA queries DO hit their own entries
+    hits_before = sess.result_cache_info()["hits"]
+    again = sess.run(q, precision="exact")
+    assert sess.result_cache_info()["hits"] == hits_before + 1
+    assert np.array_equal(again.to_numpy(), ai @ bi)
+
+
+# ---------------------------------------------------------------------------
+# MV108 verifier fixtures
+# ---------------------------------------------------------------------------
+
+
+def _annotated(e, mesh, cfg):
+    from matrel_tpu.ir import rules
+    return planner.annotate_strategies(rules.optimize(e, cfg), mesh,
+                                       cfg)
+
+
+def test_mv108_flags_violating_stamp(mesh8, rng):
+    from matrel_tpu import analysis
+    _, _, A, B = _float_pair(mesh8, rng)
+    cfg = MatrelConfig(precision_sla="exact")
+    ann = _annotated(A.expr().multiply(B.expr()), mesh8, cfg)
+    assert ann.attrs["precision_tier"] == "f32"
+    # hand-stamp a tier the SLA forbids — the wrong-answer class
+    bad = ann.with_attrs(precision_tier="bf16x1")
+    diags = [d for d in analysis.verify_plan(bad, mesh8, cfg)
+             if d.code == "MV108"]
+    assert diags and diags[0].severity == "error"
+    assert "bf16x1" in diags[0].message
+
+
+def test_mv108_flags_int_on_nonintegral(mesh8, rng):
+    from matrel_tpu import analysis
+    _, _, A, B = _float_pair(mesh8, rng)
+    cfg = MatrelConfig(precision_sla="fast")
+    ann = _annotated(A.expr().multiply(B.expr()), mesh8, cfg)
+    bad = ann.with_attrs(precision_tier="int32")
+    diags = [d for d in analysis.verify_plan(bad, mesh8, cfg)
+             if d.code == "MV108"]
+    assert diags and diags[0].severity == "error"
+    assert "truncate" in diags[0].message
+    # explicit int SLA downgrades the unprovable cast to a warning
+    cfg_i = MatrelConfig(precision_sla="int32")
+    ann_i = _annotated(A.expr().multiply(B.expr()), mesh8, cfg_i)
+    diags_i = [d for d in analysis.verify_plan(ann_i, mesh8, cfg_i)
+               if d.code == "MV108"]
+    assert diags_i and diags_i[0].severity == "warning"
+
+
+def test_mv108_clean_plans_quiet(mesh8, rng):
+    from matrel_tpu import analysis
+    a, b, A, B = _float_pair(mesh8, rng)
+    _, _, Ai, Bi = _int_pair(mesh8, rng)
+    for sla, e in (("exact", A.expr().multiply(B.expr())),
+                   ("high", A.expr().multiply(B.expr())),
+                   ("fast", A.expr().multiply(B.expr())),
+                   ("exact", Ai.expr().multiply(Bi.expr())),
+                   ("default", A.expr().multiply(B.expr()))):
+        cfg = MatrelConfig(precision_sla=sla)
+        ann = _annotated(e, mesh8, cfg)
+        assert not [d for d in analysis.verify_plan(ann, mesh8, cfg)
+                    if d.code == "MV108"], sla
+
+
+def test_mv108_error_escalates(mesh8, rng):
+    """MV108 findings are error-severity: the "error" policy raises
+    VerificationError (the executor's pre-trace gate wiring is shared
+    with every other pass and covered by test_analysis)."""
+    from matrel_tpu import analysis
+    from matrel_tpu.analysis import VerificationError
+    _, _, A, B = _float_pair(mesh8, rng)
+    cfg = MatrelConfig(precision_sla="exact", verify_plans="error")
+    ann = _annotated(A.expr().multiply(B.expr()), mesh8, cfg)
+    bad = ann.with_attrs(precision_tier="bf16x1")
+    diags = analysis.verify_plan(bad, mesh8, cfg)
+    assert any(d.code == "MV108" for d in diags)
+    with pytest.raises(VerificationError):
+        analysis.enforce(diags, "error")
+
+
+def test_mv108_off_mode_free(mesh8, rng, monkeypatch):
+    """verify_plans="off" (the default): the verifier (and with it
+    MV108) never runs on the compile path — not merely quiet, absent."""
+    from matrel_tpu import analysis
+    called = []
+    monkeypatch.setattr(analysis, "verify_plan",
+                        lambda *a, **k: called.append(1) or [])
+    _, _, A, B = _float_pair(mesh8, rng)
+    compile_expr(A.expr().multiply(B.expr()), mesh8,
+                 MatrelConfig(precision_sla="fast"))
+    assert not called
+
+
+# ---------------------------------------------------------------------------
+# Default-config bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_default_config_stamps_nothing(mesh8, rng):
+    from matrel_tpu import executor as executor_lib
+    a, b, A, B = _float_pair(mesh8, rng)
+    _, _, Ai, Bi = _int_pair(mesh8, rng)
+    for e in (A.expr().multiply(B.expr()).multiply(B.expr().t()),
+              Ai.expr().multiply(Bi.expr())):
+        plan = compile_expr(e, mesh8, MatrelConfig())
+        assert _stamped_tier(plan) == set()
+        assert "precision" not in (plan.meta or {})
+        for d in executor_lib.plan_matmul_decisions(plan):
+            assert "precision_tier" not in d
+        assert plan.run().dtype == np.float32
+
+
+def test_default_sla_key_format_unchanged(mesh8, rng):
+    """The default SLA keeps the historical cache-key format (empty
+    prefix), so existing sessions/entries are untouched."""
+    from matrel_tpu import session as session_mod
+    assert session_mod._prec_prefix("default") == ""
+    assert session_mod._prec_prefix("fast") == "prec:fast|"
+
+
+# ---------------------------------------------------------------------------
+# Drift auditor tier keying
+# ---------------------------------------------------------------------------
+
+
+def test_drift_tier_keying_and_rank_isolation():
+    from matrel_tpu.obs import drift
+    mk = lambda tier, ms, est: {
+        "kind": "query", "backend": "cpu", "execute_ms": ms,
+        "matmuls": [{"uid": 1, "dims": [512, 512, 512],
+                     "strategy": "rmm", "flops": 2.0 * 512 ** 3,
+                     "est_ici_bytes": est,
+                     **({"precision_tier": tier} if tier else {})}]}
+    # a miscalibrated bf16 population: cheaper est bytes, slower ms —
+    # would flag against the f32 rows if blended into one group
+    events = [mk(None, 2.0, 1e6)] * 3 + [mk("bf16x1", 9.0, 5e5)] * 3
+    samples = list(drift.iter_samples(events))
+    assert {s["strategy"] for s in samples} == {"rmm", "rmm@bf16x1"}
+    calib = drift.calibrate(samples)
+    assert any("rmm@bf16x1|" in k for k in calib)
+    assert any(k.startswith("rmm|") for k in calib)
+    # rank flags group per tier: the cross-tier inversion is NOT a flag
+    assert drift.rank_flags(samples) == []
+    # ...but a genuine same-tier inversion still is
+    events2 = [mk("bf16x1", 9.0, 5e5) for _ in range(3)]
+    for _ in range(3):
+        ev = mk("bf16x1", 1.0, 9e5)
+        ev["matmuls"][0]["strategy"] = "cpmm"
+        events2.append(ev)
+    flags = drift.rank_flags(list(drift.iter_samples(events2)))
+    assert flags and flags[0]["model_prefers"] == "rmm@bf16x1"
+
+
+# ---------------------------------------------------------------------------
+# Obs surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_decisions_and_meta_carry_tier(mesh8, rng):
+    _, _, A, B = _float_pair(mesh8, rng)
+    from matrel_tpu import executor as executor_lib
+    cfg = MatrelConfig(precision_sla="high")
+    plan = compile_expr(A.expr().multiply(B.expr()), mesh8, cfg)
+    (d,) = executor_lib.plan_matmul_decisions(plan)
+    assert d["precision_tier"] == "bf16x3"
+    assert d["est_passes"] == 3
+    assert d["est_rel_err"] == planner.TIER_EPS["bf16x3"]
+    assert d["est_tier_cost"] == pytest.approx(planner.tier_matmul_cost(
+        "bf16x3", *d["dims"]))
+    meta = plan.meta["precision"]
+    assert meta["sla"] == "high"
+    assert meta["tiers"] == {"bf16x3": 1}
+    assert meta["est_rel_err_bound"] == pytest.approx(
+        planner.TIER_EPS["bf16x3"] * A.shape[1])
+    # pretty/explain render the tier
+    from matrel_tpu.ir.expr import pretty
+    assert "precision=bf16x3" in pretty(plan.optimized)
+
+
+def test_history_summary_rolls_up_tiers():
+    from matrel_tpu.obs import history
+    events = [{"kind": "query", "matmuls": [
+        {"strategy": "rmm", "flops": 1.0, "precision_tier": "bf16x3",
+         "est_passes": 3},
+        {"strategy": "rmm", "flops": 1.0}]}]
+    s = history.summarize(events)
+    assert s["precision_tiers"] == {"bf16x3": {"count": 1,
+                                               "passes": 3}}
+    assert "precision tiers: bf16x3=1 (3 passes)" in \
+        history.render_summary(events)

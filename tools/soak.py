@@ -412,6 +412,93 @@ def soak_serve(n_trials: int, base: int, tol: float):
     return fails
 
 
+def soak_precision(n_trials: int, base: int, tol: float):
+    """Precision-SLA battery: random matmul-shaped queries executed at
+    every SLA tier against an f64 numpy oracle, asserting the
+    DOCUMENTED per-tier error bound (planner.tier_error_bound — the
+    docs/PRECISION.md table: bf16x3 within ~f32 tolerance, bf16x1
+    within the single-pass bf16 bound, int paths EXACT), including
+    under the sharded 8-device mesh and with result-cache tier
+    isolation live (a "fast" entry must never answer an "exact"
+    probe — checked by running the same stream at two SLAs through one
+    cache-on session and oracle-checking both)."""
+    import numpy as np
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.executor import compile_expr
+    from matrel_tpu.parallel import planner
+    from matrel_tpu.session import MatrelSession
+
+    mesh = mesh_lib.make_mesh()
+    fails = []
+    for trial in range(n_trials):
+        rng = np.random.default_rng(base + trial)
+        try:
+            n = int(rng.integers(2, 12)) * 8
+            k = int(rng.integers(2, 12)) * 8
+            m = int(rng.integers(2, 12)) * 8
+            a = rng.uniform(-1.0, 1.0, (n, k)).astype(np.float32)
+            b = rng.uniform(-1.0, 1.0, (k, m)).astype(np.float32)
+            c = rng.uniform(-1.0, 1.0, (m, n)).astype(np.float32)
+            A = BlockMatrix.from_numpy(a, mesh=mesh)
+            B = BlockMatrix.from_numpy(b, mesh=mesh)
+            C = BlockMatrix.from_numpy(c, mesh=mesh)
+            # two chained contractions: error bounds must hold through
+            # the composition, not just one product
+            want = (a.astype(np.float64) @ b.astype(np.float64)
+                    @ c.astype(np.float64))
+            for sla, tiers in (("exact", ("f32",)),
+                               ("high", ("bf16x3", "f32")),
+                               ("fast", ("bf16x1",)),
+                               ("bfloat16", ("bf16x1",)),
+                               ("bf16x3", ("bf16x3",))):
+                cfg = MatrelConfig(precision_sla=sla)
+                expr = A.expr().multiply(B.expr()).multiply(C.expr())
+                plan = compile_expr(expr, mesh, cfg)
+                got = plan.run().to_numpy().astype(np.float64)
+                # documented bound, composed over both contractions:
+                # bound(A·B) propagates through the second multiply
+                # (× m·max|C|) and the second contraction adds its own
+                worst = max(planner.TIER_EPS[t] for t in tiers)
+                bound = (worst * k * 1.0 * 1.0) * m * 1.0 \
+                    + worst * m * (k * 1.0) * 1.0
+                err = float(np.abs(got - want).max())
+                assert err <= max(bound, 64 * tol), \
+                    (sla, err, bound)
+            # integer-exact path, sharded: "exact" on integral inputs
+            # must be EXACT, not merely close
+            ai = rng.integers(-3, 4, (n, k))
+            bi = rng.integers(-3, 4, (k, m))
+            Ai = BlockMatrix.from_numpy(ai, mesh=mesh)
+            Bi = BlockMatrix.from_numpy(bi, mesh=mesh)
+            cfg = MatrelConfig(precision_sla="exact")
+            plan = compile_expr(Ai.expr().multiply(Bi.expr()), mesh,
+                                cfg)
+            got_i = plan.run().to_numpy()
+            assert got_i.dtype == np.int32, got_i.dtype
+            assert np.array_equal(got_i, ai @ bi)
+            # result-cache tier isolation under load: one cache-on
+            # session serves the same query at "fast" then "exact" —
+            # the exact answer must be exact (a cross-tier hit would
+            # hand back the bf16 result)
+            sess = MatrelSession(mesh=mesh, config=MatrelConfig(
+                result_cache_max_bytes=16 << 20))
+            qi = Ai.expr().multiply(Bi.expr())
+            fast = sess.run(qi, precision="fast")
+            assert fast.dtype == np.float32       # bf16x1 path ran
+            exact = sess.run(qi, precision="exact")
+            # dtype is the non-vacuous discriminator: small-int bf16
+            # products are VALUE-exact, so a cross-tier hit would
+            # still match the oracle — but it could never be int32
+            assert exact.dtype == np.int32, "cross-tier rc hit"
+            assert np.array_equal(exact.to_numpy(), ai @ bi)
+        except Exception as ex:  # noqa: BLE001
+            fails.append(("precision", trial, type(ex).__name__,
+                          str(ex)[:150]))
+    return fails
+
+
 def soak_checkpoint(n_trials: int, base: int, tol: float):
     """Randomized checkpoint/restore: matrices with random specs, sparse
     tile stacks, loop state — restored values AND shardings must match;
@@ -475,7 +562,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("battery",
                    choices=["fuzz", "deep", "spmv", "sharded", "routed",
-                            "ckpt", "serve", "all"])
+                            "ckpt", "serve", "precision", "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -498,6 +585,8 @@ def main():
                                  1e-6)
     if args.battery in ("serve", "all"):
         fails += soak_serve(max(args.seeds // 2, 5), args.base, tol)
+    if args.battery in ("precision", "all"):
+        fails += soak_precision(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("sharded", "all"):
         fails += soak_sharded(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("routed", "all"):
