@@ -27,7 +27,7 @@ SEEDS ?= 10
 OBS_LOG ?= .matrel_events.jsonl
 
 .PHONY: test lint soak soak-tpu multihost native bench tpu-batch \
-        tpu-batch-dry obs-report
+        tpu-batch-dry obs-report chaos
 
 lint:
 	$(PY) tools/matlint.py
@@ -38,6 +38,14 @@ test: lint
 
 soak:
 	$(PY) tools/soak.py all --seeds 25
+
+# resilience acceptance: a mixed serve stream under a seeded fault
+# schedule (every instrumented site) must converge-to-correct-or-
+# typed-failure with zero hangs (tools/chaos_drill.py), then the
+# randomized chaos soak battery on top (docs/RESILIENCE.md)
+chaos:
+	$(PY) tools/chaos_drill.py
+	$(PY) tools/soak.py chaos --seeds 25
 
 soak-tpu:
 	$(PY) tools/soak_guard.py --seeds $(SEEDS)

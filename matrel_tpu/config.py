@@ -185,6 +185,39 @@ class MatrelConfig:
         dtype SLAs bypass the gate (an explicit ask is an ask).
       precision_enable_int: same gate for the integer-exact tiers
         (int32/int8).
+      fault_inject: fault-injection spec for the resilience layer
+        (matrel_tpu/resilience/faults.py; docs/RESILIENCE.md) —
+        semicolon-separated ``site:kind[:p=F|:n=K][:max=M]`` rules
+        raising typed ``InjectedFault`` at the engine's instrumented
+        choke points (compile, lower, strategy, execute, rc_probe,
+        serve_admit, checkpoint) on a DETERMINISTIC seeded schedule.
+        "" (the default) injects nothing and constructs nothing
+        (test-enforced). Validated at construction.
+      fault_inject_seed: seed of the injection schedule's per-rule
+        random streams (and the retry policy's backoff jitter) — same
+        spec + same seed = bit-identical fault schedule.
+      retry_max_attempts: how many RETRIES a failed query gets past
+        its first attempt (resilience/retry.py). Only failures the
+        typed taxonomy classifies transient (RESOURCE_EXHAUSTED-class
+        runtime errors, injected transients) retry — VerificationError
+        and compile/shape errors never do. Each retry climbs one rung
+        of the plan-degradation ladder (resilience/degrade.py). 0
+        (the default) retries nothing.
+      retry_backoff_ms / retry_backoff_mult / retry_jitter:
+        exponential-backoff schedule between attempts — base delay,
+        per-attempt multiplier, and symmetric jitter fraction (seeded
+        by fault_inject_seed, so schedules are reproducible).
+      deadline_ms: session-default per-query deadline. A query that
+        has not produced a result when it expires raises the typed
+        ``DeadlineExceeded`` — checked at admission and BETWEEN retry
+        attempts (a running XLA dispatch is never interrupted). 0 (the
+        default) = no deadline; per-call override via
+        ``session.run(expr, deadline_ms=...)`` (also run_many/submit).
+      serve_queue_max: bound on the async pipeline's admission queue.
+        A ``submit`` against a full queue raises the typed
+        ``AdmissionShed`` instead of growing the queue without bound —
+        load shedding that protects the queries already admitted. 0
+        (the default) keeps the historical unbounded queue.
       axis_cost_weights: per-mesh-axis relative inverse-bandwidth
         weights for the planner's comm model (core/mesh.MeshTopology):
         a collective leg over axis i is billed bytes × weights[i], so
@@ -234,6 +267,14 @@ class MatrelConfig:
     verify_plans: str = "off"
     hbm_budget_bytes: int = 16 << 30
     axis_cost_weights: Tuple[float, float] = (1.0, 1.0)
+    fault_inject: str = ""
+    fault_inject_seed: int = 0
+    retry_max_attempts: int = 0
+    retry_backoff_ms: float = 25.0
+    retry_backoff_mult: float = 2.0
+    retry_jitter: float = 0.5
+    deadline_ms: float = 0.0
+    serve_queue_max: int = 0
     precision_sla: str = "default"
     precision_enable_bf16: bool = True
     precision_enable_int: bool = True
@@ -291,6 +332,33 @@ class MatrelConfig:
                 f"(per mesh axis), got {self.axis_cost_weights!r}")
         object.__setattr__(self, "axis_cost_weights",
                            (float(w[0]), float(w[1])))
+        # resilience knobs: a malformed fault spec must fail HERE, not
+        # silently inject nothing while a chaos test believes it is
+        # injecting (the obs_level typo precedent); negative retry /
+        # backoff / deadline values have no meaning and would corrupt
+        # the backoff arithmetic silently
+        if self.fault_inject:
+            from matrel_tpu.resilience.faults import parse_spec
+            parse_spec(self.fault_inject)
+        if self.retry_max_attempts < 0:
+            raise ValueError(
+                f"retry_max_attempts must be >= 0, "
+                f"got {self.retry_max_attempts!r}")
+        if self.retry_backoff_ms < 0 or self.retry_backoff_mult < 1.0 \
+                or not (0.0 <= self.retry_jitter <= 1.0):
+            raise ValueError(
+                "retry backoff needs retry_backoff_ms >= 0, "
+                "retry_backoff_mult >= 1, retry_jitter in [0, 1]; got "
+                f"({self.retry_backoff_ms!r}, "
+                f"{self.retry_backoff_mult!r}, {self.retry_jitter!r})")
+        if self.deadline_ms < 0:
+            raise ValueError(
+                f"deadline_ms must be >= 0 (0 disables), "
+                f"got {self.deadline_ms!r}")
+        if self.serve_queue_max < 0:
+            raise ValueError(
+                f"serve_queue_max must be >= 0 (0 = unbounded), "
+                f"got {self.serve_queue_max!r}")
         # the SLA vocabulary gates NUMERICS, not just performance: an
         # unvalidated typo ("fasst") would silently run the default
         # path while the caller believes a bound was requested — or

@@ -31,6 +31,7 @@ from matrel_tpu.ir import expr as expr_mod, rules
 from matrel_tpu.ir.expr import MatExpr, leaves as expr_leaves
 from matrel_tpu.obs import trace as trace_lib
 from matrel_tpu.parallel import planner, strategies
+from matrel_tpu.resilience import faults as faults_lib
 from matrel_tpu.utils.profiling import annotate
 
 Array = jax.Array
@@ -144,6 +145,10 @@ class Lowerer:
                 if self.op_hook is not None:
                     child_time.append(0.0)
                     t0 = time.perf_counter()  # matlint: disable=ML006 analyze-mode op_hook measurement — lands in analyze events
+                # fault site "lower": the resilience harness's hook at
+                # this ONE dispatch point (fires at trace time — a
+                # compile-path fault). Free when fault_inject is "".
+                faults_lib.check("lower", self.config)
                 with annotate(f"matrel.{label}"):
                     out = self._eval(node, ev, leaf_arrays, leaf_pos)
                 if self.op_hook is not None:
@@ -998,7 +1003,7 @@ class CompiledPlan:
                         config=self.config)]
         try:
             lines += ["== Collectives ==", str(self.collectives())]
-        except Exception:  # HLO dump can fail on exotic backends
+        except Exception:  # matlint: disable=ML007 explain() best-effort — HLO dump can fail on exotic backends; the plan text above still renders
             pass
         return "\n".join(lines)
 

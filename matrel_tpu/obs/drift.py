@@ -25,10 +25,13 @@ ratio and an autotune row describe the same population.
 from __future__ import annotations
 
 import json
+import logging
 import math
 import os
 import time
 from typing import Dict, List, Optional
+
+_log = logging.getLogger("matrel_tpu.obs")
 
 #: Table schema version (bump on reader-visible change, like events.py).
 TABLE_SCHEMA = 1
@@ -242,15 +245,27 @@ def rank_flags(samples: List[dict]) -> List[dict]:
 
 def load_table(path: str) -> dict:
     """Persisted table or a fresh empty one. Corrupt/absent/foreign-
-    schema files read as empty (the autotune load_table contract)."""
+    schema files read as empty (the autotune load_table contract); a
+    CORRUPT file additionally warns — the robust-reader discipline
+    (docs/RESILIENCE.md): never crash the session over an auxiliary
+    artifact, never silently eat one either."""
     try:
         with open(path) as f:
             t = json.load(f)
-    except (OSError, ValueError):
+    except OSError:
+        t = None              # absent: the normal first-run case
+    except ValueError as e:
+        _log.warning("drift table %s is corrupt (%s); rebuilding "
+                     "from empty", path, e)
         t = None
-    if (not isinstance(t, dict)
-            or t.get("schema") != TABLE_SCHEMA
-            or not isinstance(t.get("entries"), dict)):
+    else:
+        if (not isinstance(t, dict)
+                or t.get("schema") != TABLE_SCHEMA
+                or not isinstance(t.get("entries"), dict)):
+            _log.warning("drift table %s has unexpected shape/schema; "
+                         "rebuilding from empty", path)
+            t = None
+    if t is None:
         return {"schema": TABLE_SCHEMA, "entries": {}}
     return t
 

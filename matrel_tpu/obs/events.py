@@ -87,13 +87,13 @@ def _jsonable(v):
     if callable(tolist):
         try:
             return tolist()
-        except Exception:
+        except Exception:  # matlint: disable=ML007 fallback encoder — falls through to the next encoding, ends at repr()
             pass
     item = getattr(v, "item", None)
     if callable(item):
         try:
             return item()
-        except Exception:
+        except Exception:  # matlint: disable=ML007 fallback encoder — falls through to repr()
             pass
     return repr(v)
 
@@ -125,9 +125,15 @@ def read_events(path: Optional[str] = None,
 
 
 def iter_events(path: Optional[str] = None) -> Iterator[dict]:
+    """Yield parsed records, skipping anything unreadable. Corrupt
+    lines are COUNTED and warned about once per read (the robust-
+    reader contract, docs/RESILIENCE.md): a log truncated mid-line by
+    a crashed process must never take the reader down with it — but a
+    silently shrinking history would hide the corruption entirely."""
     p = resolve_path(path)
     if not os.path.exists(p):
         return
+    skipped = 0
     with open(p) as f:
         for line in f:
             line = line.strip()
@@ -136,9 +142,15 @@ def iter_events(path: Optional[str] = None) -> Iterator[dict]:
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
+                skipped += 1
                 continue
             if not isinstance(rec, dict):
+                skipped += 1
                 continue
             if rec.get("schema") != SCHEMA_VERSION:
                 continue
             yield rec
+    if skipped:
+        log.warning("event log %s: skipped %d corrupt line(s) "
+                    "(crashed-writer debris; readers continue)",
+                    p, skipped)

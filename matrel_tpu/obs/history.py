@@ -103,6 +103,7 @@ def summarize(events: List[dict]) -> dict:
         "cache_hit_rate": round(hits / len(qs), 3) if qs else None,
         "rc_hits": sum(1 for e in qs if e.get("cache") == "rc_hit"),
         "serve": _summarize_serve(events),
+        "resilience": _summarize_resilience(events, len(qs)),
         "execute_ms_total": round(sum(exec_ms), 3),
         "execute_ms_mean": (round(sum(exec_ms) / len(exec_ms), 3)
                             if exec_ms else None),
@@ -216,6 +217,38 @@ def _summarize_serve(events: List[dict]) -> dict:
     }
 
 
+def _summarize_resilience(events: List[dict], n_queries: int) -> dict:
+    """Roll up ``fault``/``retry``/``degrade`` records (the resilience
+    layer's event kinds, docs/RESILIENCE.md) into the rates the serve
+    plane's health is judged by: how often queries fault, how often a
+    retry saves one, and which degradation rungs are being climbed —
+    a rising rung census is a cost-model/kernel regression wearing a
+    recovery mechanism's clothes."""
+    faults = [e for e in events if e.get("kind") == "fault"]
+    retries = [e for e in events if e.get("kind") == "retry"]
+    degrades = [e for e in events if e.get("kind") == "degrade"]
+    rungs: Dict[str, int] = {}
+    for e in degrades:
+        lbl = str(e.get("rung_label") or e.get("rung") or "?")
+        rungs[lbl] = rungs.get(lbl, 0) + 1
+    sites: Dict[str, int] = {}
+    for e in faults:
+        s = str(e.get("site") or e.get("error") or "?")
+        sites[s] = sites.get(s, 0) + 1
+    return {
+        "faults": len(faults),
+        "injected": sum(1 for e in faults if e.get("injected")),
+        "retries": len(retries),
+        "bisects": sum(1 for e in retries
+                       if e.get("scope") == "serve_bisect"),
+        "degrades": len(degrades),
+        "retry_rate": (round(len(retries) / n_queries, 3)
+                       if n_queries else None),
+        "rungs": rungs,
+        "fault_sites": sites,
+    }
+
+
 def render_summary(events: List[dict]) -> str:
     s = summarize(events)
     lines = [
@@ -249,6 +282,22 @@ def render_summary(events: List[dict]) -> str:
                 f"{_fmt(q[f]['p50'])}/{_fmt(q[f]['p95'])}".rjust(16)
                 for f in ("optimize_ms", "trace_ms", "execute_ms"))
             lines.append(f"{kind:<14}{q['count']:>5}{cells} ms")
+    rs = s.get("resilience") or {}
+    if rs.get("faults") or rs.get("retries") or rs.get("degrades"):
+        line = (f"resilience: {rs['faults']} fault(s) "
+                f"({rs['injected']} injected), {rs['retries']} "
+                f"retrie(s) (rate {_fmt(rs['retry_rate'], 3)}), "
+                f"{rs['degrades']} degrade(s)")
+        if rs.get("bisects"):
+            line += f", {rs['bisects']} serve bisection(s)"
+        if rs.get("rungs"):
+            line += "; rungs: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(rs["rungs"].items()))
+        if rs.get("fault_sites"):
+            line += "; sites: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(
+                    rs["fault_sites"].items()))
+        lines.append(line)
     sv = s.get("serve") or {}
     if sv.get("batches"):
         lines.append(

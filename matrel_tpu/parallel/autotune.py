@@ -18,12 +18,15 @@ still wins over both.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+_log = logging.getLogger("matrel_tpu.autotune")
 
 from matrel_tpu.config import MatrelConfig, default_config
 from matrel_tpu.core import mesh as mesh_lib, padding
@@ -79,9 +82,19 @@ def load_table(path: str) -> Dict[str, dict]:
     try:
         with open(path) as f:
             t = json.load(f)
-    except (OSError, ValueError):
+    except OSError:
+        return {}            # absent table: the normal first-run case
+    except ValueError as e:
+        # corrupt/truncated table: WARN and rebuild from empty — the
+        # session must survive a torn write (a crash mid-_persist, a
+        # disk hiccup); the next _persist rewrites a clean file
+        # (docs/RESILIENCE.md robust-reader contract)
+        _log.warning("autotune table %s is corrupt (%s); "
+                     "rebuilding from empty", path, e)
         return {}
     if not isinstance(t, dict):
+        _log.warning("autotune table %s has unexpected shape (%s); "
+                     "rebuilding from empty", path, type(t).__name__)
         return {}
     return {k: v for k, v in t.items() if _current_key_format(k)}
 
@@ -281,7 +294,7 @@ def autotune_matmul(n: int, k: int, m: int,
             continue
         try:
             t = measure_strategy(s, A, B, cfg)
-        except Exception:  # noqa: BLE001 — a strategy failing to compile
+        except Exception:  # noqa: BLE001  # matlint: disable=ML007 measurement loop — a strategy failing to compile
             continue       # on this backend just drops out of the table
         if t > 0.0:        # non-positive median = noise, not a time
             results[s] = t
@@ -484,7 +497,7 @@ def lookup_or_measure_spmv(plan, mesh,
             continue
         try:
             t = measure_spmv_variant(v, plan, mesh, cfg)
-        except Exception:  # noqa: BLE001 — a variant failing to compile
+        except Exception:  # noqa: BLE001  # matlint: disable=ML007 measurement loop — a variant failing to compile
             continue       # on this backend drops out of the table
         if t > 0.0:
             results[v] = t

@@ -238,6 +238,10 @@ MATMUL_IMPLS = {
 
 def run_matmul(strategy: str, a: jax.Array, b: jax.Array, mesh: Mesh,
                config: Optional[MatrelConfig] = None) -> jax.Array:
+    # fault site "strategy": the resilience harness's hook at strategy
+    # execution (trace time). One truthiness test when injection is off.
+    from matrel_tpu.resilience import faults as faults_lib
+    faults_lib.check("strategy", config)
     impl = MATMUL_IMPLS[strategy]
     if strategy.startswith("bmm"):
         side = "left" if strategy == "bmm_left" else "right"

@@ -1,0 +1,32 @@
+"""Resilience layer — fault injection, retry/deadline policy, and the
+plan-degradation ladder for the serve plane (docs/RESILIENCE.md).
+
+The Spark-substrate fault tolerance the reference inherited (RDD
+lineage recomputation) rebuilt as explicit mechanisms: a seeded
+fault-injection harness at the engine's instrumented choke points
+(:mod:`faults`), a typed transient/deterministic error taxonomy
+(:mod:`errors`), retry with exponential backoff + per-query deadlines
+(:mod:`retry`), and a semantics-preserving plan-degradation ladder
+each retry climbs (:mod:`degrade`). The serve pipeline adds batch
+bisection (poison-query isolation) and typed backpressure on top.
+
+Default config: injects nothing, retries nothing, bit-identical plans
+— every module here is inert until asked.
+"""
+
+from matrel_tpu.resilience.errors import (AdmissionShed,
+                                          CheckpointCorruption,
+                                          DeadlineExceeded,
+                                          DrainTimeout, InjectedFault,
+                                          PipelineClosed, QueryAborted,
+                                          ResilienceError, classify,
+                                          is_transient)
+from matrel_tpu.resilience import degrade, faults, retry
+from matrel_tpu.resilience.retry import Deadline, RetryPolicy
+
+__all__ = [
+    "AdmissionShed", "CheckpointCorruption", "DeadlineExceeded",
+    "DrainTimeout", "InjectedFault", "PipelineClosed", "QueryAborted",
+    "ResilienceError", "classify", "is_transient",
+    "Deadline", "RetryPolicy", "degrade", "faults", "retry",
+]

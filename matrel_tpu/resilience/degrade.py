@@ -1,0 +1,83 @@
+"""Plan-degradation ladder — what a RETRY is allowed to change.
+
+A bare re-run clears genuinely transient faults (a flaky collective, a
+momentary RESOURCE_EXHAUSTED), but the failure classes this engine has
+actually hit in the field are config-sensitive: a measured autotune
+winner that stopped being safe, a Pallas kernel that miscompiles on one
+backend, a poisoned result-cache entry. So each retry attempt climbs
+one rung of a CUMULATIVE ladder toward the most conservative plan the
+engine has — every rung is semantics-preserving (same answer, slower),
+which is what makes escalation safe to do blindly:
+
+    rung 0  the stamped plan as compiled (no degradation)
+    rung 1  drop measured autotune winners (cost model decides)
+    rung 2  + force the safe `xla` strategy for every matmul
+            (GSPMD picks its own decomposition — no hand collectives)
+    rung 3  + disable Pallas kernels and SpGEMM dispatch (densify
+            fallback; the XLA gather paths carry sparse matmuls)
+    rung 4  + bypass the result cache for this attempt (a poisoned
+            entry cannot answer the retry)
+
+Rungs 1–3 act through the compile config (``apply_rung``), so the
+degraded attempt recompiles under a ``degr:<rung>|``-prefixed plan key
+— a degraded plan can never be served from (or inserted into) the
+default-config cache slot, and the prefix idiom matches the axisw/prec
+prefixes. The session stamps ``plan.meta["degrade"]`` and emits one
+``degrade`` obs event per escalation so ``history --summary`` can roll
+retry/degrade rates up next to the QPS numbers they tax.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+#: Highest rung (also the result-cache bypass rung).
+MAX_RUNG = 4
+
+#: Rung at (and above) which the session bypasses the result cache.
+RC_BYPASS_RUNG = 4
+
+#: rung -> short label (plan.meta / obs events / docs).
+RUNG_LABELS = {
+    0: "none",
+    1: "no-autotune",
+    2: "xla-strategy",
+    3: "no-kernels",
+    4: "no-result-cache",
+}
+
+
+def rung_label(rung: int) -> str:
+    return RUNG_LABELS.get(rung, f"rung-{rung}")
+
+
+def apply_rung(config, rung: int):
+    """The compile config of one degraded attempt — CUMULATIVE: rung N
+    includes every restriction below it. Rung 0 returns the config
+    object UNCHANGED (identity, not a copy — the bit-identity
+    contract). Rung 4's result-cache bypass is the session's job (the
+    cache is session state, not compile config); at the config level
+    it equals rung 3."""
+    if rung <= 0:
+        return config
+    kw = {"autotune": False}
+    if rung >= 2:
+        kw["strategy_override"] = "xla"
+    if rung >= 3:
+        kw["use_pallas"] = False
+        kw["pallas_interpret"] = False
+        kw["spgemm_density_threshold"] = 0.0
+    return config.replace(**kw)
+
+
+def key_prefix(rung: int) -> str:
+    """Plan-cache key prefix for a degraded compile (the axisw/prec
+    prefix idiom) — '' at rung 0 keeps the historical key format."""
+    return "" if rung <= 0 else f"degr:{min(rung, MAX_RUNG)}|"
+
+
+def next_rung(rung: int) -> Tuple[int, bool]:
+    """(new rung, escalated?) — one step up the ladder, saturating."""
+    if rung >= MAX_RUNG:
+        return rung, False
+    return rung + 1, True

@@ -1,0 +1,164 @@
+"""Typed error taxonomy for the serve-plane resilience layer.
+
+The reference got fault tolerance for free from Spark's RDD lineage
+recomputation (PAPER.md [P2]); the jax_graft rebuild dropped that
+substrate, so recovery decisions must be made explicitly — and the
+FIRST such decision is always "is this failure worth retrying?". This
+module is the single authority for that classification:
+
+- **transient** failures (device/runtime hiccups: RESOURCE_EXHAUSTED,
+  collective timeouts, injected transients from the fault harness) are
+  retry candidates — re-running the same work can succeed.
+- **deterministic** failures (VerificationError, compile/shape/type
+  errors, injected fatals) would fail identically on every attempt;
+  retrying them burns the caller's deadline for nothing, so the retry
+  policy re-raises them immediately.
+
+Every resilience-surface error is TYPED (no bare RuntimeError strings):
+callers catch `DeadlineExceeded`/`DrainTimeout`/`AdmissionShed`/
+`PipelineClosed` by class, and the matlint ML007 rule exists precisely
+so library code cannot quietly swallow-and-continue instead of raising
+one of these.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ResilienceError(Exception):
+    """Base for every typed error the resilience layer raises itself
+    (injected faults, deadlines, sheds). External failures — XLA
+    runtime errors, verification errors — keep their own types and are
+    CLASSIFIED by :func:`classify` instead."""
+
+
+class InjectedFault(ResilienceError):
+    """A fault the seeded injection harness raised at an instrumented
+    choke point (resilience/faults.py). ``transient`` drives the retry
+    classification: transient injections model device hiccups and ARE
+    retried; fatal ones model deterministic poison and are not."""
+
+    def __init__(self, site: str, kind: str, call_index: int,
+                 rule: Optional[str] = None):
+        self.site = site
+        self.kind = kind
+        self.transient = kind == "transient"
+        self.call_index = call_index
+        self.rule = rule
+        super().__init__(
+            f"injected {kind} fault at site {site!r} "
+            f"(call #{call_index}"
+            + (f", rule {rule!r}" if rule else "") + ")")
+
+
+class DeadlineExceeded(ResilienceError, TimeoutError):
+    """A query's per-query deadline expired before it produced a
+    result — raised at admission, between retry attempts, or when a
+    backoff sleep would overshoot the deadline. Never retried."""
+
+    def __init__(self, deadline_ms: float, elapsed_ms: float,
+                 context: str = "query"):
+        self.deadline_ms = deadline_ms
+        self.elapsed_ms = elapsed_ms
+        super().__init__(
+            f"{context} deadline of {deadline_ms:.0f} ms exceeded "
+            f"({elapsed_ms:.0f} ms elapsed)")
+
+
+class QueryAborted(ResilienceError):
+    """The caller cancelled (or the pipeline stopped) BETWEEN retry
+    attempts — the sanctioned cancellation point: a running XLA
+    dispatch cannot be interrupted, but the retry loop checks its
+    abort hook before every new attempt."""
+
+
+class DrainTimeout(ResilienceError, TimeoutError):
+    """``session.serve_drain(timeout=...)`` gave up waiting on a wedged
+    admission worker. The queue state is untouched — a later drain
+    (or a healthy worker) can still finish the work."""
+
+    def __init__(self, timeout_s: float, pending: int):
+        self.timeout_s = timeout_s
+        self.pending = pending
+        super().__init__(
+            f"serve drain timed out after {timeout_s:g} s "
+            f"({pending} task(s) still unfinished)")
+
+
+class PipelineClosed(ResilienceError):
+    """``submit`` after ``close()``: the admission worker is stopped,
+    so enqueueing would strand the future forever. Typed so callers
+    can distinguish "session shut down" from a query failure."""
+
+
+class AdmissionShed(ResilienceError):
+    """Backpressure shed: the bounded admission queue
+    (``config.serve_queue_max``) is full, so this submission is
+    REFUSED rather than allowed to grow the queue without bound — the
+    typed load-shedding contract protecting the rest of the stream."""
+
+    def __init__(self, queue_max: int):
+        self.queue_max = queue_max
+        super().__init__(
+            f"serve admission queue full ({queue_max} pending); "
+            f"submission shed — retry later or raise "
+            f"config.serve_queue_max")
+
+
+class CheckpointCorruption(ResilienceError):
+    """A checkpoint artifact failed its stored checksum (or its
+    metadata does not parse): the restore refuses to hand back
+    silently-corrupt arrays. The caller decides whether an older step
+    is acceptable."""
+
+
+#: Exception type names treated as transient runtime faults — the
+#: device/runtime layer's own failure vocabulary (jax wraps XLA status
+#: codes into these). Matched by NAME so the taxonomy works across jax
+#: versions that move the classes between modules.
+_TRANSIENT_TYPE_NAMES = frozenset({
+    "XlaRuntimeError", "JaxRuntimeError", "InternalError",
+})
+
+#: Message substrings that mark an otherwise-ambiguous runtime error
+#: transient: XLA status codes a retry can plausibly clear.
+_TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED", "UNAVAILABLE",
+    "ABORTED", "INTERNAL", "collective", "out of memory",
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """True when a retry of the SAME work can plausibly succeed."""
+    if isinstance(exc, InjectedFault):
+        return exc.transient
+    if isinstance(exc, ResilienceError):
+        # deadlines, sheds, closed pipelines, corruption: all
+        # deterministic by construction — retrying cannot help
+        return False
+    name = type(exc).__name__
+    if name == "VerificationError":
+        # the static verifier's findings are properties of the PLAN —
+        # identical on every attempt (the ladder may change the plan,
+        # but that is an escalation decision, not a retry decision)
+        return False
+    if name in _TRANSIENT_TYPE_NAMES:
+        return True
+    if isinstance(exc, (MemoryError,)):
+        return True
+    if isinstance(exc, (ValueError, TypeError, KeyError,
+                        NotImplementedError, AssertionError,
+                        AttributeError, IndexError, ZeroDivisionError)):
+        # compile/user/shape errors: deterministic
+        return False
+    msg = str(exc)
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def classify(exc: BaseException) -> str:
+    """``"transient"`` or ``"deterministic"`` — the retry policy's one
+    question. Unknown exception types classify DETERMINISTIC unless
+    they carry a transient marker: silently retrying an unknown bug
+    class would mask it (and burn deadline) instead of surfacing it."""
+    return "transient" if is_transient(exc) else "deterministic"

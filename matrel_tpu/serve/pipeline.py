@@ -19,7 +19,23 @@ backpressure).
 Futures resolve with the BlockMatrix as soon as its batch is
 DISPATCHED (the array is usable immediately; touching its values
 blocks until the device delivers them — ordinary JAX semantics).
-Compile/planning errors fail every future of their batch.
+
+Resilience contracts (docs/RESILIENCE.md):
+
+- **Poison-query isolation by batch bisection**: a failing MultiPlan is
+  recursively SPLIT instead of failing every sibling future — only the
+  poison query's own future resolves with the (typed) error, siblings
+  re-admit in halves and complete normally. Depth is bounded by
+  log2(batch).
+- **Backpressure**: ``config.serve_queue_max`` bounds the admission
+  queue; a submit against a full queue raises the typed
+  ``AdmissionShed`` rather than growing the queue without bound.
+- **Deadlines**: a future whose per-query deadline expires while
+  queued — or whose batch finishes past it — resolves with the typed
+  ``DeadlineExceeded``; expired entries never reach compilation.
+- **Typed shutdown**: ``drain(timeout=...)`` raises ``DrainTimeout``
+  instead of hanging on a wedged worker; ``submit`` after ``close()``
+  raises ``PipelineClosed`` instead of enqueueing into a dead worker.
 """
 
 from __future__ import annotations
@@ -30,8 +46,15 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
+from typing import Optional
 
 from matrel_tpu.obs import trace as trace_lib
+from matrel_tpu.resilience import faults as faults_lib
+from matrel_tpu.resilience import retry as retry_lib
+from matrel_tpu.resilience.errors import (AdmissionShed,
+                                          DeadlineExceeded,
+                                          DrainTimeout, PipelineClosed)
+from matrel_tpu.resilience.retry import Deadline
 
 log = logging.getLogger("matrel_tpu.serve")
 
@@ -46,41 +69,102 @@ class ServePipeline:
         self.session = session
         self.max_batch = session.config.serve_max_batch
         self.max_inflight = session.config.serve_max_inflight
-        self._q: "queue.Queue" = queue.Queue()
+        self.queue_max = session.config.serve_queue_max
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.queue_max)
         self._inflight: "collections.deque" = collections.deque()
         self._worker: threading.Thread = None
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._closed = False
+        # RLock: submit() holds it across the closed-check + enqueue +
+        # _ensure_worker (which locks again) so a concurrent close()
+        # can never interleave between them
+        self._lock = threading.RLock()
 
     # -- public surface ----------------------------------------------------
 
-    def submit(self, expr, sla: str = "default") -> Future:
+    def submit(self, expr, sla: str = "default",
+               deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one query; returns its future. ``sla`` is the
         query's precision SLA — the admission worker only coalesces
         same-SLA queries into one MultiPlan (one planning config per
-        batch; mixed SLAs run as separate sub-batches)."""
+        batch; mixed SLAs run as separate sub-batches).
+        ``deadline_ms`` starts the query's deadline clock NOW (queue
+        wait counts against it)."""
         fut: Future = Future()
+        dl = Deadline(deadline_ms) if deadline_ms is not None else None
         # enqueue timestamp, not a measurement: its delta lands in the
         # serve event record as queue_wait_ms
-        self._q.put((expr, fut, time.perf_counter(), sla))  # matlint: disable=ML006 queue-wait timestamp — lands in the serve event record
-        self._ensure_worker()
+        entry = (expr, fut, time.perf_counter(), sla, dl)  # matlint: disable=ML006 queue-wait timestamp — lands in the serve event record
+        # closed-check + enqueue + worker-ensure are ONE atomic step
+        # vs close(): a submit that passes the check enqueues with the
+        # worker alive BEFORE close() can flip _closed, and close()'s
+        # drain then still processes the entry — no future can ever be
+        # stranded in a dead queue
+        with self._lock:
+            if self._closed:
+                raise PipelineClosed(
+                    "submit after close(): the admission worker is "
+                    "stopped — build a new session (or pipeline) to "
+                    "serve again")
+            try:
+                self._q.put_nowait(entry)
+            except queue.Full:
+                # typed load shed: the bounded queue protects the
+                # queries already admitted — growing it unboundedly
+                # would trade one caller's latency for every caller's
+                # memory
+                raise AdmissionShed(self.queue_max) from None
+            self._ensure_worker()
         return fut
 
-    def drain(self) -> None:
+    def drain(self, timeout: Optional[float] = None) -> None:
         """Block until every submitted query is dispatched AND every
-        dispatched batch has materialised on device."""
-        self._q.join()
+        dispatched batch has materialised on device. ``timeout``
+        (seconds) bounds the whole wait: a wedged worker raises the
+        typed ``DrainTimeout``; queue state is untouched."""
+        t_abs = (retry_lib.now() + timeout
+                 if timeout is not None else None)
+        # queue.Queue.join() has no timeout — wait the same condition
+        # it waits, re-checking the clock on every wakeup
+        with self._q.all_tasks_done:
+            while self._q.unfinished_tasks:
+                rem = (None if t_abs is None
+                       else t_abs - retry_lib.now())
+                if rem is not None and rem <= 0:
+                    raise DrainTimeout(timeout,
+                                       self._q.unfinished_tasks)
+                self._q.all_tasks_done.wait(rem)
         while self._inflight:
+            rem = None if t_abs is None else t_abs - retry_lib.now()
+            if rem is not None and rem <= 0:
+                raise DrainTimeout(timeout, len(self._inflight))
             try:
                 outs = self._inflight.popleft()
             except IndexError:      # worker synced it concurrently
                 break
-            _sync(outs)
+            if not _sync_bounded(outs, rem):
+                # a device-side wedge: block_until_ready cannot be
+                # interrupted, so the sync ran on a helper thread and
+                # the batch goes BACK in front (a later drain — or the
+                # still-running helper — can finish it)
+                self._inflight.appendleft(outs)
+                raise DrainTimeout(timeout, len(self._inflight))
 
-    def close(self) -> None:
-        """Stop the worker after the queue drains."""
-        self.drain()
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop the worker after the queue drains. A later ``submit``
+        raises the typed ``PipelineClosed``."""
+        with self._lock:
+            # flip FIRST (atomic vs submit): any submit that already
+            # passed the check has its entry enqueued with the worker
+            # alive, and the drain below processes it; any later one
+            # raises typed
+            self._closed = True
+        self.drain(timeout=timeout)
         self._stop.set()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
 
     @property
     def inflight_depth(self) -> int:
@@ -90,6 +174,8 @@ class ServePipeline:
 
     def _ensure_worker(self) -> None:
         with self._lock:
+            if self._closed:
+                return
             if self._worker is None or not self._worker.is_alive():
                 self._stop.clear()
                 self._worker = threading.Thread(
@@ -108,10 +194,11 @@ class ServePipeline:
                     pulled.append(self._q.get_nowait())
                 except queue.Empty:
                     break
-            # normalise legacy 3-tuple entries (pre-SLA white-box
-            # callers enqueue (expr, fut, t_enq)) to the 4-tuple shape
-            pulled = [it if len(it) > 3 else (*it, "default")
-                      for it in pulled]
+            # normalise legacy short entries (pre-SLA white-box callers
+            # enqueue (expr, fut, t_enq); pre-deadline ones the
+            # 4-tuple) to the 5-tuple shape
+            pulled = [(*it, *(("default", None)[len(it) - 3:]))
+                      if len(it) < 5 else it for it in pulled]
             # transition each future to RUNNING; a future the caller
             # cancelled while queued drops out here (and can no longer
             # be cancelled mid-flight) — set_result on a cancelled
@@ -119,13 +206,24 @@ class ServePipeline:
             # stranding every sibling future of the batch
             batch = [it for it in pulled
                      if it[1].set_running_or_notify_cancel()]
+            # deadline shed BEFORE compilation: an entry that expired
+            # while queued resolves typed and never costs a compile
+            live = []
+            for it in batch:
+                dl = it[4]
+                if dl is not None and dl.expired():
+                    _fail(it[1], DeadlineExceeded(
+                        dl.budget_ms, dl.elapsed_ms(),
+                        context="queued query"))
+                else:
+                    live.append(it)
             t_admit = time.perf_counter()  # matlint: disable=ML006 queue-wait timestamp — lands in the serve event record
             # same-SLA sub-batches, admission order preserved: one
             # MultiPlan compiles under ONE planning config, so a
             # "fast" submission must never ride an "exact" query's
             # batch (precision SLAs are per query, not per batch)
             groups: "collections.OrderedDict" = collections.OrderedDict()
-            for it in batch:
+            for it in live:
                 groups.setdefault(it[3], []).append(it)
             try:
                 for sla, part in groups.items():
@@ -136,12 +234,28 @@ class ServePipeline:
 
     def _admit_group(self, sla: str, batch: list,
                      t_admit: float) -> None:
+        self._run_group(sla, batch, t_admit, depth=0,
+                        retries=self.session.config.retry_max_attempts)
+
+    def _run_group(self, sla: str, batch: list, t_admit: float,
+                   depth: int, retries: int = 0) -> None:
         """Run one same-SLA sub-batch through session.run_many and
-        resolve its futures; a planning/compile failure fails only
-        THIS group's futures and the worker survives."""
+        resolve its futures. A failing batch BISECTS: the halves
+        re-admit independently, so one poison query fails only its own
+        future (typed) while every sibling completes — the worker
+        survives regardless. A single-query group that fails TRANSIENT
+        re-admits up to ``retries`` times (the admission-level sites
+        sit outside run_many's own retry loop), so injected admission
+        hiccups converge instead of failing a healthy query."""
+        if not batch:
+            return
         waits_ms = [round((t_admit - t_enq) * 1e3, 3)
-                    for _, _, t_enq, _ in batch]
+                    for _, _, t_enq, _, _ in batch]
         try:
+            # fault site "serve_admit" INSIDE the try: an injected
+            # admission fault exercises the same bisection/re-admission
+            # path as any other batch failure (free when off)
+            faults_lib.check("serve_admit", self.session.config)
             # worker-thread tracer activation: the admission
             # span is the serve trail's root — run_many's
             # batch/plan/execute spans parent-link under it,
@@ -152,27 +266,58 @@ class ServePipeline:
                     trace_lib.span(
                         "serve.admit", batch=len(batch),
                         inflight=len(self._inflight),
+                        bisect_depth=depth,
                         max_wait_ms=(max(waits_ms)
                                      if waits_ms else 0.0)):
                 outs = self.session.run_many(
-                    [e for e, _, _, _ in batch],
+                    [e for e, _, _, _, _ in batch],
                     precision=sla,
                     _queue_wait_ms=waits_ms,
                     _inflight_depth=len(self._inflight))
         except Exception as ex:  # noqa: BLE001 — any planning/
-            # compile failure fails every future of the batch; the
-            # worker survives to serve the next one
-            dump = getattr(self.session, "_flight_auto_dump", None)
-            if dump is not None:
-                # the post-mortem trail for a failed serve batch
-                # (no-op when the flight recorder is off)
-                dump(ex, reason="serve_batch_failure")
-            for _, fut, _, _ in batch:
-                if not fut.done():
-                    fut.set_exception(ex)
+            # compile/execute failure either bisects (isolating the
+            # poison query), re-admits a transient single, or fails
+            # the lone future typed; the worker survives either way
+            if depth == 0:
+                dump = getattr(self.session, "_flight_auto_dump", None)
+                if dump is not None:
+                    # the post-mortem trail for a failed serve batch
+                    # (no-op when the flight recorder is off)
+                    dump(ex, reason="serve_batch_failure")
+            emit = getattr(self.session, "_emit_retry_event", None)
+            if len(batch) == 1:
+                from matrel_tpu.resilience.errors import is_transient
+                if retries > 0 and is_transient(ex):
+                    if emit is not None:
+                        emit(ex, attempt=depth + 1, rung=0,
+                             scope="serve_readmit")
+                    self._run_group(sla, batch, t_admit, depth + 1,
+                                    retries=retries - 1)
+                else:
+                    _fail(batch[0][1], ex)
+                return
+            # POISON ISOLATION: split and re-admit each half — only
+            # the failing query's own future ends up carrying the
+            # error. Recursion depth is bounded by log2(batch).
+            if emit is not None:
+                emit(ex, attempt=depth + 1, rung=0,
+                     scope="serve_bisect")
+            mid = len(batch) // 2
+            self._run_group(sla, batch[:mid], t_admit, depth + 1,
+                            retries=retries)
+            self._run_group(sla, batch[mid:], t_admit, depth + 1,
+                            retries=retries)
         else:
-            for (_, fut, _, _), out in zip(batch, outs):
-                if not fut.done():
+            for (_, fut, _, _, dl), out in zip(batch, outs):
+                if dl is not None and dl.expired():
+                    # the batch finished past this query's deadline:
+                    # the future resolves TYPED (the result exists but
+                    # the caller's SLA already failed — honoring it
+                    # beats handing back a late answer marked on-time)
+                    _fail(fut, DeadlineExceeded(
+                        dl.budget_ms, dl.elapsed_ms(),
+                        context="served query"))
+                elif not fut.done():
                     fut.set_result(out)
             if outs:
                 self._inflight.append(outs)
@@ -183,6 +328,27 @@ class ServePipeline:
                     _sync(self._inflight.popleft())
                 except IndexError:
                     break
+
+
+def _fail(fut: Future, ex: BaseException) -> None:
+    if not fut.done():
+        fut.set_exception(ex)
+
+
+def _sync_bounded(outs, rem: Optional[float]) -> bool:
+    """Sync one dispatched batch within ``rem`` seconds (None = no
+    bound). ``block_until_ready`` itself cannot be interrupted, so the
+    bounded form runs it on a daemon helper and gives up on it after
+    the budget — returning False so the caller can raise the typed
+    ``DrainTimeout`` instead of hanging (the drain contract)."""
+    if rem is None:
+        _sync(outs)
+        return True
+    t = threading.Thread(target=_sync, args=(outs,),
+                         name="matrel-serve-sync", daemon=True)
+    t.start()
+    t.join(rem)
+    return not t.is_alive()
 
 
 def _sync(outs) -> None:

@@ -31,6 +31,10 @@ from matrel_tpu.core import mesh as mesh_lib
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.ir.expr import MatExpr, as_expr
 from matrel_tpu.obs import trace as trace_lib
+from matrel_tpu.resilience import degrade as degrade_lib
+from matrel_tpu.resilience import errors as rerrors
+from matrel_tpu.resilience import faults as faults_lib
+from matrel_tpu.resilience.retry import RetryPolicy
 from matrel_tpu.serve.result_cache import (CacheEntry, ResultCache,
                                            result_nbytes)
 
@@ -149,7 +153,7 @@ class MatrelSession:
         the keep-k policy the moment older saves carry higher steps).
         Returns the step path."""
         from matrel_tpu.utils.checkpoint import CheckpointManager
-        mgr = CheckpointManager(directory)
+        mgr = CheckpointManager(directory, config=self.config)
         if step is None:
             step = mgr.next_step()
         return mgr.save(step, matrices=dict(self.catalog))
@@ -160,7 +164,9 @@ class MatrelSession:
         catalog (sharding-preserving, existing names overwritten).
         Returns the restored names; empty directory → empty list."""
         from matrel_tpu.utils.checkpoint import CheckpointManager
-        got = CheckpointManager(directory).restore(self.mesh, step)
+        got = CheckpointManager(directory,
+                                config=self.config).restore(self.mesh,
+                                                            step)
         if got is None:
             return []
         _step, mats, _arrays, _state = got
@@ -218,22 +224,31 @@ class MatrelSession:
             return self.config
         return self.config.replace(precision_sla=sla)
 
-    def _compile_entry(self, e: MatExpr, sla: Optional[str] = None
+    def _compile_entry(self, e: MatExpr, sla: Optional[str] = None,
+                       rung: int = 0
                        ) -> Tuple[executor_lib.CompiledPlan, bool, str]:
         """(plan, cache_hit, key) — the compile path with its cache
         outcome exposed, so compute() can emit hit/miss events without
-        a second key computation."""
+        a second key computation. ``rung`` > 0 compiles a DEGRADED
+        retry attempt (resilience/degrade.py): the config loses the
+        rung's features and the key gains the ``degr:<rung>|`` prefix,
+        so a degraded plan never shares a cache slot with the stamped
+        original (the axisw/prec prefix idiom)."""
         sla = sla if sla is not None else self.config.precision_sla
+        # fault site "compile" (resilience/faults.py): free when off
+        faults_lib.check("compile", self.config)
         key, pins = _plan_key(e)
-        key = self._axisw_prefix() + _prec_prefix(sla) + key
+        key = (degrade_lib.key_prefix(rung) + self._axisw_prefix()
+               + _prec_prefix(sla) + key)
         with self._compile_lock:
             plan = self._plan_cache.get(key)
             if plan is not None:
                 self._plan_cache.move_to_end(key)
                 return plan, True, key
             try:
-                plan = executor_lib.compile_expr(e, self.mesh,
-                                                 self._sla_config(sla))
+                plan = executor_lib.compile_expr(
+                    e, self.mesh,
+                    degrade_lib.apply_rung(self._sla_config(sla), rung))
             except Exception as ex:
                 # post-mortem trail BEFORE the error propagates: a
                 # VerificationError / compile failure in the field
@@ -249,6 +264,11 @@ class MatrelSession:
             # reachable from the expr, so its old value is pinned
             # explicitly via the collected pins list.
             plan._cache_pin = (e, pins)
+            if rung:
+                # the rung rides the plan so obs events / explain say
+                # WHICH ladder step produced this attempt's plan
+                plan.meta["degrade"] = {
+                    "rung": rung, "label": degrade_lib.rung_label(rung)}
             self._plan_cache[key] = plan
             self._plan_cache_bytes += _plan_bytes(plan)
             self._evict_plans()
@@ -266,7 +286,8 @@ class MatrelSession:
         return f"axisw:{wts[0]:g}x{wts[1]:g}|"
 
     def _compile_multi_entry(self, roots: List[MatExpr],
-                             sla: Optional[str] = None
+                             sla: Optional[str] = None,
+                             rung: int = 0
                              ) -> Tuple["executor_lib.MultiPlan", bool,
                                         List[str]]:
         """(multiplan, cache_hit, per-root keys) — the MultiPlan twin
@@ -279,6 +300,8 @@ class MatrelSession:
         its root-key order (``_root_keys``) so callers can map outputs
         back to their own root order."""
         sla = sla if sla is not None else self.config.precision_sla
+        # fault site "compile": the MultiPlan twin shares the site
+        faults_lib.check("compile", self.config)
         keyed = []
         pins_all: list = []
         for e in roots:
@@ -289,7 +312,8 @@ class MatrelSession:
         for k, e in zip(keyed, roots):
             uniq.setdefault(k, e)
         skeys = sorted(uniq)
-        mkey = ("multi:" + self._axisw_prefix() + _prec_prefix(sla)
+        mkey = ("multi:" + degrade_lib.key_prefix(rung)
+                + self._axisw_prefix() + _prec_prefix(sla)
                 + "||".join(skeys))
         with self._compile_lock:
             plan = self._plan_cache.get(mkey)
@@ -299,10 +323,13 @@ class MatrelSession:
             try:
                 plan = executor_lib.compile_exprs(
                     [uniq[k] for k in skeys], self.mesh,
-                    self._sla_config(sla))
+                    degrade_lib.apply_rung(self._sla_config(sla), rung))
             except Exception as ex:
                 self._flight_auto_dump(ex)   # same trail as the
                 raise                        # single-plan entry
+            if rung:
+                plan.meta["degrade"] = {
+                    "rung": rung, "label": degrade_lib.rung_label(rung)}
             plan._cache_pin = (tuple(uniq[k] for k in skeys), pins_all)
             plan._root_keys = tuple(skeys)
             self._plan_cache[mkey] = plan
@@ -359,6 +386,9 @@ class MatrelSession:
         keys under it, so a ``"fast"`` entry can never answer an
         ``"exact"`` query (or vice versa) — accuracy SLAs partition
         the cache, they do not share it."""
+        # fault site "rc_probe": a faulting cache consult is exactly
+        # what the ladder's rung-4 bypass exists to route around
+        faults_lib.check("rc_probe", self.config)
         parts, pins, spans = _plan_key_spans(e)
         key = prefix + "|".join(parts)
         ent = self._result_cache.lookup(key)
@@ -711,14 +741,23 @@ class MatrelSession:
         return out
 
     def compute(self, expr: MatExpr,
-                precision: Optional[str] = None) -> BlockMatrix:
+                precision: Optional[str] = None,
+                deadline_ms: Optional[float] = None) -> BlockMatrix:
         """Execute one query. ``precision`` is the per-query accuracy
         SLA ("exact"/"high"/"fast"/explicit dtype — docs/PRECISION.md);
         None defers to a SQL PRECISION clause, then
-        ``config.precision_sla``."""
+        ``config.precision_sla``. ``deadline_ms`` is the per-query
+        deadline (None defers to ``config.deadline_ms``; expiry raises
+        the typed ``DeadlineExceeded`` — docs/RESILIENCE.md)."""
         e = as_expr(expr)
         sla = self._resolve_sla(precision, e)
+        # resilience gate (retry/deadline/fault-injection): None for
+        # the default config + no per-call deadline — the resilient
+        # path is never entered and costs nothing
+        pol = RetryPolicy.from_config(self.config, deadline_ms)
         rc = self._rc_enabled()
+        if pol is not None:
+            return self._compute_resilient(e, rc, sla, pol)
         if (not rc and not self._obs_enabled()
                 and self._tracer is None):
             # the production path: zero event assembly, zero extra
@@ -734,9 +773,11 @@ class MatrelSession:
             return self._compute_observed(e, rc, sla)
 
     def _compute_observed(self, e: MatExpr, rc: bool,
-                          sla: Optional[str] = None) -> BlockMatrix:
+                          sla: Optional[str] = None,
+                          rung: int = 0) -> BlockMatrix:
         """compute() behind the fast-path gate: result-cache admission,
-        compile, execute — each scoped by a tracing span."""
+        compile, execute — each scoped by a tracing span. ``rung`` is
+        the resilient path's degradation-ladder step (0 = none)."""
         sla = sla if sla is not None else self.config.precision_sla
         key = pins = None
         if rc:
@@ -754,7 +795,10 @@ class MatrelSession:
                                     exc_info=True)
                 return ent.result
         with trace_lib.span("plan"):
-            plan, hit, pkey = self._compile_entry(e, sla=sla)
+            plan, hit, pkey = self._compile_entry(e, sla=sla, rung=rung)
+        # fault site "execute": the host-side dispatch point — the main
+        # retryable site (per attempt, unlike the trace-time sites)
+        faults_lib.check("execute", self.config)
         if self._obs_enabled():
             out = self._run_observed(e, plan, hit, pkey)
         else:
@@ -766,12 +810,91 @@ class MatrelSession:
             self._rc_insert(key, pins, e, out)
         return out
 
+    # -- resilient execution (matrel_tpu/resilience/) ----------------------
+
+    def _compute_resilient(self, e: MatExpr, rc: bool, sla: str,
+                           pol: RetryPolicy,
+                           should_abort=None) -> BlockMatrix:
+        """The attempt loop: run the query; on a TRANSIENT failure
+        (errors.classify) retry with backoff, climbing one rung of the
+        plan-degradation ladder per retry (resilience/degrade.py) —
+        rung 4 additionally bypasses the result cache. Deterministic
+        failures, exhausted attempts, and expired deadlines propagate
+        typed. Cancellation (``should_abort``) is honored between
+        attempts — a running XLA dispatch is never interrupted."""
+        deadline = pol.deadline()
+        attempt = 0
+        rung = 0
+        while True:
+            deadline.raise_if_expired()
+            try:
+                with trace_lib.activate(self._tracer), \
+                        trace_lib.span("query", root_kind=e.kind,
+                                       attempt=attempt, rung=rung):
+                    out = self._compute_observed(
+                        e, rc and rung < degrade_lib.RC_BYPASS_RUNG,
+                        sla, rung=rung)
+                # deadline holds on SUCCESS too: a result delivered
+                # past the SLA raises typed, matching submit()'s
+                # late-batch semantics (one meaning per knob)
+                deadline.raise_if_expired()
+                return out
+            except Exception as ex:
+                self._emit_fault_event(ex, scope="query")
+                if not pol.should_retry(ex, attempt):
+                    raise
+                attempt += 1
+                rung, escalated = degrade_lib.next_rung(rung)
+                self._emit_retry_event(ex, attempt, rung,
+                                       scope="query")
+                if escalated:
+                    self._emit_degrade_event(rung, ex, scope="query")
+                pol.backoff_sleep(attempt, deadline,
+                                  should_abort=should_abort)
+
+    def _emit_fault_event(self, ex: BaseException, scope: str) -> None:
+        """One ``fault`` record per failure the resilient path caught
+        (obs on / flight recorder on; no-op otherwise). Injected
+        faults carry their site/kind so the chaos drill and history
+        roll-up can attribute them."""
+        rec = {"scope": scope, "error": type(ex).__name__,
+               "classification": rerrors.classify(ex),
+               "message": str(ex)[:200]}
+        if isinstance(ex, rerrors.InjectedFault):
+            rec["site"] = ex.site
+            rec["injected"] = True
+        try:
+            self._obs_emit("fault", rec)
+        except Exception:
+            log.warning("obs: fault event dropped", exc_info=True)
+
+    def _emit_retry_event(self, ex: BaseException, attempt: int,
+                          rung: int, scope: str) -> None:
+        try:
+            self._obs_emit("retry", {
+                "scope": scope, "attempt": attempt, "rung": rung,
+                "rung_label": degrade_lib.rung_label(rung),
+                "error": type(ex).__name__})
+        except Exception:
+            log.warning("obs: retry event dropped", exc_info=True)
+
+    def _emit_degrade_event(self, rung: int, ex: BaseException,
+                            scope: str) -> None:
+        try:
+            self._obs_emit("degrade", {
+                "scope": scope, "rung": rung,
+                "rung_label": degrade_lib.rung_label(rung),
+                "cause": type(ex).__name__})
+        except Exception:
+            log.warning("obs: degrade event dropped", exc_info=True)
+
     # alias: the reference's Dataset actions read as "run the query"
     run = compute
 
     # -- micro-batched admission + async pipeline (serve/) -----------------
 
     def run_many(self, exprs, precision: Optional[str] = None,
+                 deadline_ms: Optional[float] = None,
                  _queue_wait_ms=None,
                  _inflight_depth: int = 0) -> List[BlockMatrix]:
         """Execute several queries as ONE micro-batched admission: the
@@ -788,6 +911,10 @@ class MatrelSession:
         serve pipeline groups mixed-SLA submissions into same-SLA
         batches before calling here).
 
+        ``deadline_ms`` is the BATCH deadline (None defers to
+        ``config.deadline_ms``): expiry between retry attempts raises
+        the typed ``DeadlineExceeded`` for the whole batch.
+
         The underscore parameters are the serve pipeline's channel for
         queue-wait/in-flight observability; direct callers leave them
         alone."""
@@ -796,6 +923,11 @@ class MatrelSession:
             return []
         sla = (normalize_sla(precision) if precision is not None
                else self.config.precision_sla)
+        pol = RetryPolicy.from_config(self.config, deadline_ms)
+        if pol is not None:
+            return self._run_many_resilient(es, sla, pol,
+                                            _queue_wait_ms,
+                                            _inflight_depth)
         rc = self._rc_enabled()
         obs = self._obs_enabled()
         with trace_lib.activate(self._tracer), \
@@ -804,10 +936,50 @@ class MatrelSession:
                                            _queue_wait_ms,
                                            _inflight_depth, sla)
 
+    def _run_many_resilient(self, es, sla: str, pol: RetryPolicy,
+                            _queue_wait_ms, _inflight_depth,
+                            should_abort=None) -> List[BlockMatrix]:
+        """``_compute_resilient``'s batch twin: the whole MultiPlan
+        retries as one unit, climbing the same ladder (poison-query
+        ISOLATION is the serve worker's bisection, not this loop —
+        a direct run_many call is one caller asking for one batch)."""
+        deadline = pol.deadline()
+        attempt = 0
+        rung = 0
+        while True:
+            deadline.raise_if_expired(context="batch")
+            rc = (self._rc_enabled()
+                  and rung < degrade_lib.RC_BYPASS_RUNG)
+            obs = self._obs_enabled()
+            try:
+                with trace_lib.activate(self._tracer), \
+                        trace_lib.span("serve.batch", size=len(es),
+                                       attempt=attempt,
+                                       rung=rung) as sp_batch:
+                    outs = self._run_many_observed(
+                        es, rc, obs, sp_batch, _queue_wait_ms,
+                        _inflight_depth, sla, rung=rung)
+                # SLA semantics match _compute_resilient/submit: a
+                # batch finishing past its deadline raises typed
+                deadline.raise_if_expired(context="batch")
+                return outs
+            except Exception as ex:
+                self._emit_fault_event(ex, scope="batch")
+                if not pol.should_retry(ex, attempt):
+                    raise
+                attempt += 1
+                rung, escalated = degrade_lib.next_rung(rung)
+                self._emit_retry_event(ex, attempt, rung,
+                                       scope="batch")
+                if escalated:
+                    self._emit_degrade_event(rung, ex, scope="batch")
+                pol.backoff_sleep(attempt, deadline,
+                                  should_abort=should_abort)
+
     def _run_many_observed(self, es, rc, obs, sp_batch, _queue_wait_ms,
                            _inflight_depth,
-                           sla: Optional[str] = None
-                           ) -> List[BlockMatrix]:
+                           sla: Optional[str] = None,
+                           rung: int = 0) -> List[BlockMatrix]:
         sla = sla if sla is not None else self.config.precision_sla
         results: dict = {}
         rc_meta: dict = {}
@@ -834,8 +1006,10 @@ class MatrelSession:
         if pend:
             with trace_lib.span("plan", roots=len(pend)):
                 plan, plan_hit, keys = self._compile_multi_entry(
-                    [e for _, e in pend], sla=sla)
+                    [e for _, e in pend], sla=sla, rung=rung)
             pos = {k: j for j, k in enumerate(plan._root_keys)}
+            # fault site "execute" — per batch attempt (host side)
+            faults_lib.check("execute", self.config)
             # the batch's execute span: under obs the sync happens
             # INSIDE it (dur = device wall); flight-recorder-only runs
             # mark dispatch without adding a sync
@@ -894,7 +1068,8 @@ class MatrelSession:
                 log.warning("obs: serve event dropped", exc_info=True)
         return [results[i] for i in range(len(es))]
 
-    def submit(self, expr, precision: Optional[str] = None):
+    def submit(self, expr, precision: Optional[str] = None,
+               deadline_ms: Optional[float] = None):
         """Asynchronous query admission: returns a
         ``concurrent.futures.Future`` resolving to the BlockMatrix.
         Concurrent submissions coalesce into micro-batches
@@ -903,7 +1078,14 @@ class MatrelSession:
         by ``config.serve_max_inflight`` (serve/pipeline.py).
         ``precision`` rides each submission: the admission worker only
         coalesces SAME-SLA queries into one MultiPlan, so a "fast"
-        neighbour can never change an "exact" query's numerics."""
+        neighbour can never change an "exact" query's numerics.
+
+        ``deadline_ms`` rides each submission too (None defers to
+        ``config.deadline_ms``): a future whose deadline expires while
+        queued — or whose batch finishes past it — resolves with the
+        typed ``DeadlineExceeded``. Submitting into a CLOSED pipeline
+        raises the typed ``PipelineClosed``; a full bounded queue
+        (``config.serve_queue_max``) raises ``AdmissionShed``."""
         if self._serve is None:
             from matrel_tpu.serve.pipeline import ServePipeline
             # under the lock: two concurrent FIRST submissions must not
@@ -913,13 +1095,26 @@ class MatrelSession:
                 if self._serve is None:
                     self._serve = ServePipeline(self)
         e = as_expr(expr)
-        return self._serve.submit(e, self._resolve_sla(precision, e))
+        if deadline_ms is None and self.config.deadline_ms > 0:
+            deadline_ms = self.config.deadline_ms
+        return self._serve.submit(e, self._resolve_sla(precision, e),
+                                  deadline_ms=deadline_ms)
 
-    def serve_drain(self) -> None:
+    def serve_drain(self, timeout: Optional[float] = None) -> None:
         """Block until every submitted query has been dispatched and
-        every in-flight batch has materialised."""
+        every in-flight batch has materialised. ``timeout`` (seconds)
+        bounds the wait: a wedged admission worker raises the typed
+        ``DrainTimeout`` instead of hanging the caller forever; the
+        queue state is untouched, so a later drain can still finish."""
         if self._serve is not None:
-            self._serve.drain()
+            self._serve.drain(timeout=timeout)
+
+    def serve_close(self, timeout: Optional[float] = None) -> None:
+        """Drain then stop the admission worker. A later ``submit``
+        raises the typed ``PipelineClosed`` (never enqueues into a
+        dead worker)."""
+        if self._serve is not None:
+            self._serve.close(timeout=timeout)
 
     def explain(self, expr: MatExpr, physical: bool = True,
                 analyze: bool = False,

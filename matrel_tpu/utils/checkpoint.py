@@ -18,6 +18,7 @@ file per addressable shard (the layout leaves room: files are per-name).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
@@ -29,6 +30,38 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.resilience import faults as faults_lib
+from matrel_tpu.resilience.errors import CheckpointCorruption
+
+
+def _file_sha1(path: str) -> str:
+    """Streamed sha1 of one artifact file — the stored checksum the
+    restore path verifies (a torn write, disk bit-flip, or truncated
+    copy must fail TYPED, never hand back silently-corrupt arrays)."""
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _verify_file(d: str, fname: str, meta: Dict[str, Any]) -> str:
+    """Path of one checkpoint artifact, checksum-verified when the
+    metadata carries one (legacy checkpoints without checksums load
+    unverified — backward compatible by construction)."""
+    path = os.path.join(d, fname)
+    want = (meta.get("checksums") or {}).get(fname)
+    if want is not None:
+        if not os.path.exists(path):
+            raise CheckpointCorruption(
+                f"checkpoint artifact {fname} missing from {d}")
+        got = _file_sha1(path)
+        if got != want:
+            raise CheckpointCorruption(
+                f"checkpoint artifact {fname} failed its checksum "
+                f"(stored {want[:12]}…, computed {got[:12]}…) — "
+                f"refusing to restore corrupt data from {d}")
+    return path
 
 
 def _check_name(name: str) -> None:
@@ -68,10 +101,22 @@ class CheckpointManager:
         latest = self.latest_step()
         return 0 if latest is None else latest + 1
 
-    def __init__(self, directory: str, keep: int = 2):
+    def __init__(self, directory: str, keep: int = 2, config=None):
         self.directory = directory
         self.keep = keep
+        # config is only consulted for the resilience fault site
+        # ("checkpoint" — resilience/faults.py); None defers to
+        # default_config() at check time so env-configured chaos
+        # schedules reach direct CheckpointManager users too
+        self.config = config
         os.makedirs(directory, exist_ok=True)
+
+    def _fault_check(self) -> None:
+        cfg = self.config
+        if cfg is None:
+            from matrel_tpu.config import default_config
+            cfg = default_config()
+        faults_lib.check("checkpoint", cfg)
 
     # -- save ---------------------------------------------------------------
 
@@ -80,6 +125,7 @@ class CheckpointManager:
              arrays: Optional[Mapping[str, jax.Array]] = None,
              sparse: Optional[Mapping[str, Any]] = None,
              state: Optional[Dict[str, Any]] = None) -> str:
+        self._fault_check()
         matrices = dict(matrices or {})
         arrays = dict(arrays or {})
         sparse = dict(sparse or {})
@@ -91,7 +137,8 @@ class CheckpointManager:
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         meta: Dict[str, Any] = {"step": step, "state": state or {},
-                                "matrices": {}, "arrays": [], "sparse": {}}
+                                "matrices": {}, "arrays": [],
+                                "sparse": {}, "checksums": {}}
         for name, bm in matrices.items():
             bm.data.block_until_ready()
             np.save(os.path.join(tmp, f"{name}.npy"), np.asarray(bm.data))
@@ -109,6 +156,12 @@ class CheckpointManager:
                      block_cols=np.asarray(sm.block_cols))
             meta["sparse"][name] = {"shape": list(sm.shape),
                                     "block_size": sm.block_size}
+        # per-artifact checksums, computed AFTER every write: restore
+        # verifies each file it reads and raises the typed
+        # CheckpointCorruption on mismatch (docs/RESILIENCE.md)
+        for fname in sorted(os.listdir(tmp)):
+            meta["checksums"][fname] = _file_sha1(
+                os.path.join(tmp, fname))
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
         if os.path.exists(final):
@@ -126,40 +179,55 @@ class CheckpointManager:
     def restore(self, mesh: Mesh, step: Optional[int] = None
                 ) -> Optional[Tuple[int, Dict[str, BlockMatrix],
                                     Dict[str, jax.Array], Dict[str, Any]]]:
-        """Returns (step, matrices, arrays, state) or None if empty."""
+        """Returns (step, matrices, arrays, state) or None if empty.
+        Every artifact is checksum-verified against the metadata
+        written at save time; a mismatch (or unparseable metadata)
+        raises the typed ``CheckpointCorruption``."""
+        self._fault_check()
         if step is None:
             step = self.latest_step()
         if step is None:
             return None
         d = os.path.join(self.directory, f"step_{step:09d}")
-        with open(os.path.join(d, "meta.json")) as f:
-            meta = json.load(f)
+        meta = self._load_meta(d)
         matrices: Dict[str, BlockMatrix] = {}
         for name, m in meta["matrices"].items():
-            host = np.load(os.path.join(d, f"{name}.npy"))
+            host = np.load(_verify_file(d, f"{name}.npy", meta))
             spec = _spec_from_json(m["spec"])
             data = jax.device_put(host, NamedSharding(mesh, spec))
             matrices[name] = BlockMatrix(
                 data=data, shape=tuple(m["shape"]), mesh=mesh, spec=spec,
                 nnz=m["nnz"], block_size=m["block_size"])
-        arrays = {name: jax.device_put(np.load(os.path.join(d, f"{name}.npy")))
+        arrays = {name: jax.device_put(
+                      np.load(_verify_file(d, f"{name}.npy", meta)))
                   for name in meta["arrays"]}
         return meta["step"], matrices, arrays, meta["state"]
+
+    @staticmethod
+    def _load_meta(d: str) -> Dict[str, Any]:
+        """Parse one step's meta.json; corruption raises TYPED (the
+        restore caller decides whether an older step will do)."""
+        try:
+            with open(os.path.join(d, "meta.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruption(
+                f"checkpoint metadata unreadable in {d}: {e}") from e
 
     def restore_sparse(self, mesh: Mesh, step: Optional[int] = None) -> Dict[str, Any]:
         """Restore BlockSparseMatrix entries saved via ``save(sparse=...)``."""
         from matrel_tpu.core.sparse import BlockSparseMatrix
+        self._fault_check()
         if step is None:
             step = self.latest_step()
         if step is None:
             return {}
         d = os.path.join(self.directory, f"step_{step:09d}")
-        with open(os.path.join(d, "meta.json")) as f:
-            meta = json.load(f)
+        meta = self._load_meta(d)
         rep = NamedSharding(mesh, P())
         out = {}
         for name, m in meta.get("sparse", {}).items():
-            z = np.load(os.path.join(d, f"{name}.npz"))
+            z = np.load(_verify_file(d, f"{name}.npz", meta))
             out[name] = BlockSparseMatrix(
                 blocks=jax.device_put(z["blocks"], rep),
                 block_rows=jax.device_put(z["block_rows"], rep),

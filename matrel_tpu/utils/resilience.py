@@ -18,23 +18,19 @@ from __future__ import annotations
 import logging
 from typing import Any, Callable, Dict, Mapping, Optional, Tuple
 
-import jax
-
 from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.resilience.errors import is_transient
 from matrel_tpu.utils.checkpoint import CheckpointManager
 
 log = logging.getLogger("matrel_tpu.resilience")
 
-# Exceptions that indicate a device/runtime fault worth a restart (rather
-# than a programming error): XlaRuntimeError covers device loss, OOM, and
-# collective timeouts.
-_RETRYABLE = (jax.errors.JaxRuntimeError,) if hasattr(jax.errors, "JaxRuntimeError") else ()
-
 
 def _is_retryable(e: BaseException) -> bool:
-    name = type(e).__name__
-    return isinstance(e, _RETRYABLE) or name in (
-        "XlaRuntimeError", "JaxRuntimeError", "InternalError")
+    """Restart-worthiness now delegates to the resilience layer's ONE
+    transient/deterministic taxonomy (resilience/errors.py) — the
+    driver-loop restart and the serve-plane retry must never disagree
+    about what a device fault looks like."""
+    return is_transient(e)
 
 
 def run_resilient(

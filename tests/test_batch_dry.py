@@ -185,6 +185,28 @@ def test_flight_drill_artifact(dry_batch):
     assert table["schema"] == 1 and table["entries"]
 
 
+def test_chaos_drill_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records, lambda r: r.get("metric") == "chaos_drill",
+               "chaos_drill")
+    # the resilience acceptance: >= 50 queries under a seeded fault
+    # schedule with every instrumented site firing, 0 wrong answers,
+    # 0 unclassified failures, only the deterministic-fault queries
+    # failing (typed), the poison batch isolating exactly one future,
+    # and zero hangs (the drill itself drains under a timeout)
+    assert rec["ok"] is True, rec
+    assert rec["queries"] >= 50
+    assert rec["wrong_answers"] == 0
+    assert rec["untyped_failures"] == 0
+    assert rec["poison_isolated"] is True
+    assert rec["deadline_typed"] is True
+    assert rec["checkpoint_ok"] is True
+    assert set(rec["sites_fired"]) == {
+        "compile", "lower", "strategy", "execute", "rc_probe",
+        "serve_admit", "checkpoint"}
+    assert rec["retries"] > 0 and rec["degrades"] > 0
+
+
 def test_sweep_and_gram_artifacts(dry_batch):
     _, records, _ = dry_batch
     verdict = _one(records, lambda r: "results" in r and "ok" in r,

@@ -279,6 +279,98 @@ class TestML006RawTiming:
         assert _lint(tmp_path, src, "matrel_tpu/io.py") == []
 
 
+class TestML007BroadSwallow:
+    def test_fires_on_except_exception_pass(self, tmp_path):
+        src = """
+            def load(path):
+                try:
+                    return open(path).read()
+                except Exception:
+                    pass
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/io.py")
+        assert _rules(got) == ["ML007"]
+
+    def test_fires_on_bare_except_continue(self, tmp_path):
+        src = """
+            def drain(items):
+                out = []
+                for it in items:
+                    try:
+                        out.append(it())
+                    except:
+                        continue
+                return out
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/x.py")
+        assert _rules(got) == ["ML007"]
+
+    def test_fires_on_base_exception_ellipsis(self, tmp_path):
+        src = """
+            def f(g):
+                try:
+                    g()
+                except BaseException:
+                    ...
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/utils/x.py")
+        assert _rules(got) == ["ML007"]
+
+    def test_narrow_except_is_classification(self, tmp_path):
+        src = """
+            def load(path):
+                try:
+                    return open(path).read()
+                except OSError:
+                    pass
+        """
+        # naming the exception IS the taxonomy — out of scope
+        assert _lint(tmp_path, src, "matrel_tpu/io.py") == []
+
+    def test_logging_handler_not_flagged(self, tmp_path):
+        src = """
+            import logging
+            def load(path):
+                try:
+                    return open(path).read()
+                except Exception:
+                    logging.warning("unreadable: %s", path)
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/io.py") == []
+
+    def test_typed_reraise_not_flagged(self, tmp_path):
+        src = """
+            from matrel_tpu.resilience.errors import CheckpointCorruption
+            def load(path):
+                try:
+                    return open(path).read()
+                except Exception as e:
+                    raise CheckpointCorruption(str(e)) from e
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/utils/x.py") == []
+
+    def test_out_of_package_ignored(self, tmp_path):
+        src = """
+            def probe(f):
+                try:
+                    f()
+                except Exception:
+                    pass
+        """
+        # tools/bench harnesses collect failures their own way
+        assert _lint(tmp_path, src, "tools/soak.py") == []
+
+    def test_suppression_with_justification(self, tmp_path):
+        src = """
+            def emit(fn, rec):
+                try:
+                    fn(rec)
+                except Exception:  # matlint: disable=ML007 never-fail obs sink
+                    pass
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/obs/sink.py") == []
+
+
 class TestSuppression:
     def test_inline_disable_silences(self, tmp_path):
         src = """

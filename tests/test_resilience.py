@@ -1,0 +1,786 @@
+"""Resilience layer (matrel_tpu/resilience/ + session/serve/checkpoint
+integration): seeded fault-injection determinism per site, the typed
+transient/deterministic taxonomy, retry/backoff schedules, per-query
+deadlines + cancellation between attempts, the plan-degradation ladder
+(each rung correct vs oracle), poison-query isolation by serve-batch
+bisection, typed drain/close/shed errors, robust auxiliary-file
+readers, checkpoint checksums, and the default-config bit-identity
+contract (zero injection objects, unchanged plan keys)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from matrel_tpu.config import MatrelConfig
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.resilience import degrade, errors, faults
+from matrel_tpu.resilience.errors import (AdmissionShed,
+                                          CheckpointCorruption,
+                                          DeadlineExceeded,
+                                          DrainTimeout, InjectedFault,
+                                          PipelineClosed, QueryAborted)
+from matrel_tpu.resilience.faults import FaultInjector
+from matrel_tpu.resilience.retry import Deadline, RetryPolicy
+from matrel_tpu.session import MatrelSession
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    """Each test starts (and leaves) a clean process-wide injector
+    registry — schedules are per-(spec, seed) and stateful."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _mat(rng, n, m, mesh):
+    return BlockMatrix.from_numpy(
+        rng.standard_normal((n, m)).astype(np.float32), mesh=mesh)
+
+
+def _sess(mesh, **cfg):
+    return MatrelSession(mesh=mesh, config=MatrelConfig(**cfg))
+
+
+def _events(path):
+    return [json.loads(l) for l in open(path)] if os.path.exists(
+        path) else []
+
+
+# ---------------------------------------------------------------------------
+# Fault injection: spec parsing + per-site deterministic schedules
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjection:
+    def test_spec_validation_at_config_construction(self):
+        with pytest.raises(ValueError, match="site"):
+            MatrelConfig(fault_inject="warp_core:transient:p=0.5")
+        with pytest.raises(ValueError, match="kind"):
+            MatrelConfig(fault_inject="compile:sometimes:p=0.5")
+        with pytest.raises(ValueError, match="exactly one"):
+            MatrelConfig(fault_inject="compile:transient")
+        with pytest.raises(ValueError, match="exactly one"):
+            MatrelConfig(fault_inject="compile:transient:p=0.5:n=3")
+        with pytest.raises(ValueError, match="p="):
+            MatrelConfig(fault_inject="compile:transient:p=1.5")
+        # valid specs construct fine
+        MatrelConfig(fault_inject="compile:transient:p=0.5;"
+                                  "execute:fatal:n=3:max=1")
+
+    def _schedule(self, spec, seed, site, n_calls=200):
+        inj = FaultInjector(spec, seed)
+        fired = []
+        for i in range(n_calls):
+            try:
+                inj.check(site)
+            except InjectedFault:
+                fired.append(i)
+        return fired
+
+    @pytest.mark.parametrize("site", faults.SITES)
+    def test_probability_schedule_deterministic_per_site(self, site):
+        spec = f"{site}:transient:p=0.1"
+        a = self._schedule(spec, 42, site)
+        b = self._schedule(spec, 42, site)
+        assert a == b and len(a) > 0
+        c = self._schedule(spec, 43, site)
+        assert a != c      # the seed IS the schedule
+
+    def test_sites_independent_streams(self):
+        # one site's draws do not perturb another's schedule
+        solo = self._schedule("execute:transient:p=0.1", 7, "execute")
+        inj = FaultInjector(
+            "execute:transient:p=0.1;compile:transient:p=0.1", 7)
+        fired = []
+        for i in range(200):
+            try:
+                inj.check("compile")
+            except InjectedFault:
+                pass
+            try:
+                inj.check("execute")
+            except InjectedFault:
+                fired.append(i)
+        assert fired == solo
+
+    def test_nth_call_fires_exactly_once(self):
+        fired = self._schedule("compile:transient:n=5", 0, "compile",
+                               n_calls=50)
+        assert fired == [4]                      # 1-based call 5
+
+    def test_max_caps_total_fires(self):
+        fired = self._schedule("execute:transient:p=1.0:max=3", 0,
+                               "execute", n_calls=50)
+        assert fired == [0, 1, 2]
+
+    def test_all_site_expands_to_every_site(self):
+        inj = FaultInjector("all:transient:n=1", 0)
+        for site in faults.SITES:
+            with pytest.raises(InjectedFault):
+                inj.check(site)
+
+    def test_unlisted_site_never_fires(self):
+        assert self._schedule("compile:transient:p=1.0", 0,
+                              "execute") == []
+
+    def test_sibling_rule_counters_advance_past_a_fire(self):
+        # one rule firing must not skew a sibling's call count: the
+        # n=3 rule fires on the site's THIRD check even though the
+        # n=1 rule fired (and raised) on the first
+        inj = FaultInjector(
+            "execute:transient:n=1;execute:fatal:n=3", 0)
+        with pytest.raises(InjectedFault) as e1:
+            inj.check("execute")
+        assert e1.value.transient
+        inj.check("execute")                     # call 2: quiet
+        with pytest.raises(InjectedFault) as e3:
+            inj.check("execute")                 # call 3: the fatal
+        assert not e3.value.transient
+        assert e3.value.call_index == 3
+
+    def test_injected_fault_is_typed_and_attributed(self):
+        inj = FaultInjector("execute:fatal:n=1", 0)
+        with pytest.raises(InjectedFault) as ei:
+            inj.check("execute")
+        assert ei.value.site == "execute"
+        assert ei.value.transient is False
+        assert ei.value.call_index == 1
+
+
+# ---------------------------------------------------------------------------
+# Taxonomy + retry policy units
+# ---------------------------------------------------------------------------
+
+
+class TestTaxonomy:
+    def test_injected_faults_classify_by_kind(self):
+        assert errors.classify(
+            InjectedFault("execute", "transient", 1)) == "transient"
+        assert errors.classify(
+            InjectedFault("execute", "fatal", 1)) == "deterministic"
+
+    def test_verification_error_never_retries(self):
+        from matrel_tpu.analysis import Diagnostic, VerificationError
+        d = Diagnostic(code="MV999", severity="error", node="x",
+                       message="boom")
+        assert errors.classify(
+            VerificationError([d])) == "deterministic"
+
+    def test_compile_class_errors_deterministic(self):
+        for ex in (ValueError("bad shape"), TypeError("no"),
+                   NotImplementedError("op"), KeyError("k")):
+            assert errors.classify(ex) == "deterministic"
+
+    def test_runtime_class_errors_transient(self):
+        class XlaRuntimeError(Exception):
+            pass
+        assert errors.classify(XlaRuntimeError("dead")) == "transient"
+        assert errors.classify(
+            RuntimeError("RESOURCE_EXHAUSTED: oom")) == "transient"
+        assert errors.classify(MemoryError()) == "transient"
+        # unknown types without markers default deterministic
+        assert errors.classify(
+            RuntimeError("who knows")) == "deterministic"
+
+    def test_resilience_errors_never_retry(self):
+        for ex in (DeadlineExceeded(5.0, 6.0), DrainTimeout(1.0, 2),
+                   AdmissionShed(4), PipelineClosed("closed")):
+            assert errors.classify(ex) == "deterministic"
+
+
+class TestRetryPolicy:
+    def test_from_config_none_for_default(self):
+        assert RetryPolicy.from_config(MatrelConfig()) is None
+
+    def test_from_config_active_when_asked(self):
+        assert RetryPolicy.from_config(
+            MatrelConfig(retry_max_attempts=2)) is not None
+        assert RetryPolicy.from_config(
+            MatrelConfig(fault_inject="execute:transient:n=1")) \
+            is not None
+        assert RetryPolicy.from_config(MatrelConfig(),
+                                       deadline_ms=10.0) is not None
+        assert RetryPolicy.from_config(
+            MatrelConfig(deadline_ms=10.0)) is not None
+
+    def test_backoff_schedule_closed_form_without_jitter(self):
+        pol = RetryPolicy(5, backoff_ms=8.0, backoff_mult=2.0,
+                          jitter=0.0, seed=0)
+        assert [pol.backoff_delay_s(a) for a in (1, 2, 3, 4)] == \
+            [0.008, 0.016, 0.032, 0.064]
+
+    def test_backoff_jitter_seeded_reproducible(self):
+        a = RetryPolicy(5, 8.0, 2.0, jitter=0.5, seed=11, nonce=0)
+        b = RetryPolicy(5, 8.0, 2.0, jitter=0.5, seed=11, nonce=0)
+        da = [a.backoff_delay_s(i) for i in (1, 2, 3)]
+        db = [b.backoff_delay_s(i) for i in (1, 2, 3)]
+        assert da == db
+        # jitter stays inside the documented symmetric band
+        for i, d in enumerate(da, start=1):
+            base = 0.008 * 2.0 ** (i - 1)
+            assert 0.5 * base <= d <= 1.5 * base
+        c = RetryPolicy(5, 8.0, 2.0, jitter=0.5, seed=12, nonce=0)
+        assert [c.backoff_delay_s(i) for i in (1, 2, 3)] != da
+
+    def test_concurrent_policies_do_not_share_jitter_stream(self):
+        # the de-dogpile property: two policies from ONE config (the
+        # burst-of-queries case) must draw distinct jitter sequences
+        cfg = MatrelConfig(retry_max_attempts=3, retry_jitter=0.5)
+        a = RetryPolicy.from_config(cfg)
+        b = RetryPolicy.from_config(cfg)
+        assert [a.backoff_delay_s(i) for i in (1, 2, 3)] != \
+            [b.backoff_delay_s(i) for i in (1, 2, 3)]
+
+    def test_backoff_overshooting_deadline_raises_now(self):
+        pol = RetryPolicy(5, backoff_ms=500.0, backoff_mult=1.0,
+                          jitter=0.0, seed=0, deadline_ms=20.0)
+        dl = pol.deadline()
+        with pytest.raises(DeadlineExceeded):
+            pol.backoff_sleep(1, dl)     # 500 ms sleep vs 20 ms budget
+
+    def test_cancellation_honored_between_attempts(self):
+        pol = RetryPolicy(5, backoff_ms=1.0, backoff_mult=1.0,
+                          jitter=0.0, seed=0)
+        with pytest.raises(QueryAborted):
+            pol.backoff_sleep(1, pol.deadline(),
+                              should_abort=lambda: True)
+
+    def test_should_retry_gates_on_class_and_budget(self):
+        pol = RetryPolicy(2, 1.0, 2.0, 0.0, 0)
+        t = InjectedFault("execute", "transient", 1)
+        assert pol.should_retry(t, 0) and pol.should_retry(t, 1)
+        assert not pol.should_retry(t, 2)              # budget spent
+        assert not pol.should_retry(ValueError("x"), 0)  # wrong class
+
+
+# ---------------------------------------------------------------------------
+# Session integration: retries, ladder, deadlines, events
+# ---------------------------------------------------------------------------
+
+
+class TestSessionResilience:
+    def test_transient_execute_fault_retries_to_correct(self, mesh8,
+                                                        rng):
+        sess = _sess(mesh8, fault_inject="execute:transient:n=1",
+                     retry_max_attempts=2, retry_backoff_ms=1.0)
+        A, B = _mat(rng, 32, 48, mesh8), _mat(rng, 48, 16, mesh8)
+        out = sess.run(A.expr().multiply(B.expr()))
+        np.testing.assert_allclose(out.to_numpy(),
+                                   A.to_numpy() @ B.to_numpy(),
+                                   rtol=3e-4, atol=3e-4)
+        stats = faults.injector_for(sess.config).stats()
+        assert stats["execute"]["fires"] == 1
+
+    def test_fatal_fault_raises_typed_without_retry(self, mesh8, rng):
+        sess = _sess(mesh8, fault_inject="compile:fatal:n=1",
+                     retry_max_attempts=3, retry_backoff_ms=1.0)
+        A = _mat(rng, 32, 32, mesh8)
+        with pytest.raises(InjectedFault):
+            sess.run(A.expr().multiply(A.expr()))
+        # deterministic = ONE attempt: the compile site saw one call
+        assert faults.injector_for(
+            sess.config).stats()["compile"]["calls"] == 1
+
+    def test_retries_exhausted_raises_last_fault(self, mesh8, rng):
+        sess = _sess(mesh8, fault_inject="execute:transient:p=1.0",
+                     retry_max_attempts=2, retry_backoff_ms=0.5)
+        A = _mat(rng, 32, 32, mesh8)
+        with pytest.raises(InjectedFault) as ei:
+            sess.run(A.expr().multiply(A.expr()))
+        assert ei.value.transient   # typed, attributable, transient
+
+    def test_ladder_escalates_to_rung4_and_stays_correct(self, mesh8,
+                                                         rng):
+        # every attempt's execute faults until the cap: the query
+        # climbs all four rungs and STILL answers correctly
+        sess = _sess(mesh8, fault_inject="execute:transient:p=1.0:max=4",
+                     retry_max_attempts=4, retry_backoff_ms=0.5)
+        A, B = _mat(rng, 32, 48, mesh8), _mat(rng, 48, 16, mesh8)
+        out = sess.run(A.expr().multiply(B.expr()))
+        np.testing.assert_allclose(out.to_numpy(),
+                                   A.to_numpy() @ B.to_numpy(),
+                                   rtol=3e-4, atol=3e-4)
+        # the degraded attempt's plan is cached under the rung prefix
+        assert any(k.startswith("degr:4|")
+                   for k in sess._plan_cache), list(sess._plan_cache)
+        plan = sess._plan_cache[next(
+            k for k in sess._plan_cache if k.startswith("degr:4|"))]
+        assert plan.meta["degrade"] == {"rung": 4,
+                                        "label": "no-result-cache"}
+
+    def test_rc_bypass_rung_recovers_from_poisoned_probe(self, mesh8,
+                                                         rng):
+        # rc_probe faults on EVERY consult — only the ladder's rung-4
+        # cache bypass can complete this query. That it does is the
+        # ladder working as designed.
+        sess = _sess(mesh8, fault_inject="rc_probe:transient:p=1.0",
+                     retry_max_attempts=4, retry_backoff_ms=0.5,
+                     result_cache_max_bytes=1 << 24)
+        A, B = _mat(rng, 32, 48, mesh8), _mat(rng, 48, 16, mesh8)
+        out = sess.run(A.expr().multiply(B.expr()))
+        np.testing.assert_allclose(out.to_numpy(),
+                                   A.to_numpy() @ B.to_numpy(),
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_deadline_expired_raises_typed(self, mesh8, rng):
+        sess = _sess(mesh8)
+        A = _mat(rng, 32, 32, mesh8)
+        with pytest.raises(DeadlineExceeded):
+            sess.run(A.expr().multiply(A.expr()), deadline_ms=1e-6)
+
+    def test_config_default_deadline_applies(self, mesh8, rng):
+        sess = _sess(mesh8, deadline_ms=1e-6)
+        A = _mat(rng, 32, 32, mesh8)
+        with pytest.raises(DeadlineExceeded):
+            sess.run(A.expr().multiply(A.expr()))
+
+    def test_deadline_enforced_on_late_success(self, mesh8, rng,
+                                               monkeypatch):
+        # an attempt that SUCCEEDS past the deadline still raises
+        # typed — run() matches submit()'s late-batch semantics. The
+        # clock is stepped: 0 s at deadline start/entry check, 10 s
+        # from the post-attempt check on.
+        import matrel_tpu.resilience.retry as retry_mod
+        ticks = iter([0.0, 0.0, 0.0])
+        monkeypatch.setattr(retry_mod.time, "monotonic",
+                            lambda: next(ticks, 10.0))
+        sess = _sess(mesh8)
+        A = _mat(rng, 32, 32, mesh8)
+        with pytest.raises(DeadlineExceeded):
+            sess.run(A.expr().multiply(A.expr()), deadline_ms=1000.0)
+
+    def test_generous_deadline_does_not_interfere(self, mesh8, rng):
+        sess = _sess(mesh8)
+        A, B = _mat(rng, 32, 48, mesh8), _mat(rng, 48, 16, mesh8)
+        out = sess.run(A.expr().multiply(B.expr()), deadline_ms=60_000)
+        np.testing.assert_allclose(out.to_numpy(),
+                                   A.to_numpy() @ B.to_numpy(),
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_cancellation_between_attempts(self, mesh8, rng):
+        sess = _sess(mesh8, fault_inject="execute:transient:p=1.0",
+                     retry_max_attempts=5, retry_backoff_ms=1.0)
+        A = _mat(rng, 32, 32, mesh8)
+        from matrel_tpu.ir.expr import as_expr
+        pol = RetryPolicy.from_config(sess.config)
+        with pytest.raises(QueryAborted):
+            sess._compute_resilient(
+                as_expr(A.expr().multiply(A.expr())), False,
+                "default", pol, should_abort=lambda: True)
+
+    def test_run_many_retries_whole_batch(self, mesh8, rng):
+        sess = _sess(mesh8, fault_inject="execute:transient:n=1",
+                     retry_max_attempts=2, retry_backoff_ms=1.0)
+        A, B = _mat(rng, 32, 48, mesh8), _mat(rng, 48, 16, mesh8)
+        outs = sess.run_many([A.expr().multiply(B.expr()),
+                              B.expr().t().multiply(A.expr().t())])
+        np.testing.assert_allclose(outs[0].to_numpy(),
+                                   A.to_numpy() @ B.to_numpy(),
+                                   rtol=3e-4, atol=3e-4)
+        np.testing.assert_allclose(outs[1].to_numpy(),
+                                   (A.to_numpy() @ B.to_numpy()).T,
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_run_many_deadline_typed(self, mesh8, rng):
+        sess = _sess(mesh8)
+        A = _mat(rng, 32, 32, mesh8)
+        with pytest.raises(DeadlineExceeded):
+            sess.run_many([A.expr().multiply(A.expr())],
+                          deadline_ms=1e-6)
+
+
+class TestResilienceEvents:
+    def test_fault_retry_degrade_events_and_rollup(self, mesh8, rng,
+                                                   tmp_path):
+        log = tmp_path / "events.jsonl"
+        sess = _sess(mesh8, fault_inject="execute:transient:n=1",
+                     retry_max_attempts=2, retry_backoff_ms=1.0,
+                     obs_level="on", obs_event_log=str(log))
+        A, B = _mat(rng, 32, 48, mesh8), _mat(rng, 48, 16, mesh8)
+        sess.run(A.expr().multiply(B.expr()))
+        evs = _events(str(log))
+        kinds = [e["kind"] for e in evs]
+        assert "fault" in kinds and "retry" in kinds \
+            and "degrade" in kinds
+        fault = next(e for e in evs if e["kind"] == "fault")
+        assert fault["site"] == "execute" and fault["injected"]
+        assert fault["classification"] == "transient"
+        retry = next(e for e in evs if e["kind"] == "retry")
+        assert retry["attempt"] == 1 and retry["rung"] == 1
+        deg = next(e for e in evs if e["kind"] == "degrade")
+        assert deg["rung_label"] == "no-autotune"
+        # the query record still landed (the retry SAVED the query)
+        assert "query" in kinds
+        from matrel_tpu.obs.history import render_summary, summarize
+        from matrel_tpu.obs.events import read_events
+        s = summarize(read_events(str(log)))
+        rs = s["resilience"]
+        assert rs["faults"] == 1 and rs["injected"] == 1
+        assert rs["retries"] == 1 and rs["degrades"] == 1
+        assert rs["rungs"] == {"no-autotune": 1}
+        assert rs["fault_sites"] == {"execute": 1}
+        assert "resilience: 1 fault(s)" in render_summary(
+            read_events(str(log)))
+
+    def test_obs_off_resilient_path_emits_nothing(self, mesh8, rng,
+                                                  tmp_path):
+        log = tmp_path / "events.jsonl"
+        os.environ.pop("MATREL_OBS_EVENT_LOG", None)
+        sess = _sess(mesh8, fault_inject="execute:transient:n=1",
+                     retry_max_attempts=2, retry_backoff_ms=1.0,
+                     obs_event_log=str(log))
+        A = _mat(rng, 32, 32, mesh8)
+        sess.run(A.expr().multiply(A.expr()))
+        assert not log.exists()     # obs off: recovery is silent
+
+
+# ---------------------------------------------------------------------------
+# Degradation ladder units + oracle equivalence per rung
+# ---------------------------------------------------------------------------
+
+
+class TestDegradationLadder:
+    def test_rung0_is_identity(self):
+        cfg = MatrelConfig()
+        assert degrade.apply_rung(cfg, 0) is cfg
+        assert degrade.key_prefix(0) == ""
+
+    def test_rungs_are_cumulative(self):
+        cfg = MatrelConfig(autotune=True)
+        c1 = degrade.apply_rung(cfg, 1)
+        assert c1.autotune is False
+        assert c1.strategy_override == "auto"
+        c2 = degrade.apply_rung(cfg, 2)
+        assert c2.autotune is False
+        assert c2.strategy_override == "xla"
+        c3 = degrade.apply_rung(cfg, 3)
+        assert (c3.strategy_override, c3.use_pallas,
+                c3.spgemm_density_threshold) == ("xla", False, 0.0)
+        c4 = degrade.apply_rung(cfg, 4)
+        assert c4 == c3      # rung 4's rc bypass is session-side
+
+    @pytest.mark.parametrize("rung", [1, 2, 3, 4])
+    def test_each_rung_produces_correct_results(self, mesh8, rng,
+                                                rung):
+        # the ladder's safety property: every rung is semantics-
+        # preserving — same answers from dense, S×S AND COO matmuls
+        from matrel_tpu.core.coo import COOMatrix
+        from matrel_tpu.core.sparse import BlockSparseMatrix
+        from matrel_tpu.executor import compile_expr
+        cfg = degrade.apply_rung(MatrelConfig(), rung)
+        A, B = _mat(rng, 48, 32, mesh8), _mat(rng, 32, 24, mesh8)
+        want = A.to_numpy() @ B.to_numpy()
+        got = compile_expr(A.expr().multiply(B.expr()), mesh8,
+                           cfg).run()
+        np.testing.assert_allclose(got.to_numpy(), want, rtol=3e-4,
+                                   atol=3e-4)
+        sn = rng.standard_normal((48, 48)).astype(np.float32)
+        sn[rng.random((48, 48)) < 0.8] = 0.0
+        S = BlockSparseMatrix.from_numpy(sn, block_size=8, mesh=mesh8,
+                                         config=cfg)
+        got = compile_expr(S.expr().multiply(S.expr()), mesh8,
+                           cfg).run()
+        np.testing.assert_allclose(got.to_numpy(), sn @ sn, rtol=3e-4,
+                                   atol=3e-4)
+        rows, cols = np.nonzero(sn)
+        C = COOMatrix.from_edges(rows, cols, sn[rows, cols],
+                                 shape=sn.shape)
+        D = _mat(rng, 48, 24, mesh8)
+        got = compile_expr(C.expr().multiply(D.expr()), mesh8,
+                           cfg).run()
+        np.testing.assert_allclose(got.to_numpy(), sn @ D.to_numpy(),
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_rung2_plan_stamps_xla_everywhere(self, mesh8, rng):
+        from matrel_tpu.executor import compile_expr, \
+            plan_matmul_decisions
+        cfg = degrade.apply_rung(MatrelConfig(), 2)
+        A, B = _mat(rng, 64, 64, mesh8), _mat(rng, 64, 64, mesh8)
+        plan = compile_expr(A.expr().multiply(B.expr()), mesh8, cfg)
+        assert all(d["strategy"] == "xla"
+                   for d in plan_matmul_decisions(plan))
+
+
+# ---------------------------------------------------------------------------
+# Default-config bit-identity: zero resilience overhead when off
+# ---------------------------------------------------------------------------
+
+
+class TestDefaultConfigInert:
+    def test_zero_injection_objects_constructed(self, mesh8, rng,
+                                                monkeypatch):
+        def poisoned(self, *a, **kw):
+            raise AssertionError(
+                "FaultInjector constructed under default config")
+        monkeypatch.setattr(FaultInjector, "__init__", poisoned)
+        sess = _sess(mesh8)
+        A, B = _mat(rng, 32, 48, mesh8), _mat(rng, 48, 16, mesh8)
+        out = sess.run(A.expr().multiply(B.expr()))
+        sess.run_many([A.expr().multiply(B.expr())])
+        np.testing.assert_allclose(out.to_numpy(),
+                                   A.to_numpy() @ B.to_numpy(),
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_no_retry_policy_objects_on_default_path(self, mesh8, rng,
+                                                     monkeypatch):
+        calls = []
+        orig = RetryPolicy.__init__
+
+        def spy(self, *a, **kw):
+            calls.append(a)
+            return orig(self, *a, **kw)
+        monkeypatch.setattr(RetryPolicy, "__init__", spy)
+        sess = _sess(mesh8)
+        A = _mat(rng, 32, 32, mesh8)
+        sess.run(A.expr().multiply(A.expr()))
+        assert calls == []
+
+    def test_plan_cache_keys_carry_no_resilience_prefix(self, mesh8,
+                                                        rng):
+        sess = _sess(mesh8)
+        A = _mat(rng, 32, 32, mesh8)
+        sess.run(A.expr().multiply(A.expr()))
+        assert all(not k.startswith("degr:")
+                   for k in sess._plan_cache)
+
+    def test_default_plans_carry_no_degrade_meta(self, mesh8, rng):
+        sess = _sess(mesh8)
+        A = _mat(rng, 32, 32, mesh8)
+        plan = sess.compile(A.expr().multiply(A.expr()))
+        assert "degrade" not in plan.meta
+
+
+# ---------------------------------------------------------------------------
+# Serve plane: bisection, backpressure, typed drain/close, deadlines
+# ---------------------------------------------------------------------------
+
+
+class TestServeResilience:
+    def test_one_poison_in_five_query_batch_fails_exactly_one(
+            self, mesh8, rng):
+        # THE regression the tentpole exists for: pre-bisection, one
+        # poison failed every sibling future of its coalesced batch
+        import jax
+        from matrel_tpu.core import mesh as mesh_lib
+        sess = _sess(mesh8, serve_max_batch=8)
+        A, B = _mat(rng, 32, 48, mesh8), _mat(rng, 48, 16, mesh8)
+        other = mesh_lib.make_mesh((1, 1), devices=jax.devices()[:1])
+        M_other = BlockMatrix.from_numpy(
+            rng.standard_normal((48, 16)).astype(np.float32),
+            mesh=other)
+        good = [A.expr().multiply(B.expr()).multiply_scalar(float(s))
+                for s in (1, 2, 3, 4)]
+        futs = [sess.submit(e) for e in good[:2]]
+        futs.append(sess.submit(A.expr().multiply(M_other.expr())))
+        futs += [sess.submit(e) for e in good[2:]]
+        sess.serve_drain(timeout=120)
+        excs = [f.exception(timeout=30) for f in futs]
+        assert isinstance(excs[2], ValueError)      # the poison, typed
+        assert [e is None for e in excs] == [True, True, False, True,
+                                             True]
+        want = A.to_numpy() @ B.to_numpy()
+        for f, s in zip((futs[0], futs[1], futs[3], futs[4]),
+                        (1, 2, 3, 4)):
+            np.testing.assert_allclose(f.result().to_numpy(), want * s,
+                                       rtol=3e-4, atol=3e-4)
+
+    def test_serve_admit_transient_converges(self, mesh8, rng):
+        sess = _sess(mesh8, fault_inject="serve_admit:transient:n=1",
+                     retry_max_attempts=2, retry_backoff_ms=1.0)
+        A, B = _mat(rng, 32, 48, mesh8), _mat(rng, 48, 16, mesh8)
+        f = sess.submit(A.expr().multiply(B.expr()))
+        np.testing.assert_allclose(f.result(timeout=60).to_numpy(),
+                                   A.to_numpy() @ B.to_numpy(),
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_drain_timeout_typed_on_wedged_worker(self, mesh8, rng):
+        from matrel_tpu.serve.pipeline import ServePipeline
+        sess = _sess(mesh8)
+        p = ServePipeline(sess)
+        p._ensure_worker = lambda: None       # a wedged worker
+        A = _mat(rng, 16, 16, mesh8)
+        p.submit(A.expr())
+        with pytest.raises(DrainTimeout) as ei:
+            p.drain(timeout=0.1)
+        assert ei.value.pending == 1
+        # the queue was left intact: a healthy worker can still drain
+        assert p._q.unfinished_tasks == 1
+
+    def test_submit_after_close_raises_typed(self, mesh8, rng):
+        sess = _sess(mesh8)
+        A = _mat(rng, 16, 16, mesh8)
+        sess.submit(A.expr()).result(timeout=60)
+        sess.serve_close()
+        with pytest.raises(PipelineClosed):
+            sess.submit(A.expr())
+
+    def test_bounded_queue_sheds_typed(self, mesh8, rng):
+        from matrel_tpu.serve.pipeline import ServePipeline
+        sess = _sess(mesh8, serve_queue_max=2)
+        p = ServePipeline(sess)
+        p._ensure_worker = lambda: None       # nothing drains
+        A = _mat(rng, 16, 16, mesh8)
+        p.submit(A.expr())
+        p.submit(A.expr())
+        with pytest.raises(AdmissionShed) as ei:
+            p.submit(A.expr())
+        assert ei.value.queue_max == 2
+
+    def test_queued_deadline_expiry_fails_future_typed(self, mesh8,
+                                                       rng):
+        sess = _sess(mesh8)
+        A, B = _mat(rng, 32, 48, mesh8), _mat(rng, 48, 16, mesh8)
+        f = sess.submit(A.expr().multiply(B.expr()), deadline_ms=1e-6)
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=60)
+        # a generous deadline serves normally
+        f2 = sess.submit(A.expr().multiply(B.expr()),
+                         deadline_ms=120_000)
+        np.testing.assert_allclose(f2.result(timeout=60).to_numpy(),
+                                   A.to_numpy() @ B.to_numpy(),
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_worker_survives_poison_and_serves_next(self, mesh8, rng):
+        import jax
+        from matrel_tpu.core import mesh as mesh_lib
+        sess = _sess(mesh8)
+        A, B = _mat(rng, 32, 48, mesh8), _mat(rng, 48, 16, mesh8)
+        other = mesh_lib.make_mesh((1, 1), devices=jax.devices()[:1])
+        M_other = BlockMatrix.from_numpy(
+            rng.standard_normal((48, 16)).astype(np.float32),
+            mesh=other)
+        bad = sess.submit(A.expr().multiply(M_other.expr()))
+        assert isinstance(bad.exception(timeout=60), ValueError)
+        ok = sess.submit(A.expr().multiply(B.expr()))
+        np.testing.assert_allclose(ok.result(timeout=60).to_numpy(),
+                                   A.to_numpy() @ B.to_numpy(),
+                                   rtol=3e-4, atol=3e-4)
+
+
+# ---------------------------------------------------------------------------
+# Robust readers + checkpoint checksums
+# ---------------------------------------------------------------------------
+
+
+class TestRobustReaders:
+    def test_corrupt_drift_table_warns_and_rebuilds(self, tmp_path,
+                                                    caplog):
+        from matrel_tpu.obs import drift
+        p = tmp_path / "drift.json"
+        p.write_text('{"schema": 1, "entr')        # torn write
+        with caplog.at_level("WARNING", logger="matrel_tpu.obs"):
+            t = drift.load_table(str(p))
+        assert t == {"schema": drift.TABLE_SCHEMA, "entries": {}}
+        assert any("corrupt" in r.message for r in caplog.records)
+
+    def test_corrupt_autotune_table_warns_and_rebuilds(self, tmp_path,
+                                                       caplog):
+        from matrel_tpu.parallel import autotune
+        p = tmp_path / "autotune.json"
+        p.write_text("NOT JSON {{{")
+        with caplog.at_level("WARNING",
+                             logger="matrel_tpu.autotune"):
+            t = autotune.load_table(str(p))
+        assert t == {}
+        assert any("corrupt" in r.message for r in caplog.records)
+
+    def test_absent_tables_read_silently_empty(self, tmp_path,
+                                               caplog):
+        from matrel_tpu.obs import drift
+        from matrel_tpu.parallel import autotune
+        with caplog.at_level("WARNING"):
+            assert autotune.load_table(str(tmp_path / "nope")) == {}
+            assert drift.load_table(
+                str(tmp_path / "nope"))["entries"] == {}
+        assert not caplog.records     # absence is normal, not corrupt
+
+    def test_corrupt_event_log_line_skipped_with_warning(
+            self, tmp_path, caplog):
+        from matrel_tpu.obs.events import EventLog, read_events
+        p = tmp_path / "events.jsonl"
+        EventLog(str(p)).emit("query", {"n": 1})
+        with open(p, "a") as f:
+            f.write('{"kind": "query", "trunca\n')   # crashed writer
+        EventLog(str(p)).emit("query", {"n": 2})
+        with caplog.at_level("WARNING", logger="matrel_tpu.obs"):
+            evs = read_events(str(p))
+        assert [e["n"] for e in evs] == [1, 2]
+        assert any("corrupt line" in r.message for r in caplog.records)
+
+
+class TestCheckpointChecksums:
+    def _save_one(self, tmp_path, mesh, rng, config=None):
+        from matrel_tpu.utils.checkpoint import CheckpointManager
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), config=config)
+        A = _mat(rng, 16, 16, mesh)
+        path = mgr.save(0, matrices={"A": A}, state={"i": 1})
+        return mgr, A, path
+
+    def test_round_trip_verifies_clean(self, tmp_path, mesh8, rng):
+        mgr, A, _ = self._save_one(tmp_path, mesh8, rng)
+        step, mats, _, state = mgr.restore(mesh8)
+        np.testing.assert_allclose(mats["A"].to_numpy(), A.to_numpy())
+        assert state == {"i": 1}
+
+    def test_seeded_corruption_raises_typed(self, tmp_path, mesh8,
+                                            rng):
+        mgr, _, path = self._save_one(tmp_path, mesh8, rng)
+        npy = os.path.join(path, "A.npy")
+        blob = bytearray(open(npy, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF              # one flipped byte
+        open(npy, "wb").write(bytes(blob))
+        with pytest.raises(CheckpointCorruption, match="checksum"):
+            mgr.restore(mesh8)
+
+    def test_truncated_artifact_raises_typed(self, tmp_path, mesh8,
+                                             rng):
+        mgr, _, path = self._save_one(tmp_path, mesh8, rng)
+        npy = os.path.join(path, "A.npy")
+        blob = open(npy, "rb").read()
+        open(npy, "wb").write(blob[: len(blob) // 2])
+        with pytest.raises(CheckpointCorruption, match="checksum"):
+            mgr.restore(mesh8)
+
+    def test_corrupt_meta_raises_typed(self, tmp_path, mesh8, rng):
+        mgr, _, path = self._save_one(tmp_path, mesh8, rng)
+        open(os.path.join(path, "meta.json"), "w").write("{torn")
+        with pytest.raises(CheckpointCorruption, match="metadata"):
+            mgr.restore(mesh8)
+
+    def test_legacy_checkpoint_without_checksums_loads(self, tmp_path,
+                                                       mesh8, rng):
+        mgr, A, path = self._save_one(tmp_path, mesh8, rng)
+        meta_p = os.path.join(path, "meta.json")
+        meta = json.load(open(meta_p))
+        meta.pop("checksums")                     # a pre-round-10 save
+        json.dump(meta, open(meta_p, "w"))
+        step, mats, _, _ = mgr.restore(mesh8)
+        np.testing.assert_allclose(mats["A"].to_numpy(), A.to_numpy())
+
+    def test_session_catalog_round_trip_still_works(self, tmp_path,
+                                                    mesh8, rng):
+        sess = _sess(mesh8)
+        A = _mat(rng, 16, 16, mesh8)
+        sess.register("A", A)
+        sess.save_catalog(str(tmp_path / "cat"))
+        sess2 = _sess(mesh8)
+        assert sess2.load_catalog(str(tmp_path / "cat")) == ["A"]
+        np.testing.assert_allclose(sess2.table("A").to_numpy(),
+                                   A.to_numpy())
+
+
+# ---------------------------------------------------------------------------
+# utils/resilience.py delegation
+# ---------------------------------------------------------------------------
+
+
+class TestRunResilientDelegation:
+    def test_driver_loop_uses_shared_taxonomy(self):
+        from matrel_tpu.utils.resilience import _is_retryable
+        assert _is_retryable(InjectedFault("execute", "transient", 1))
+        assert not _is_retryable(ValueError("x"))
+        assert not _is_retryable(
+            InjectedFault("execute", "fatal", 1))

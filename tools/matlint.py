@@ -27,6 +27,7 @@ Rule catalogue (each rule's class docstring is the authority):
   ML004  direct MatrelConfig() construction inside the package
   ML005  cache dict keyed by sharding-spec-ish values
   ML006  raw wall-clock timing in library code outside obs/
+  ML007  bare/broad except that silently swallows and continues
 """
 
 from __future__ import annotations
@@ -328,10 +329,15 @@ class RawTimingRule(Rule):
     _BARE = ("perf_counter", "monotonic")
 
     def applies_to(self, relpath: str) -> bool:
+        # resilience/retry.py is scoped out like autotune: deadline /
+        # backoff arithmetic IS that module's function (every other
+        # resilience module stays in scope), and its outcomes land in
+        # the event log as retry/degrade records
         return (relpath.startswith("matrel_tpu/")
                 and not relpath.startswith("matrel_tpu/obs/")
                 and relpath not in ("matrel_tpu/utils/profiling.py",
-                                    "matrel_tpu/parallel/autotune.py"))
+                                    "matrel_tpu/parallel/autotune.py",
+                                    "matrel_tpu/resilience/retry.py"))
 
     def check(self, tree, relpath):
         for node in ast.walk(tree):
@@ -346,9 +352,71 @@ class RawTimingRule(Rule):
                     "the measurement lands in the event log")
 
 
+class BroadSwallowRule(Rule):
+    """ML007: bare/broad ``except`` that silently swallows and
+    continues in library modules.
+
+    ``except Exception: pass`` (or a bare ``except:``/``continue``
+    body) erases the failure AND the information needed to classify it
+    — exactly the anti-pattern the resilience layer's typed taxonomy
+    (matrel_tpu/resilience/errors.py) exists to replace: a swallowed
+    transient is a lost retry, a swallowed deterministic error is a
+    silent wrong answer waiting to recur. Library code must either
+    raise a TYPED error, classify-and-handle, or at minimum log the
+    failure it chose to survive. The handful of legitimate
+    swallow-and-continue sites (never-fail observability sinks, the
+    autotune loop dropping strategies that fail to compile, fallback
+    encoders) carry inline suppressions with their justification —
+    deliberate, reviewable exceptions, not defaults. Narrow excepts
+    (``except OSError:``) are out of scope: naming the exception IS
+    the classification."""
+
+    id = "ML007"
+    _BROAD_NAMES = ("Exception", "BaseException")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("matrel_tpu/")
+
+    def _broad(self, etype) -> bool:
+        if etype is None:                       # bare except:
+            return True
+        if isinstance(etype, ast.Name):
+            return etype.id in self._BROAD_NAMES
+        if isinstance(etype, ast.Attribute):    # e.g. builtins.Exception
+            return etype.attr in self._BROAD_NAMES
+        return False
+
+    @staticmethod
+    def _swallows(body) -> bool:
+        """True when the handler body ONLY discards: pass/continue
+        statements (an ``...`` Ellipsis expression counts as pass)."""
+        for stmt in body:
+            if isinstance(stmt, (ast.Pass, ast.Continue)):
+                continue
+            if (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Constant)
+                    and stmt.value.value is Ellipsis):
+                continue
+            return False
+        return True
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if self._broad(node.type) and self._swallows(node.body):
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    "broad except swallows the failure and continues "
+                    "— raise a typed error (resilience/errors.py), "
+                    "classify-and-handle, or log what you chose to "
+                    "survive")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
-                        SpecKeyedCacheRule(), RawTimingRule())
+                        SpecKeyedCacheRule(), RawTimingRule(),
+                        BroadSwallowRule())
 
 
 def _suppressed_codes(line: str) -> set:

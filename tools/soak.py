@@ -499,6 +499,89 @@ def soak_precision(n_trials: int, base: int, tol: float):
     return fails
 
 
+def soak_chaos(n_trials: int, base: int, tol: float):
+    """Randomized chaos: each trial builds a session with a RANDOM
+    seeded fault schedule (random sites, kinds, probabilities) and
+    runs a small mixed query stream against numpy oracles. The
+    resilience contract under soak: every query either converges to
+    the correct answer (retries + degradation ladder) or fails with a
+    TYPED error attributable to a deterministic fault — never a wrong
+    answer, never an unclassified crash, never a hang."""
+    import numpy as np
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.resilience import errors as rerrors, faults
+    from matrel_tpu.session import MatrelSession
+
+    mesh = mesh_lib.make_mesh()
+    fails = []
+    for trial in range(base, base + n_trials):
+        rng = np.random.default_rng(trial)
+        # total transient fire budget (sum of max=) stays STRICTLY
+        # below retry_max_attempts: the stream must be able to absorb
+        # every transient even if one query eats the whole budget —
+        # otherwise "transient escaped the retry loop" would be a
+        # legitimate outcome and the battery seed-flaky, not a check
+        sites = list(rng.choice(faults.SITES,
+                                size=int(rng.integers(1, 4)),
+                                replace=False))
+        has_fatal = bool(rng.random() < 0.3)
+        rules = [f"{s}:transient:p={float(rng.uniform(0.05, 0.3)):.3f}"
+                 f":max=1" for s in sites]
+        if has_fatal:
+            # one deterministic one-shot fault somewhere in the stream
+            rules.append(
+                f"{str(rng.choice(faults.SITES))}:fatal"
+                f":n={int(rng.integers(1, 20))}")
+        try:
+            faults.reset()
+            cfg = MatrelConfig(
+                fault_inject=";".join(rules),
+                fault_inject_seed=trial,
+                retry_max_attempts=6, retry_backoff_ms=1.0,
+                result_cache_max_bytes=(1 << 24
+                                        if trial % 2 else 0))
+            sess = MatrelSession(mesh=mesh, config=cfg)
+            n = int(rng.choice([16, 32, 48]))
+            an = rng.standard_normal((n, n)).astype(np.float32)
+            bn = rng.standard_normal((n, n)).astype(np.float32)
+            A, B = sess.from_numpy(an), sess.from_numpy(bn)
+            for q in range(6):
+                e = (A.expr().multiply(B.expr())
+                     .multiply_scalar(float(q + 1)))
+                want = an @ bn * (q + 1)
+                try:
+                    got = sess.run(e).to_numpy()
+                except rerrors.InjectedFault as ex:
+                    # only a DETERMINISTIC injected fault may surface
+                    if ex.transient:
+                        raise AssertionError(
+                            f"transient fault escaped the retry "
+                            f"loop: {ex}") from ex
+                    continue
+                np.testing.assert_allclose(got, want, rtol=tol,
+                                           atol=tol)
+            # batch surface too, same contract
+            try:
+                outs = sess.run_many(
+                    [A.expr().multiply(B.expr()),
+                     B.expr().multiply(A.expr())])
+                np.testing.assert_allclose(outs[0].to_numpy(), an @ bn,
+                                           rtol=tol, atol=tol)
+                np.testing.assert_allclose(outs[1].to_numpy(), bn @ an,
+                                           rtol=tol, atol=tol)
+            except rerrors.InjectedFault as ex:
+                if ex.transient:
+                    raise AssertionError(
+                        f"transient fault escaped run_many: "
+                        f"{ex}") from ex
+        except Exception as ex:  # noqa: BLE001 — soak collects all
+            fails.append(("chaos", trial, type(ex).__name__,
+                          str(ex)[:200]))
+    faults.reset()
+    return fails
+
+
 def soak_checkpoint(n_trials: int, base: int, tol: float):
     """Randomized checkpoint/restore: matrices with random specs, sparse
     tile stacks, loop state — restored values AND shardings must match;
@@ -562,7 +645,8 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("battery",
                    choices=["fuzz", "deep", "spmv", "sharded", "routed",
-                            "ckpt", "serve", "precision", "all"])
+                            "ckpt", "serve", "precision", "chaos",
+                            "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -585,6 +669,8 @@ def main():
                                  1e-6)
     if args.battery in ("serve", "all"):
         fails += soak_serve(max(args.seeds // 2, 5), args.base, tol)
+    if args.battery in ("chaos", "all"):
+        fails += soak_chaos(max(args.seeds // 4, 5), args.base, tol)
     if args.battery in ("precision", "all"):
         fails += soak_precision(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("sharded", "all"):

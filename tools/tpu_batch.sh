@@ -72,6 +72,8 @@ log "--- topology_flip (ICI/DCN-weighted planner flip proof, staged this round)"
 python tools/topology_flip.py
 log "--- flight_drill (obs tier 2: flight recorder + chrome trace + drift smoke, staged this round)"
 python tools/flight_drill.py
+log "--- chaos_drill (resilience: seeded fault schedule over a mixed serve stream, staged this round)"
+python tools/chaos_drill.py
 log "--- north_star_sweep (VERDICT #10 residual)"
 python tools/north_star_sweep.py
 log "--- gram_manual3 (symmetric-Gram microbench, BASELINE row 3 support)"
