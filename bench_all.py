@@ -68,6 +68,15 @@ def bench_serve(mesh, cfg):
     return {"metric": "serve_repeated_traffic_qps", **payload}
 
 
+def bench_reshard(mesh, cfg):
+    """Reshard-planner sweep: planned staged step sequences vs the
+    naive one-shot constraint per src→dst layout move, {ms, bytes
+    moved, peak bytes} each (see bench.measure_reshard)."""
+    import bench
+    payload = bench.measure_reshard()
+    return {"metric": "reshard_sweep", **payload}
+
+
 def bench_precision(mesh, cfg):
     """Precision-tier sweep: f32 vs bf16x1 vs bf16x3 vs int32 on the
     dense flagship multiply, TFLOPS + measured max-abs-error vs an f64
@@ -377,11 +386,12 @@ def main():
     # numbers.
     dry = bool(os.environ.get("MATREL_DRY"))
     dry_rows = (bench_dense_4k, bench_chain, bench_spgemm, bench_serve,
-                bench_precision)
+                bench_precision, bench_reshard)
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
                bench_spgemm, bench_serve, bench_precision,
-               bench_pagerank, bench_pagerank_10x, bench_cg,
-               bench_eigen, bench_triangles, bench_north_star):
+               bench_reshard, bench_pagerank, bench_pagerank_10x,
+               bench_cg, bench_eigen, bench_triangles,
+               bench_north_star):
         if dry and fn not in dry_rows:
             print(json.dumps({"metric": fn.__name__, "skipped": "dry"}),
                   flush=True)

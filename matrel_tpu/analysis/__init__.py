@@ -34,6 +34,7 @@ codes documented in :mod:`matrel_tpu.analysis.diagnostics`):
   topology   MV106  dominant collective off the slow (DCN) mesh axis
   result_cache MV107 result-cache stamp agrees with the cached entry
   precision  MV108  stamped precision tier satisfies the query SLA
+  reshard    MV109  staged reshard peaks fit reshard_peak_budget_bytes
 """
 
 from __future__ import annotations
@@ -47,6 +48,7 @@ from matrel_tpu.analysis.hbm_pass import check_hbm_feasibility
 from matrel_tpu.analysis.layout_pass import check_layout_claims
 from matrel_tpu.analysis.padding_pass import check_padding_flow
 from matrel_tpu.analysis.precision_pass import check_precision_stamps
+from matrel_tpu.analysis.reshard_pass import check_reshard_peaks
 from matrel_tpu.analysis.result_cache_pass import check_result_cache_stamps
 from matrel_tpu.analysis.strategy_pass import (check_spgemm_dispatch,
                                                check_strategy_stamps)
@@ -67,6 +69,7 @@ PASSES = (
     ("topology", check_axis_traffic),
     ("result_cache", check_result_cache_stamps),
     ("precision", check_precision_stamps),
+    ("reshard", check_reshard_peaks),
 )
 
 

@@ -19,6 +19,9 @@ Code space (stable — tests and suppressions key on them):
   MV107  result-cache stamp disagrees with the cache   (warning)
   MV108  precision tier violates the query's accuracy
          SLA, or int tier on unprovable operands       (error)
+  MV109  staged reshard peak over reshard_peak_budget_
+         bytes, or a stamped reshard record that
+         understates its recompiled peak               (error)
 """
 
 from __future__ import annotations

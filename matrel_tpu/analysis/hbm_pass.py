@@ -66,6 +66,27 @@ def check_hbm_feasibility(root, mesh, config) -> Iterator[Diagnostic]:
         need = planner.strategy_hbm_bytes(strat, pn, pk, pm, gx, gy,
                                           isz)
         if need > budget:
+            hint = ("re-plan on this config (admissible() now "
+                    "drops this strategy; cpmm/summa keep the "
+                    "working set O(N^2/P)), or raise "
+                    "hbm_budget_bytes if the chip really has "
+                    "more HBM")
+            # when a NON-replicating alternative fits the budget, the
+            # operands can still move: a peak-bounded staged reshard
+            # (parallel/reshard.py) re-lays them to that strategy's
+            # layout without the full-gather transient the one-shot
+            # move risks — name the knob instead of leaving a hard
+            # refusal (the "can't reshard it at all" wall, ROADMAP 2)
+            alts = [s for s in ("cpmm", "summa")
+                    if planner.admissible(s, pn, pk, pm, gx, gy,
+                                          itemsize=isz,
+                                          hbm_budget_bytes=budget)]
+            if alts:
+                hint += (f"; a staged reshard would make {alts[0]!r} "
+                         "feasible here — set config."
+                         "reshard_peak_budget_bytes > 0 so the "
+                         "re-lays run as peak-bounded step sequences "
+                         "(docs/RESHARD.md, MV109)")
             yield Diagnostic(
                 code="MV105", severity="error", node=node_addr(n),
                 message=f"strategy {strat!r} needs "
@@ -74,10 +95,6 @@ def check_hbm_feasibility(root, mesh, config) -> Iterator[Diagnostic]:
                         f"{gx}x{gy} grid) but hbm_budget_bytes is "
                         f"{budget / 2**30:.2f} GiB — the replicated "
                         "operands cannot exist on the chip",
-                fix_hint="re-plan on this config (admissible() now "
-                         "drops this strategy; cpmm/summa keep the "
-                         "working set O(N^2/P)), or raise "
-                         "hbm_budget_bytes if the chip really has "
-                         "more HBM")
+                fix_hint=hint)
 
     yield from walk(root)

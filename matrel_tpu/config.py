@@ -218,6 +218,19 @@ class MatrelConfig:
         ``AdmissionShed`` instead of growing the queue without bound —
         load shedding that protects the queries already admitted. 0
         (the default) keeps the historical unbounded queue.
+      reshard_peak_budget_bytes: peak per-device bytes a layout change
+        (reshard) may have live during any one step of its lowering
+        (matrel_tpu/parallel/reshard.py; docs/RESHARD.md — the
+        arXiv:2112.01075 bounded-redistribution discipline). 0 (the
+        default) keeps the legacy single-constraint path bit-
+        identically — XLA emits whatever one-shot collective it likes,
+        no ReshardPlan object is ever constructed (test-enforced).
+        > 0: cross-axis layout changes lower as a verified step
+        sequence (per-axis all_to_all / staged gathers) whose peak
+        footprint fits the budget, the planner prices reshards from
+        the plan's real per-axis bytes, and MV109 proves every stamped
+        reshard's peak fits — the knob that lets near-HBM-limit
+        operands move at all instead of being refused by MV105.
       axis_cost_weights: per-mesh-axis relative inverse-bandwidth
         weights for the planner's comm model (core/mesh.MeshTopology):
         a collective leg over axis i is billed bytes × weights[i], so
@@ -266,6 +279,7 @@ class MatrelConfig:
     drift_table_path: str = ""
     verify_plans: str = "off"
     hbm_budget_bytes: int = 16 << 30
+    reshard_peak_budget_bytes: int = 0
     axis_cost_weights: Tuple[float, float] = (1.0, 1.0)
     fault_inject: str = ""
     fault_inject_seed: int = 0
@@ -355,6 +369,14 @@ class MatrelConfig:
             raise ValueError(
                 f"deadline_ms must be >= 0 (0 disables), "
                 f"got {self.deadline_ms!r}")
+        # a negative reshard budget has no meaning — and would silently
+        # read as "unbounded" in every fits() check while the caller
+        # believes a cap is in force (the obs_level typo precedent)
+        if self.reshard_peak_budget_bytes < 0:
+            raise ValueError(
+                f"reshard_peak_budget_bytes must be >= 0 (0 = legacy "
+                f"single-shot reshards), "
+                f"got {self.reshard_peak_budget_bytes!r}")
         if self.serve_queue_max < 0:
             raise ValueError(
                 f"serve_queue_max must be >= 0 (0 = unbounded), "

@@ -87,6 +87,11 @@ class Lowerer:
         # around tracing measures nothing, so compile_expr never sets
         # it; the hot path stays sync-free (obs_level contract).
         self.op_hook = op_hook
+        # layout/dtype memos for the staged-reshard lowering (budget
+        # > 0 only): infer_layout/infer_dtype walks at trace time stay
+        # O(nodes) across a plan's matmuls (the annotate-pass idiom)
+        self._lay_memo: Dict[int, str] = {}
+        self._dt_memo: Dict[int, object] = {}
         # id(plan) -> (plan, measured SpMV executor variant "compact" |
         # "expanded"), populated at compile time by the autotune loop
         # (parallel/autotune.lookup_or_measure_spmv); empty = hand
@@ -173,6 +178,13 @@ class Lowerer:
                 if tuple(out.shape) != pshape:
                     out = jnp.pad(out, ((0, pshape[0] - out.shape[0]),
                                         (0, pshape[1] - out.shape[1])))
+                if self.config.reshard_peak_budget_bytes > 0:
+                    # the ROOT canonical re-lay through the staged
+                    # reshard path too (a bmm root's row/col → 2d move
+                    # — the _root_reshard_cost leg, made explicit and
+                    # per-kind-annotated); the constraint below then
+                    # finds the layout already canonical
+                    out = self._stage_root_relay(root, out)
                 outs.append(jax.lax.with_sharding_constraint(
                     out, padding.canonical_sharding(pshape, self.mesh)))
             return tuple(outs)
@@ -567,6 +579,15 @@ class Lowerer:
                 return symmetric_gram(x, mm).astype(jnp.float32)
         a, b = ev(node.children[0]), ev(node.children[1])
         strategy = node.attrs.get("strategy", "xla")
+        if self.config.reshard_peak_budget_bytes > 0:
+            # staged reshard lowering (parallel/reshard.py): re-lay
+            # each operand to the layout the strategy consumes through
+            # the compiled peak-bounded step sequence — explicit
+            # per-step collectives under per-kind annotate labels —
+            # instead of whatever one-shot move XLA would emit from
+            # the shard_map in_spec. Off (the default) this branch
+            # constructs nothing and the lowering is bit-identical.
+            a, b = self._stage_matmul_operands(node, a, b)
         tier = node.attrs.get("precision_tier")
         if tier is not None and tier != "f32":
             # precision-tiered execution (ops/precision.py): the
@@ -586,6 +607,44 @@ class Lowerer:
                 and out.dtype != a.dtype):
             out = out.astype(a.dtype)  # f32 accumulate, input-dtype storage
         return out
+
+    def _stage_root_relay(self, root: MatExpr, out: Array) -> Array:
+        """Root output → canonical 2d through the compiled reshard
+        steps (budget > 0 only; vectors and indivisible shapes keep
+        the legacy constraint). The derivation is
+        ``reshard.root_relay_plan`` — shared with MV109, which is the
+        layer that BLOCKS an over-budget root move pre-trace
+        (verify_plans="error"); the lowering itself still applies the
+        min-peak plan, which is never worse than the one-shot move."""
+        from matrel_tpu.parallel import reshard as reshard_lib
+        plan = reshard_lib.root_relay_plan(root, self.mesh, self.config,
+                                           self._lay_memo,
+                                           self._dt_memo)
+        if plan is None:
+            return out
+        return reshard_lib.apply_staged(out, plan, self.mesh)
+
+    def _stage_matmul_operands(self, node: MatExpr, a: Array,
+                               b: Array) -> Tuple[Array, Array]:
+        """Apply the staged ReshardPlans of a dense matmul's operand
+        re-lays (reshard.staged_matmul_moves — the ONE derivation
+        shared with matmul_decisions and MV109). With autotune on, a
+        MEASURED "naive" winner for the move's shape class skips the
+        staging (the closed measurement loop overrules the model, the
+        matmul-strategy contract)."""
+        from matrel_tpu.parallel import reshard as reshard_lib
+        moves = reshard_lib.staged_matmul_moves(
+            node, self.mesh, self.config, self._lay_memo, self._dt_memo)
+        arrs = [a, b]
+        for i, plan in moves:
+            if self.config.autotune:
+                from matrel_tpu.parallel import autotune
+                choice = autotune.lookup_or_measure_reshard(
+                    plan, self.mesh, self.config)
+                if choice == "naive":
+                    continue
+            arrs[i] = reshard_lib.apply_staged(arrs[i], plan, self.mesh)
+        return arrs[0], arrs[1]
 
     def _elemwise(self, node: MatExpr, ev) -> Array:
         l, r = node.children

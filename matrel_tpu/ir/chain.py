@@ -82,7 +82,17 @@ def optimal_order(operands: List[MatExpr],
     # dishonest pricing (the weighted-topology precedent).
     from matrel_tpu.parallel import planner as _planner   # lazy: no cycle
     flop_scale = _planner.sla_compute_factor(config)
-    if n >= 3 and flop_scale == 1.0:
+    # staged reshard pricing (round 10): with reshard_peak_budget_bytes
+    # set, the planner prices opposite-1D re-lays from the compiled
+    # ReshardPlan — which a tight budget forces onto the higher staged
+    # bill the native mirror's closed forms do not know. Degrade to the
+    # Python DP (the reference implementation) rather than misprice —
+    # the flop_scale/topology precedent; the equivalence fuzz
+    # cross-checks native vs the plan-derived costs at budget 0, where
+    # the two are bit-identical by construction (tests/test_reshard.py).
+    reshard_budget = getattr(config, "reshard_peak_budget_bytes", 0) \
+        if config is not None else 0
+    if n >= 3 and flop_scale == 1.0 and reshard_budget == 0:
         from matrel_tpu.utils import native
         dims = [op.shape[0] for op in operands] + [operands[-1].shape[1]]
         dens = [op.density for op in operands]

@@ -132,6 +132,30 @@ def iter_samples(events: List[dict]):
                     and not e.get("batch")
                     and isinstance(ms, (int, float)) and ms > 0):
                 yield _sample(mm[0], float(ms), backend, "query")
+        elif kind == "bench" and e.get("metric") == "reshard_sweep":
+            # bench.py --reshard rows: both lowerings of each src->dst
+            # move, measured with their modelled bytes — the
+            # ``reshard:<kind>`` ms/MiB calibration rows, and the
+            # population rank_flags compares so a reshard model whose
+            # preferred lowering measures >= RANK_FLAG_MARGIN slower
+            # raises a DRIFT flag like any miscalibrated strategy
+            for row in e.get("rows") or ():
+                if not isinstance(row, dict):
+                    continue
+                n = row.get("n")
+                for variant, bytes_key, ms_key in (
+                        (f"reshard:{row.get('kind', 'staged')}",
+                         "staged_bytes", "staged_ms"),
+                        ("reshard:oneshot", "naive_bytes", "naive_ms")):
+                    b, ms = row.get(bytes_key), row.get(ms_key)
+                    if not (isinstance(b, (int, float)) and b > 0
+                            and isinstance(ms, (int, float)) and ms > 0):
+                        continue
+                    yield {"strategy": variant,
+                           "class": shape_class([n] if n else ()),
+                           "backend": backend, "tier": "",
+                           "flops": 0.0, "est_bytes": float(b),
+                           "ms": float(ms), "source": "bench"}
 
 
 def _sample(d: dict, ms: float, backend: str, source: str) -> dict:

@@ -53,8 +53,29 @@ def summarize(events: List[dict]) -> dict:
     strategies: Dict[str, dict] = {}
     rule_hits: Dict[str, int] = {}
     tiers: Dict[str, dict] = {}
+    reshards: dict = {"matmuls": 0, "steps": {}, "bytes_x": 0.0,
+                      "bytes_y": 0.0, "peak_bytes": 0.0}
     for e in qs:
         for d in e.get("matmuls", []):
+            # staged-reshard roll-up (round 10): step kinds, per-axis
+            # bytes and the worst per-device peak across every staged
+            # move in the log — the event-log view of what the reshard
+            # planner is actually doing (and the regression signal
+            # when a layout change starts paying a gather it didn't)
+            rr = d.get("reshard")
+            if isinstance(rr, dict):
+                reshards["matmuls"] += 1
+                for kind in rr.get("steps") or ():
+                    reshards["steps"][kind] = \
+                        reshards["steps"].get(kind, 0) + 1
+                ba = rr.get("bytes_by_axis") or (0.0, 0.0)
+                if len(ba) == 2 and all(
+                        isinstance(v, (int, float)) for v in ba):
+                    reshards["bytes_x"] += ba[0]
+                    reshards["bytes_y"] += ba[1]
+                if isinstance(rr.get("peak_bytes"), (int, float)):
+                    reshards["peak_bytes"] = max(reshards["peak_bytes"],
+                                                 rr["peak_bytes"])
             # precision-tier roll-up (round 8): chosen tier + the pass
             # counts the cost model billed, so a tier-selection
             # regression (an "exact" stream suddenly running bf16)
@@ -111,6 +132,7 @@ def summarize(events: List[dict]) -> dict:
         "plan_cache": last_cache,
         "strategies": strategies,
         "precision_tiers": tiers,
+        "reshards": reshards if reshards["matmuls"] else None,
         "rule_hits": rule_hits,
         "bench_runs": sum(1 for e in events if e.get("kind") == "bench"),
         "bench_errors": _last_bench_errors(events),
@@ -337,6 +359,15 @@ def render_summary(events: List[dict]) -> str:
         lines.append("precision tiers: " + ", ".join(
             f"{t}={d['count']} ({d['passes']} passes)"
             for t, d in sorted(s["precision_tiers"].items())))
+    rsh = s.get("reshards")
+    if rsh:
+        lines.append(
+            f"reshards: {rsh['matmuls']} staged matmul move(s) ("
+            + ", ".join(f"{k}={v}"
+                        for k, v in sorted(rsh["steps"].items()))
+            + f"), bytes x/y {rsh['bytes_x'] / 2**20:.2f}/"
+              f"{rsh['bytes_y'] / 2**20:.2f} MiB, "
+              f"peak {rsh['peak_bytes'] / 2**20:.2f} MiB/device")
     if s["rule_hits"]:
         lines.append("")
         lines.append("rewrite-rule hits: " + ", ".join(

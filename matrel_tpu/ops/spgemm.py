@@ -468,9 +468,9 @@ def spgemm_sharded(A: BlockSparseMatrix, B: BlockSparseMatrix,
         return tiles.astype(out_dtype)
 
     tiles = run(_edge_masked(A), _edge_masked(B),
-                jax.device_put(pa_d.reshape(-1), sh1),
-                jax.device_put(pb_d.reshape(-1), sh1),
-                jax.device_put(slot_d.reshape(-1), sh1))
+                jax.device_put(pa_d.reshape(-1), sh1),  # matlint: disable=ML008 host-built pair-table placed on its sharded layout at plan build
+                jax.device_put(pb_d.reshape(-1), sh1),  # matlint: disable=ML008 host-built pair-table placed on its sharded layout at plan build
+                jax.device_put(slot_d.reshape(-1), sh1))  # matlint: disable=ML008 host-built pair-table placed on its sharded layout at plan build
     rep = NamedSharding(mesh, P())
     return BlockSparseMatrix(
         blocks=jax.lax.with_sharding_constraint(tiles, rep),

@@ -123,8 +123,8 @@ def shard_block_sparse(S: BlockSparseMatrix,
             sh3))(S.blocks)
     return ShardedBlockSparseMatrix(
         blocks=blocks,
-        brow_loc=jax.device_put(brow_loc.reshape(-1), sh1),
-        bcols=jax.device_put(bcols.reshape(-1), sh1),
+        brow_loc=jax.device_put(brow_loc.reshape(-1), sh1),  # matlint: disable=ML008 host-built tile metadata placed on its sharded layout at plan build
+        bcols=jax.device_put(bcols.reshape(-1), sh1),  # matlint: disable=ML008 host-built tile metadata placed on its sharded layout at plan build
         shape=tuple(S.shape), block_size=bs,
         rows_per_dev=rows_per_dev, cap=cap, nnzb=S.nnzb, mesh=mesh,
         padding_ratio=p * cap / max(S.nnzb, 1))

@@ -523,10 +523,10 @@ def shard_plan(plan: EdgeSpMVPlan, mesh) -> EdgeSpMVPlan:
     sh2 = NamedSharding(mesh, P(axes, None))
     return dataclasses.replace(
         plan,
-        src8=jax.device_put(padded(plan.src8, fills["src8"]), sh2),
-        lane=jax.device_put(padded(plan.lane, fills["lane"]), sh2),
-        off=jax.device_put(padded(plan.off, fills["off"]), sh2),
-        val=jax.device_put(padded(plan.val, fills["val"]), sh2))
+        src8=jax.device_put(padded(plan.src8, fills["src8"]), sh2),  # matlint: disable=ML008 host-built compact table placed on its sharded layout at plan build
+        lane=jax.device_put(padded(plan.lane, fills["lane"]), sh2),  # matlint: disable=ML008 host-built compact table placed on its sharded layout at plan build
+        off=jax.device_put(padded(plan.off, fills["off"]), sh2),  # matlint: disable=ML008 host-built compact table placed on its sharded layout at plan build
+        val=jax.device_put(padded(plan.val, fills["val"]), sh2))  # matlint: disable=ML008 host-built compact table placed on its sharded layout at plan build
 
 
 _spmv_jitted = jax.jit(spmv_apply, static_argnums=0)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import time
 from typing import Dict, Optional, Tuple
@@ -102,15 +103,21 @@ def load_table(path: str) -> Dict[str, dict]:
 def _current_key_format(key: str) -> bool:
     """Does a persisted key match the CURRENT (backend-suffixed) key
     formats? Matmul keys are ``side|gxXgy|dtype|backend`` (4 fields);
-    SpMV keys ``spmv|backend|rows x cols|nb|cap|blk|grid`` (7 fields).
-    Either may carry one extra trailing ``w<wx>x<wy>`` field — the
+    SpMV keys ``spmv|backend|rows x cols|nb|cap|blk|grid`` (7 fields);
+    reshard keys ``reshard|src>dst|side|grid|backend`` (5 fields).
+    Any may carry one extra trailing ``w<wx>x<wy>`` field — the
     topology-weight suffix of a non-uniform mesh. Legacy un-suffixed
     entries (one field short) and anything unknown read as stale."""
     if not isinstance(key, str):
         return False
     fields = key.split("|")
     n = len(fields)
-    base = 7 if key.startswith("spmv|") else 4
+    if key.startswith("spmv|"):
+        base = 7
+    elif key.startswith("reshard|"):
+        base = 5
+    else:
+        base = 4
     if n == base:
         return True
     return n == base + 1 and fields[-1].startswith("w")
@@ -511,6 +518,120 @@ def lookup_or_measure_spmv(plan, mesh,
         return None
     best = _pick_winner(results)
     _SPMV_CACHE[key] = best
+    if cfg.autotune or cfg.autotune_table_path:
+        _persist(_table_path(cfg), key, best, results)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# Reshard plan-vs-naive measurement (round 10) — the closed loop for the
+# staged redistribution planner (parallel/reshard.py): per
+# (src->dst, side class, grid, backend) shape class, time the compiled
+# step sequence against the legacy one-shot sharding constraint and
+# persist the winner like matmul strategies, so a backend where XLA's
+# own one-shot move beats the staged chain keeps it (the executor's
+# staged lowering consults this before applying steps).
+# ---------------------------------------------------------------------------
+
+_RESHARD_CACHE: Dict[str, Optional[str]] = {}
+
+RESHARD_VARIANTS = ("staged", "naive")
+
+
+def _reshard_key(plan, gx: int, gy: int,
+                 weights: Tuple[float, float] = (1.0, 1.0)) -> str:
+    """``reshard|src>dst|<=side|gxXgy|backend[|w..]`` — side bucketed
+    to the power of two above sqrt(nbytes/4), the drift auditor's
+    shape-class granularity, so a 3800² and a 4096² move share a row.
+    Backend (and non-uniform weights) key like every other table row:
+    a CPU winner has nothing to say about Mosaic."""
+    side = math.sqrt(max(plan.nbytes / 4.0, 1.0))
+    cls = 1 << max(0, math.ceil(math.log2(max(side, 1.0))))
+    key = (f"reshard|{plan.src}>{plan.dst}|{cls}|{gx}x{gy}"
+           f"|{jax.default_backend()}")
+    if weights != (1.0, 1.0):
+        key += f"|w{weights[0]:g}x{weights[1]:g}"
+    return key
+
+
+def measure_reshard_variant(variant: str, plan, mesh,
+                            config: Optional[MatrelConfig] = None,
+                            n_times: int = 5) -> float:
+    """Median seconds for one lowering of the plan's move at its shape
+    class, on a square f32 probe padded to the mesh (the matmul-probe
+    discipline). "naive" is a single constraint to the destination
+    sharding (XLA's own collective choice); "staged" applies the
+    compiled step sequence."""
+    import numpy as np
+    from jax.sharding import NamedSharding
+    from matrel_tpu.core import padding
+    from matrel_tpu.parallel import reshard as reshard_lib
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    p = max(gx * gy, 1)
+    side = int(round(math.sqrt(max(plan.nbytes / 4.0, 1.0))))
+    side = max(p, -(-side // p) * p)            # divisible probe
+    probe = reshard_lib.compile_reshard(
+        plan.src, plan.dst, float(side) * side * 4, gx, gy,
+        plan.weights, peak_budget=plan.peak_bytes or 0.0)
+    src_sh = NamedSharding(mesh, reshard_lib._state_spec(plan.src,
+                                                         mesh))
+    dst_sh = NamedSharding(mesh, reshard_lib._state_spec(plan.dst,
+                                                         mesh))
+    x = jax.device_put(  # matlint: disable=ML008 measurement-probe input placement — the harness's own array, not a lowering re-lay
+        np.random.default_rng(0).standard_normal(
+            (side, side)).astype(np.float32), src_sh)
+    if variant == "naive":
+        f = jax.jit(lambda v: jax.lax.with_sharding_constraint(v,
+                                                               dst_sh))
+    else:
+        f = jax.jit(lambda v: reshard_lib.apply_staged(v, probe, mesh))
+    f(x).block_until_ready()                    # compile + warm
+    ts = []
+    for _ in range(max(n_times, 1)):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def lookup_or_measure_reshard(plan, mesh,
+                              config: Optional[MatrelConfig] = None
+                              ) -> Optional[str]:
+    """Measured lowering for this reshard's shape class ("staged" /
+    "naive"), or None when the model's pick should stand (ties, shapes
+    above autotune_max_dim — measuring would allocate the probe —
+    single-step plans, or a variant failing to compile). Same table
+    discipline as the matmul/SpMV loops."""
+    cfg = config or default_config()
+    if len(plan.steps) < 2:
+        return None          # staged == naive: nothing to compare
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    key = _reshard_key(plan, gx, gy, mesh_lib.axis_weights(mesh, cfg))
+    if key in _RESHARD_CACHE:
+        return _RESHARD_CACHE[key]
+    entry = _load_table_cached(_table_path(cfg)).get(key)
+    if isinstance(entry, dict) and entry.get("times"):
+        best = entry.get("best")
+        best = best if isinstance(best, str) else None
+        _RESHARD_CACHE[key] = best
+        return best
+    if math.sqrt(max(plan.nbytes / 4.0, 1.0)) > cfg.autotune_max_dim:
+        _RESHARD_CACHE[key] = None
+        return None
+    results: Dict[str, float] = {}
+    for v in RESHARD_VARIANTS:
+        try:
+            t = measure_reshard_variant(v, plan, mesh, cfg)
+        except Exception:  # noqa: BLE001  # matlint: disable=ML007 measurement loop — a variant failing to compile on this backend drops out of the table
+            continue
+        if t > 0.0:
+            results[v] = t
+    if len(results) < 2:
+        _RESHARD_CACHE[key] = None
+        return None
+    best = _pick_winner(results)
+    _RESHARD_CACHE[key] = best
     if cfg.autotune or cfg.autotune_table_path:
         _persist(_table_path(cfg), key, best, results)
     return best

@@ -1162,16 +1162,34 @@ def choose_strategy_ex(node: MatExpr, mesh: Mesh,
 
 def _reshard_to_axis(bytes_: float, layout: str, axis: str,
                      gx: int, gy: int,
-                     weights: Tuple[float, float] = (1.0, 1.0)
-                     ) -> float:
+                     weights: Tuple[float, float] = (1.0, 1.0),
+                     config: Optional[MatrelConfig] = None) -> float:
     """Per-device ICI bytes to re-lay an operand as 1D-sharded over all
     devices along ``axis`` ("row"/"col") from its current ``layout`` —
     the join-side analogue of comm_cost's per-layout reshard terms,
-    billed at the topology weight of the mesh axis each move rides."""
+    billed at the topology weight of the mesh axis each move rides.
+
+    With ``config.reshard_peak_budget_bytes`` > 0 the price comes from
+    the REAL ReshardPlan the lowering will run (parallel/reshard.py)
+    instead of these closed forms: for single-axis moves the two are
+    bit-identical by construction (the plan compiler reuses this
+    module's float expressions verbatim — equality-tested), and for
+    the one move where they can differ — the opposite-1D flip whose
+    bounded decomposition routes through 2d when the direct move's
+    transient would blow the budget — the plan's honestly higher
+    staged bill is what the join scheme must rank by. The default
+    config never constructs a plan (closed forms stay the fast path).
+    """
     p = max(gx * gy, 1)
     wx, wy = weights
     if layout == axis or layout == "rep":
         return 0.0
+    if config is not None and config.reshard_peak_budget_bytes > 0:
+        from matrel_tpu.parallel import reshard as reshard_lib
+        return reshard_lib.compile_reshard(
+            layout, axis, bytes_, gx, gy, weights,
+            peak_budget=float(config.reshard_peak_budget_bytes)
+        ).weighted_cost
     if layout in ("2d", "other"):
         # gather along the perpendicular mesh axis (same closed form as
         # comm_cost's bmm reshard terms). "other" (a real non-canonical
@@ -1274,8 +1292,10 @@ def choose_join_scheme(node: MatExpr, mesh: Mesh,
             f"constructor-enforced equality (relational/ops.py)")
     if a_extent >= p:
         cost["align"] = (
-            _reshard_to_axis(a_bytes, la, axis, gx, gy, weights=wts)
-            + _reshard_to_axis(b_bytes, lb, axis, gx, gy, weights=wts))
+            _reshard_to_axis(a_bytes, la, axis, gx, gy, weights=wts,
+                             config=config)
+            + _reshard_to_axis(b_bytes, lb, axis, gx, gy, weights=wts,
+                               config=config))
     best = min(cost, key=cost.get)
     return _hint_tiebreak(
         cost, best, lambda s: _scheme_out_layout(s, node, la, lb),
@@ -1451,6 +1471,7 @@ def matmul_decisions(root: MatExpr, mesh: Mesh,
     topo = mesh_lib.mesh_topology(mesh, cfg)
     wts = topo.axis_weights
     lmemo: dict = {}
+    dmemo: dict = {}
     out: list = []
     seen: set = set()
 
@@ -1536,6 +1557,16 @@ def matmul_decisions(root: MatExpr, mesh: Mesh,
                         alpha_bytes=cfg.comm_alpha_bytes, weights=wts)
                     rec["axis_weights"] = list(wts)
                     rec["topology_source"] = topo.source
+                if cfg.reshard_peak_budget_bytes > 0:
+                    # the staged reshard moves this decision's lowering
+                    # will actually run (parallel/reshard.py — the ONE
+                    # derivation the executor and MV109 share): step
+                    # kinds, raw per-axis bytes, worst per-device peak
+                    from matrel_tpu.parallel import reshard as _resh
+                    rr = _resh.moves_record(_resh.staged_matmul_moves(
+                        n, mesh, cfg, lmemo, dmemo))
+                    if rr is not None:
+                        rec["reshard"] = rr
             except ValueError:       # an override string the model
                 rec["est_ici_bytes"] = None   # doesn't know
         out.append(rec)

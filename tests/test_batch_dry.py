@@ -128,6 +128,25 @@ def test_precision_row_artifact(dry_batch):
     assert rec["all_within_bound"] is True
 
 
+def test_reshard_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records,
+               lambda r: r.get("metric") == "reshard_sweep"
+               and "rows" in r, "bench.py --reshard")
+    # the reshard-planner acceptance on the dry mesh: every move
+    # measured both ways with its modelled bytes/peaks, and the staged
+    # CROSS plans peak-bounded below the one-shot full-gather model
+    assert rec["ok"] is True, rec
+    pairs = [row["pair"] for row in rec["rows"]]
+    assert pairs == ["row->col", "col->row", "row->2d", "2d->rep"], pairs
+    for row in rec["rows"]:
+        assert row["staged_ms"] > 0 and row["naive_ms"] > 0, row
+        assert row["staged_bytes"] >= 0 and row["peak_bytes"] > 0
+        if row["cross"]:
+            assert row["steps"] == ["all_to_all", "all_to_all"], row
+            assert row["peak_bytes"] < row["naive_peak_bytes"], row
+
+
 def test_bench_all_rows_artifacts(dry_batch):
     _, records, _ = dry_batch
     # every heavy row emits an explicit, parseable skip record — a

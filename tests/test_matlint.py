@@ -394,6 +394,62 @@ class TestSuppression:
         assert _rules(got) == ["ML000"]
 
 
+class TestML008DevicePut:
+    SRC = """
+        import jax
+        def relay(x, sh):
+            return jax.device_put(x, sh)
+    """
+
+    def test_fires_in_lowering_modules(self, tmp_path):
+        for rel in ("matrel_tpu/executor.py",
+                    "matrel_tpu/ops/custom.py",
+                    "matrel_tpu/parallel/planner.py",
+                    "matrel_tpu/serve/result_cache.py"):
+            got = _lint(tmp_path, self.SRC, rel)
+            assert "ML008" in _rules(got), rel
+
+    def test_reshard_module_and_core_exempt(self, tmp_path):
+        for rel in ("matrel_tpu/parallel/reshard.py",
+                    "matrel_tpu/core/blockmatrix.py",
+                    "matrel_tpu/utils/checkpoint.py",
+                    "tools/some_harness.py"):
+            assert "ML008" not in _rules(_lint(tmp_path, self.SRC,
+                                               rel)), rel
+
+    def test_compile_time_eval_sanctioned(self, tmp_path):
+        src = """
+            import jax
+            def place_tables(tables, sh):
+                with jax.ensure_compile_time_eval():
+                    return [jax.device_put(t, sh) for t in tables]
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/ops/custom.py")
+        assert "ML008" not in _rules(got)
+
+    def test_replicated_destination_sanctioned(self, tmp_path):
+        src = """
+            import jax
+            from matrel_tpu.core.mesh import replicated
+            def place(x, mesh):
+                rep = replicated(mesh)
+                a = jax.device_put(x, rep)
+                b = jax.device_put(x, replicated(mesh))
+                c = jax.device_put(x, device=rep)
+                return a, b, c
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/ops/custom.py")
+        assert "ML008" not in _rules(got)
+
+    def test_suppression_with_justification(self, tmp_path):
+        src = """
+            import jax
+            def place(x, sh):
+                return jax.device_put(x, sh)  # matlint: disable=ML008 host-built kernel table placement
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/ops/custom.py") == []
+
+
 def test_repo_lints_clean():
     """`make lint`'s contract, enforced from inside tier-1: the whole
     default scan set (package, tools, examples, bench harnesses) has

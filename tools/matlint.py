@@ -28,6 +28,7 @@ Rule catalogue (each rule's class docstring is the authority):
   ML005  cache dict keyed by sharding-spec-ish values
   ML006  raw wall-clock timing in library code outside obs/
   ML007  bare/broad except that silently swallows and continues
+  ML008  layout-changing jax.device_put in lowering modules
 """
 
 from __future__ import annotations
@@ -413,10 +414,87 @@ class BroadSwallowRule(Rule):
                     "survive")
 
 
+class DevicePutRule(Rule):
+    """ML008: ``jax.device_put`` in lowering modules — a layout change
+    the planner cannot see or price.
+
+    The reshard planner (matrel_tpu/parallel/reshard.py, round 10)
+    exists so that every layout change lowers through a COSTED,
+    peak-bounded step sequence: a raw ``device_put`` in a lowering
+    module re-lays an array with whatever one-shot collective XLA
+    picks, invisible to the byte model, to MV109's peak proof and to
+    the obs decision records. Route layout changes through the planner
+    (sharding constraints the reshard plan stages) instead. Out of
+    scope by design: ``core/`` (construction-time initial placement is
+    where arrays are BORN), the reshard module itself (it IS the
+    sanctioned lowering), and ``utils/``/``obs/`` (checkpoint IO,
+    host-side tooling). Two in-scope idioms are exempt: placements
+    under ``jax.ensure_compile_time_eval()`` (host-built static
+    metadata, the ML001-sanctioned pattern) and placements onto a
+    fully-REPLICATED sharding (a ``rep``/``repl`` destination or
+    ``replicated(...)`` call — metadata broadcast, not a re-lay).
+    The remaining legit sites (host-built kernel tables placed onto
+    their sharded layout at plan-build time) carry justified inline
+    suppressions."""
+
+    id = "ML008"
+    _SCOPE = re.compile(
+        r"^matrel_tpu/(executor\.py|session\.py|ops/|relational\.?/|"
+        r"serve/|workloads/|ir/|parallel/)")
+    _EXEMPT = ("matrel_tpu/parallel/reshard.py",)
+
+    def applies_to(self, relpath: str) -> bool:
+        return bool(self._SCOPE.match(relpath)) \
+            and relpath not in self._EXEMPT
+
+    @staticmethod
+    def _replicated_dest(node: ast.Call) -> bool:
+        dest = None
+        if len(node.args) >= 2:
+            dest = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "device":
+                dest = kw.value
+        if dest is None:
+            return False
+        if isinstance(dest, ast.Name) and re.match(r"^repl?\b", dest.id):
+            return True
+        if isinstance(dest, ast.Call):
+            tail = _call_name(dest.func).rsplit(".", 1)[-1]
+            if tail == "replicated":
+                return True
+        return False
+
+    def check(self, tree, relpath):
+        # (node, under ensure_compile_time_eval) — the ML001 walker
+        stack: List[tuple] = [(tree, False)]
+        while stack:
+            node, under_cte = stack.pop()
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    name = _call_name(item.context_expr.func) if \
+                        isinstance(item.context_expr, ast.Call) else ""
+                    if name.endswith("ensure_compile_time_eval"):
+                        under_cte = True
+            if isinstance(node, ast.Call):
+                tail = _call_name(node.func).rsplit(".", 1)[-1]
+                if (tail == "device_put" and not under_cte
+                        and not self._replicated_dest(node)):
+                    yield Finding(
+                        relpath, node.lineno, self.id,
+                        "jax.device_put in a lowering module — a "
+                        "layout change the planner cannot price; "
+                        "route it through the reshard planner "
+                        "(parallel/reshard.py) or a costed sharding "
+                        "constraint")
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, under_cte))
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
                         SpecKeyedCacheRule(), RawTimingRule(),
-                        BroadSwallowRule())
+                        BroadSwallowRule(), DevicePutRule())
 
 
 def _suppressed_codes(line: str) -> set:

@@ -45,6 +45,7 @@ if [ "$DRY" = 1 ]; then
     export MATREL_SERVE_N=256 MATREL_SERVE_K=64 \
            MATREL_SERVE_QUERIES=18 MATREL_SERVE_MEAS=3
     export MATREL_PRECISION_N=256 MATREL_PRECISION_REPEATS=3
+    export MATREL_RESHARD_N=256 MATREL_RESHARD_REPEATS=3
     export MATREL_NS_N=2048
     export MATREL_GRAM3_K=64 MATREL_GRAM3_PANEL=4096 MATREL_GRAM3_NPANELS=2
     export MATREL_GRAMFULL_N=200000 MATREL_GRAMFULL_K=64 \
@@ -66,6 +67,8 @@ log "--- bench.py --serve (repeated-traffic serving QPS row, staged this round)"
 python bench.py --serve
 log "--- bench.py --precision (bf16/int precision-tier sweep + error bounds, staged this round)"
 python bench.py --precision
+log "--- bench.py --reshard (staged-vs-naive reshard sweep, staged this round)"
+python bench.py --reshard
 log "--- bench_all.py (all BASELINE rows)"
 python bench_all.py
 log "--- topology_flip (ICI/DCN-weighted planner flip proof, staged this round)"
