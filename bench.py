@@ -329,6 +329,117 @@ def measure_spgemm() -> dict:
     return out
 
 
+def measure_sparse_kernels() -> dict:
+    """Structure-specialized SpGEMM kernel sweep (ROADMAP item 5, the
+    round-11 acceptance row): for each structure class, a synthetic
+    operand pair EXHIBITING it (the registry's own generator, so the
+    measured population is the one the classifier bins) is multiplied
+    through every relevant registered kernel with the registry choice
+    pinned, reporting per-kernel ms median + half-width against the
+    pre-registry fixed Pallas kernel (``pallas_generic``) as baseline.
+    CPU interpret mode is acceptable (the wedge-safe dry harness): the
+    grouped variants' grid-step reduction shows in interpret wall
+    clock just as on-chip. The row also closes the autotune loop
+    in-process: the winner for one (shape, structure) class is
+    measured, PERSISTED, the in-process caches dropped, and the
+    persisted winner replayed — the cross-session proof."""
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    from matrel_tpu.config import MatrelConfig, set_default_config
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.ops import kernel_registry as kr
+    from matrel_tpu.ops import spgemm as spgemm_lib
+    from matrel_tpu.parallel import autotune
+
+    n = _env_int("MATREL_SPK_N", 100_352)
+    bs = _env_int("MATREL_SPK_BS", 512)
+    reps = _env_int("MATREL_SPK_REPEATS", 5)
+    interp = jax.default_backend() not in ("tpu", "axon")
+    cfg = MatrelConfig(obs_level="off", pallas_interpret=interp)
+    set_default_config(cfg)
+    mesh = mesh_lib.make_mesh()
+    fetch = jax.jit(lambda t: jnp.sum(t.astype(jnp.float32)))
+
+    def timed(fn) -> dict:
+        fn()                                   # compile + warm
+        ts = []
+        for _ in range(max(reps, 2)):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        med = ts[len(ts) // 2]
+        return {"ms": round(med * 1e3, 3),
+                "half_width_ms": round((ts[-1] - ts[0]) / 2 * 1e3, 3)}
+
+    rows = []
+    best_speedup = 0.0
+    for structure in ("row_band", "clustered_tile", "powerlaw_coo"):
+        A = kr.synthesize_structure(structure, n, bs, mesh, seed=0)
+        B = kr.synthesize_structure(structure, n, bs, mesh, seed=1)
+        npairs = int(spgemm_lib._pair_structure_cached(A, B)[0].size)
+        kernels: dict = {}
+        for kid in kr.kernel_ids():
+            spec = kr.get_kernel(kid)
+            if not (spec.universal or structure in spec.structures):
+                continue
+            if not kr.admissible(kid, bs, npairs, cfg):
+                continue
+
+            def go(_k=kid):
+                tiles, _, _ = spgemm_lib.spgemm_tiles(A, B, cfg,
+                                                      kernel=_k)
+                float(np.asarray(fetch(tiles)))
+
+            kernels[kid] = timed(go)
+        base = kernels.get("pallas_generic", {}).get("ms")
+        specialized = next(
+            (kid for kid in kernels
+             if structure in kr.get_kernel(kid).structures), None)
+        speedup = None
+        if base and specialized and kernels[specialized]["ms"] > 0:
+            speedup = round(base / kernels[specialized]["ms"], 2)
+            best_speedup = max(best_speedup, speedup)
+        rows.append({
+            "structure": structure,
+            "classified": kr.structure_of_matrix(A),
+            "n": A.shape[0], "bs": bs, "nnzb": A.nnzb,
+            "pairs": npairs, "kernels": kernels,
+            "specialized": specialized,
+            "speedup_vs_generic": speedup,
+        })
+
+    # autotune persist + replay across "sessions" (fresh caches) — a
+    # bounded probe side so the loop also runs at flagship-n configs
+    aside = min(n, _env_int("MATREL_SPK_AUTOTUNE_SIDE", 2048))
+    table = os.environ.get("MATREL_SPK_TABLE", "") or os.path.join(
+        tempfile.gettempdir(), f"matrel_spk_autotune_{os.getpid()}.json")
+    acfg = cfg.replace(autotune=True, autotune_table_path=table)
+    winner = autotune.lookup_or_measure_spgemm(aside, "row_band", bs,
+                                               mesh, acfg)
+    key = autotune._spgemm_key(
+        aside, "row_band", bs, *mesh_lib.mesh_grid_shape(mesh),
+        mesh_lib.axis_weights(mesh, acfg))
+    persisted = key in autotune.load_table(table)
+    autotune._SPGEMM_CACHE.clear()
+    autotune._TABLE_CACHE.clear()
+    replay = autotune.lookup_or_measure_spgemm(aside, "row_band", bs,
+                                               mesh, acfg)
+    classified_ok = all(r["classified"] == r["structure"] for r in rows)
+    return {
+        "n": n, "bs": bs, "repeats": reps,
+        "backend": jax.default_backend(), "interpret": interp,
+        "baseline_kernel": "pallas_generic",
+        "rows": rows, "best_speedup": round(best_speedup, 2),
+        "autotune": {"side": aside, "winner": winner,
+                     "persisted": persisted,
+                     "replayed": replay == winner, "key": key},
+        "ok": (classified_ok and best_speedup >= 1.3
+               and persisted and replay == winner),
+    }
+
+
 def measure_precision() -> dict:
     """Precision-tier sweep (the ROADMAP item-3 acceptance row): the
     dense flagship multiply at f32 vs bf16×1 vs bf16×3 vs int32, each
@@ -1107,6 +1218,24 @@ def main_reshard() -> None:
     print(json.dumps(record))
 
 
+def main_sparse_kernels() -> None:
+    """Wedge-safe structure-specialized kernel sweep capture
+    (tools/tpu_batch.sh step): probe, then the measurement child under
+    a hard timeout; one parseable JSON line either way, rc 0 — same
+    contract as the headline metric."""
+    ok, payload = _run_child("probe", PROBE_TIMEOUT_S)
+    if ok:
+        ok, payload = _run_child("sparse_kernels", MEASURE_TIMEOUT_S)
+    record = {"metric": "sparse_kernel_sweep"}
+    if ok and isinstance(payload, dict):
+        record.update(payload)
+        _emit_bench_event(dict(record))
+    else:
+        record.update({"value": None, "error": str(payload)[:500]})
+        _emit_bench_error(record["metric"], str(payload))
+    print(json.dumps(record))
+
+
 def main_spgemm() -> None:
     """Wedge-safe SpGEMM row capture (tools/tpu_batch.sh step): probe,
     then the measurement child under a hard timeout; one parseable JSON
@@ -1138,6 +1267,10 @@ if __name__ == "__main__":
         print(json.dumps(measure_precision()))
     elif "--_reshard" in sys.argv:
         print(json.dumps(measure_reshard()))
+    elif "--_sparse_kernels" in sys.argv:
+        print(json.dumps(measure_sparse_kernels()))
+    elif "--sparse-kernels" in sys.argv:
+        main_sparse_kernels()
     elif "--reshard" in sys.argv:
         main_reshard()
     elif "--spgemm" in sys.argv:

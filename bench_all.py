@@ -59,6 +59,16 @@ def bench_spgemm(mesh, cfg):
     return {"metric": "blocksparse_spgemm_100k_1pct", **payload}
 
 
+def bench_sparse_kernels(mesh, cfg):
+    """Structure-specialized SpGEMM kernel sweep (ops/kernel_registry):
+    per structure class, every relevant registered kernel vs the fixed
+    pre-registry Pallas baseline, plus the autotune persist/replay
+    proof (see bench.measure_sparse_kernels)."""
+    import bench
+    payload = bench.measure_sparse_kernels()
+    return {"metric": "sparse_kernel_sweep", **payload}
+
+
 def bench_serve(mesh, cfg):
     """Repeated-traffic serving QPS (matrel_tpu/serve/): mixed query
     stream, {result cache off/on} x {sequential/micro-batched} — the
@@ -385,13 +395,14 @@ def main():
     # step order, the JSON contract and the harness glue, not the
     # numbers.
     dry = bool(os.environ.get("MATREL_DRY"))
-    dry_rows = (bench_dense_4k, bench_chain, bench_spgemm, bench_serve,
-                bench_precision, bench_reshard)
+    dry_rows = (bench_dense_4k, bench_chain, bench_spgemm,
+                bench_sparse_kernels, bench_serve, bench_precision,
+                bench_reshard)
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
-               bench_spgemm, bench_serve, bench_precision,
-               bench_reshard, bench_pagerank, bench_pagerank_10x,
-               bench_cg, bench_eigen, bench_triangles,
-               bench_north_star):
+               bench_spgemm, bench_sparse_kernels, bench_serve,
+               bench_precision, bench_reshard, bench_pagerank,
+               bench_pagerank_10x, bench_cg, bench_eigen,
+               bench_triangles, bench_north_star):
         if dry and fn not in dry_rows:
             print(json.dumps({"metric": fn.__name__, "skipped": "dry"}),
                   flush=True)

@@ -28,6 +28,8 @@ codes documented in :mod:`matrel_tpu.analysis.diagnostics`):
 
   strategy   MV101  stamped strategy admissible on this mesh
   spgemm     MV104  SpGEMM stamp <-> dispatch predicate agreement
+  spgemm_kernel MV110 stamped kernel id in-registry + admissible for
+                    the stamped structure class (both directions)
   layout     MV102  infer_layout claims pinned by the lowering
   padding    MV103  zero-padding invariant restored after breakers
   hbm        MV105  per-device working set fits hbm_budget_bytes
@@ -51,6 +53,7 @@ from matrel_tpu.analysis.precision_pass import check_precision_stamps
 from matrel_tpu.analysis.reshard_pass import check_reshard_peaks
 from matrel_tpu.analysis.result_cache_pass import check_result_cache_stamps
 from matrel_tpu.analysis.strategy_pass import (check_spgemm_dispatch,
+                                               check_spgemm_kernel,
                                                check_strategy_stamps)
 from matrel_tpu.analysis.topology_pass import check_axis_traffic
 from matrel_tpu.config import MatrelConfig, default_config
@@ -63,6 +66,7 @@ log = logging.getLogger("matrel_tpu.analysis")
 PASSES = (
     ("strategy", check_strategy_stamps),
     ("spgemm", check_spgemm_dispatch),
+    ("spgemm_kernel", check_spgemm_kernel),
     ("layout", check_layout_claims),
     ("padding", check_padding_flow),
     ("hbm", check_hbm_feasibility),

@@ -22,6 +22,8 @@ Code space (stable — tests and suppressions key on them):
   MV109  staged reshard peak over reshard_peak_budget_
          bytes, or a stamped reshard record that
          understates its recompiled peak               (error)
+  MV110  SpGEMM kernel stamp unknown / inadmissible for
+         the stamped structure class                   (error)
 """
 
 from __future__ import annotations

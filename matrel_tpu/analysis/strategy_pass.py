@@ -146,3 +146,107 @@ def check_spgemm_dispatch(root, mesh, config) -> Iterator[Diagnostic]:
                          "of hand-setting attrs['strategy']")
 
     yield from walk(root)
+
+
+def check_spgemm_kernel(root, mesh, config) -> Iterator[Diagnostic]:
+    """MV110: a stamped ``spgemm_kernel`` must be truthful in BOTH
+    directions under the verifying config.
+
+    Forward: the stamped kernel id must exist in the registry
+    (ops/kernel_registry.py), be runnable here (a Pallas id stamped
+    where Pallas cannot run would crash — or silently densify — at
+    lowering), and be admissible for the operand pair's structure
+    class: a specialized kernel stamped on a FOREIGN structure (absent
+    the config forcing knob) means the plan was annotated under
+    different operand statistics, so its cost record describes a
+    schedule the registry would no longer pick. The stamped structure
+    class itself is re-derived and compared, the MV104 re-check
+    discipline. Backward: a kernel stamp on a node that does NOT
+    dispatch the SpGEMM path is reporting metadata for a lowering that
+    never runs."""
+    from matrel_tpu import executor as exec_lib
+    from matrel_tpu.ir import stats
+    from matrel_tpu.ops import kernel_registry as kr
+    seen = set()
+
+    def walk(n) -> Iterator[Diagnostic]:
+        if n.uid in seen:
+            return
+        seen.add(n.uid)
+        for c in n.children:
+            yield from walk(c)
+        if n.kind != "matmul":
+            return
+        kid = n.attrs.get("spgemm_kernel")
+        if kid is None:
+            # unstamped dispatch is legal: the lowering asks the
+            # shared chooser itself (MV104 owns stamp/dispatch
+            # agreement for the strategy)
+            return
+        if _dispatch_kind(n, config) != "spgemm":
+            yield Diagnostic(
+                code="MV110", severity="error", node=node_addr(n),
+                message=f"spgemm_kernel {kid!r} stamped but the node "
+                        "does not dispatch the S×S SpGEMM under the "
+                        "verifying config — the kernel record "
+                        "describes a lowering that never runs",
+                fix_hint="re-plan under the executing config")
+            return
+        if kid not in kr.REGISTRY:
+            yield Diagnostic(
+                code="MV110", severity="error", node=node_addr(n),
+                message=f"stamped spgemm_kernel {kid!r} is not in the "
+                        f"kernel registry {kr.kernel_ids()}",
+                fix_hint="re-run planner.annotate_strategies, or fix "
+                         "the spgemm_kernel_override string")
+            return
+        spec = kr.get_kernel(kid)
+        bs = exec_lib._spgemm_block_size(n, config)
+        est = exec_lib.spgemm_estimates(n, config)
+        npairs = max(int(round(est.get("est_pairs") or 0.0)), 1)
+        if not kr.admissible(kid, bs, npairs, config):
+            # the FULL runnability gate (the lowering's own): Pallas
+            # availability, the 8-sublane block rule, VMEM-feasible
+            # group — a stamp failing any of these makes the lowering
+            # silently swap in the legacy default while the decision
+            # record still names this kernel
+            yield Diagnostic(
+                code="MV110", severity="error", node=node_addr(n),
+                message=f"stamped spgemm_kernel {kid!r} is not "
+                        "runnable under the verifying config (Pallas "
+                        "gate, 8-sublane block rule, or VMEM-feasible "
+                        "group) — the lowering would silently run the "
+                        "legacy default while obs records this kernel",
+                fix_hint="re-plan under the executing config, or "
+                         "force the XLA entry "
+                         "(spgemm_kernel_override='xla_gather')")
+            return
+        derived = stats.pair_structure_class(
+            kr.structure_of_child(n.children[0], bs),
+            kr.structure_of_child(n.children[1], bs))
+        stamped_struct = n.attrs.get("spgemm_structure")
+        if stamped_struct is not None and stamped_struct != derived:
+            yield Diagnostic(
+                code="MV110", severity="error", node=node_addr(n),
+                message=f"stamped structure class {stamped_struct!r} "
+                        f"but the operand pair classifies "
+                        f"{derived!r} — operand statistics changed "
+                        "since annotation",
+                fix_hint="re-plan so the kernel choice sees the "
+                         "current structure")
+            return
+        forced = (config.spgemm_kernel_override
+                  if config is not None else "")
+        if (not spec.universal and derived not in spec.structures
+                and forced != kid):
+            yield Diagnostic(
+                code="MV110", severity="error", node=node_addr(n),
+                message=f"specialized kernel {kid!r} stamped on "
+                        f"foreign structure class {derived!r} "
+                        f"(home: {spec.structures}) without an "
+                        "override — the registry would not pick this "
+                        "schedule here",
+                fix_hint="re-plan, or force it explicitly via "
+                         "config.spgemm_kernel_override")
+
+    yield from walk(root)

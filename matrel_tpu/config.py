@@ -40,6 +40,18 @@ class MatrelConfig:
         the multiply falls back to the densify path (SpMM over a
         densified right operand), where the MXU's dense throughput wins.
         0 disables SpGEMM entirely.
+      spgemm_kernel_override: force one REGISTERED SpGEMM kernel id
+        (ops/kernel_registry.py — "xla_gather", "pallas_generic",
+        "pallas_band", "pallas_cluster", "pallas_powerlaw") for every
+        dispatching S×S multiply, bypassing the registry's structure
+        classification, the autotune table and the cost model. The
+        soak battery's forcing knob and the degradation ladder's
+        rung-3 escape hatch (resilience/degrade.py forces
+        "xla_gather" there so a miscompiling specialized Pallas
+        kernel cannot survive the retry ladder). An inadmissible
+        override (a Pallas id with Pallas unavailable) falls back to
+        the legacy default; an UNKNOWN id raises at selection. ""
+        (the default) disables forcing.
       comm_alpha_bytes: per-collective-STEP latency charge for the
         planner's comm model, in per-device byte-equivalents (the α of
         an α-β model; ~1 µs of v5e ICI ≈ 200 kB). Stepped strategies
@@ -251,6 +263,7 @@ class MatrelConfig:
     strategy_override: str = "auto"
     sparsity_threshold: float = 0.05
     spgemm_density_threshold: float = 0.25
+    spgemm_kernel_override: str = ""
     comm_alpha_bytes: float = 200_000.0
     default_dtype: str = "float32"
     matmul_precision: str = "highest"
@@ -388,6 +401,20 @@ class MatrelConfig:
         # construction (case-insensitive, "bf16" normalised).
         object.__setattr__(self, "precision_sla",
                            normalize_sla(self.precision_sla))
+        # same hazard for the kernel forcing knob: a typo'd override
+        # would surface only as a mid-traffic ValueError on the first
+        # dispatching query — or never, while the operator believes
+        # the knob is in force. Validated against the vocabulary tuple
+        # (the PRECISION_SLAS precedent — config cannot import the
+        # registry, which needs jax; test_kernel_registry pins the
+        # tuple == the registry's actual ids).
+        if (self.spgemm_kernel_override
+                and self.spgemm_kernel_override not in
+                SPGEMM_KERNEL_IDS):
+            raise ValueError(
+                f"spgemm_kernel_override must be one of "
+                f"{SPGEMM_KERNEL_IDS} (or '' to disable), got "
+                f"{self.spgemm_kernel_override!r}")
 
     def replace(self, **kw: Any) -> "MatrelConfig":
         return dataclasses.replace(self, **kw)
@@ -433,6 +460,14 @@ class MatrelConfig:
 #: levels plus the explicit-dtype spellings that pin one tier.
 PRECISION_SLAS = ("default", "exact", "high", "fast",
                   "float32", "bfloat16", "bf16x3", "int32", "int8")
+
+#: The SpGEMM kernel-registry vocabulary (docs/SPARSE_KERNELS.md) —
+#: what ``spgemm_kernel_override`` validates against at construction.
+#: Config cannot import ops/kernel_registry (it needs jax), so the
+#: tuple lives here and test_kernel_registry pins it equal to the
+#: registry's actual ids; registering a new kernel extends BOTH.
+SPGEMM_KERNEL_IDS = ("xla_gather", "pallas_generic", "pallas_band",
+                     "pallas_cluster", "pallas_powerlaw")
 
 
 def normalize_sla(sla) -> str:

@@ -478,12 +478,20 @@ class Lowerer:
         neither operand is densified (ops/spgemm.py); the product is
         scattered to the padded dense canonical layout every consumer
         expects (apply_dense pads to padded_shape(node.shape, mesh) —
-        the same pair this lowering's consumers compute)."""
+        the same pair this lowering's consumers compute). The KERNEL
+        comes from the planner's ``spgemm_kernel`` stamp (registry
+        dispatch — MV110 verifies it); an unstamped node (direct
+        execute of a hand-built tree) asks the shared chooser
+        itself, so the two can never drift."""
         from matrel_tpu.ops import spgemm as spgemm_lib
         bs = _spgemm_block_size(node, self.config)
         SA = self._as_block_sparse(node.children[0], bs)
         SB = self._as_block_sparse(node.children[1], bs)
-        return spgemm_lib.apply_dense(SA, SB, self.config)
+        kid = node.attrs.get("spgemm_kernel")
+        if kid is None:
+            kid, _, _ = spgemm_kernel_choice(node, self.config,
+                                             self.mesh)
+        return spgemm_lib.apply_dense(SA, SB, self.config, kernel=kid)
 
     def _matmul(self, node: MatExpr, ev) -> Array:
         l, r = node.children
@@ -1340,6 +1348,29 @@ def spgemm_estimates(node: MatExpr, config=None) -> dict:
     rec["est_out_block_density"] = spgemm_out_block_density(node, cfg)
     rec["block_size"] = bs
     return rec
+
+
+def spgemm_kernel_choice(node: MatExpr, config=None, mesh=None):
+    """(kernel_id, structure_class, source) for a dispatching S×S
+    matmul — the SINGLE source of truth shared by the planner's stamp
+    (annotate_strategies), the MV110 verifier and the lowering's
+    unstamped fallback, mirroring the _spgemm_dispatch contract.
+    Structure classification is memoised per operand
+    (kernel_registry.structure_of_child, the pair_structure idiom) and
+    surfaces in matmul_decisions / explain(analyze=True)."""
+    from matrel_tpu.ir import stats
+    from matrel_tpu.ops import kernel_registry as kr
+    cfg = config or default_config()
+    bs = _spgemm_block_size(node, cfg)
+    l, r = node.children
+    structure = stats.pair_structure_class(
+        kr.structure_of_child(l, bs), kr.structure_of_child(r, bs))
+    est = spgemm_estimates(node, cfg)
+    npairs = max(int(round(est.get("est_pairs") or 0.0)), 1)
+    side = max(l.shape[0], l.shape[1], r.shape[1])
+    kid, source = kr.select_kernel(structure, bs, npairs, cfg,
+                                   side=side, mesh=mesh)
+    return kid, structure, source
 
 
 def _coo_dispatch_plan(node: MatExpr):

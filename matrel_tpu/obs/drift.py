@@ -78,9 +78,17 @@ def _strategy_key(d: dict) -> str:
     blended into the f32 row — or a bf16 sample ranked against an f32
     one — would poison both the calibration and the rank-order flags.
     Untier records keep the historical bare-strategy key, so existing
-    persisted tables merge unchanged."""
+    persisted tables merge unchanged. SpGEMM dispatches with a
+    registry kernel stamp calibrate PER KERNEL (``spgemm:<kernel_id>``
+    rows): the specialized variants retire the same estimated
+    FLOPs/bytes at deliberately different rates, so one blended
+    ``dispatch:spgemm`` row would mask exactly the per-kernel drift
+    the registry's cost model needs audited; un-stamped spgemm
+    records (pre-registry logs) keep the historical key."""
     disp = d.get("dispatch")
-    if disp:
+    if disp == "spgemm" and d.get("kernel_id"):
+        key = f"spgemm:{d['kernel_id']}"
+    elif disp:
         key = f"dispatch:{disp}"
     else:
         key = d.get("strategy", "?")

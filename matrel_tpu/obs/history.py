@@ -53,6 +53,7 @@ def summarize(events: List[dict]) -> dict:
     strategies: Dict[str, dict] = {}
     rule_hits: Dict[str, int] = {}
     tiers: Dict[str, dict] = {}
+    spk: Dict[str, dict] = {}
     reshards: dict = {"matmuls": 0, "steps": {}, "bytes_x": 0.0,
                       "bytes_y": 0.0, "peak_bytes": 0.0}
     for e in qs:
@@ -86,6 +87,24 @@ def summarize(events: List[dict]) -> dict:
                 row["count"] += 1
                 if isinstance(d.get("est_passes"), int):
                     row["passes"] += d["est_passes"]
+            # SpGEMM kernel census (round 11): which registry kernels
+            # the planner stamped, over which structure classes, and
+            # how often a measured winner overrode the estimate — the
+            # event-log view of the specialized-kernel loop (a
+            # structure whose census is all "generic" means the
+            # classifier never fires; all "estimate" means the
+            # autotuner never measured)
+            kid = d.get("kernel_id")
+            if kid:
+                row = spk.setdefault(kid, {"count": 0, "measured": 0,
+                                           "structures": {}})
+                row["count"] += 1
+                if d.get("est_vs_measured") == "measured":
+                    row["measured"] += 1
+                sc = d.get("structure_class")
+                if sc:
+                    row["structures"][sc] = \
+                        row["structures"].get(sc, 0) + 1
             s = strategies.setdefault(
                 d.get("strategy", "?"),
                 {"count": 0, "flops": 0.0, "est_ici_bytes": 0.0})
@@ -132,6 +151,7 @@ def summarize(events: List[dict]) -> dict:
         "plan_cache": last_cache,
         "strategies": strategies,
         "precision_tiers": tiers,
+        "spgemm_kernels": spk,
         "reshards": reshards if reshards["matmuls"] else None,
         "rule_hits": rule_hits,
         "bench_runs": sum(1 for e in events if e.get("kind") == "bench"),
@@ -359,6 +379,17 @@ def render_summary(events: List[dict]) -> str:
         lines.append("precision tiers: " + ", ".join(
             f"{t}={d['count']} ({d['passes']} passes)"
             for t, d in sorted(s["precision_tiers"].items())))
+    if s.get("spgemm_kernels"):
+        lines.append("")
+        lines.append("spgemm kernels: " + ", ".join(
+            f"{k}={d['count']}"
+            + (f" ({d['measured']} measured)" if d.get("measured")
+               else "")
+            + (" [" + ", ".join(
+                f"{sc}={n}" for sc, n in sorted(
+                    d["structures"].items())) + "]"
+               if d.get("structures") else "")
+            for k, d in sorted(s["spgemm_kernels"].items())))
     rsh = s.get("reshards")
     if rsh:
         lines.append(

@@ -134,7 +134,7 @@ def make_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg: MatrelConfig,
     # keep full-f32 MXU passes.
     precision = (jax.lax.Precision.DEFAULT if out_dtype == jnp.bfloat16
                  else jax.lax.Precision.HIGHEST)
-    kernel = pl.pallas_call(
+    kernel = pl.pallas_call(  # matlint: disable=ML009 legacy SpMM kernel, unported to the registry this round (block-sparse x DENSE path; registry covers S x S)
         _make_kernel(precision, nnzb),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((gr * bs, pm), out_dtype),

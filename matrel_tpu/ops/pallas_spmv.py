@@ -84,7 +84,7 @@ def _compact_runner(nb: int, cap: int, block: int, lo: int, passes: int,
                     interpret: bool):
     hi_n = block // lo
     cr = cap // LANE
-    scatter = pl.pallas_call(
+    scatter = pl.pallas_call(  # matlint: disable=ML009 legacy SpMV scatter kernel, unported to the registry this round (autotuned via the spmv| table rows)
         _make_scatter_kernel(hi_n, lo, passes),
         grid=(nb,),
         in_specs=[
@@ -368,7 +368,7 @@ def _compact_runner_k(nb: int, cap: int, block: int, lo: int,
                       passes: int, k: int, interpret: bool):
     hi_n = block // lo
     cr = cap // LANE
-    return pl.pallas_call(
+    return pl.pallas_call(  # matlint: disable=ML009 legacy SpMV scatter kernel, unported to the registry this round (autotuned via the spmv| table rows)
         _make_scatter_kernel_k(hi_n, lo, passes, k),
         grid=(nb,),
         in_specs=[

@@ -20,12 +20,17 @@ TILE MAPS instead:
   output tile stack. Dense bs×bs tiles keep the MXU at full speed — the
   sparsity is exploited BETWEEN tiles, never inside one.
 
-* Pallas variant (alongside ops/pallas_spmm.py, TPU only): the pair
-  lists drive a scalar-prefetched grid — per step one A tile and one B
-  tile are DMA'd, multiplied on the MXU, and accumulated into an f32
-  VMEM scratch; the output tile is written once per run of equal output
-  slots (pairs are sorted by slot; TPU grids run sequentially, making
-  the revisit-accumulate safe — same idiom as pallas_spmm).
+* Kernels (device): dispatched through the REGISTRY
+  (ops/kernel_registry.py, round 11 — docs/SPARSE_KERNELS.md): the XLA
+  gather path and the original scalar-prefetch Pallas kernel are the
+  universal entries, joined by per-structure Pallas variants (band
+  diagonal-walk, grouped cluster accumulate, powerlaw run-length
+  bucketing) selected by the operand pair's classified structure, a
+  measured autotune winner, or config.spgemm_kernel_override. On
+  GENERIC-classified pairs (and wherever Pallas is unavailable) the
+  unforced/unmeasured selection is bit-identical to the historical
+  two-way choice; home-structure pairs get their specialized schedule
+  (numerically equivalent — different accumulation order).
 
 * Sharded wrapper (style of ops/spmm_sharded.py): output tiles cut into
   ``mesh.size`` equal contiguous slot ranges; each device owns the
@@ -172,102 +177,38 @@ def pallas_eligible(bs: int, npairs: int) -> bool:
     return bs % 8 == 0 and npairs > 0
 
 
-def _make_pallas_kernel(precision, npairs):
-    from jax.experimental import pallas as pl
-
-    def kern(slots, pa, pb, a_ref, b_ref, out_ref, acc_ref):
-        i = pl.program_id(0)
-        s = slots[i]
-        first = jnp.logical_or(i == 0,
-                               slots[jnp.maximum(i - 1, 0)] != s)
-        last = jnp.logical_or(
-            i == npairs - 1, slots[jnp.minimum(i + 1, npairs - 1)] != s)
-
-        @pl.when(first)
-        def _init():
-            acc_ref[:] = jnp.zeros_like(acc_ref)
-
-        acc_ref[:] += jax.lax.dot(
-            a_ref[0], b_ref[0], precision=precision,
-            preferred_element_type=jnp.float32)
-
-        @pl.when(last)
-        def _flush():
-            out_ref[0] = acc_ref[:].astype(out_ref.dtype)
-
-    return kern
-
-
-def _pallas_tiles_runner(bs, npairs, n_out, prec, out_dtype, interpret):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    from matrel_tpu.utils import compat
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,                 # slots, pa, pb
-        grid=(npairs,),
-        in_specs=[
-            pl.BlockSpec((1, bs, bs), lambda i, slots, pa, pb: (pa[i], 0, 0)),
-            pl.BlockSpec((1, bs, bs), lambda i, slots, pa, pb: (pb[i], 0, 0)),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, bs, bs), lambda i, slots, pa, pb: (slots[i], 0, 0)),
-        scratch_shapes=[pltpu.VMEM((bs, bs), jnp.float32)],
-    )
-    kernel = pl.pallas_call(
-        _make_pallas_kernel(prec, npairs),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n_out, bs, bs), out_dtype),
-        compiler_params=compat.tpu_compiler_params(
-            dimension_semantics=("arbitrary",)),
-        interpret=interpret,
-    )
-
-    @jax.jit
-    def run(a_blocks, b_blocks, slots, pa, pb):
-        return kernel(slots, pa, pb, a_blocks.astype(out_dtype),
-                      b_blocks.astype(out_dtype))
-
-    return run
-
-
-def _xla_tiles_runner(n_out, prec, out_dtype):
-    @jax.jit
-    def run(a_blocks, b_blocks, slots, pa, pb):
-        common = jnp.promote_types(a_blocks.dtype, b_blocks.dtype)
-        ga = jnp.take(a_blocks.astype(common), pa, axis=0)
-        gb = jnp.take(b_blocks.astype(common), pb, axis=0)
-        part = jax.lax.dot_general(
-            ga, gb, (((2,), (1,)), ((0,), (0,))),       # batched tile GEMM
-            precision=prec, preferred_element_type=jnp.float32)
-        tiles = jax.ops.segment_sum(part, slots, num_segments=n_out)
-        return tiles.astype(out_dtype)
-
-    return run
-
-
-def _tiles_runner(A, B, cfg, interpret, npairs, n_out, out_dtype):
-    """Cached device runner producing the output TILE STACK from the two
-    payload stacks + pair tables. Pallas on real TPU (or forced
-    interpret) when eligible, XLA gather/segment-sum otherwise."""
-    from matrel_tpu.config import pallas_enabled
-    use_pallas = (pallas_enabled(cfg)
-                  and pallas_eligible(A.block_size, npairs))
-    key = (id(A), id(B), npairs, n_out, str(out_dtype), use_pallas,
+def _tiles_runner(A, B, cfg, interpret, pairs, n_out, out_dtype,
+                  kernel=None):
+    """Cached device runner producing the output TILE STACK from the
+    two payload stacks + pair tables — now a REGISTRY dispatch
+    (ops/kernel_registry.py): the chosen kernel id comes from the
+    caller (the executor passes the planner's ``spgemm_kernel`` stamp)
+    or from the registry's own selection over the operand pair's
+    structure class. With nothing stamped, measured or overridden, a
+    GENERIC-classified pair selects bit-identically to the historical
+    two-way choice (Pallas on real TPU / forced interpret when
+    eligible, XLA gather/segment-sum otherwise); home-structure pairs
+    get their specialized schedule — same product, different
+    accumulation order."""
+    from matrel_tpu.ops import kernel_registry as kr
+    pa = pairs[1]
+    npairs = int(np.asarray(pa).size)
+    kid = kernel
+    if kid is None:
+        structure = kr.pair_class_of(A, B)
+        kid, _ = kr.select_kernel(structure, A.block_size, npairs, cfg,
+                                  side=max(A.shape[0], A.shape[1],
+                                           B.shape[1]),
+                                  mesh=A.mesh)
+    elif not kr.admissible(kid, A.block_size, npairs, cfg):
+        kid = kr.legacy_default(A.block_size, npairs, cfg)
+    key = (id(A), id(B), npairs, n_out, str(out_dtype), kid,
            interpret, cfg.matmul_precision)
     run = _RUNNER_CACHE.get(key)
     if run is not None:
         return run
-    if use_pallas:
-        # bf16 payloads run the MXU's native pass; see pallas_spmm
-        prec = (jax.lax.Precision.DEFAULT if out_dtype == jnp.bfloat16
-                else jax.lax.Precision.HIGHEST)
-        run = _pallas_tiles_runner(A.block_size, npairs, n_out, prec,
-                                   out_dtype, interpret)
-    else:
-        prec = getattr(jax.lax.Precision, cfg.matmul_precision.upper(),
-                       jax.lax.Precision.HIGHEST)
-        run = _xla_tiles_runner(n_out, prec, out_dtype)
+    run = kr.build_runner(kid, A, B, cfg, interpret, pairs, n_out,
+                          out_dtype)
     _RUNNER_CACHE[key] = run
     _register_purge(A)
     _register_purge(B)
@@ -320,11 +261,13 @@ def _edge_masked(S: BlockSparseMatrix):
 
 def spgemm_tiles(A: BlockSparseMatrix, B: BlockSparseMatrix,
                  config: Optional[MatrelConfig] = None,
-                 interpret=None):
+                 interpret=None, kernel: Optional[str] = None):
     """C = A·B as (tiles, out_rows, out_cols): the output tile stack
     [n_out, bs, bs] plus its coordinates on the (gr_A, gc_B) grid.
     Neither operand is densified; empty intersection yields one zero
-    tile at (0, 0) (the BlockSparseMatrix empty convention)."""
+    tile at (0, 0) (the BlockSparseMatrix empty convention).
+    ``kernel`` forces one registered kernel id (the executor passes
+    the planner's stamp; None lets the registry select)."""
     cfg = config or default_config()
     _check_shapes(A, B)
     interp = resolve_interpret(interpret, cfg)
@@ -334,21 +277,31 @@ def spgemm_tiles(A: BlockSparseMatrix, B: BlockSparseMatrix,
         tiles = jnp.zeros((1, A.block_size, A.block_size), out_dtype)
         return tiles, np.zeros(1, np.int32), np.zeros(1, np.int32)
     n_out = int(out_rows.size)
-    run = _tiles_runner(A, B, cfg, interp, int(pa.size), n_out,
-                        out_dtype)
-    tiles = run(_edge_masked(A), _edge_masked(B),
-                jnp.asarray(slot), jnp.asarray(pa), jnp.asarray(pb))
+    run = _tiles_runner(A, B, cfg, interp,
+                        (slot, pa, pb, out_rows, out_cols), n_out,
+                        out_dtype, kernel=kernel)
+    if getattr(run, "consumes_args", True):
+        tiles = run(_edge_masked(A), _edge_masked(B),
+                    jnp.asarray(slot), jnp.asarray(pa),
+                    jnp.asarray(pb))
+    else:
+        # baked specialized runners replay their pre-gathered payload;
+        # uploading npairs-sized tables per call would be pure dead
+        # work on the repeated-query hot path
+        tiles = run(None, None, None, None, None)
     return tiles, out_rows, out_cols
 
 
 def spgemm(A: BlockSparseMatrix, B: BlockSparseMatrix,
            config: Optional[MatrelConfig] = None,
-           interpret=None) -> BlockSparseMatrix:
+           interpret=None, kernel: Optional[str] = None
+           ) -> BlockSparseMatrix:
     """C = A·B with a SPARSE result: only the tile intersections are
     computed and only the nonzero output tiles are stored."""
     cfg = config or default_config()
     tiles, out_rows, out_cols = spgemm_tiles(A, B, cfg,
-                                             interpret=interpret)
+                                             interpret=interpret,
+                                             kernel=kernel)
     rep = NamedSharding(A.mesh, P())
     return BlockSparseMatrix(
         blocks=jax.lax.with_sharding_constraint(tiles, rep)
@@ -361,14 +314,16 @@ def spgemm(A: BlockSparseMatrix, B: BlockSparseMatrix,
 
 def apply_dense(A: BlockSparseMatrix, B: BlockSparseMatrix,
                 config: Optional[MatrelConfig] = None,
-                interpret=None) -> jax.Array:
+                interpret=None, kernel: Optional[str] = None
+                ) -> jax.Array:
     """Trace-compatible SpGEMM for the executor: the product scattered
     into a PADDED dense array with canonical sharding (what every other
     lowering hands its consumer). The scatter is the only dense
     materialisation — it is the op's OUTPUT, not an operand."""
     cfg = config or default_config()
     tiles, out_rows, out_cols = spgemm_tiles(A, B, cfg,
-                                             interpret=interpret)
+                                             interpret=interpret,
+                                             kernel=kernel)
     n, m = A.shape[0], B.shape[1]
     bs = A.block_size
     gr = math.ceil(n / bs)

@@ -104,7 +104,10 @@ def _current_key_format(key: str) -> bool:
     """Does a persisted key match the CURRENT (backend-suffixed) key
     formats? Matmul keys are ``side|gxXgy|dtype|backend`` (4 fields);
     SpMV keys ``spmv|backend|rows x cols|nb|cap|blk|grid`` (7 fields);
-    reshard keys ``reshard|src>dst|side|grid|backend`` (5 fields).
+    reshard keys ``reshard|src>dst|side|grid|backend`` (5 fields);
+    SpGEMM kernel keys ``spgemm|<=side|structure|bs|grid|backend``
+    (6 fields — the structure class must be in the CURRENT classifier
+    vocabulary, so keys from a retired taxonomy are pruned too).
     Any may carry one extra trailing ``w<wx>x<wy>`` field — the
     topology-weight suffix of a non-uniform mesh. Legacy un-suffixed
     entries (one field short) and anything unknown read as stale."""
@@ -116,6 +119,11 @@ def _current_key_format(key: str) -> bool:
         base = 7
     elif key.startswith("reshard|"):
         base = 5
+    elif key.startswith("spgemm|"):
+        from matrel_tpu.ir import stats
+        base = 6
+        if n >= 3 and fields[2] not in stats.STRUCTURE_CLASSES:
+            return False
     else:
         base = 4
     if n == base:
@@ -518,6 +526,128 @@ def lookup_or_measure_spmv(plan, mesh,
         return None
     best = _pick_winner(results)
     _SPMV_CACHE[key] = best
+    if cfg.autotune or cfg.autotune_table_path:
+        _persist(_table_path(cfg), key, best, results)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# SpGEMM kernel measurement (round 11) — the closed loop for the sparse
+# kernel registry (ops/kernel_registry.py): per (shape class, structure
+# class, backend) the registered variants are timed over a synthetic
+# operand pair EXHIBITING that structure (the same generator the bench
+# and soak batteries draw from), and the winner persists exactly like
+# matmul strategies. ``kernel_registry.select_kernel`` consults this
+# before trusting its cost model (the "measured" stamp source).
+# ---------------------------------------------------------------------------
+
+_SPGEMM_CACHE: Dict[str, Optional[str]] = {}
+
+#: Probe block-density seed for the synthetic structure pair — fixed so
+#: the measured population is reproducible per key.
+SPGEMM_PROBE_SEEDS = (0, 1)
+
+
+def _spgemm_side_class(side: int) -> int:
+    """Power-of-two side bucket — the drift auditor's shape-class
+    granularity, so a 3800² and a 4096² S×S share a row."""
+    return 1 << max(0, math.ceil(math.log2(max(int(side), 1))))
+
+
+def _spgemm_key(side: int, structure: str, bs: int, gx: int, gy: int,
+                weights: Tuple[float, float] = (1.0, 1.0)) -> str:
+    """``spgemm|<=side|structure|bs|grid|backend[|w..]`` — the issue'd
+    key format: side bucketed, structure class explicit, backend (and
+    non-uniform weights) suffixed like every other table row. Keys in
+    any OTHER spgemm format (including a retired structure taxonomy)
+    are legacy and pruned on load (_current_key_format)."""
+    key = (f"spgemm|<={_spgemm_side_class(side)}|{structure}|bs{bs}"
+           f"|{gx}x{gy}|{jax.default_backend()}")
+    if weights != (1.0, 1.0):
+        key += f"|w{weights[0]:g}x{weights[1]:g}"
+    return key
+
+
+def measure_spgemm_kernel(kernel_id: str, A, B,
+                          config: Optional[MatrelConfig] = None,
+                          n_times: int = 5) -> float:
+    """Median seconds for one forced-kernel SpGEMM over the probe pair,
+    through the REAL ops path (spgemm_tiles with the registry choice
+    pinned). Sync timing with a forced scalar fetch — every kernel
+    pays the identical fetch, so the ranking is unaffected."""
+    from matrel_tpu.ops import spgemm as spgemm_lib
+    cfg = config or default_config()
+    fetch = jax.jit(lambda t: jnp.sum(t.astype(jnp.float32)))
+
+    def go():
+        tiles, _, _ = spgemm_lib.spgemm_tiles(A, B, cfg,
+                                              kernel=kernel_id)
+        float(fetch(tiles))
+
+    go()                        # compile + warm (runner cache fill)
+    ts = []
+    for _ in range(max(n_times, 1)):
+        t0 = time.perf_counter()
+        go()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def lookup_or_measure_spgemm(side: int, structure: str, bs: int, mesh,
+                             config: Optional[MatrelConfig] = None
+                             ) -> Optional[str]:
+    """The registry's compile-time entry point (config.autotune=True):
+    the measured kernel id for this (shape class, structure class,
+    backend), or None when the cost model should decide. Same table
+    discipline as the matmul/SpMV/reshard loops: in-process cache →
+    persisted table → measure once (bounded probe — shapes above
+    autotune_max_dim are never measured inline); ties and
+    single-variant result sets resolve to None and are never fake
+    winners."""
+    from matrel_tpu.ops import kernel_registry as kr
+    cfg = config or default_config()
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    wts = mesh_lib.axis_weights(mesh, cfg)
+    key = _spgemm_key(side, structure, bs, gx, gy, wts)
+    if key in _SPGEMM_CACHE:
+        return _SPGEMM_CACHE[key]
+    entry = _load_table_cached(_table_path(cfg)).get(key)
+    if isinstance(entry, dict) and entry.get("times"):
+        best = entry.get("best")
+        best = best if isinstance(best, str) else None
+        _SPGEMM_CACHE[key] = best
+        return best
+    if side > cfg.autotune_max_dim:
+        _SPGEMM_CACHE[key] = None
+        return None
+    probe_n = int(side)
+    A = kr.synthesize_structure(structure, probe_n, bs, mesh,
+                                seed=SPGEMM_PROBE_SEEDS[0])
+    B = kr.synthesize_structure(structure, probe_n, bs, mesh,
+                                seed=SPGEMM_PROBE_SEEDS[1])
+    npairs = 1              # admissibility probe: eligibility, not size
+    results: Dict[str, float] = {}
+    for kid in kr.kernel_ids():
+        spec = kr.get_kernel(kid)
+        if not (spec.universal or structure in spec.structures):
+            continue        # foreign specializations aren't candidates
+        if not kr.admissible(kid, bs, npairs, cfg):
+            continue
+        try:
+            t = measure_spgemm_kernel(kid, A, B, cfg)
+        except Exception:  # noqa: BLE001  # matlint: disable=ML007 measurement loop — a kernel failing to compile on this backend drops out of the table
+            continue
+        if t > 0.0:
+            results[kid] = t
+    # which kernels are admissible depends on CONFIG state (use_pallas,
+    # interpret) the key does not encode — a single-variant "result"
+    # proves nothing and is never persisted (the SpMV-loop precedent)
+    if len(results) < 2:
+        _SPGEMM_CACHE[key] = None
+        return None
+    best = _pick_winner(results)
+    _SPGEMM_CACHE[key] = best
     if cfg.autotune or cfg.autotune_table_path:
         _persist(_table_path(cfg), key, best, results)
     return best

@@ -1446,6 +1446,22 @@ def annotate_strategies(e: MatExpr, mesh: Mesh,
                                            consumer_hint=_consumer_hint,
                                            root_scale=_root_scale)
         e = e.with_attrs(strategy=strat, strategy_source=source)
+        if strat == "spgemm":
+            # registry dispatch (ops/kernel_registry.py): stamp WHICH
+            # kernel the S×S lowering will run — chosen from the
+            # registry's cost estimates over the operand pair's
+            # structure class, overridden by a measured autotune
+            # winner (the MV106 "measured"-stamp precedent) or the
+            # config forcing knob. The lowering honors the stamp and
+            # MV110 verifies it; the shared chooser
+            # (executor.spgemm_kernel_choice) is the single source of
+            # truth so the three can never drift.
+            from matrel_tpu import executor as _exec
+            kid, struct, ksrc = _exec.spgemm_kernel_choice(e, config,
+                                                           mesh)
+            e = e.with_attrs(spgemm_kernel=kid,
+                             spgemm_structure=struct,
+                             spgemm_kernel_source=ksrc)
     if e.kind in ("join_rows", "join_cols") and "replicate" not in e.attrs:
         e = e.with_attrs(replicate=choose_join_scheme(
             e, mesh, config, layout_memo=lmemo,
@@ -1519,6 +1535,22 @@ def matmul_decisions(root: MatExpr, mesh: Mesh,
             from matrel_tpu import executor as _exec
             rec["dispatch"] = "spgemm"
             rec.update(_exec.spgemm_estimates(n, cfg))
+            # registry dispatch record: WHICH kernel runs, over WHAT
+            # structure class, and whether a measurement or the cost
+            # estimate picked it — the obs surface (query events,
+            # explain(analyze=True), history's kernel census, the
+            # drift auditor's spgemm:<kernel_id> calibration rows)
+            kid = n.attrs.get("spgemm_kernel")
+            struct = n.attrs.get("spgemm_structure")
+            ksrc = n.attrs.get("spgemm_kernel_source")
+            if kid is None:
+                kid, struct, ksrc = _exec.spgemm_kernel_choice(
+                    n, cfg, mesh)
+            rec["kernel_id"] = kid
+            rec["structure_class"] = struct
+            rec["kernel_source"] = ksrc
+            rec["est_vs_measured"] = ("measured" if ksrc == "measured"
+                                      else "estimate")
         elif any(c.kind == "coo_leaf" for c in n.children):
             # checked BEFORE sparse_leaf — Lowerer._matmul's order: a
             # mixed coo×sparse matmul runs the COO SpMV path (review r6)

@@ -14,7 +14,10 @@ which is what makes escalation safe to do blindly:
     rung 2  + force the safe `xla` strategy for every matmul
             (GSPMD picks its own decomposition — no hand collectives)
     rung 3  + disable Pallas kernels and SpGEMM dispatch (densify
-            fallback; the XLA gather paths carry sparse matmuls)
+            fallback; the XLA gather paths carry sparse matmuls) and
+            pin the sparse-kernel registry to its XLA generic entry
+            (a forced specialized Pallas kernel must not survive the
+            ladder)
     rung 4  + bypass the result cache for this attempt (a poisoned
             entry cannot answer the retry)
 
@@ -67,6 +70,15 @@ def apply_rung(config, rung: int):
         kw["use_pallas"] = False
         kw["pallas_interpret"] = False
         kw["spgemm_density_threshold"] = 0.0
+        # ALSO force the kernel registry to the XLA generic entry: a
+        # base config carrying spgemm_kernel_override (a forced
+        # specialized Pallas kernel — the soak/bench knob) would
+        # otherwise survive every rung, so the very kernel the ladder
+        # exists to escape kept being re-stamped on the degraded
+        # attempt. Zeroing the threshold kills the expr-level
+        # dispatch; the override pin covers direct ops-level callers
+        # and makes the escape independent of admissibility gating.
+        kw["spgemm_kernel_override"] = "xla_gather"
     return config.replace(**kw)
 
 

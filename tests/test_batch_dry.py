@@ -87,6 +87,39 @@ def test_spgemm_row_artifact(dry_batch):
     assert rec["cmp_densify_ms"] > 0
 
 
+def test_sparse_kernels_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    # twice in the dry batch, like its sibling rows: the wedge-safe
+    # bench.py --sparse-kernels step AND bench_all's dry-enabled row
+    recs = [r for r in records
+            if r.get("metric") == "sparse_kernel_sweep"
+            and "rows" in r]
+    assert len(recs) == 2, f"expected 2 sweep artifacts, got {recs}"
+    rec = recs[0]
+    # the round-11 acceptance on the dry mesh: every structure class
+    # classified as generated, every relevant registered kernel
+    # measured with its interval, at least one specialized variant
+    # >= 1.3x over the fixed pre-registry Pallas kernel on its home
+    # class, and the autotuned winner persisted + replayed from the
+    # (redirected) table
+    assert rec["ok"] is True, rec
+    assert rec["baseline_kernel"] == "pallas_generic"
+    structures = [r["structure"] for r in rec["rows"]]
+    assert structures == ["row_band", "clustered_tile",
+                          "powerlaw_coo"], structures
+    for row in rec["rows"]:
+        assert row["classified"] == row["structure"], row
+        assert row["pairs"] > 0
+        assert {"xla_gather", "pallas_generic"} <= set(row["kernels"])
+        assert row["specialized"] in row["kernels"], row
+        for t in row["kernels"].values():
+            assert t["ms"] > 0 and "half_width_ms" in t
+    assert rec["best_speedup"] >= 1.3, rec["best_speedup"]
+    at = rec["autotune"]
+    assert at["persisted"] is True and at["replayed"] is True
+    assert at["key"].startswith("spgemm|")
+
+
 def test_serve_row_artifact(dry_batch):
     _, records, _ = dry_batch
     rec = _one(records,
@@ -248,7 +281,8 @@ def test_artifacts_redirected_out_of_repo(dry_batch):
     # every side-effect landed in the dry dir, not the capture history
     for name in ("events.jsonl", "progress.jsonl", "soaklog.jsonl",
                  "bench_last_good.json", "cpu_baseline.json",
-                 "autotune_dry.json", "flight.json", "drift.json"):
+                 "autotune_dry.json", "spk_autotune.json",
+                 "flight.json", "drift.json"):
         assert (art / name).exists(), f"{name} not redirected"
     events = [json.loads(l) for l in (art / "events.jsonl").open()]
     assert any(e.get("kind") == "bench" for e in events)

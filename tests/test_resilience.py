@@ -459,8 +459,58 @@ class TestDegradationLadder:
         c3 = degrade.apply_rung(cfg, 3)
         assert (c3.strategy_override, c3.use_pallas,
                 c3.spgemm_density_threshold) == ("xla", False, 0.0)
+        assert c3.spgemm_kernel_override == "xla_gather"
         c4 = degrade.apply_rung(cfg, 4)
         assert c4 == c3      # rung 4's rc bypass is session-side
+
+    def test_rung3_forces_registry_off_a_forced_pallas_kernel(self):
+        # the regression: a base config FORCING a specialized Pallas
+        # kernel (the soak/bench knob) must not survive rung 3 — the
+        # rung's whole point is escaping a miscompiling kernel
+        cfg = MatrelConfig(spgemm_kernel_override="pallas_band",
+                           pallas_interpret=True)
+        c2 = degrade.apply_rung(cfg, 2)
+        assert c2.spgemm_kernel_override == "pallas_band"
+        c3 = degrade.apply_rung(cfg, 3)
+        assert c3.spgemm_kernel_override == "xla_gather"
+
+    def test_rung3_escapes_miscompiling_forced_kernel(self, mesh8,
+                                                      monkeypatch):
+        # end to end: the forced specialized Pallas kernel's BUILDER
+        # blows up with a transient-classified fault (a Mosaic
+        # miscompile's shape); rungs 1–2 keep the forced kernel and
+        # keep failing; rung 3 pins the registry to the XLA generic
+        # entry and the query completes
+        from matrel_tpu.ops import kernel_registry as kr
+        from matrel_tpu.ops import spgemm as spgemm_lib
+        sess = _sess(mesh8, spgemm_kernel_override="pallas_band",
+                     pallas_interpret=True, retry_max_attempts=4,
+                     retry_backoff_ms=0.5)
+        A = kr.synthesize_structure("row_band", 2048, 16, mesh8,
+                                    seed=31)
+        B = kr.synthesize_structure("row_band", 2048, 16, mesh8,
+                                    seed=32)
+        orig = kr.build_runner
+        attempts = []
+
+        def broken(kid, *a, **k):
+            if kid == "pallas_band":
+                attempts.append(kid)
+                raise RuntimeError(
+                    "INTERNAL: injected Mosaic miscompile")
+            return orig(kid, *a, **k)
+
+        monkeypatch.setattr(kr, "build_runner", broken)
+        spgemm_lib._RUNNER_CACHE.clear()
+        out = sess.run(A.multiply(B))
+        assert attempts, "forced kernel was never even tried"
+        n = A.shape[0]
+        np.testing.assert_allclose(out.to_numpy()[:n, :n],
+                                   A.to_numpy() @ B.to_numpy(),
+                                   rtol=3e-4, atol=3e-4)
+        # the completing attempt ran degraded at rung >= 3
+        assert any(k.startswith("degr:3|") or k.startswith("degr:4|")
+                   for k in sess._plan_cache), list(sess._plan_cache)
 
     @pytest.mark.parametrize("rung", [1, 2, 3, 4])
     def test_each_rung_produces_correct_results(self, mesh8, rng,

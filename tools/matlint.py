@@ -29,6 +29,8 @@ Rule catalogue (each rule's class docstring is the authority):
   ML006  raw wall-clock timing in library code outside obs/
   ML007  bare/broad except that silently swallows and continues
   ML008  layout-changing jax.device_put in lowering modules
+  ML009  Pallas kernel defined outside ops/kernel_registry.py in
+         executor-reachable ops modules (the "one seam" rule)
 """
 
 from __future__ import annotations
@@ -491,10 +493,54 @@ class DevicePutRule(Rule):
                 stack.append((child, under_cte))
 
 
+class KernelSeamRule(Rule):
+    """ML009: Pallas kernel construction outside the kernel registry,
+    in modules reachable from executor dispatch — the "one seam" rule.
+
+    The sparse kernel registry (matrel_tpu/ops/kernel_registry.py)
+    exists so that every kernel the executor's sparse-matmul dispatch
+    can reach is REGISTERED: declared structure classes for the
+    planner's stamp, admissibility MV110 can verify, a row the
+    autotuner can measure, a forcing knob the degradation ladder can
+    escape. A ``pallas_call`` authored elsewhere in ``ops/`` is a
+    kernel the registry cannot select, verify, measure or escape —
+    exactly the hardcoded branch the registry replaced (and the seam
+    where future GPU/multi-backend kernels must land, ROADMAP north
+    star). Scope: ``matrel_tpu/ops/`` (the executor's kernel modules);
+    the registry module itself is the sanctioned home. The legacy
+    SpMV/SpMM paths (ops/pallas_spmv.py, ops/pallas_spmm.py,
+    ops/spmv_routed.py) predate the registry and stay unported this
+    round — they carry justified inline suppressions, which double as
+    the porting worklist."""
+
+    id = "ML009"
+    _SCOPE = re.compile(r"^matrel_tpu/ops/")
+    _EXEMPT = ("matrel_tpu/ops/kernel_registry.py",)
+
+    def applies_to(self, relpath: str) -> bool:
+        return bool(self._SCOPE.match(relpath)) \
+            and relpath not in self._EXEMPT
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _call_name(node.func).rsplit(".", 1)[-1]
+            if tail == "pallas_call":
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    "pallas_call outside the kernel registry — a "
+                    "kernel the registry cannot select/verify/"
+                    "measure/escape; define it in "
+                    "ops/kernel_registry.py (the one seam) and "
+                    "register it")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
                         SpecKeyedCacheRule(), RawTimingRule(),
-                        BroadSwallowRule(), DevicePutRule())
+                        BroadSwallowRule(), DevicePutRule(),
+                        KernelSeamRule())
 
 
 def _suppressed_codes(line: str) -> set:

@@ -337,6 +337,81 @@ def soak_routed(n_trials: int, base: int, tol: float,
     return fails
 
 
+def soak_sparse_kernels(n_trials: int, base: int, tol: float):
+    """Sparse kernel-registry battery (round 11): random matrices
+    drawn PER structure class × EVERY registered kernel forced via the
+    config override, each checked against the numpy oracle; one
+    rotating kernel per trial additionally runs the full
+    executor/planner path — annotated plan verified clean (MV104 +
+    MV110) and the structural no-densify guarantee re-asserted with a
+    poisoned ``to_dense`` (the test_spgemm acceptance idiom, per
+    variant)."""
+    import numpy as np
+    from matrel_tpu import analysis, executor as executor_lib
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.sparse import BlockSparseMatrix
+    from matrel_tpu.ops import kernel_registry as kr
+    from matrel_tpu.ops import spgemm as spgemm_lib
+    from matrel_tpu.parallel import planner
+
+    mesh = mesh_lib.make_mesh()
+    fails = []
+    structures = ("row_band", "clustered_tile", "powerlaw_coo",
+                  "generic")
+    kids = kr.kernel_ids()
+    for trial in range(base, base + n_trials):
+        rng = np.random.default_rng(trial)
+        try:
+            structure = structures[trial % len(structures)]
+            bs = int(rng.choice([8, 16]))
+            n = bs * int(rng.integers(48, 72))
+            A = kr.synthesize_structure(structure, n, bs, mesh,
+                                        seed=trial)
+            B = kr.synthesize_structure(structure, n, bs, mesh,
+                                        seed=trial + 17)
+            ref = A.to_numpy() @ B.to_numpy()
+            scale = max(float(np.abs(ref).max()), 1.0)
+            for kid in kids:
+                cfg = MatrelConfig(pallas_interpret=True, block_size=bs,
+                                   spgemm_kernel_override=kid)
+                got = spgemm_lib.spgemm(A, B, cfg).to_numpy()
+                np.testing.assert_allclose(got / scale, ref / scale,
+                                           rtol=tol, atol=tol)
+            # full executor path for one rotating kernel per trial
+            # (compiles are the expensive part of this battery)
+            kid = kids[trial % len(kids)]
+            cfg = MatrelConfig(pallas_interpret=True, block_size=bs,
+                               spgemm_kernel_override=kid)
+            e = A.multiply(B)
+            if not executor_lib._spgemm_dispatch(e, cfg):
+                continue
+            ann = planner.annotate_strategies(e, mesh, cfg)
+            assert ann.attrs.get("spgemm_kernel") == kid, \
+                (kid, ann.attrs.get("spgemm_kernel"))
+            bad = [d for d in analysis.verify_plan(ann, mesh, cfg)
+                   if d.code in ("MV104", "MV110")]
+            assert not bad, bad
+            orig = BlockSparseMatrix.to_dense
+
+            def _boom(self, *a, **k):
+                raise AssertionError(
+                    "SpGEMM kernel variant densified an operand")
+
+            BlockSparseMatrix.to_dense = _boom
+            try:
+                out = executor_lib.execute(ann, mesh, cfg)
+            finally:
+                BlockSparseMatrix.to_dense = orig
+            np.testing.assert_allclose(
+                out.to_numpy()[:n, :n] / scale, ref / scale,
+                rtol=tol, atol=tol)
+        except Exception as ex:  # noqa: BLE001 — soak collects all
+            fails.append(("spk", trial, type(ex).__name__,
+                          str(ex)[:200]))
+    return fails
+
+
 def soak_serve(n_trials: int, base: int, tol: float):
     """Serving-layer battery: a random query stream (with heavy
     repetition, so the result cache and the MultiPlan plan cache both
@@ -646,7 +721,7 @@ def main():
     p.add_argument("battery",
                    choices=["fuzz", "deep", "spmv", "sharded", "routed",
                             "ckpt", "serve", "precision", "chaos",
-                            "all"])
+                            "sparse_kernels", "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -675,6 +750,9 @@ def main():
         fails += soak_precision(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("sharded", "all"):
         fails += soak_sharded(max(args.seeds // 2, 5), args.base, tol)
+    if args.battery in ("sparse_kernels", "all"):
+        fails += soak_sparse_kernels(max(args.seeds // 5, 4),
+                                     args.base, tol)
     if args.battery in ("routed", "all"):
         if args.tpu:
             # REAL-Mosaic routed battery: few trials, small shapes —

@@ -42,6 +42,9 @@ if [ "$DRY" = 1 ]; then
     export MATREL_BENCH_N=512 MATREL_BENCH_REPEATS=3
     export MATREL_BENCH_BACKOFFS="" MATREL_BENCH_DEADLINE=360
     export MATREL_SPGEMM_N=8192 MATREL_SPGEMM_CMP_N=4096
+    export MATREL_SPK_N=1024 MATREL_SPK_BS=64 MATREL_SPK_REPEATS=3 \
+           MATREL_SPK_AUTOTUNE_SIDE=1024 \
+           MATREL_SPK_TABLE="$DRY_DIR/spk_autotune.json"
     export MATREL_SERVE_N=256 MATREL_SERVE_K=64 \
            MATREL_SERVE_QUERIES=18 MATREL_SERVE_MEAS=3
     export MATREL_PRECISION_N=256 MATREL_PRECISION_REPEATS=3
@@ -63,6 +66,8 @@ log "--- soak_guard (on-chip oracle soak)"
 python tools/soak_guard.py --seeds $SEEDS
 log "--- bench.py --spgemm (S x S tile-intersection SpGEMM row, staged this round)"
 python bench.py --spgemm
+log "--- bench.py --sparse-kernels (structure-specialized kernel sweep + autotune replay, staged this round)"
+python bench.py --sparse-kernels
 log "--- bench.py --serve (repeated-traffic serving QPS row, staged this round)"
 python bench.py --serve
 log "--- bench.py --precision (bf16/int precision-tier sweep + error bounds, staged this round)"
