@@ -440,6 +440,148 @@ def measure_sparse_kernels() -> dict:
     }
 
 
+def measure_fusion() -> dict:
+    """Whole-plan fusion sweep (ROADMAP item 3, the round-12
+    acceptance row): the PageRank-step and linreg-epilogue chains
+    emitted BOTH ways through the executor's unit-program seam —
+    ``compile_staged_units`` (one jitted program per physical op: a
+    dispatch and an HBM round-trip per plan edge, the per-op floor)
+    vs ``compile_region_units`` (one jitted program per fused region —
+    XLA sees the whole segment). Reports ms median + half-width and
+    the DISPATCH COUNTS for both forms per chain; the acceptance
+    number is fused >= 1.3x over staged at bench scale with the
+    dispatch count reduced. CPU backend is acceptable (the wedge-safe
+    dry harness): the win IS the per-edge dispatch + HBM round-trip
+    elimination, which the CPU pays like the TPU does. Outputs of the
+    two forms are asserted equal (same member lowerings, one program
+    boundary apart)."""
+    import jax
+    from matrel_tpu.config import MatrelConfig, set_default_config
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu import executor as executor_lib
+    from matrel_tpu.ir import fusion as fusion_lib
+
+    n = _env_int("MATREL_FUSION_N", 512)
+    k = _env_int("MATREL_FUSION_K", 128)
+    reps = _env_int("MATREL_FUSION_REPEATS", 9)
+    inner = _env_int("MATREL_FUSION_INNER", 8)
+    cfg_off = MatrelConfig(obs_level="off")
+    cfg_on = cfg_off.replace(fusion_enable=True)
+    set_default_config(cfg_off)
+    mesh = mesh_lib.make_mesh()
+    rng = np.random.default_rng(0)
+
+    def timed(units) -> dict:
+        """Median ms per EXECUTION over ``reps`` samples of ``inner``
+        back-to-back runs each (amortises per-sample host jitter on a
+        shared box — the per-program dispatch cost under measure is
+        paid identically in every inner run)."""
+        import jax
+
+        def sample():
+            out = None
+            for _ in range(max(inner, 1)):
+                out = units.run()
+            jax.block_until_ready(out)
+
+        sample()                               # compile + warm
+        ts = []
+        for _ in range(max(reps, 2)):
+            t0 = time.perf_counter()
+            sample()
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        scale = 1e3 / max(inner, 1)
+        return {"ms": round(ts[len(ts) // 2] * scale, 3),
+                "half_width_ms": round((ts[-1] - ts[0]) / 2 * scale,
+                                       3)}
+
+    def pagerank_step_expr():
+        # r' = α·(Âᵀ·(w∘r) + 1·(dangling·r)/n) + (1-α)/n — the whole
+        # per-round update as ONE fusable region anchored on the
+        # matvec (prologue w∘r below the anchor, epilogue above)
+        a = rng.random((n, n), dtype=np.float32)
+        r = rng.random((n, 1), dtype=np.float32)
+        w = rng.random((n, 1), dtype=np.float32)
+        dang = (rng.random((n, 1)) < 0.05).astype(np.float32)
+        A = BlockMatrix.from_numpy(a, mesh=mesh)
+        R = BlockMatrix.from_numpy(r, mesh=mesh)
+        W = BlockMatrix.from_numpy(w, mesh=mesh)
+        D = BlockMatrix.from_numpy(dang, mesh=mesh)
+        alpha = 0.85
+        contrib = A.expr().t().multiply(
+            W.expr().elem_multiply(R.expr()))
+        dmass = D.expr().elem_multiply(R.expr()).sum() \
+            .multiply_scalar(1.0 / n)
+        return contrib.add(dmass).multiply_scalar(alpha) \
+            .add_scalar((1.0 - alpha) / n)
+
+    def linreg_epilogue_expr():
+        # ridge-normalised Gram + row-mean diagnostic:
+        # rowsum((XᵀX)·(1/n) + λ·I)·(1/k) — the BASELINE row-3
+        # epilogue chain fused into the producing contraction
+        x = rng.random((n, k), dtype=np.float32)
+        eye = np.eye(k, dtype=np.float32)
+        X = BlockMatrix.from_numpy(x, mesh=mesh)
+        I = BlockMatrix.from_numpy(eye, mesh=mesh)
+        return X.expr().t().multiply(X.expr()) \
+            .multiply_scalar(1.0 / n) \
+            .add(I.expr().multiply_scalar(0.1)) \
+            .row_sum().multiply_scalar(1.0 / k)
+
+    rows = []
+    all_ok = True
+    for name, make in (("pagerank_step", pagerank_step_expr),
+                       ("linreg_epilogue", linreg_epilogue_expr)):
+        e = make()
+        staged = executor_lib.compile_staged_units(e, mesh, cfg_off)
+        fused = executor_lib.compile_region_units(e, mesh, cfg_on)
+        regions = sum(1 for _n, _f, _i, nm in fused.units if nm > 1)
+        got_s = np.asarray(jax.block_until_ready(staged.run()))
+        got_f = np.asarray(jax.block_until_ready(fused.run()))
+        scale = max(float(np.abs(got_s).max()), 1.0)
+        agree = bool(np.allclose(got_f / scale, got_s / scale,
+                                 atol=1e-5))
+        t_staged = timed(staged)
+        t_fused = timed(fused)
+        speedup = (round(t_staged["ms"] / t_fused["ms"], 2)
+                   if t_fused["ms"] > 0 else None)
+        ok = (agree and speedup is not None and speedup >= 1.3
+              and fused.dispatches < staged.dispatches)
+        all_ok = all_ok and ok
+        rows.append({
+            "chain": name,
+            "staged_ms": t_staged["ms"],
+            "staged_half_width_ms": t_staged["half_width_ms"],
+            "fused_ms": t_fused["ms"],
+            "fused_half_width_ms": t_fused["half_width_ms"],
+            "staged_dispatches": staged.dispatches,
+            "fused_dispatches": fused.dispatches,
+            "regions": regions,
+            "speedup": speedup,
+            "outputs_agree": agree,
+            "ok": ok,
+        })
+    # the default-path contract rides the row: fusion off constructs
+    # ZERO region objects and MV111 is quiet on a fresh fused plan
+    before = fusion_lib._CONSTRUCTED["count"]
+    executor_lib.compile_expr(linreg_epilogue_expr(), mesh, cfg_off)
+    off_clean = fusion_lib._CONSTRUCTED["count"] == before
+    from matrel_tpu import analysis
+    plan_on = executor_lib.compile_expr(linreg_epilogue_expr(), mesh,
+                                        cfg_on)
+    mv111 = [d.render() for d in analysis.verify_plan(
+        plan_on.optimized, mesh, cfg_on) if d.code == "MV111"]
+    return {"n": n, "k": k, "repeats": reps,
+            "backend": jax.default_backend(),
+            "rows": rows,
+            "off_constructs_nothing": off_clean,
+            "mv111_quiet": not mv111,
+            "mv111": mv111[:4],
+            "ok": bool(all_ok and off_clean and not mv111)}
+
+
 def measure_precision() -> dict:
     """Precision-tier sweep (the ROADMAP item-3 acceptance row): the
     dense flagship multiply at f32 vs bf16×1 vs bf16×3 vs int32, each
@@ -1236,6 +1378,24 @@ def main_sparse_kernels() -> None:
     print(json.dumps(record))
 
 
+def main_fusion() -> None:
+    """Wedge-safe fused-vs-staged fusion sweep capture
+    (tools/tpu_batch.sh step): probe, then the measurement child under
+    a hard timeout; one parseable JSON line either way, rc 0 — same
+    contract as the headline metric."""
+    ok, payload = _run_child("probe", PROBE_TIMEOUT_S)
+    if ok:
+        ok, payload = _run_child("fusion", MEASURE_TIMEOUT_S)
+    record = {"metric": "fusion_region_sweep"}
+    if ok and isinstance(payload, dict):
+        record.update(payload)
+        _emit_bench_event(dict(record))
+    else:
+        record.update({"value": None, "error": str(payload)[:500]})
+        _emit_bench_error(record["metric"], str(payload))
+    print(json.dumps(record))
+
+
 def main_spgemm() -> None:
     """Wedge-safe SpGEMM row capture (tools/tpu_batch.sh step): probe,
     then the measurement child under a hard timeout; one parseable JSON
@@ -1269,6 +1429,10 @@ if __name__ == "__main__":
         print(json.dumps(measure_reshard()))
     elif "--_sparse_kernels" in sys.argv:
         print(json.dumps(measure_sparse_kernels()))
+    elif "--_fusion" in sys.argv:
+        print(json.dumps(measure_fusion()))
+    elif "--fusion" in sys.argv:
+        main_fusion()
     elif "--sparse-kernels" in sys.argv:
         main_sparse_kernels()
     elif "--reshard" in sys.argv:

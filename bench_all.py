@@ -69,6 +69,15 @@ def bench_sparse_kernels(mesh, cfg):
     return {"metric": "sparse_kernel_sweep", **payload}
 
 
+def bench_fusion(mesh, cfg):
+    """Whole-plan fusion sweep: the PageRank-step and linreg-epilogue
+    chains as one jitted program per fused region vs one per physical
+    op, ms + dispatch counts both ways (see bench.measure_fusion)."""
+    import bench
+    payload = bench.measure_fusion()
+    return {"metric": "fusion_region_sweep", **payload}
+
+
 def bench_serve(mesh, cfg):
     """Repeated-traffic serving QPS (matrel_tpu/serve/): mixed query
     stream, {result cache off/on} x {sequential/micro-batched} — the
@@ -396,13 +405,13 @@ def main():
     # numbers.
     dry = bool(os.environ.get("MATREL_DRY"))
     dry_rows = (bench_dense_4k, bench_chain, bench_spgemm,
-                bench_sparse_kernels, bench_serve, bench_precision,
-                bench_reshard)
+                bench_sparse_kernels, bench_fusion, bench_serve,
+                bench_precision, bench_reshard)
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
-               bench_spgemm, bench_sparse_kernels, bench_serve,
-               bench_precision, bench_reshard, bench_pagerank,
-               bench_pagerank_10x, bench_cg, bench_eigen,
-               bench_triangles, bench_north_star):
+               bench_spgemm, bench_sparse_kernels, bench_fusion,
+               bench_serve, bench_precision, bench_reshard,
+               bench_pagerank, bench_pagerank_10x, bench_cg,
+               bench_eigen, bench_triangles, bench_north_star):
         if dry and fn not in dry_rows:
             print(json.dumps({"metric": fn.__name__, "skipped": "dry"}),
                   flush=True)

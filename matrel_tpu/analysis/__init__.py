@@ -37,6 +37,9 @@ codes documented in :mod:`matrel_tpu.analysis.diagnostics`):
   result_cache MV107 result-cache stamp agrees with the cached entry
   precision  MV108  stamped precision tier satisfies the query SLA
   reshard    MV109  staged reshard peaks fit reshard_peak_budget_bytes
+  fusion     MV111  fused-region stamps cover exactly the regions the
+                    executor lowers (both directions); tier/remask
+                    preserved; fusion off stamps nothing
 """
 
 from __future__ import annotations
@@ -46,6 +49,7 @@ from typing import List, Optional
 
 from matrel_tpu.analysis.diagnostics import (  # noqa: F401 (re-export)
     Diagnostic, VerificationError)
+from matrel_tpu.analysis.fusion_pass import check_fusion_stamps
 from matrel_tpu.analysis.hbm_pass import check_hbm_feasibility
 from matrel_tpu.analysis.layout_pass import check_layout_claims
 from matrel_tpu.analysis.padding_pass import check_padding_flow
@@ -74,6 +78,7 @@ PASSES = (
     ("result_cache", check_result_cache_stamps),
     ("precision", check_precision_stamps),
     ("reshard", check_reshard_peaks),
+    ("fusion", check_fusion_stamps),
 )
 
 

@@ -243,6 +243,20 @@ class MatrelConfig:
         the plan's real per-axis bytes, and MV109 proves every stamped
         reshard's peak fits — the knob that lets near-HBM-limit
         operands move at all instead of being refused by MV105.
+      fusion_enable: whole-plan program fusion (matrel_tpu/ir/fusion.py;
+        docs/FUSION.md). Off (the default) is bit-identical to the
+        historical per-op path: no region is ever segmented, no
+        FusedRegion object constructed (test-enforced), plan snapshots
+        unchanged. On: the planner stamps fusable regions (elementwise
+        chains, reductions, scalar epilogues absorbed into their
+        producer matmul/SpGEMM) after ``annotate_strategies``; the
+        executor lowers each region under ONE annotate() dispatch
+        frame with the epilogue pushed into the producing kernel's
+        epilogue slot, the region-program seam can emit one jitted
+        program per region, matmul_decisions records the boundary
+        (est saved dispatches / HBM bytes), and MV111 verifies every
+        stamp. The degradation ladder's rung 3 forces this off so a
+        miscompiling fused region cannot survive retry.
       axis_cost_weights: per-mesh-axis relative inverse-bandwidth
         weights for the planner's comm model (core/mesh.MeshTopology):
         a collective leg over axis i is billed bytes × weights[i], so
@@ -305,6 +319,7 @@ class MatrelConfig:
     precision_sla: str = "default"
     precision_enable_bf16: bool = True
     precision_enable_int: bool = True
+    fusion_enable: bool = False
 
     def __post_init__(self):
         # enablement is "anything != off", so an unvalidated typo/case

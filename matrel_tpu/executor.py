@@ -140,13 +140,22 @@ class Lowerer:
                 # (SURVEY.md §5 "Tracing / profiling"). EVERY node
                 # lowering dispatch must go through this one wrapped
                 # call — tests/test_obs.py structurally enforces it, so
-                # new ops can't silently skip instrumentation.
-                label = node.kind
-                if node.kind == "matmul":
-                    label += ":" + node.attrs.get("strategy", "xla")
-                    tier = node.attrs.get("precision_tier")
-                    if tier is not None:    # tiered lowering: the
-                        label += f"@{tier}"  # per-op label says so
+                # new ops can't silently skip instrumentation. A fused
+                # region (ir/fusion.py stamp, config.fusion_enable) is
+                # ONE dispatch: the whole member set lowers under this
+                # single frame — that per-edge dispatch collapse is the
+                # point of the fusion pass.
+                sig = (node.attrs.get("fused_region")
+                       if self.config.fusion_enable else None)
+                if sig is not None:
+                    label = f"fused:{sig}"
+                else:
+                    label = node.kind
+                    if node.kind == "matmul":
+                        label += ":" + node.attrs.get("strategy", "xla")
+                        tier = node.attrs.get("precision_tier")
+                        if tier is not None:    # tiered lowering: the
+                            label += f"@{tier}"  # per-op label says so
                 if self.op_hook is not None:
                     child_time.append(0.0)
                     t0 = time.perf_counter()  # matlint: disable=ML006 analyze-mode op_hook measurement — lands in analyze events
@@ -155,7 +164,12 @@ class Lowerer:
                 # compile-path fault). Free when fault_inject is "".
                 faults_lib.check("lower", self.config)
                 with annotate(f"matrel.{label}"):
-                    out = self._eval(node, ev, leaf_arrays, leaf_pos)
+                    if sig is not None:
+                        out = self._eval_region(node, ev, leaf_arrays,
+                                                leaf_pos)
+                    else:
+                        out = self._eval(node, ev, leaf_arrays,
+                                         leaf_pos)
                 if self.op_hook is not None:
                     # the ONE sanctioned lowering-path sync: analyze
                     # mode only (op_hook is never set on the hot path —
@@ -251,6 +265,63 @@ class Lowerer:
         if k in ("join_rows", "join_cols"):
             return self._join_axis(node, ev)
         raise NotImplementedError(f"lowering for node kind {k!r}")
+
+    def _eval_region(self, root: MatExpr, ev, leaf_arrays,
+                     leaf_pos) -> Array:
+        """Lower one FUSED REGION (ir/fusion.py stamp) as a single
+        dispatch: every member lowers inside the caller's ONE
+        ``annotate()`` frame; region INPUTS (non-member children) go
+        back through the outer ``ev`` and keep their own frames. The
+        member chain ABOVE the anchor matmul is composed into an
+        epilogue callable and pushed into the producing kernel's
+        epilogue slot (strategies.run_matmul / ops/spmm.apply /
+        ops/spgemm.apply_dense → the kernel-registry hook), so XLA
+        sees the whole segment as the contraction's epilogue. Member
+        lowerings are byte-for-byte the staged ``_eval`` paths —
+        every re-mask of the zero-padding invariant runs exactly
+        where the staged path runs it (MV111's remask census)."""
+        from matrel_tpu.ir import fusion as fusion_lib
+        members = fusion_lib.region_nodes(root)
+        anchor_uid = root.attrs.get("fused_anchor")
+
+        def make_lev(env: Dict[int, Array]):
+            """ONE member evaluator for both the region body and the
+            epilogue closure — member-lowering semantics must never
+            diverge between the two (the MV111 byte-for-byte
+            invariant)."""
+
+            def lev(n: MatExpr) -> Array:
+                out = env.get(n.uid)
+                if out is not None:
+                    return out
+                if n.uid not in members:
+                    out = ev(n)          # region input: its own frame
+                else:
+                    out = self._eval(n, lev, leaf_arrays, leaf_pos)  # fused-region member — lowers under the single annotate frame opened by ev
+                env[n.uid] = out
+                return out
+
+            return lev
+
+        env: Dict[int, Array] = {}
+        lev = make_lev(env)
+        anchor = members.get(anchor_uid) if anchor_uid is not None \
+            else None
+        if anchor is None or anchor.uid == root.uid:
+            return lev(root)
+
+        def epilogue(x: Array) -> Array:
+            env2 = dict(env)
+            env2[anchor.uid] = x
+            return make_lev(env2)(root)
+
+        epi_ew = fusion_lib.epilogue_elementwise_chain(
+            root, members, anchor.uid)
+        # the anchor's lowering consumes the epilogue: its output IS
+        # the region root's value (operand prologues below the anchor
+        # lower through lev when the anchor evaluates its children)
+        return self._matmul(anchor, lev, epilogue=epilogue,
+                            epilogue_elementwise=epi_ew)
 
     def _solve(self, node: MatExpr, ev) -> Array:
         """X = A⁻¹·B as a dense solve on the LOGICAL shapes — LU by
@@ -473,7 +544,8 @@ class Lowerer:
         m._block_sparse_memo = (bs, self.mesh, S)
         return S
 
-    def _spgemm(self, node: MatExpr) -> Array:
+    def _spgemm(self, node: MatExpr, epilogue=None,
+                epilogue_elementwise: bool = False) -> Array:
         """S×S below the density crossover: tile-intersection SpGEMM —
         neither operand is densified (ops/spgemm.py); the product is
         scattered to the padded dense canonical layout every consumer
@@ -491,9 +563,20 @@ class Lowerer:
         if kid is None:
             kid, _, _ = spgemm_kernel_choice(node, self.config,
                                              self.mesh)
-        return spgemm_lib.apply_dense(SA, SB, self.config, kernel=kid)
+        return spgemm_lib.apply_dense(
+            SA, SB, self.config, kernel=kid, epilogue=epilogue,
+            epilogue_elementwise=epilogue_elementwise)
 
-    def _matmul(self, node: MatExpr, ev) -> Array:
+    def _matmul(self, node: MatExpr, ev, epilogue=None,
+                epilogue_elementwise: bool = False) -> Array:
+        """``epilogue`` is the fused-region slot (ir/fusion.py): a
+        callable applied to THIS matmul's canonical output inside the
+        same traced region — the staged consumer chain pushed into the
+        producing contraction. Dense strategies, SpMM and SpGEMM
+        consume it through their own epilogue slots; every other
+        dispatch applies it to the branch's finished output (``fin``),
+        so fused and staged lowerings are numerically identical."""
+        fin = (lambda out: out) if epilogue is None else epilogue
         l, r = node.children
         # S×S (block-sparse AND element-sparse leaves, any mix): the
         # tile-intersection SpGEMM when the ESTIMATED output block
@@ -502,7 +585,8 @@ class Lowerer:
         # predicate (_spgemm_dispatch) shared with the planner's
         # pricing/layout/decision readers so they can never drift.
         if _spgemm_dispatch(node, self.config):
-            return self._spgemm(node)
+            return self._spgemm(node, epilogue=epilogue,
+                                epilogue_elementwise=epilogue_elementwise)
         # coo_leaf matmuls: per-column one-hot SpMV for narrow dense
         # operands; wide ones (or refused plans) densify — at that point
         # the MXU over a dense block layout beats serialized matvecs.
@@ -514,11 +598,12 @@ class Lowerer:
             if plan is None:
                 blk = A.to_block(self.mesh, self.config).data
                 return strategies.run_matmul("xla", blk, ev(r), self.mesh,
-                                             self.config)
+                                             self.config,
+                                             epilogue=epilogue)
             dense = ev(r)
             out = self._coo_spmv_stack(
                 plan, [dense[: A.shape[1], j] for j in range(k)])
-            return self._pad_to_node(out, node)
+            return fin(self._pad_to_node(out, node))
         if r.kind == "coo_leaf":
             # A·S = (Sᵀ·Aᵀ)ᵀ — use the original matrix's cached
             # transpose plan (_get_plan_t), built at most once
@@ -527,15 +612,16 @@ class Lowerer:
             if plan is None:
                 blk = S.to_block(self.mesh, self.config).data
                 return strategies.run_matmul("xla", ev(l), blk, self.mesh,
-                                             self.config)
+                                             self.config,
+                                             epilogue=epilogue)
             a = ev(l)
             out = self._coo_spmv_stack(
                 plan, [a[i, : l.shape[1]] for i in range(k)]).T
-            return self._pad_to_node(out, node)
+            return fin(self._pad_to_node(out, node))
         if l.kind == "sparse_leaf":
             from matrel_tpu.ops import spmm as spmm_lib
             return spmm_lib.apply(l.attrs["matrix"], ev(r), r.shape,
-                                  self.config)
+                                  self.config, epilogue=epilogue)
         if r.kind == "sparse_leaf" and l.kind != "sparse_leaf":
             # A·S = (Sᵀ·Aᵀ)ᵀ — transpose the tile stack once, EAGERLY:
             # this code runs inside the executor's trace, and a traced
@@ -551,7 +637,7 @@ class Lowerer:
             at = ev(l).T
             out = spmm_lib.apply(st, at, (l.shape[1], l.shape[0]),
                                  self.config)
-            return out.T
+            return fin(out.T)
         gram = None
         if l.kind == "transpose" and self._same_operand(l.children[0], r):
             gram = ("AtA", r)
@@ -584,7 +670,7 @@ class Lowerer:
                 else:                    # A·Aᵀ
                     mm = lambda p, q: strategies.run_matmul(
                         strategy, p, q.T, self.mesh, self.config)
-                return symmetric_gram(x, mm).astype(jnp.float32)
+                return fin(symmetric_gram(x, mm).astype(jnp.float32))
         a, b = ev(node.children[0]), ev(node.children[1])
         strategy = node.attrs.get("strategy", "xla")
         if self.config.reshard_peak_budget_bytes > 0:
@@ -609,12 +695,20 @@ class Lowerer:
             from matrel_tpu.ops import precision as precision_lib
             mm = lambda p, q: strategies.run_matmul(
                 strategy, p, q, self.mesh, self.config)
-            return precision_lib.tiered_matmul(tier, a, b, mm)
-        out = strategies.run_matmul(strategy, a, b, self.mesh, self.config)
-        if (self.config.keep_input_dtype and a.dtype == b.dtype
-                and out.dtype != a.dtype):
-            out = out.astype(a.dtype)  # f32 accumulate, input-dtype storage
-        return out
+            return fin(precision_lib.tiered_matmul(tier, a, b, mm))
+
+        def storage_epi(out: Array) -> Array:
+            # the keep_input_dtype storage cast composes BEFORE the
+            # fused epilogue, so the epilogue chain sees exactly the
+            # value the staged consumer would (bit-identical numerics
+            # between fused and staged lowerings)
+            if (self.config.keep_input_dtype and a.dtype == b.dtype
+                    and out.dtype != a.dtype):
+                out = out.astype(a.dtype)
+            return fin(out)
+
+        return strategies.run_matmul(strategy, a, b, self.mesh,
+                                     self.config, epilogue=storage_epi)
 
     def _stage_root_relay(self, root: MatExpr, out: Array) -> Array:
         """Root output → canonical 2d through the compiled reshard
@@ -1164,6 +1258,33 @@ def _precision_meta(opts, cfg) -> Optional[Dict]:
             "est_rel_err_bound": bound[0]}
 
 
+def _fusion_meta(opts, cfg) -> Optional[Dict]:
+    """Plan-level fusion roll-up for ``plan.meta`` (obs query events,
+    ``history --summary``'s fusion line): region count, merged member
+    census, and the modelled dispatch/HBM savings of every stamped
+    boundary. None with fusion off — the default compile path pays
+    zero extra walks (the bit-identity contract, the _precision_meta
+    idiom)."""
+    if not cfg.fusion_enable:
+        return None
+    from matrel_tpu.ir import fusion as fusion_lib
+    regions = 0
+    census: Dict[str, int] = {}
+    saved_d = 0
+    saved_b = 0.0
+    for o in opts:
+        for node in fusion_lib.collect_stamps(o):
+            regions += 1
+            for k, v in (node.attrs.get("fused_census") or {}).items():
+                census[k] = census.get(k, 0) + v
+            saved_d += int(node.attrs.get("fused_saved_dispatches") or 0)
+            saved_b += float(node.attrs.get("fused_saved_hbm_bytes")
+                             or 0.0)
+    return {"regions": regions, "census": census,
+            "est_saved_dispatches": saved_d,
+            "est_saved_hbm_bytes": saved_b}
+
+
 def _verify_plans(opts, mesh, cfg) -> Optional[List[dict]]:
     """Run the static verifier (matrel_tpu/analysis/) over annotated
     roots when ``config.verify_plans`` asks for it — PRE-execution,
@@ -1211,6 +1332,14 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
                            counts=rule_hits),
             mesh, cfg)
             for e in exprs)
+        if cfg.fusion_enable:
+            # whole-plan fusion boundaries (ir/fusion.py): stamped
+            # after strategies/tiers so anchors carry their recipes,
+            # before the verifier so MV111 sees every region. Off (the
+            # default) this branch constructs nothing — bit-identity.
+            from matrel_tpu.ir import fusion as fusion_lib
+            opts = tuple(fusion_lib.annotate_fusion(o, mesh, cfg)
+                         for o in opts)
     with trace_lib.phase("plan.verify"):
         verify_diags = _verify_plans(opts, mesh, cfg)
     leaf_order = []
@@ -1234,6 +1363,9 @@ def compile_exprs(exprs, mesh: Optional[Mesh] = None,
     prec_meta = _precision_meta(opts, cfg)
     if prec_meta is not None:
         meta["precision"] = prec_meta
+    fus_meta = _fusion_meta(opts, cfg)
+    if fus_meta is not None:
+        meta["fusion"] = fus_meta
     return MultiPlan(jitted=jax.jit(fn), leaf_order=leaf_order,
                      optimized=opts, mesh=mesh, config=cfg,
                      extra_args=extra, meta=meta)
@@ -1453,6 +1585,11 @@ def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
                              grid=mesh_lib.mesh_grid_shape(mesh),
                              mesh=mesh, counts=rule_hits)
         opt = planner.annotate_strategies(opt, mesh, cfg)
+        if cfg.fusion_enable:
+            # fusion boundaries after strategies, before the verifier
+            # (the compile_exprs ordering — one contract)
+            from matrel_tpu.ir import fusion as fusion_lib
+            opt = fusion_lib.annotate_fusion(opt, mesh, cfg)
     with trace_lib.phase("plan.verify"):
         verify_diags = _verify_plans((opt,), mesh, cfg)
     leaf_order = expr_leaves(opt)
@@ -1471,6 +1608,9 @@ def compile_expr(expr: MatExpr, mesh: Optional[Mesh] = None,
     prec_meta = _precision_meta((opt,), cfg)
     if prec_meta is not None:
         meta["precision"] = prec_meta
+    fus_meta = _fusion_meta((opt,), cfg)
+    if fus_meta is not None:
+        meta["fusion"] = fus_meta
     return CompiledPlan(jitted=jitted, leaf_order=leaf_order, optimized=opt,
                         mesh=mesh, config=cfg, extra_args=extra, meta=meta)
 
@@ -1513,3 +1653,216 @@ def multiplan_root_decisions(plan: MultiPlan) -> List[List[dict]]:
 def execute(expr: MatExpr, mesh: Optional[Mesh] = None,
             config: Optional[MatrelConfig] = None) -> BlockMatrix:
     return compile_expr(expr, mesh, config).run()
+
+
+# ---------------------------------------------------------------------------
+# Unit-program emission — the region seam (ir/fusion.py; docs/FUSION.md)
+#
+# The default executor compiles the WHOLE plan into one program; these
+# builders are the measurable decomposition of that spectrum's other
+# end: ``compile_staged_units`` emits one jitted program PER PHYSICAL
+# OP (the per-op dispatch floor — a dispatch and an HBM round-trip per
+# plan edge), ``compile_region_units`` one program PER FUSED REGION
+# (XLA sees the whole segment). ``bench.py --fusion`` sweeps the two;
+# the autotune ``fuse|`` loop measures a single region's pair through
+# the same machinery. This module is the ONE sanctioned jit seam —
+# matlint ML010 keeps jitted-program emission here (and utils/).
+# ---------------------------------------------------------------------------
+
+#: Leaf kinds whose payloads stay INSIDE a unit as trace constants
+#: (their lowerings read static host metadata off the node attrs).
+_UNIT_CONST_LEAVES = ("sparse_leaf", "coo_leaf")
+
+
+def _unit_fn(low: Lowerer, root: MatExpr,
+             input_uids: Tuple[int, ...]):
+    """One jitted program computing ``root`` from its unit inputs
+    (everything not in ``input_uids`` — members of the unit's region,
+    sparse-payload leaves — lowers inside). Members lower through the
+    Lowerer's per-node paths, byte-for-byte the staged lowerings, so
+    fused and staged units agree exactly."""
+
+    def fn(*arrs):
+        env = dict(zip(input_uids, arrs))
+
+        def lev(n: MatExpr):
+            v = env.get(n.uid)
+            if v is not None:
+                return v
+            v = low._eval(n, lev, (), {})  # unit-program member — jitted as one region by the seam builders below
+            env[n.uid] = v
+            return v
+
+        return lev(root)
+
+    return jax.jit(fn)
+
+
+@dataclasses.dataclass
+class UnitPrograms:
+    """An expression compiled as a SEQUENCE of jitted unit programs —
+    ``dispatches`` programs per run (the quantity fusion shrinks).
+    ``run()`` executes the units in topo order over raw padded arrays
+    and returns the root unit's output."""
+
+    #: (node, jitted fn, input uids, member count) in execution order.
+    units: List
+    optimized: MatExpr
+    leaf_order: List[MatExpr]
+    mesh: Mesh
+    config: MatrelConfig
+
+    @property
+    def dispatches(self) -> int:
+        return len(self.units)
+
+    def run(self, bindings: Optional[Dict[int, Array]] = None):
+        env = {l.uid: l.attrs["matrix"].data for l in self.leaf_order}
+        if bindings:
+            env.update(bindings)
+        for node, fn, input_uids, _n in self.units:
+            env[node.uid] = fn(*(env[u] for u in input_uids))
+        return env[self.optimized.uid]
+
+
+def _build_units(opt: MatExpr, mesh: Mesh, cfg: MatrelConfig,
+                 per_region: bool) -> UnitPrograms:
+    from matrel_tpu.ir import fusion as fusion_lib
+    low = Lowerer(mesh, cfg)
+    units: List = []
+    leaf_order: List[MatExpr] = []
+    seen: set = set()
+    member_of: Dict[int, int] = {}     # member uid -> region root uid
+    if per_region:
+        for stamp in fusion_lib.collect_stamps(opt):
+            for u in stamp.attrs.get("fused_members") or ():
+                member_of[u] = stamp.uid
+
+    def walk(n: MatExpr):
+        if n.uid in seen:
+            return
+        seen.add(n.uid)
+        for c in n.children:
+            walk(c)
+        if n.kind == "leaf":
+            leaf_order.append(n)
+            return
+        if n.kind in _UNIT_CONST_LEAVES:
+            return                      # consts inside the consumer unit
+        if n.uid in member_of:
+            return                      # lowers inside its region unit
+        if per_region and "fused_region" in n.attrs:
+            members = fusion_lib.region_nodes(n)
+            inputs = []
+            in_seen = set()
+            for m in members.values():
+                for c in m.children:
+                    if (c.uid not in members
+                            and c.kind not in _UNIT_CONST_LEAVES
+                            and c.uid not in in_seen):
+                        in_seen.add(c.uid)
+                        inputs.append(c.uid)
+            units.append((n, _unit_fn(low, n, tuple(inputs)),
+                          tuple(inputs), len(members)))
+            return
+        inputs = tuple(c.uid for c in n.children
+                       if c.kind not in _UNIT_CONST_LEAVES)
+        units.append((n, _unit_fn(low, n, inputs), inputs, 1))
+
+    walk(opt)
+    if not units:                       # a bare leaf plan: identity unit
+        units.append((opt, jax.jit(lambda x: x), (opt.uid,), 1))
+    return UnitPrograms(units=units, optimized=opt,
+                        leaf_order=leaf_order, mesh=mesh, config=cfg)
+
+
+def compile_staged_units(expr: MatExpr, mesh: Optional[Mesh] = None,
+                         config: Optional[MatrelConfig] = None
+                         ) -> UnitPrograms:
+    """One jitted program PER PHYSICAL OP — the staged dispatch floor
+    the fused form is measured against (fusion stamps, if any, are
+    ignored: every plan edge pays its dispatch and HBM round-trip)."""
+    cfg = config or default_config()
+    lvs = expr_leaves(expr)
+    if mesh is None:
+        mesh = lvs[0].attrs["matrix"].mesh if lvs else mesh_lib.make_mesh(
+            cfg.mesh_shape, cfg.mesh_axis_names)
+    opt = planner.annotate_strategies(
+        rules.optimize(expr, cfg, grid=mesh_lib.mesh_grid_shape(mesh),
+                       mesh=mesh), mesh, cfg)
+    return _build_units(opt, mesh, cfg, per_region=False)
+
+
+def compile_region_units(expr: MatExpr, mesh: Optional[Mesh] = None,
+                         config: Optional[MatrelConfig] = None
+                         ) -> UnitPrograms:
+    """One jitted program PER FUSED REGION (non-region nodes keep one
+    each) — requires ``config.fusion_enable``; the region grammar is
+    ``ir/fusion.annotate_fusion``'s, so the emitted boundaries are
+    exactly the ones MV111 verifies and the bench sweeps."""
+    cfg = config or default_config()
+    lvs = expr_leaves(expr)
+    if mesh is None:
+        mesh = lvs[0].attrs["matrix"].mesh if lvs else mesh_lib.make_mesh(
+            cfg.mesh_shape, cfg.mesh_axis_names)
+    opt = planner.annotate_strategies(
+        rules.optimize(expr, cfg, grid=mesh_lib.mesh_grid_shape(mesh),
+                       mesh=mesh), mesh, cfg)
+    if cfg.fusion_enable:
+        from matrel_tpu.ir import fusion as fusion_lib
+        opt = fusion_lib.annotate_fusion(opt, mesh, cfg)
+    return _build_units(opt, mesh, cfg, per_region=True)
+
+
+def region_probe_programs(root_node: MatExpr, member_uids,
+                          mesh: Mesh, cfg: MatrelConfig):
+    """(fused_fn, staged_units, input_uids, probe_arrays, root_uid)
+    for ONE region — the autotune ``fuse|`` measurement harness
+    (lookup_or_measure_fusion). Region inputs are replaced by
+    synthetic padded f32 probes; regions whose members read
+    sparse-leaf payloads return None (the probe cannot substitute
+    static tile metadata — the model decides there)."""
+    import numpy as _np
+    members = {root_node.uid: root_node}
+    want = set(member_uids)
+    stack = [root_node]
+    while stack:
+        n = stack.pop()
+        for c in n.children:
+            if c.uid in want and c.uid not in members:
+                members[c.uid] = c
+                stack.append(c)
+    inputs: List[MatExpr] = []
+    in_seen: set = set()
+    for m in members.values():
+        for c in m.children:
+            if c.uid in members or c.uid in in_seen:
+                continue
+            if c.kind in _UNIT_CONST_LEAVES:
+                return None
+            in_seen.add(c.uid)
+            inputs.append(c)
+    low = Lowerer(mesh, cfg)
+    input_uids = tuple(c.uid for c in inputs)
+    fused = _unit_fn(low, root_node, input_uids)
+    staged: List = []
+    order: List[MatExpr] = []
+    seen: set = set()
+
+    def topo(n: MatExpr):
+        if n.uid in seen or n.uid not in members:
+            return
+        seen.add(n.uid)
+        for c in n.children:
+            topo(c)
+        order.append(n)
+
+    topo(root_node)
+    for n in order:
+        ins = tuple(c.uid for c in n.children)
+        staged.append((n, _unit_fn(low, n, ins), ins))
+    rng = _np.random.default_rng(0)
+    arrays = {c.uid: jnp.asarray(rng.standard_normal(
+        padding.padded_shape(c.shape, mesh)).astype(_np.float32))
+        for c in inputs}
+    return fused, staged, input_uids, arrays, root_node.uid

@@ -75,15 +75,34 @@ def _fmt_bytes(b) -> str:
     return f"{b:.1f}GiB"
 
 
+def _fusion_stamps(plan) -> Dict[int, dict]:
+    """uid -> stamp attrs for every fused-region root in the plan's
+    optimized tree(s) (ir/fusion.py) — empty with fusion off."""
+    from matrel_tpu.ir import fusion as fusion_lib
+    roots = (plan.optimized if isinstance(plan.optimized, tuple)
+             else (plan.optimized,))
+    out: Dict[int, dict] = {}
+    for r in roots:
+        for node in fusion_lib.collect_stamps(r):
+            out[node.uid] = node.attrs
+    return out
+
+
 def render(plan, per_op: Dict[int, Tuple[str, float]],
            fused_s: float) -> str:
     """Physical tree annotated with measured per-op milliseconds and,
     per matmul, the planner's choice + its estimated ICI bytes/FLOPs —
-    measured-vs-estimated on one screen."""
+    measured-vs-estimated on one screen. Fused regions (ir/fusion.py)
+    report their EXCLUSIVE ms on the region-root row with absorbed
+    members marked "(in fused region)" — never zero-ms ghost rows that
+    would skew the drift auditor's per-op samples."""
     from matrel_tpu import executor as executor_lib
     decisions = {d["uid"]: d
                  for d in executor_lib.plan_matmul_decisions(plan)
                  if "uid" in d}
+    stamps = _fusion_stamps(plan)
+    member_uids = {u for a in stamps.values()
+                   for u in (a.get("fused_members") or ())}
     lines = ["== Analyzed physical plan (per-op measured, eager) =="]
     printed = set()
 
@@ -109,7 +128,13 @@ def render(plan, per_op: Dict[int, Tuple[str, float]],
                          f"(shared — timed above)")
             return
         printed.add(n.uid)
+        if n.uid in stamps:
+            a = stamps[n.uid]
+            extra += (f" fused={a.get('fused_region')} "
+                      f"members={len(a.get('fused_members') or ()) + 1}")
         ms = f" [{timed[1] * 1e3:.3f} ms]" if timed else ""
+        if not timed and n.uid in member_uids:
+            ms = " (in fused region — ms attributed to region root)"
         line = f"{pad}{n.kind}{extra} shape={n.shape}{ms}"
         d = decisions.get(n.uid)
         if d is not None:
@@ -150,13 +175,26 @@ def analyze_record(plan, per_op: Dict[int, Tuple[str, float]],
     joined (by uid) to the plan's decision records — the cost-model
     drift auditor's highest-fidelity sample source (obs/drift.py reads
     these back to calibrate estimated bytes/FLOPs against measured
-    per-op milliseconds, per strategy / shape class / backend)."""
+    per-op milliseconds, per strategy / shape class / backend).
+
+    Fused-region rows carry ``fused_region`` + ``members`` so the
+    auditor joins an absorbed anchor's decision to the region's
+    measured ms BY MEMBERSHIP and keys the sample ``fused:<sig>`` —
+    absorbed ops contribute no zero-ms ghost samples."""
     from matrel_tpu import executor as executor_lib
+    stamps = _fusion_stamps(plan)
+    rows = []
+    for uid, (label, seconds) in sorted(per_op.items()):
+        row = {"uid": uid, "label": label,
+               "ms": round(seconds * 1e3, 4)}
+        a = stamps.get(uid)
+        if a is not None:
+            row["fused_region"] = a.get("fused_region")
+            row["members"] = sorted(a.get("fused_members") or ())
+        rows.append(row)
     return {
         "backend": jax.default_backend(),
         "fused_ms": round(fused_s * 1e3, 3),
-        "per_op": [{"uid": uid, "label": label,
-                    "ms": round(seconds * 1e3, 4)}
-                   for uid, (label, seconds) in sorted(per_op.items())],
+        "per_op": rows,
         "matmuls": executor_lib.plan_matmul_decisions(plan),
     }

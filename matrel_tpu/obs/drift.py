@@ -84,12 +84,20 @@ def _strategy_key(d: dict) -> str:
     FLOPs/bytes at deliberately different rates, so one blended
     ``dispatch:spgemm`` row would mask exactly the per-kernel drift
     the registry's cost model needs audited; un-stamped spgemm
-    records (pre-registry logs) keep the historical key."""
-    disp = d.get("dispatch")
-    if disp == "spgemm" and d.get("kernel_id"):
+    records (pre-registry logs) keep the historical key.
+
+    A fused-region anchor calibrates under ``fused:<region_sig>`` (the
+    ``spgemm:<kernel_id>`` precedent): the region's measured ms covers
+    the anchor PLUS its absorbed members, so blending it into the bare
+    strategy row would drift every per-strategy flag by the epilogue's
+    cost — and a miscalibrated fused estimate must be visible as a
+    fused row, not as a poisoned strategy row."""
+    if d.get("fused_region"):
+        key = f"fused:{d['fused_region']}"
+    elif d.get("dispatch") == "spgemm" and d.get("kernel_id"):
         key = f"spgemm:{d['kernel_id']}"
-    elif disp:
-        key = f"dispatch:{disp}"
+    elif d.get("dispatch"):
+        key = f"dispatch:{d['dispatch']}"
     else:
         key = d.get("strategy", "?")
     tier = d.get("precision_tier")
@@ -127,8 +135,19 @@ def iter_samples(events: List[dict]):
         if kind == "analyze":
             per_op = {p.get("uid"): p for p in (e.get("per_op") or ())
                       if isinstance(p, dict)}
+            # fused regions report ONE row at the region root with the
+            # member uids listed (the ghost-row fix): an anchor matmul
+            # absorbed into a region joins its decision to the region
+            # row by MEMBERSHIP, so the fused:<sig> calibration row
+            # gets the region's measured ms
+            member_row = {}
+            for p in per_op.values():
+                for u in p.get("members") or ():
+                    member_row[u] = p
             for d in e.get("matmuls") or ():
                 op = per_op.get(d.get("uid"))
+                if op is None and d.get("fused_region"):
+                    op = member_row.get(d.get("uid"))
                 if op is None or not isinstance(op.get("ms"),
                                                 (int, float)):
                     continue

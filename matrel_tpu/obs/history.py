@@ -54,9 +54,27 @@ def summarize(events: List[dict]) -> dict:
     rule_hits: Dict[str, int] = {}
     tiers: Dict[str, dict] = {}
     spk: Dict[str, dict] = {}
+    # whole-plan fusion roll-up (round 12): region counts, member-op
+    # census and the modelled dispatch/HBM savings from each query
+    # record's plan-level ``fusion`` field (executor._fusion_meta) —
+    # the event-log view of what the fusion pass is actually buying
+    fusion: dict = {"queries": 0, "regions": 0, "census": {},
+                    "est_saved_dispatches": 0,
+                    "est_saved_hbm_bytes": 0.0}
     reshards: dict = {"matmuls": 0, "steps": {}, "bytes_x": 0.0,
                       "bytes_y": 0.0, "peak_bytes": 0.0}
     for e in qs:
+        fus = e.get("fusion")
+        if isinstance(fus, dict) and fus.get("regions"):
+            fusion["queries"] += 1
+            fusion["regions"] += int(fus.get("regions") or 0)
+            for k, v in (fus.get("census") or {}).items():
+                fusion["census"][k] = fusion["census"].get(k, 0) \
+                    + int(v)
+            fusion["est_saved_dispatches"] += int(
+                fus.get("est_saved_dispatches") or 0)
+            fusion["est_saved_hbm_bytes"] += float(
+                fus.get("est_saved_hbm_bytes") or 0.0)
         for d in e.get("matmuls", []):
             # staged-reshard roll-up (round 10): step kinds, per-axis
             # bytes and the worst per-device peak across every staged
@@ -152,6 +170,7 @@ def summarize(events: List[dict]) -> dict:
         "strategies": strategies,
         "precision_tiers": tiers,
         "spgemm_kernels": spk,
+        "fusion": fusion if fusion["queries"] else None,
         "reshards": reshards if reshards["matmuls"] else None,
         "rule_hits": rule_hits,
         "bench_runs": sum(1 for e in events if e.get("kind") == "bench"),
@@ -379,6 +398,16 @@ def render_summary(events: List[dict]) -> str:
         lines.append("precision tiers: " + ", ".join(
             f"{t}={d['count']} ({d['passes']} passes)"
             for t, d in sorted(s["precision_tiers"].items())))
+    fus = s.get("fusion")
+    if fus:
+        lines.append(
+            f"fusion: {fus['regions']} region(s) over "
+            f"{fus['queries']} query(ies) ["
+            + ", ".join(f"{k}={v}"
+                        for k, v in sorted(fus["census"].items()))
+            + f"], est saved {fus['est_saved_dispatches']} "
+              f"dispatch(es) / "
+              f"{fus['est_saved_hbm_bytes'] / 2**20:.2f} MiB HBM")
     if s.get("spgemm_kernels"):
         lines.append("")
         lines.append("spgemm kernels: " + ", ".join(

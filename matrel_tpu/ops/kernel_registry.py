@@ -189,6 +189,57 @@ def select_kernel(structure: str, bs: int, npairs: int,
     return legacy_default(bs, npairs, cfg), "default"
 
 
+# -- fused epilogue hooks (whole-plan fusion, docs/FUSION.md) ---------------
+# The ``apply_dense``-style epilogue seam: when a fused region absorbs a
+# consumer chain into its producer SpGEMM (ir/fusion.py), the chain
+# reaches the kernel HERE — per structure class, WITHOUT forking kernel
+# bodies. Each hook names how the epilogue is applied to the kernel's
+# output:
+#
+#   "tilewise"  the epilogue runs over the [n_out, bs, bs] OUTPUT TILE
+#               STACK before the dense scatter — nnzb·bs² elements
+#               instead of n·m. Only legal for zero-preserving,
+#               shape-polymorphic chains (scalar mul / pow>0 — the
+#               executor's epilogue_elementwise flag proves it); the
+#               untouched tiles stay exact zeros so the scatter's
+#               padded region is still exact.
+#   "dense"     the epilogue runs over the scattered padded dense
+#               output (always legal; the conservative default).
+#
+# Registering a specialized mode for a new structure class is one
+# ``register_epilogue_hook`` call — the ML009 "one seam" discipline
+# extended to epilogues (MV111 verifies the stamps that route here).
+
+EPILOGUE_MODES = ("tilewise", "dense")
+
+_EPILOGUE_HOOKS: Dict[str, str] = {}
+
+
+def register_epilogue_hook(structure: str, mode: str) -> None:
+    if mode not in EPILOGUE_MODES:
+        raise ValueError(
+            f"epilogue mode must be one of {EPILOGUE_MODES}, "
+            f"got {mode!r}")
+    _EPILOGUE_HOOKS[structure] = mode
+
+
+def epilogue_mode(structure: str, elementwise_ok: bool) -> str:
+    """The application mode for one fused SpGEMM epilogue: the
+    structure class's registered hook, demoted to "dense" whenever the
+    chain is not provably zero-preserving shape-polymorphic
+    (``elementwise_ok`` False) — correctness never rides the
+    registration."""
+    if not elementwise_ok:
+        return "dense"
+    return _EPILOGUE_HOOKS.get(structure, "dense")
+
+
+def apply_tile_epilogue(tiles, epilogue):
+    """Run a zero-preserving pointwise epilogue over the output tile
+    stack (the "tilewise" hook body — one place, every kernel)."""
+    return epilogue(tiles)
+
+
 # -- structure classification (memoised per operand) ------------------------
 
 
@@ -303,7 +354,7 @@ def _build_pallas_generic(bs, npairs, n_out, out_dtype, interpret):
         interpret=interpret,
     )
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 registry runner — the sanctioned kernel seam's own dispatch program
     def run(a_blocks, b_blocks, slots, pa, pb):
         return kernel(slots, pa, pb, a_blocks.astype(out_dtype),
                       b_blocks.astype(out_dtype))
@@ -315,7 +366,7 @@ def _build_xla_gather(n_out, out_dtype, cfg):
     prec = getattr(jax.lax.Precision, cfg.matmul_precision.upper(),
                    jax.lax.Precision.HIGHEST)
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 registry runner — the sanctioned kernel seam's own dispatch program
     def run(a_blocks, b_blocks, slots, pa, pb):
         common = jnp.promote_types(a_blocks.dtype, b_blocks.dtype)
         ga = jnp.take(a_blocks.astype(common), pa, axis=0)
@@ -479,7 +530,7 @@ def _build_grouped(A, B, bs, pairs, n_out, out_dtype, interpret, G):
     kernel = _grouped_call(bs, G, group_slot.size, n_out, out_dtype,
                            interpret)
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 registry runner — the sanctioned kernel seam's own dispatch program
     def _run(gs, a, b):
         return kernel(gs, a, b)
 
@@ -627,7 +678,7 @@ def _build_band(A, B, bs, pairs, n_out, out_dtype, interpret, wmax,
         interpret=interpret,
     )
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 registry runner — the sanctioned kernel seam's own dispatch program
     def _run(a, b, sel_):
         rowout = kernel(a, b)
         flat = rowout.reshape(gr * nchunks, bs, rc, bs) \
@@ -682,7 +733,7 @@ def _build_bucketed(A, B, bs, pairs, n_out, out_dtype, interpret,
 
     kernels = [b[0] for b in buckets]
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 registry runner — the sanctioned kernel seam's own dispatch program
     def _run(*flat):
         # baked arrays arrive as ARGUMENTS, never closed-over: a
         # zero-arg jit would trace the multi-GB payload stacks as
@@ -830,3 +881,13 @@ register_kernel(KernelSpec(
     needs_pallas=True, group=8, bucket_split=4,
     description="output rows bucketed by pair count: light rows pad "
                 "to a small group, hub rows run the wide one"))
+
+# fused-epilogue hooks per structure class: the home classes of the
+# specialized kernels apply zero-preserving epilogues TILE-WISE (their
+# output stacks are far smaller than the dense grid — band: O(gr·bw)
+# tiles, powerlaw: hub-dominated); "generic" keeps the conservative
+# dense application, bit-matching the legacy post-scatter order.
+register_epilogue_hook("row_band", "tilewise")
+register_epilogue_hook("clustered_tile", "tilewise")
+register_epilogue_hook("powerlaw_coo", "tilewise")
+register_epilogue_hook("generic", "dense")

@@ -175,7 +175,7 @@ def make_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg: MatrelConfig,
         _, payload_prepared, rows_d, cols_d = memo
     mesh = S.mesh
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 pre-seam ops runner cache — the porting worklist (the ML009 legacy-kernel idiom)
     def _run(payload, rows, cols, dd):
         dd = jax.lax.with_sharding_constraint(dd, NamedSharding(mesh, d_spec))
         want_rows = gc * bs

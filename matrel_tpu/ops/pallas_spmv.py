@@ -146,7 +146,7 @@ def compact_apply(plan_static, tables, ov, x: jax.Array,
     return y
 
 
-_compact_jitted = jax.jit(compact_apply, static_argnums=(0, 4, 5))
+_compact_jitted = jax.jit(compact_apply, static_argnums=(0, 4, 5))  # matlint: disable=ML010 pre-seam ops runner cache — the porting worklist (the ML009 legacy-kernel idiom)
 
 
 def compact_apply_chunked(plan_static, tables, ov, x: jax.Array,
@@ -302,7 +302,7 @@ def _compact_sharded_runner(plan_static, mesh, passes: int, n_ov: int,
                                      (src8, lane, off, val), ov, x,
                                      axes, passes, interpret)
 
-    return jax.jit(shard_map(kernel, mesh=mesh,
+    return jax.jit(shard_map(kernel, mesh=mesh,  # matlint: disable=ML010 pre-seam ops runner cache — the porting worklist (the ML009 legacy-kernel idiom)
                              in_specs=compact_sharded_specs(axes, n_ov),
                              out_specs=P(), check_vma=False))
 
@@ -419,7 +419,7 @@ def compact_matmat_apply(plan_static, tables, ov, X: jax.Array,
     return Y
 
 
-_compact_matmat_jitted = jax.jit(compact_matmat_apply,
+_compact_matmat_jitted = jax.jit(compact_matmat_apply,  # matlint: disable=ML010 pre-seam ops runner cache — the porting worklist (the ML009 legacy-kernel idiom)
                                  static_argnums=(0, 4, 5))
 
 

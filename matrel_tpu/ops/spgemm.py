@@ -314,16 +314,34 @@ def spgemm(A: BlockSparseMatrix, B: BlockSparseMatrix,
 
 def apply_dense(A: BlockSparseMatrix, B: BlockSparseMatrix,
                 config: Optional[MatrelConfig] = None,
-                interpret=None, kernel: Optional[str] = None
+                interpret=None, kernel: Optional[str] = None,
+                epilogue=None, epilogue_elementwise: bool = False
                 ) -> jax.Array:
     """Trace-compatible SpGEMM for the executor: the product scattered
     into a PADDED dense array with canonical sharding (what every other
     lowering hands its consumer). The scatter is the only dense
-    materialisation — it is the op's OUTPUT, not an operand."""
+    materialisation — it is the op's OUTPUT, not an operand.
+
+    ``epilogue`` is the fused-region slot (ir/fusion.py /
+    docs/FUSION.md): the absorbed consumer chain reaches the kernel
+    seam through the registry's per-structure epilogue hook
+    (``kernel_registry.epilogue_mode``) — zero-preserving pointwise
+    chains (``epilogue_elementwise`` True, the executor's proof) may
+    run TILE-WISE over the output stack before the scatter on
+    structure classes registered "tilewise"; everything else applies
+    to the scattered dense output. No kernel body is forked either
+    way."""
+    from matrel_tpu.ops import kernel_registry as kr
     cfg = config or default_config()
     tiles, out_rows, out_cols = spgemm_tiles(A, B, cfg,
                                              interpret=interpret,
                                              kernel=kernel)
+    if epilogue is not None:
+        mode = kr.epilogue_mode(kr.pair_class_of(A, B),
+                                epilogue_elementwise)
+        if mode == "tilewise":
+            tiles = kr.apply_tile_epilogue(tiles, epilogue)
+            epilogue = None          # consumed before the scatter
     n, m = A.shape[0], B.shape[1]
     bs = A.block_size
     gr = math.ceil(n / bs)
@@ -344,6 +362,8 @@ def apply_dense(A: BlockSparseMatrix, B: BlockSparseMatrix,
     # operands' edge tiles (products of clean operands are clean), so
     # no re-mask is needed — and the padded region BEYOND the tile
     # grid is zeros from jnp.pad already.
+    if epilogue is not None:         # the conservative "dense" hook
+        dense = epilogue(dense)
     return jax.lax.with_sharding_constraint(dense, sharding)
 
 
@@ -413,7 +433,7 @@ def spgemm_sharded(A: BlockSparseMatrix, B: BlockSparseMatrix,
                    in_specs=(P(), P(), P(axes), P(axes), P(axes)),
                    out_specs=P(), check_vma=False)
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 pre-seam ops runner cache — the porting worklist (the ML009 legacy-kernel idiom)
     def run(ab, bb, pa_l, pb_l, slot_l):
         ab = jnp.concatenate(
             [ab.astype(common), jnp.zeros((1, bs, bs), common)])

@@ -97,10 +97,18 @@ def _dense_spec(pm: int, mesh) -> P:
 def apply(S: BlockSparseMatrix, dd: jax.Array,
           d_shape: Tuple[int, int],
           config: Optional[MatrelConfig] = None,
-          interpret=None) -> jax.Array:
+          interpret=None, epilogue=None) -> jax.Array:
     """Trace-compatible SpMM: S (static metadata) × dense padded array
     ``dd`` of logical shape ``d_shape``. Returns the padded product with
-    canonical output sharding."""
+    canonical output sharding.
+
+    ``epilogue`` is the fused-region slot (ir/fusion.py /
+    docs/FUSION.md): a traceable callable applied to the padded product
+    inside the SAME traced computation, so an absorbed consumer chain
+    compiles as the SpMM's epilogue instead of its own dispatch. The
+    runner itself is epilogue-agnostic (one cached kernel per matrix,
+    never forked per epilogue); None keeps the historical path
+    bit-identically."""
     cfg = config or default_config()
     n, k = S.shape
     k2, m = d_shape
@@ -115,7 +123,8 @@ def apply(S: BlockSparseMatrix, dd: jax.Array,
     d_spec = _dense_spec(pm, mesh)
     run = _cached_runner(S, pm, out_pshape, d_spec, out_sharding, cfg,
                          interpret, explicit_interpret)
-    return run(S.blocks, S.block_rows, S.block_cols, dd)
+    out = run(S.blocks, S.block_rows, S.block_cols, dd)
+    return out if epilogue is None else epilogue(out)
 
 
 def spmm(S: BlockSparseMatrix, D: BlockMatrix,
@@ -139,7 +148,7 @@ def _xla_spmm(S, pm, out_pshape, d_spec, out_sharding, cfg):
     prec = getattr(jax.lax.Precision, cfg.matmul_precision.upper(),
                    jax.lax.Precision.HIGHEST)
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 pre-seam ops runner cache — the porting worklist (the ML009 legacy-kernel idiom)
     def run(blocks, brows, bcols, dd):
         dd = jax.lax.with_sharding_constraint(dd, NamedSharding(mesh, d_spec))
         want_rows = gc * bs

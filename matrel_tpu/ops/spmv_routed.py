@@ -371,7 +371,7 @@ def routed_apply(plan_static, arrays, x: jax.Array, passes: int = 2,
     return y
 
 
-_routed_jitted = jax.jit(routed_apply, static_argnums=(0, 3, 4))
+_routed_jitted = jax.jit(routed_apply, static_argnums=(0, 3, 4))  # matlint: disable=ML010 pre-seam ops runner cache — the porting worklist (the ML009 legacy-kernel idiom)
 
 
 def routed_spmv(plan: RoutedSpMVPlan, x: jax.Array, passes: int = 2,

@@ -124,6 +124,8 @@ def _current_key_format(key: str) -> bool:
         base = 6
         if n >= 3 and fields[2] not in stats.STRUCTURE_CLASSES:
             return False
+    elif key.startswith("fuse|"):
+        base = 5         # fuse|<sig>|<=side|grid|backend (round 12)
     else:
         base = 4
     if n == base:
@@ -235,9 +237,9 @@ def measure_strategy(strategy: str, A: BlockMatrix, B: BlockMatrix,
     NON-POSITIVE value on a hopelessly noisy host — callers must treat
     that as "no measurement", never clamp it into a fake winner."""
     mesh = A.mesh
-    f = jax.jit(lambda x, y: strategies.run_matmul(strategy, x, y, mesh,
+    f = jax.jit(lambda x, y: strategies.run_matmul(strategy, x, y, mesh,  # matlint: disable=ML010 measurement probe — the autotune loop times candidates outside the plan path
                                                    config))
-    fetch = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+    fetch = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))  # matlint: disable=ML010 measurement probe — the autotune loop times candidates outside the plan path
 
     def chained(n: int):
         cur = A.data
@@ -464,7 +466,7 @@ def measure_spmv_variant(variant: str, plan, mesh,
     # real use (one fused program).
     saved = (plan._tables, plan._spmm_tables)
     try:
-        f = jax.jit(lambda v: jnp.sum(low._coo_spmv_stack(plan, [v])))
+        f = jax.jit(lambda v: jnp.sum(low._coo_spmv_stack(plan, [v])))  # matlint: disable=ML010 measurement probe — the autotune loop times candidates outside the plan path
         float(f(x))    # compile + warm (also table upload/expansion)
         ts = []
         for _ in range(max(n_times, 1)):
@@ -577,7 +579,7 @@ def measure_spgemm_kernel(kernel_id: str, A, B,
     pays the identical fetch, so the ranking is unaffected."""
     from matrel_tpu.ops import spgemm as spgemm_lib
     cfg = config or default_config()
-    fetch = jax.jit(lambda t: jnp.sum(t.astype(jnp.float32)))
+    fetch = jax.jit(lambda t: jnp.sum(t.astype(jnp.float32)))  # matlint: disable=ML010 measurement probe — the autotune loop times candidates outside the plan path
 
     def go():
         tiles, _, _ = spgemm_lib.spgemm_tiles(A, B, cfg,
@@ -654,6 +656,130 @@ def lookup_or_measure_spgemm(side: int, structure: str, bs: int, mesh,
 
 
 # ---------------------------------------------------------------------------
+# Fused-vs-staged region measurement (round 12) — the closed loop for
+# the whole-plan fusion pass (ir/fusion.py; docs/FUSION.md): per
+# (region signature, shape class, backend), the region is emitted BOTH
+# ways through the executor's unit-program seam — one jitted program
+# for the whole segment vs one per member op — over synthetic padded
+# probes, and the winner persists under the ``fuse|`` key family.
+# ``fusion.annotate_fusion`` consults this before stamping: a measured
+# "staged" winner SUPPRESSES the region (fusion boundaries are planner
+# decisions, and the closed measurement loop overrules the model).
+# ---------------------------------------------------------------------------
+
+_FUSION_CACHE: Dict[str, Optional[str]] = {}
+
+FUSION_VARIANTS = ("fused", "staged")
+
+
+def _fusion_key(sig: str, side: int, gx: int, gy: int,
+                weights: Tuple[float, float] = (1.0, 1.0)) -> str:
+    """``fuse|<sig>|<=side|grid|backend[|w..]`` — the region signature
+    is '|'-free by construction (ir/fusion.region_sig); side bucketed
+    to the drift auditor's power-of-two class like every other row."""
+    cls = 1 << max(0, math.ceil(math.log2(max(int(side), 1))))
+    key = (f"fuse|{sig}|<={cls}|{gx}x{gy}"
+           f"|{jax.default_backend()}")
+    if weights != (1.0, 1.0):
+        key += f"|w{weights[0]:g}x{weights[1]:g}"
+    return key
+
+
+def measure_fusion_region(region, root_tree, mesh,
+                          config: Optional[MatrelConfig] = None,
+                          n_times: int = 5) -> Dict[str, float]:
+    """{'fused': s, 'staged': s} medians for ONE region, both lowered
+    through the executor's unit-program seam over synthetic padded
+    probes (region_probe_programs). Empty dict when the region is not
+    probeable (sparse-payload inputs) or a variant fails to build."""
+    from matrel_tpu import executor as executor_lib
+    cfg = config or default_config()
+    node = _find_region_root(root_tree, region.root_uid)
+    if node is None:
+        return {}
+    probe = executor_lib.region_probe_programs(
+        node, region.member_uids, mesh, cfg)
+    if probe is None:
+        return {}
+    fused, staged, input_uids, arrays, root_uid = probe
+
+    def run_fused():
+        jax.block_until_ready(fused(*(arrays[u] for u in input_uids)))
+
+    def run_staged():
+        env = dict(arrays)
+        for n, fn, ins in staged:
+            env[n.uid] = fn(*(env[u] for u in ins))
+        jax.block_until_ready(env[root_uid])
+
+    results: Dict[str, float] = {}
+    for name, go in (("fused", run_fused), ("staged", run_staged)):
+        try:
+            go()                      # compile + warm every unit
+            ts = []
+            for _ in range(max(n_times, 1)):
+                t0 = time.perf_counter()
+                go()
+                ts.append(time.perf_counter() - t0)
+            ts.sort()
+            t = ts[len(ts) // 2]
+        except Exception:  # noqa: BLE001  # matlint: disable=ML007 measurement loop — a region variant failing to build/compile drops out of the table
+            continue
+        if t > 0.0:
+            results[name] = t
+    return results
+
+
+def _find_region_root(root_tree, uid: int):
+    from matrel_tpu.ir import fusion as fusion_lib
+    return fusion_lib._find_uid(root_tree, uid)
+
+
+def lookup_or_measure_fusion(region, root_tree, mesh,
+                             config: Optional[MatrelConfig] = None
+                             ) -> Optional[str]:
+    """The fusion pass's boundary consult (config.autotune on):
+    "fused" / "staged" / None (no measured preference — the region
+    stamps by default, the model's pick). Same table discipline as the
+    matmul/SpMV/SpGEMM/reshard loops: in-process cache → persisted
+    table → measure once (bounded probe side); ties and one-variant
+    result sets resolve to None and are never fake winners."""
+    cfg = config or default_config()
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    node = _find_region_root(root_tree, region.root_uid)
+    side = max([1] + [d for u in (region.member_uids
+                                  + (region.root_uid,))
+                      for d in _member_dims(root_tree, u)])
+    key = _fusion_key(region.sig, side, gx, gy,
+                      mesh_lib.axis_weights(mesh, cfg))
+    if key in _FUSION_CACHE:
+        return _FUSION_CACHE[key]
+    entry = _load_table_cached(_table_path(cfg)).get(key)
+    if isinstance(entry, dict) and entry.get("times"):
+        best = entry.get("best")
+        best = best if isinstance(best, str) else None
+        _FUSION_CACHE[key] = best
+        return best
+    if node is None or side > cfg.autotune_max_dim:
+        _FUSION_CACHE[key] = None
+        return None
+    results = measure_fusion_region(region, root_tree, mesh, cfg)
+    if len(results) < 2:
+        _FUSION_CACHE[key] = None
+        return None
+    best = _pick_winner(results)
+    _FUSION_CACHE[key] = best
+    if cfg.autotune or cfg.autotune_table_path:
+        _persist(_table_path(cfg), key, best, results)
+    return best
+
+
+def _member_dims(root_tree, uid: int):
+    n = _find_region_root(root_tree, uid)
+    return tuple(n.shape) if n is not None else ()
+
+
+# ---------------------------------------------------------------------------
 # Reshard plan-vs-naive measurement (round 10) — the closed loop for the
 # staged redistribution planner (parallel/reshard.py): per
 # (src->dst, side class, grid, backend) shape class, time the compiled
@@ -711,10 +837,10 @@ def measure_reshard_variant(variant: str, plan, mesh,
         np.random.default_rng(0).standard_normal(
             (side, side)).astype(np.float32), src_sh)
     if variant == "naive":
-        f = jax.jit(lambda v: jax.lax.with_sharding_constraint(v,
+        f = jax.jit(lambda v: jax.lax.with_sharding_constraint(v,  # matlint: disable=ML010 measurement probe — the autotune loop times candidates outside the plan path
                                                                dst_sh))
     else:
-        f = jax.jit(lambda v: reshard_lib.apply_staged(v, probe, mesh))
+        f = jax.jit(lambda v: reshard_lib.apply_staged(v, probe, mesh))  # matlint: disable=ML010 measurement probe — the autotune loop times candidates outside the plan path
     f(x).block_until_ready()                    # compile + warm
     ts = []
     for _ in range(max(n_times, 1)):

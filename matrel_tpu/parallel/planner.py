@@ -1490,6 +1490,26 @@ def matmul_decisions(root: MatExpr, mesh: Mesh,
     dmemo: dict = {}
     out: list = []
     seen: set = set()
+    # fused-region prepass (ir/fusion.py stamps live on region ROOTS,
+    # which may be elementwise/agg nodes): map each anchor matmul's uid
+    # to its region record so the matmul's decision carries the chosen
+    # boundary — fused_region, member census, est saved dispatches/HBM
+    # — into the obs event stream. Empty with fusion off (no stamps):
+    # zero extra fields, the bit-identity obs contract.
+    fused_of: dict = {}
+    fseen: set = set()
+
+    def fwalk(node: MatExpr):
+        if node.uid in fseen:
+            return
+        fseen.add(node.uid)
+        for c in node.children:
+            fwalk(c)
+        a_uid = node.attrs.get("fused_anchor")
+        if "fused_region" in node.attrs and a_uid is not None:
+            fused_of[a_uid] = node.attrs
+
+    fwalk(root)
 
     def walk(n: MatExpr):
         if n.uid in seen:
@@ -1601,6 +1621,21 @@ def matmul_decisions(root: MatExpr, mesh: Mesh,
                         rec["reshard"] = rr
             except ValueError:       # an override string the model
                 rec["est_ici_bytes"] = None   # doesn't know
+        fr = fused_of.get(n.uid)
+        if fr is not None:
+            # this matmul anchors a fused region: the decision record
+            # carries the chosen boundary so obs/history/drift see it
+            # (the drift auditor keys these rows ``fused:<sig>`` — a
+            # miscalibrated fused estimate must not poison the
+            # per-strategy calibration rows). setdefault on the HBM
+            # field: a SpGEMM anchor's est_saved_hbm_bytes already
+            # means "saved vs densify" and keeps that meaning.
+            rec["fused_region"] = fr.get("fused_region")
+            rec["fused_census"] = dict(fr.get("fused_census") or {})
+            rec["est_saved_dispatches"] = fr.get(
+                "fused_saved_dispatches")
+            rec.setdefault("est_saved_hbm_bytes",
+                           fr.get("fused_saved_hbm_bytes"))
         out.append(rec)
 
     walk(root)

@@ -237,7 +237,14 @@ MATMUL_IMPLS = {
 
 
 def run_matmul(strategy: str, a: jax.Array, b: jax.Array, mesh: Mesh,
-               config: Optional[MatrelConfig] = None) -> jax.Array:
+               config: Optional[MatrelConfig] = None,
+               epilogue=None) -> jax.Array:
+    """``epilogue`` is the fused-region slot (ir/fusion.py /
+    docs/FUSION.md): a traceable callable applied to the strategy's
+    output INSIDE the same traced computation, so an absorbed
+    elementwise/scalar/reduction chain compiles as the contraction's
+    epilogue instead of its own dispatch. None (the default) is the
+    historical path, bit-identically."""
     # fault site "strategy": the resilience harness's hook at strategy
     # execution (trace time). One truthiness test when injection is off.
     from matrel_tpu.resilience import faults as faults_lib
@@ -245,5 +252,7 @@ def run_matmul(strategy: str, a: jax.Array, b: jax.Array, mesh: Mesh,
     impl = MATMUL_IMPLS[strategy]
     if strategy.startswith("bmm"):
         side = "left" if strategy == "bmm_left" else "right"
-        return matmul_bmm(a, b, mesh, config, broadcast_side=side)
-    return impl(a, b, mesh, config)
+        out = matmul_bmm(a, b, mesh, config, broadcast_side=side)
+    else:
+        out = impl(a, b, mesh, config)
+    return out if epilogue is None else epilogue(out)

@@ -14,10 +14,13 @@ which is what makes escalation safe to do blindly:
     rung 2  + force the safe `xla` strategy for every matmul
             (GSPMD picks its own decomposition — no hand collectives)
     rung 3  + disable Pallas kernels and SpGEMM dispatch (densify
-            fallback; the XLA gather paths carry sparse matmuls) and
+            fallback; the XLA gather paths carry sparse matmuls),
             pin the sparse-kernel registry to its XLA generic entry
             (a forced specialized Pallas kernel must not survive the
-            ladder)
+            ladder), and force STAGED execution (fusion_enable off —
+            a miscompiling fused region must not survive retry; the
+            per-op path is the conservative anchor MV111's off-state
+            contract guarantees is stamp-free)
     rung 4  + bypass the result cache for this attempt (a poisoned
             entry cannot answer the retry)
 
@@ -79,6 +82,12 @@ def apply_rung(config, rung: int):
         # dispatch; the override pin covers direct ops-level callers
         # and makes the escape independent of admissibility gating.
         kw["spgemm_kernel_override"] = "xla_gather"
+        # force staged execution: a base config running whole-plan
+        # fusion would otherwise re-stamp the very fused region the
+        # retry exists to escape (the kernel-override rationale, one
+        # rung, same direction — toward the per-op path the engine
+        # has always trusted)
+        kw["fusion_enable"] = False
     return config.replace(**kw)
 
 

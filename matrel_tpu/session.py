@@ -604,6 +604,12 @@ class MatrelSession:
         }
         if batch is not None:
             record["batch"] = batch
+        if meta.get("fusion"):
+            # plan-level fusion roll-up (executor._fusion_meta):
+            # regions, member census, est saved dispatches/HBM — the
+            # `history --summary` fusion line's feed. Absent with
+            # fusion off (the bit-identity obs contract).
+            record["fusion"] = meta["fusion"]
         if self._rc_enabled():
             record["result_cache"] = self._result_cache.info()
         import jax

@@ -32,7 +32,7 @@ def cg_runner(matvec: Callable, tol: float = 1e-6,
     so the compiled program is cached across calls. ``b`` may be any
     float array shaped (n,) or (n, 1) — coerced like cg_solve_linop."""
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 workload runner cache, jitted once per static dims outside the plan path
     def run(b):
         b = jnp.asarray(b, jnp.float32).reshape(-1)
         bnorm = jnp.maximum(jnp.linalg.norm(b), 1e-30)

@@ -38,7 +38,7 @@ def pagerank(A: BlockMatrix, rounds: int = 30, alpha: float = 0.85,
     pn = A.padded_shape[0]
     out_sharding = NamedSharding(mesh, P())
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 workload runner cache, jitted once per static dims outside the plan path
     def run(ad):
         valid_row = (jnp.arange(pn) < n)[:, None]
         deg = jnp.sum(ad, axis=1, keepdims=True)               # out-degree
@@ -384,7 +384,7 @@ def _compact_sharded_loop(n: int, rounds: int, alpha: float, plan_static,
               else compat.pvary(r0, axes))
         return jax.lax.fori_loop(0, rounds, body, r0)
 
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=in_specs,
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=in_specs,  # matlint: disable=ML010 workload runner cache, jitted once per static dims outside the plan path
                              out_specs=P(), check_vma=False))
 
 
@@ -446,7 +446,7 @@ def _onehot_sharded_runner(n: int, rounds: int, alpha: float, plan_static,
 
     # check_vma=False: see _sharded_spmv_runner — the all_gathered carry
     # is value-identical per device but typed varying
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=in_specs,
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=in_specs,  # matlint: disable=ML010 workload runner cache, jitted once per static dims outside the plan path
                              out_specs=P(), check_vma=False))
 
 
@@ -472,7 +472,7 @@ def _onehot_runner(n: int, rounds: int, alpha: float, plan_static,
                    n_arrays: int):
     from matrel_tpu.ops import spmv as spmv_lib
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 workload runner cache, jitted once per static dims outside the plan path
     def run(arrays, dangling):
         body = _power_body(
             lambda r: spmv_lib.spmv_apply(plan_static, arrays, r),
@@ -487,7 +487,7 @@ def _compact_runner_loop(n: int, rounds: int, alpha: float, plan_static,
                          n_ov: int, passes: int, interpret: bool):
     from matrel_tpu.ops import pallas_spmv as pc
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 workload runner cache, jitted once per static dims outside the plan path
     def run(tables, ov, dangling):
         body = _power_body(
             lambda r: pc.compact_apply(plan_static, tables, ov, r,
@@ -503,14 +503,14 @@ def _edges_runner(n: int, rounds: int, alpha: float):
     """Jitted programs cached per (n, rounds, alpha) — fresh closures per
     call would recompile on every invocation."""
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 workload runner cache, jitted once per static dims outside the plan path
     def prepare(s, d, w):
         # sort edges by destination once so the per-round scatter-add runs
         # with indices_are_sorted (much cheaper on TPU)
         order = jnp.argsort(d)
         return s[order], d[order], w[order]
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 workload runner cache, jitted once per static dims outside the plan path
     def run(s, d, w):
         outdeg = jax.ops.segment_sum(w, s, num_segments=n)
         inv_deg = jnp.where(outdeg > 0,
@@ -562,7 +562,7 @@ def pagerank_csr(src, dst, n: int, rounds: int = 30, alpha: float = 0.85,
 
 @functools.lru_cache(maxsize=32)
 def _csr_runner(n: int, rounds: int, alpha: float, D: int):
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 workload runner cache, jitted once per static dims outside the plan path
     def run(neighbors, outdeg):
         inv_deg = jnp.where(outdeg > 0, 1.0 / jnp.maximum(outdeg, 1.0), 0.0)
         dangling = (outdeg == 0).astype(jnp.float32)
@@ -598,7 +598,7 @@ def pagerank_block_sparse(S, rounds: int = 30, alpha: float = 0.85,
         S, BlockMatrix.from_numpy(np.ones((n, 1), np.float32), mesh=mesh),
         config)
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 workload runner cache, jitted once per static dims outside the plan path
     def prep(deg):
         # epsilon (not 1.0) floor: weighted adjacencies can have row sums
         # below 1, and clamping those would silently skew the ranks
@@ -612,7 +612,7 @@ def pagerank_block_sparse(S, rounds: int = 30, alpha: float = 0.85,
     r = BlockMatrix.from_numpy(np.full((n, 1), 1.0 / n, np.float32),
                                mesh=mesh)
 
-    @jax.jit
+    @jax.jit  # matlint: disable=ML010 workload runner cache, jitted once per static dims outside the plan path
     def poststep(contrib, r_old):
         dmass = jnp.sum(dangling * r_old)
         r_new = alpha * (contrib + dmass / n) + teleport

@@ -120,6 +120,35 @@ def test_sparse_kernels_row_artifact(dry_batch):
     assert at["key"].startswith("spgemm|")
 
 
+def test_fusion_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    # twice in the dry batch, like its sibling rows: the wedge-safe
+    # bench.py --fusion step AND bench_all's dry-enabled row
+    recs = [r for r in records
+            if r.get("metric") == "fusion_region_sweep"
+            and "rows" in r]
+    assert len(recs) == 2, f"expected 2 fusion artifacts, got {recs}"
+    rec = recs[0]
+    # the round-12 acceptance on the dry mesh: both chains measured
+    # both ways with intervals, fused >= 1.3x over staged with the
+    # dispatch count reduced and recorded, outputs identical, the
+    # default (fusion off) path constructing zero region objects, and
+    # MV111 quiet on a fresh fused annotation
+    assert rec["ok"] is True, rec
+    chains = [r["chain"] for r in rec["rows"]]
+    assert chains == ["pagerank_step", "linreg_epilogue"], chains
+    for row in rec["rows"]:
+        assert row["staged_ms"] > 0 and row["fused_ms"] > 0
+        assert "staged_half_width_ms" in row \
+            and "fused_half_width_ms" in row
+        assert row["fused_dispatches"] < row["staged_dispatches"], row
+        assert row["regions"] >= 1
+        assert row["speedup"] >= 1.3, row
+        assert row["outputs_agree"] is True
+    assert rec["off_constructs_nothing"] is True
+    assert rec["mv111_quiet"] is True, rec["mv111"]
+
+
 def test_serve_row_artifact(dry_batch):
     _, records, _ = dry_batch
     rec = _one(records,

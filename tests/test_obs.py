@@ -401,15 +401,24 @@ class TestInstrumentationGuard:
     def test_every_lowering_dispatch_is_annotated(self):
         """Structural check: each `self._eval(` dispatch call site in
         executor.py sits inside a `with annotate(` block, so a new op
-        path can't silently skip the per-op scope/timing hook."""
+        path can't silently skip the per-op scope/timing hook. Two
+        sanctioned exceptions, both DELIBERATELY single-frame: the
+        fused-region member sites (one `with annotate("matrel.fused:…")`
+        frame covers the whole member set — that per-edge frame
+        collapse IS the fusion design, docs/FUSION.md) and the
+        unit-program seam (jitted region emission for the bench/
+        autotune measurement harness) — each must say so inline."""
         import inspect
         from matrel_tpu import executor
         lines = inspect.getsource(executor).splitlines()
         sites = [i for i, ln in enumerate(lines)
                  if "self._eval(" in ln and "def _eval" not in ln]
         assert sites, "executor lost its central _eval dispatch"
+        exempt = ("fused-region member", "unit-program member")
         for i in sites:
-            window = "\n".join(lines[max(0, i - 3):i])
+            if any(tag in lines[i] for tag in exempt):
+                continue
+            window = "\n".join(lines[max(0, i - 5):i])
             assert "with annotate(" in window, (
                 f"executor.py line {i + 1}: lowering dispatch not "
                 f"wrapped in annotate()")

@@ -31,6 +31,9 @@ Rule catalogue (each rule's class docstring is the authority):
   ML008  layout-changing jax.device_put in lowering modules
   ML009  Pallas kernel defined outside ops/kernel_registry.py in
          executor-reachable ops modules (the "one seam" rule)
+  ML010  jax.jit call site outside the executor's region-emission
+         seam (executor.py) and utils/ — jitted-program emission is
+         one compilation seam (the ML009 idiom for programs)
 """
 
 from __future__ import annotations
@@ -536,11 +539,77 @@ class KernelSeamRule(Rule):
                     "register it")
 
 
+class JitSeamRule(Rule):
+    """ML010: ``jax.jit`` call sites in ``matrel_tpu/`` outside the
+    executor's region-emission seam (``executor.py``) and ``utils/``.
+
+    The whole-plan fusion work (ir/fusion.py, docs/FUSION.md) made
+    program emission a PLANNER decision: the executor compiles whole
+    plans, fused regions and per-op staged units through ONE seam,
+    where the boundary is stamped, measured (the autotune ``fuse|``
+    family), verified (MV111) and escapable (degradation rung 3). A
+    ``jax.jit`` authored elsewhere in the package is a compiled
+    program the planner cannot see, the dispatch-count accounting
+    cannot count, and the fused-vs-staged measurement cannot sweep —
+    the ML009 "one seam" argument applied to programs instead of
+    kernels. Scope: the package minus executor.py (the seam) and
+    utils/ (host-side tooling/profiling helpers); harness scripts
+    (bench/tools/tests) are out of scope — they ARE measurement.
+    The pre-existing legitimate sites (workload runner caches, ops
+    table builders, autotune probes, core constructors) carry
+    justified inline suppressions, which double as the worklist for
+    porting them onto the seam."""
+
+    id = "ML010"
+    _EXEMPT = ("matrel_tpu/executor.py",)
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("matrel_tpu/")
+                and relpath not in self._EXEMPT
+                and not relpath.startswith("matrel_tpu/utils/"))
+
+    @staticmethod
+    def _is_jit(node: ast.AST) -> bool:
+        # Name/Attribute targets only: an ast.Call target (the
+        # `jax.jit(f)(x)` outer call's func) must NOT match, or an
+        # immediately-invoked jit site reports twice at one line
+        if isinstance(node, ast.Call):
+            return False
+        return _call_name(node).rsplit(".", 1)[-1] == "jit"
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and self._is_jit(node.func):
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    "jax.jit outside the executor's region-emission "
+                    "seam — a compiled program the planner cannot "
+                    "see/measure/escape; emit it through "
+                    "matrel_tpu/executor.py (or justify with an "
+                    "inline suppression)")
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                # bare `@jax.jit` only — call-form decorators
+                # (`@jax.jit` with args, `@partial(jax.jit, ...)`)
+                # are ast.Calls the branch above already walks
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call) \
+                            and self._is_jit(dec):
+                        yield Finding(
+                            relpath, dec.lineno, self.id,
+                            "@jax.jit outside the executor's "
+                            "region-emission seam — a compiled "
+                            "program the planner cannot see/measure/"
+                            "escape; emit it through "
+                            "matrel_tpu/executor.py (or justify with "
+                            "an inline suppression)")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
                         SpecKeyedCacheRule(), RawTimingRule(),
                         BroadSwallowRule(), DevicePutRule(),
-                        KernelSeamRule())
+                        KernelSeamRule(), JitSeamRule())
 
 
 def _suppressed_codes(line: str) -> set:

@@ -412,6 +412,134 @@ def soak_sparse_kernels(n_trials: int, base: int, tol: float):
     return fails
 
 
+def soak_fusion(n_trials: int, base: int, tol: float):
+    """Whole-plan fusion battery (round 12): random elementwise/
+    reduction chains over DENSE, S×S (block-sparse) and COO producers
+    executed with fusion FORCED ON against numpy oracles, per
+    precision tier on the dense trials — and, every trial, the fused
+    run compared tightly against the staged (fusion-off) run of the
+    SAME expression, which must agree to float noise (identical member
+    lowerings, one program boundary apart). A rotating
+    fusion-boundary pass additionally compiles one trial per round
+    under ``verify_plans="error"`` so a boundary MV111 would reject
+    can never reach execution."""
+    import numpy as np
+    from matrel_tpu import analysis, executor as executor_lib
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.core.coo import COOMatrix
+    from matrel_tpu.ops import kernel_registry as kr
+    from matrel_tpu.parallel import planner
+
+    mesh = mesh_lib.make_mesh()
+    fails = []
+    producers = ("dense", "sxs", "coo")
+    tiers = ("default", "float32", "high", "fast")
+    for trial in range(n_trials):
+        rng = np.random.default_rng(base + trial)
+        try:
+            producer = producers[trial % len(producers)]
+            sla = tiers[trial % len(tiers)] if producer == "dense" \
+                else "default"
+            n = int(rng.choice([24, 32, 48]))
+            if producer == "dense":
+                a = rng.standard_normal((n, n)).astype(np.float32)
+                b = rng.standard_normal((n, n)).astype(np.float32)
+                A = BlockMatrix.from_numpy(a, mesh=mesh)
+                B = BlockMatrix.from_numpy(b, mesh=mesh)
+                e = A.expr().multiply(B.expr())
+                ref = a.astype(np.float64) @ b.astype(np.float64)
+            elif producer == "sxs":
+                bs = int(rng.choice([8, 16]))
+                n = bs * int(rng.integers(16, 32))
+                SA = kr.synthesize_structure("row_band", n, bs, mesh,
+                                             seed=base + trial)
+                SB = kr.synthesize_structure("row_band", n, bs, mesh,
+                                             seed=base + trial + 9)
+                e = SA.multiply(SB)
+                ref = (SA.to_numpy().astype(np.float64)
+                       @ SB.to_numpy().astype(np.float64))
+            else:
+                nnz = max(8, 3 * n)
+                flat = rng.choice(n * n, size=min(nnz, n * n),
+                                  replace=False)
+                rows, cols = flat // n, flat % n
+                vals = rng.standard_normal(rows.size).astype(
+                    np.float32)
+                C = COOMatrix.from_edges(rows, cols, vals, (n, n))
+                d = rng.standard_normal((n, 4)).astype(np.float32)
+                D = BlockMatrix.from_numpy(d, mesh=mesh)
+                e = C.expr().multiply(D.expr())
+                cd = np.zeros((n, n), np.float64)
+                cd[rows, cols] = vals.astype(np.float64)
+                ref = cd @ d.astype(np.float64)
+            # random fusable chain over the producer (the oracle
+            # follows along in float64)
+            for _ in range(int(rng.integers(2, 6))):
+                op = int(rng.integers(0, 5))
+                if op == 0:
+                    s = float(rng.uniform(-2, 2))
+                    e, ref = e.multiply_scalar(s), ref * s
+                elif op == 1:
+                    s = float(rng.uniform(-1, 1))
+                    e, ref = e.add_scalar(s), ref + s
+                elif op == 2:
+                    w = rng.standard_normal(ref.shape).astype(
+                        np.float32)
+                    W = BlockMatrix.from_numpy(w, mesh=mesh)
+                    e = e.add(W.expr())
+                    ref = ref + w.astype(np.float64)
+                elif op == 3:
+                    w = rng.standard_normal(ref.shape).astype(
+                        np.float32)
+                    W = BlockMatrix.from_numpy(w, mesh=mesh)
+                    e = e.elem_multiply(W.expr())
+                    ref = ref * w.astype(np.float64)
+                else:
+                    if ref.shape[0] > 1:
+                        e, ref = e.row_sum(), ref.sum(
+                            axis=1, keepdims=True)
+            cfg_on = MatrelConfig(fusion_enable=True,
+                                  precision_sla=sla)
+            cfg_off = cfg_on.replace(fusion_enable=False)
+            out_on = executor_lib.execute(e, mesh, cfg_on).to_numpy()
+            out_off = executor_lib.execute(e, mesh,
+                                           cfg_off).to_numpy()
+            lr, lc = ref.shape
+            scale = max(float(np.abs(ref).max()), 1.0)
+            # bf16 tiers carry their documented looser bound; the
+            # fused-vs-staged comparison below stays TIGHT per tier
+            tier_tol = {"high": 2 * tol, "fast": 2e-2}.get(sla, tol)
+            np.testing.assert_allclose(
+                out_on[:lr, :lc] / scale, ref / scale,
+                rtol=tier_tol, atol=tier_tol)
+            np.testing.assert_allclose(
+                out_on / scale, out_off / scale,
+                rtol=1e-5, atol=1e-5)
+            if trial % 3 == 0:
+                # rotating fusion-boundary pass: the annotated fused
+                # plan verifies clean and compiles under the error
+                # gate (nothing MV111 rejects may execute)
+                opt = planner.annotate_strategies(
+                    __import__("matrel_tpu.ir.rules",
+                               fromlist=["optimize"]).optimize(
+                        e, cfg_on), mesh, cfg_on)
+                from matrel_tpu.ir import fusion as fusion_lib
+                opt = fusion_lib.annotate_fusion(opt, mesh, cfg_on)
+                bad = [d for d in analysis.verify_plan(opt, mesh,
+                                                       cfg_on)
+                       if d.code == "MV111"
+                       and d.severity == "error"]
+                assert not bad, bad
+                executor_lib.compile_expr(
+                    e, mesh, cfg_on.replace(verify_plans="error"))
+        except Exception as ex:  # noqa: BLE001 — soak collects all
+            fails.append(("fusion", trial, type(ex).__name__,
+                          str(ex)[:200]))
+    return fails
+
+
 def soak_serve(n_trials: int, base: int, tol: float):
     """Serving-layer battery: a random query stream (with heavy
     repetition, so the result cache and the MultiPlan plan cache both
@@ -721,7 +849,7 @@ def main():
     p.add_argument("battery",
                    choices=["fuzz", "deep", "spmv", "sharded", "routed",
                             "ckpt", "serve", "precision", "chaos",
-                            "sparse_kernels", "all"])
+                            "sparse_kernels", "fusion", "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -753,6 +881,8 @@ def main():
     if args.battery in ("sparse_kernels", "all"):
         fails += soak_sparse_kernels(max(args.seeds // 5, 4),
                                      args.base, tol)
+    if args.battery in ("fusion", "all"):
+        fails += soak_fusion(max(args.seeds // 4, 6), args.base, tol)
     if args.battery in ("routed", "all"):
         if args.tpu:
             # REAL-Mosaic routed battery: few trials, small shapes —

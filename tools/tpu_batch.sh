@@ -45,6 +45,8 @@ if [ "$DRY" = 1 ]; then
     export MATREL_SPK_N=1024 MATREL_SPK_BS=64 MATREL_SPK_REPEATS=3 \
            MATREL_SPK_AUTOTUNE_SIDE=1024 \
            MATREL_SPK_TABLE="$DRY_DIR/spk_autotune.json"
+    export MATREL_FUSION_N=256 MATREL_FUSION_K=64 \
+           MATREL_FUSION_REPEATS=5 MATREL_FUSION_INNER=4
     export MATREL_SERVE_N=256 MATREL_SERVE_K=64 \
            MATREL_SERVE_QUERIES=18 MATREL_SERVE_MEAS=3
     export MATREL_PRECISION_N=256 MATREL_PRECISION_REPEATS=3
@@ -68,6 +70,8 @@ log "--- bench.py --spgemm (S x S tile-intersection SpGEMM row, staged this roun
 python bench.py --spgemm
 log "--- bench.py --sparse-kernels (structure-specialized kernel sweep + autotune replay, staged this round)"
 python bench.py --sparse-kernels
+log "--- bench.py --fusion (fused-vs-staged region sweep, staged this round)"
+python bench.py --fusion
 log "--- bench.py --serve (repeated-traffic serving QPS row, staged this round)"
 python bench.py --serve
 log "--- bench.py --precision (bf16/int precision-tier sweep + error bounds, staged this round)"
