@@ -87,6 +87,29 @@ def bench_serve(mesh, cfg):
     return {"metric": "serve_repeated_traffic_qps", **payload}
 
 
+def bench_traffic(mesh, cfg):
+    """Open-loop overload traffic harness (tools/traffic.py;
+    docs/OVERLOAD.md): seeded Poisson arrivals at 2x measured
+    closed-loop capacity over 3 weighted tenants — per-tenant
+    percentiles, goodput ratio, typed-shed counts, Jain fairness,
+    brownout enter/exit. Run as a subprocess: the harness forces the
+    CPU backend (it drills the control plane, not the chip) and must
+    not re-initialise this process's backend."""
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "traffic.py")],
+        capture_output=True, text=True, timeout=600)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    if not lines:
+        raise RuntimeError(
+            f"traffic harness emitted no artifact (rc {proc.returncode}): "
+            f"{proc.stderr[-400:]}")
+    return json.loads(lines[-1])
+
+
 def bench_reshard(mesh, cfg):
     """Reshard-planner sweep: planned staged step sequences vs the
     naive one-shot constraint per src→dst layout move, {ms, bytes
@@ -406,10 +429,11 @@ def main():
     dry = bool(os.environ.get("MATREL_DRY"))
     dry_rows = (bench_dense_4k, bench_chain, bench_spgemm,
                 bench_sparse_kernels, bench_fusion, bench_serve,
-                bench_precision, bench_reshard)
+                bench_precision, bench_reshard, bench_traffic)
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
                bench_spgemm, bench_sparse_kernels, bench_fusion,
                bench_serve, bench_precision, bench_reshard,
+               bench_traffic,
                bench_pagerank, bench_pagerank_10x, bench_cg,
                bench_eigen, bench_triangles, bench_north_star):
         if dry and fn not in dry_rows:

@@ -40,6 +40,10 @@ codes documented in :mod:`matrel_tpu.analysis.diagnostics`):
   fusion     MV111  fused-region stamps cover exactly the regions the
                     executor lowers (both directions); tier/remask
                     preserved; fusion off stamps nothing
+  brownout   MV112  brownout stamps agree with the rung that claims
+                    them (tier downshift matches the compile SLA,
+                    staleness only at rung >= 2, no stamps with the
+                    controller off)
 """
 
 from __future__ import annotations
@@ -47,6 +51,7 @@ from __future__ import annotations
 import logging
 from typing import List, Optional
 
+from matrel_tpu.analysis.brownout_pass import check_brownout_stamps
 from matrel_tpu.analysis.diagnostics import (  # noqa: F401 (re-export)
     Diagnostic, VerificationError)
 from matrel_tpu.analysis.fusion_pass import check_fusion_stamps
@@ -79,6 +84,7 @@ PASSES = (
     ("precision", check_precision_stamps),
     ("reshard", check_reshard_peaks),
     ("fusion", check_fusion_stamps),
+    ("brownout", check_brownout_stamps),
 )
 
 

@@ -24,6 +24,8 @@ Code space (stable — tests and suppressions key on them):
          understates its recompiled peak               (error)
   MV110  SpGEMM kernel stamp unknown / inadmissible for
          the stamped structure class                   (error)
+  MV112  brownout stamp disagrees with the rung that
+         claims it (tier/staleness/controller-off)     (warning)
 """
 
 from __future__ import annotations

@@ -229,7 +229,67 @@ class MatrelConfig:
         A ``submit`` against a full queue raises the typed
         ``AdmissionShed`` instead of growing the queue without bound —
         load shedding that protects the queries already admitted. 0
-        (the default) keeps the historical unbounded queue.
+        (the default) keeps the historical unbounded queue. Expired-
+        deadline entries are PURGED (resolved typed) at the shed
+        decision point before the bound is enforced, so a queue full
+        of dead entries never sheds live traffic (docs/OVERLOAD.md).
+      serve_tenant_weights: per-tenant weighted-fair-queuing weights
+        for the admission worker (serve/admission.py;
+        docs/OVERLOAD.md) — ``"gold:4,silver:2,bronze:1"``. With
+        weights set, each tenant gets its own admission queue and the
+        worker pops entries in stride-scheduled proportion to weight
+        (the YARN/Spark fair-scheduler analogue of PAPER.md [P1]'s
+        multi-tenant operating point), so one chatty tenant cannot
+        monopolize a MultiPlan or starve the stream. "" (the default)
+        keeps ONE implicit tenant and is bit-identical to the
+        historical FIFO admission order. Tenants not named here get
+        weight 1.0. Validated at construction.
+      serve_tenant_queue_max: per-tenant admission-queue bound. A
+        tenant at its cap sheds typed ``AdmissionShed(tenant=...)``
+        BEFORE the global ``serve_queue_max`` bound is consulted —
+        per-tenant quota protects every OTHER tenant's share of the
+        queue. 0 (the default) = no per-tenant cap.
+      brownout_enable: the adaptive brownout controller
+        (resilience/brownout.py; docs/OVERLOAD.md). Off (the default)
+        constructs NO controller object and the serve plane is
+        bit-identical. On: the admission worker samples queue depth,
+        queue-wait p95 and deadline-miss rate over a sliding window
+        and climbs a cumulative rung ladder under sustained pressure —
+        rung 1 downshifts default-SLA queries to the "fast" precision
+        tier (results stay SLA-key-isolated), rung 2 serves
+        result-cache entries a rebind marked STALE to queries that
+        declare a ``staleness_ms`` tolerance, rung 3 sheds
+        lowest-weight tenants (typed) — descending with hysteresis
+        when every signal falls below the (separated) exit thresholds.
+      brownout_window: sliding-window length (admission-cycle samples)
+        the controller's statistics cover.
+      brownout_dwell: minimum samples between rung moves — the
+        hysteresis dwell that stops the ladder oscillating on one
+        noisy sample.
+      brownout_wait_high_ms / brownout_wait_low_ms: queue-wait p95
+        enter/exit thresholds. Enter pressure when p95 exceeds high;
+        the wait signal reads calm only below low (low < high,
+        validated — the separation IS the hysteresis).
+      brownout_depth_high / brownout_depth_low: queue-depth enter/exit
+        thresholds (same contract).
+      brownout_miss_high / brownout_miss_low: deadline-miss-rate
+        enter/exit thresholds over the window (fractions in [0, 1],
+        low < high).
+      breaker_threshold: per-plan-class circuit breakers
+        (resilience/breaker.py; docs/OVERLOAD.md). 0 (the default)
+        constructs NO breaker objects. > 0: consecutive TERMINAL
+        failures of one plan class (the drift auditor's
+        kind + pow2-shape-class key) — failures that already exhausted
+        the retry budget — open that class's breaker, and further
+        queries of the class fail FAST with the typed ``CircuitOpen``
+        (carrying the half-open probe schedule) instead of burning
+        compile/retry budget the healthy classes need. After
+        ``breaker_cooldown_ms`` the breaker goes half-open and admits
+        ``breaker_half_open_probes`` probe queries: a probe success
+        closes it, a probe failure re-opens it for another cooldown.
+      breaker_cooldown_ms: open→half-open cooldown (must be > 0).
+      breaker_half_open_probes: concurrent probe budget in half-open
+        (>= 1).
       reshard_peak_budget_bytes: peak per-device bytes a layout change
         (reshard) may have live during any one step of its lowering
         (matrel_tpu/parallel/reshard.py; docs/RESHARD.md — the
@@ -316,6 +376,20 @@ class MatrelConfig:
     retry_jitter: float = 0.5
     deadline_ms: float = 0.0
     serve_queue_max: int = 0
+    serve_tenant_weights: str = ""
+    serve_tenant_queue_max: int = 0
+    brownout_enable: bool = False
+    brownout_window: int = 32
+    brownout_dwell: int = 8
+    brownout_wait_high_ms: float = 200.0
+    brownout_wait_low_ms: float = 50.0
+    brownout_depth_high: int = 64
+    brownout_depth_low: int = 8
+    brownout_miss_high: float = 0.25
+    brownout_miss_low: float = 0.05
+    breaker_threshold: int = 0
+    breaker_cooldown_ms: float = 1000.0
+    breaker_half_open_probes: int = 1
     precision_sla: str = "default"
     precision_enable_bf16: bool = True
     precision_enable_int: bool = True
@@ -409,6 +483,50 @@ class MatrelConfig:
             raise ValueError(
                 f"serve_queue_max must be >= 0 (0 = unbounded), "
                 f"got {self.serve_queue_max!r}")
+        # overload control plane (docs/OVERLOAD.md): a malformed tenant
+        # weight spec must fail at construction (the fault_inject
+        # precedent) — silently weighting nothing while the operator
+        # believes fairness is in force is the worst failure mode a
+        # fairness knob can have
+        if self.serve_tenant_weights:
+            parse_tenant_weights(self.serve_tenant_weights)
+        if self.serve_tenant_queue_max < 0:
+            raise ValueError(
+                f"serve_tenant_queue_max must be >= 0 (0 = no "
+                f"per-tenant cap), got {self.serve_tenant_queue_max!r}")
+        # brownout hysteresis NEEDS separated thresholds: low == high
+        # would flap the rung on every sample and low > high would
+        # deadlock the ladder (enter and exit both impossible)
+        if self.brownout_window < 1 or self.brownout_dwell < 1:
+            raise ValueError(
+                "brownout_window and brownout_dwell must be >= 1; got "
+                f"({self.brownout_window!r}, {self.brownout_dwell!r})")
+        for name, lo, hi in (
+                ("wait", self.brownout_wait_low_ms,
+                 self.brownout_wait_high_ms),
+                ("depth", self.brownout_depth_low,
+                 self.brownout_depth_high),
+                ("miss", self.brownout_miss_low,
+                 self.brownout_miss_high)):
+            if not (0 <= lo < hi):
+                raise ValueError(
+                    f"brownout_{name} thresholds need 0 <= low < high "
+                    f"(the hysteresis separation), got ({lo!r}, {hi!r})")
+        if not (0.0 <= self.brownout_miss_high <= 1.0):
+            raise ValueError(
+                f"brownout_miss_high must be a rate in [0, 1], "
+                f"got {self.brownout_miss_high!r}")
+        if self.breaker_threshold < 0:
+            raise ValueError(
+                f"breaker_threshold must be >= 0 (0 disables "
+                f"breakers), got {self.breaker_threshold!r}")
+        if self.breaker_cooldown_ms <= 0 \
+                or self.breaker_half_open_probes < 1:
+            raise ValueError(
+                "breakers need breaker_cooldown_ms > 0 and "
+                "breaker_half_open_probes >= 1; got "
+                f"({self.breaker_cooldown_ms!r}, "
+                f"{self.breaker_half_open_probes!r})")
         # the SLA vocabulary gates NUMERICS, not just performance: an
         # unvalidated typo ("fasst") would silently run the default
         # path while the caller believes a bound was requested — or
@@ -500,6 +618,45 @@ def normalize_sla(sla) -> str:
             f"precision SLA must be one of {PRECISION_SLAS} (or 'bf16'/"
             f"'f32' aliases), got {sla!r}")
     return s
+
+
+def parse_tenant_weights(spec) -> dict:
+    """Validate + parse a ``serve_tenant_weights`` spec
+    (``"gold:4,silver:2,bronze:1"``) into ``{tenant: float weight}``.
+    Empty/None → {} (one implicit tenant, the historical FIFO).
+    Raises ``ValueError`` on empty names, duplicate names, or
+    non-positive weights — config.__post_init__ calls this so a typo
+    fails at construction (the fault_inject precedent)."""
+    if not spec:
+        return {}
+    out: dict = {}
+    for part in (p.strip() for p in str(spec).split(",")):
+        if not part:
+            continue
+        name, sep, w = part.partition(":")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(
+                f"serve_tenant_weights entry {part!r} must be "
+                f"'name:weight'")
+        if name in out:
+            raise ValueError(
+                f"serve_tenant_weights names tenant {name!r} twice")
+        try:
+            weight = float(w)
+        except ValueError:
+            raise ValueError(
+                f"serve_tenant_weights weight {w!r} (tenant "
+                f"{name!r}) is not a number") from None
+        if not weight > 0.0:
+            raise ValueError(
+                f"serve_tenant_weights weight for {name!r} must be "
+                f"> 0, got {weight!r}")
+        out[name] = weight
+    if not out:
+        raise ValueError(
+            f"serve_tenant_weights {spec!r} names no tenants")
+    return out
 
 
 _default_config = MatrelConfig.from_env()

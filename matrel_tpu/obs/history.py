@@ -162,6 +162,7 @@ def summarize(events: List[dict]) -> dict:
         "rc_hits": sum(1 for e in qs if e.get("cache") == "rc_hit"),
         "serve": _summarize_serve(events),
         "resilience": _summarize_resilience(events, len(qs)),
+        "overload": _summarize_overload(events),
         "execute_ms_total": round(sum(exec_ms), 3),
         "execute_ms_mean": (round(sum(exec_ms) / len(exec_ms), 3)
                             if exec_ms else None),
@@ -310,6 +311,71 @@ def _summarize_resilience(events: List[dict], n_queries: int) -> dict:
     }
 
 
+def _summarize_overload(events: List[dict]) -> Optional[dict]:
+    """Roll up ``overload`` records (one per admission cycle while the
+    control plane is active — serve/pipeline.py; docs/OVERLOAD.md)
+    into the numbers saturation is judged by: per-tenant shed rate and
+    p99 queue wait, the brownout rung census, and breaker
+    open/half-open/close transition counts. Shed/purge/transition
+    fields on each record are PER-CYCLE DELTAS (the serve roll-up's
+    multi-session discipline), so summing them is correct across
+    sessions; rung/depth fields are instantaneous."""
+    ov = [e for e in events if e.get("kind") == "overload"]
+    if not ov:
+        return None
+    rungs: Dict[str, int] = {}
+    tenants: Dict[str, dict] = {}
+    trans = {"open": 0, "half_open": 0, "close": 0}
+    purged = stale = misses = 0
+    for e in ov:
+        rungs[str(e.get("rung", 0))] = \
+            rungs.get(str(e.get("rung", 0)), 0) + 1
+        purged += int(e.get("purged_expired") or 0)
+        stale += int(e.get("stale_served") or 0)
+        misses += int(e.get("deadline_misses") or 0)
+        for t, n in (e.get("admitted") or {}).items():
+            row = tenants.setdefault(
+                t, {"admitted": 0, "sheds": 0, "waits": []})
+            row["admitted"] += int(n)
+        for t, n in (e.get("sheds") or {}).items():
+            row = tenants.setdefault(
+                t, {"admitted": 0, "sheds": 0, "waits": []})
+            row["sheds"] += int(n)
+        for t, ws in (e.get("tenant_waits_ms") or {}).items():
+            row = tenants.setdefault(
+                t, {"admitted": 0, "sheds": 0, "waits": []})
+            row["waits"].extend(float(w) for w in ws
+                                if isinstance(w, (int, float)))
+        br = e.get("breakers") or {}
+        for k, n in (br.get("transitions") or {}).items():
+            if k in trans:
+                trans[k] += int(n)
+    out_tenants: Dict[str, dict] = {}
+    for t, row in tenants.items():
+        seen = row["admitted"] + row["sheds"]
+        waits = sorted(row["waits"])
+        out_tenants[t or "(default)"] = {
+            "admitted": row["admitted"],
+            "sheds": row["sheds"],
+            "shed_rate": (round(row["sheds"] / seen, 3) if seen
+                          else None),
+            "queue_wait_p99_ms": _pctile(waits, 0.99),
+        }
+    last_br = (ov[-1].get("breakers") or {})
+    return {
+        "cycles": len(ov),
+        "rungs": rungs,
+        "max_rung": max((int(e.get("rung") or 0) for e in ov),
+                        default=0),
+        "tenants": out_tenants,
+        "purged_expired": purged,
+        "stale_served": stale,
+        "deadline_misses": misses,
+        "breaker_transitions": trans,
+        "breakers_open_now": last_br.get("open") or [],
+    }
+
+
 def render_summary(events: List[dict]) -> str:
     s = summarize(events)
     lines = [
@@ -359,6 +425,32 @@ def render_summary(events: List[dict]) -> str:
                 f"{k}={v}" for k, v in sorted(
                     rs["fault_sites"].items()))
         lines.append(line)
+    ov = s.get("overload")
+    if ov:
+        line = (f"overload: {ov['cycles']} cycle(s), max rung "
+                f"{ov['max_rung']}; rungs: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(ov["rungs"].items()))
+                + f"; purged {ov['purged_expired']} expired, "
+                  f"{ov['stale_served']} stale-served, "
+                  f"{ov['deadline_misses']} deadline miss(es)")
+        bt = ov.get("breaker_transitions") or {}
+        if any(bt.values()) or ov.get("breakers_open_now"):
+            line += ("; breakers: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(bt.items())))
+            if ov.get("breakers_open_now"):
+                line += (" (open now: "
+                         + ", ".join(ov["breakers_open_now"]) + ")")
+        lines.append(line)
+        if ov.get("tenants"):
+            header = (f"{'tenant':<14}{'admitted':>9}{'sheds':>7}"
+                      f"{'shed rate':>11}{'wait p99':>10}")
+            lines += [header, "-" * len(header)]
+            for t in sorted(ov["tenants"]):
+                d = ov["tenants"][t]
+                lines.append(
+                    f"{t:<14}{d['admitted']:>9}{d['sheds']:>7}"
+                    f"{_fmt(d['shed_rate'], 3):>11}"
+                    f"{_fmt(d['queue_wait_p99_ms']):>10} ms")
     sv = s.get("serve") or {}
     if sv.get("batches"):
         lines.append(

@@ -9,6 +9,12 @@ fault-injection harness at the engine's instrumented choke points
 (:mod:`retry`), and a semantics-preserving plan-degradation ladder
 each retry climbs (:mod:`degrade`). The serve pipeline adds batch
 bisection (poison-query isolation) and typed backpressure on top.
+Round 13 adds the overload control plane's session halves
+(docs/OVERLOAD.md): the adaptive brownout controller
+(:mod:`brownout` — tier-downshift / stale-serve / tenant-shed rungs
+with hysteresis) and per-plan-class circuit breakers
+(:mod:`breaker` — typed ``CircuitOpen`` fail-fast for classes that
+kept failing past the retry budget).
 
 Default config: injects nothing, retries nothing, bit-identical plans
 — every module here is inert until asked.
@@ -16,17 +22,23 @@ Default config: injects nothing, retries nothing, bit-identical plans
 
 from matrel_tpu.resilience.errors import (AdmissionShed,
                                           CheckpointCorruption,
+                                          CircuitOpen,
                                           DeadlineExceeded,
                                           DrainTimeout, InjectedFault,
                                           PipelineClosed, QueryAborted,
                                           ResilienceError, classify,
                                           is_transient)
-from matrel_tpu.resilience import degrade, faults, retry
+from matrel_tpu.resilience import (breaker, brownout, degrade, faults,
+                                   retry)
+from matrel_tpu.resilience.breaker import BreakerRegistry
+from matrel_tpu.resilience.brownout import LoadController
 from matrel_tpu.resilience.retry import Deadline, RetryPolicy
 
 __all__ = [
-    "AdmissionShed", "CheckpointCorruption", "DeadlineExceeded",
-    "DrainTimeout", "InjectedFault", "PipelineClosed", "QueryAborted",
-    "ResilienceError", "classify", "is_transient",
-    "Deadline", "RetryPolicy", "degrade", "faults", "retry",
+    "AdmissionShed", "CheckpointCorruption", "CircuitOpen",
+    "DeadlineExceeded", "DrainTimeout", "InjectedFault",
+    "PipelineClosed", "QueryAborted", "ResilienceError", "classify",
+    "is_transient", "Deadline", "RetryPolicy", "BreakerRegistry",
+    "LoadController", "breaker", "brownout", "degrade", "faults",
+    "retry",
 ]

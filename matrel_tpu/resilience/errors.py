@@ -93,17 +93,56 @@ class PipelineClosed(ResilienceError):
 
 
 class AdmissionShed(ResilienceError):
-    """Backpressure shed: the bounded admission queue
-    (``config.serve_queue_max``) is full, so this submission is
-    REFUSED rather than allowed to grow the queue without bound — the
-    typed load-shedding contract protecting the rest of the stream."""
+    """Backpressure shed: the bounded admission queue is full (the
+    global ``config.serve_queue_max`` bound, or — checked FIRST — this
+    tenant's ``config.serve_tenant_queue_max`` quota), or the brownout
+    controller's rung-3 tenant shed refused the submission. The
+    submission is REFUSED rather than allowed to grow the queue
+    without bound — the typed load-shedding contract protecting the
+    queries already admitted. ``tenant`` names the shed tenant (None
+    for the implicit single tenant); ``scope`` says which bound fired
+    ("tenant" quota / "queue" global / "brownout" rung 3)."""
 
-    def __init__(self, queue_max: int):
+    def __init__(self, queue_max: int, tenant: Optional[str] = None,
+                 scope: str = "queue"):
         self.queue_max = queue_max
+        self.tenant = tenant
+        self.scope = scope
+        who = f" (tenant {tenant!r})" if tenant else ""
+        if scope == "brownout":
+            msg = (f"submission shed{who}: brownout rung 3 sheds "
+                   f"lowest-weight tenants under sustained overload — "
+                   f"retry later")
+        elif scope == "tenant":
+            msg = (f"per-tenant admission quota full{who} "
+                   f"({queue_max} pending); submission shed — retry "
+                   f"later or raise config.serve_tenant_queue_max")
+        else:
+            msg = (f"serve admission queue full ({queue_max} "
+                   f"pending){who}; submission shed — retry later or "
+                   f"raise config.serve_queue_max")
+        super().__init__(msg)
+
+
+class CircuitOpen(ResilienceError):
+    """A plan class's circuit breaker is OPEN
+    (resilience/breaker.py): the class kept failing after the retry
+    budget, so further queries of that class fail FAST instead of
+    burning compile/retry budget the healthy classes need. Carries
+    the half-open probe schedule: ``retry_after_ms`` until the next
+    probe window, ``probes`` allowed then. Never retried (retrying
+    IS what the breaker exists to stop)."""
+
+    def __init__(self, plan_class: str, retry_after_ms: float,
+                 probes: int = 1):
+        self.plan_class = plan_class
+        self.retry_after_ms = retry_after_ms
+        self.probes = probes
         super().__init__(
-            f"serve admission queue full ({queue_max} pending); "
-            f"submission shed — retry later or raise "
-            f"config.serve_queue_max")
+            f"circuit open for plan class {plan_class!r}: the class "
+            f"kept failing past its retry budget — fails fast; "
+            f"half-open probe window ({probes} probe(s)) in "
+            f"{max(retry_after_ms, 0.0):.0f} ms")
 
 
 class CheckpointCorruption(ResilienceError):

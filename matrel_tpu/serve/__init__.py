@@ -17,11 +17,19 @@ serving analogue, three coordinated pieces the session wires together:
                 overlaps host planning of batch N+1 with device
                 execution of batch N, bounded by
                 ``config.serve_max_inflight``.
+  admission     per-tenant weighted-fair admission queue (round 13,
+                docs/OVERLOAD.md): stride-scheduled tenant queues,
+                quota sheds typed BEFORE the global bound, deadline-
+                expired entries purged at the shed decision points.
+                With no tenant weights configured it is bit-identical
+                to the historical FIFO.
 
 ``session.run_many`` is the synchronous batch surface (one MultiPlan,
 session-plan-cached); ``session.submit`` the asynchronous one. See
 docs/SERVING.md for cache semantics, invalidation rules and the QPS
-methodology.
+methodology, and docs/OVERLOAD.md for the overload control plane
+(tenants, brownout, circuit breakers, the traffic harness).
 """
 
+from matrel_tpu.serve.admission import AdmissionQueue  # noqa: F401
 from matrel_tpu.serve.result_cache import CacheEntry, ResultCache  # noqa: F401

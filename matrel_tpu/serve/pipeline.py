@@ -36,6 +36,30 @@ Resilience contracts (docs/RESILIENCE.md):
 - **Typed shutdown**: ``drain(timeout=...)`` raises ``DrainTimeout``
   instead of hanging on a wedged worker; ``submit`` after ``close()``
   raises ``PipelineClosed`` instead of enqueueing into a dead worker.
+
+Overload control plane (docs/OVERLOAD.md, round 13):
+
+- **Per-tenant admission**: the FIFO queue became the weighted-fair
+  :class:`serve.admission.AdmissionQueue` — per-tenant queues,
+  stride-scheduled pops (so batch formation is fair by construction),
+  per-tenant quota sheds BEFORE the global bound, and deadline-expired
+  entries purged at every shed decision point. ``submit`` carries
+  ``tenant=`` and ``staleness_ms=``.
+- **Adaptive brownout**: when the session owns a
+  :class:`resilience.brownout.LoadController` the worker feeds it one
+  sample per admission cycle (queue depth, waits, deadline misses);
+  rung 1 downshifts default-SLA queries to the "fast" tier (stamped,
+  MV112-verified, SLA-key-isolated), rung 2 serves STALE result-cache
+  entries to queries declaring ``staleness_ms``, rung 3 sheds
+  lowest-weight tenants typed at submit.
+- **Circuit breakers**: with a session
+  :class:`resilience.breaker.BreakerRegistry`, each entry's plan
+  class is gated at batch formation — an OPEN class fails its future
+  fast with the typed ``CircuitOpen`` (half-open probe schedule
+  attached) instead of riding a batch it would poison.
+- **Obs**: one ``overload`` event per admission cycle (rung, tenant
+  depths/waits, shed/purge/stale deltas, breaker state) whenever the
+  control plane is active.
 """
 
 from __future__ import annotations
@@ -49,14 +73,22 @@ from concurrent.futures import Future
 from typing import Optional
 
 from matrel_tpu.obs import trace as trace_lib
+from matrel_tpu.resilience import breaker as breaker_lib
+from matrel_tpu.resilience import brownout as brownout_lib
 from matrel_tpu.resilience import faults as faults_lib
 from matrel_tpu.resilience import retry as retry_lib
-from matrel_tpu.resilience.errors import (AdmissionShed,
+from matrel_tpu.resilience.errors import (AdmissionShed, CircuitOpen,
                                           DeadlineExceeded,
                                           DrainTimeout, PipelineClosed)
 from matrel_tpu.resilience.retry import Deadline
+from matrel_tpu.serve.admission import AdmissionQueue
 
 log = logging.getLogger("matrel_tpu.serve")
+
+#: Entry layout: (expr, future, t_enqueue, sla, deadline, tenant,
+#: staleness_ms). Legacy white-box callers enqueue shorter tuples;
+#: the worker right-pads with these defaults.
+_ENTRY_DEFAULTS = ("default", None, "", None)
 
 
 class ServePipeline:
@@ -70,8 +102,8 @@ class ServePipeline:
         self.max_batch = session.config.serve_max_batch
         self.max_inflight = session.config.serve_max_inflight
         self.queue_max = session.config.serve_queue_max
-        self._q: "queue.Queue" = queue.Queue(maxsize=self.queue_max)
-        self._inflight: "collections.deque" = collections.deque()
+        self._q = AdmissionQueue(session.config)
+        self._inflight: "collections.deque" = collections.deque()  # matlint: disable=ML011 bounded by the serve_max_inflight sync loop in _run_group
         self._worker: threading.Thread = None
         self._stop = threading.Event()
         self._closed = False
@@ -79,22 +111,45 @@ class ServePipeline:
         # _ensure_worker (which locks again) so a concurrent close()
         # can never interleave between them
         self._lock = threading.RLock()
+        # overload control plane (session-owned; None when off — the
+        # bit-identity contract): brownout controller + breakers, plus
+        # the last counter snapshot the overload event diffs against
+        self._brownout = getattr(session, "_brownout", None)
+        self._breakers = getattr(session, "_breakers", None)
+        self._overload_active = (
+            self._brownout is not None or self._breakers is not None
+            or bool(self._q.weights))
+        self._overload_last: dict = {}
+        self.stale_served = 0
+        self.deadline_misses = 0
+        # late deadline misses (batch finished past a query's SLA),
+        # folded into the NEXT cycle's controller sample — one
+        # observe() per admission cycle is the hysteresis contract,
+        # so _run_group must not sample mid-batch. Worker-thread-only.
+        self._late_misses = 0
 
     # -- public surface ----------------------------------------------------
 
     def submit(self, expr, sla: str = "default",
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None,
+               staleness_ms: Optional[float] = None) -> Future:
         """Enqueue one query; returns its future. ``sla`` is the
         query's precision SLA — the admission worker only coalesces
         same-SLA queries into one MultiPlan (one planning config per
         batch; mixed SLAs run as separate sub-batches).
         ``deadline_ms`` starts the query's deadline clock NOW (queue
-        wait counts against it)."""
+        wait counts against it). ``tenant`` names the submitting
+        tenant for weighted-fair admission (None = the implicit
+        tenant); ``staleness_ms`` declares how old a STALE result-
+        cache answer this query tolerates (consumed only at brownout
+        rung >= 2 — docs/OVERLOAD.md)."""
         fut: Future = Future()
         dl = Deadline(deadline_ms) if deadline_ms is not None else None
         # enqueue timestamp, not a measurement: its delta lands in the
         # serve event record as queue_wait_ms
-        entry = (expr, fut, time.perf_counter(), sla, dl)  # matlint: disable=ML006 queue-wait timestamp — lands in the serve event record
+        entry = (expr, fut, time.perf_counter(), sla, dl, tenant or "",  # matlint: disable=ML006 queue-wait timestamp — lands in the serve event record
+                 staleness_ms)
         # closed-check + enqueue + worker-ensure are ONE atomic step
         # vs close(): a submit that passes the check enqueues with the
         # worker alive BEFORE close() can flip _closed, and close()'s
@@ -106,14 +161,20 @@ class ServePipeline:
                     "submit after close(): the admission worker is "
                     "stopped — build a new session (or pipeline) to "
                     "serve again")
-            try:
-                self._q.put_nowait(entry)
-            except queue.Full:
-                # typed load shed: the bounded queue protects the
-                # queries already admitted — growing it unboundedly
-                # would trade one caller's latency for every caller's
-                # memory
-                raise AdmissionShed(self.queue_max) from None
+            # brownout rung 3: shed lowest-weight tenants FIRST —
+            # typed, before any queue slot is consumed
+            ctl = self._brownout
+            if (ctl is not None
+                    and ctl.rung() >= brownout_lib.SHED_RUNG
+                    and self._q.lowest_weight_tenant(tenant)):
+                self._q.record_shed(tenant)
+                raise AdmissionShed(self._q.tenant_max
+                                    or self._q.global_max,
+                                    tenant=tenant, scope="brownout")
+            # typed load shed (per-tenant quota first, then the global
+            # bound — each after purging deadline-expired entries):
+            # the bounded queue protects the queries already admitted
+            self._q.put(entry, tenant or "")
             self._ensure_worker()
         return fut
 
@@ -194,11 +255,11 @@ class ServePipeline:
                     pulled.append(self._q.get_nowait())
                 except queue.Empty:
                     break
-            # normalise legacy short entries (pre-SLA white-box callers
-            # enqueue (expr, fut, t_enq); pre-deadline ones the
-            # 4-tuple) to the 5-tuple shape
-            pulled = [(*it, *(("default", None)[len(it) - 3:]))
-                      if len(it) < 5 else it for it in pulled]
+            # normalise legacy short entries (pre-SLA white-box
+            # callers enqueue (expr, fut, t_enq); later rounds added
+            # sla / deadline / tenant / staleness) to the 7-tuple
+            pulled = [(*it, *_ENTRY_DEFAULTS[len(it) - 3:])
+                      if len(it) < 7 else it for it in pulled]
             # transition each future to RUNNING; a future the caller
             # cancelled while queued drops out here (and can no longer
             # be cancelled mid-flight) — set_result on a cancelled
@@ -206,18 +267,85 @@ class ServePipeline:
             # stranding every sibling future of the batch
             batch = [it for it in pulled
                      if it[1].set_running_or_notify_cancel()]
+            t_admit = time.perf_counter()  # matlint: disable=ML006 queue-wait timestamp — lands in the serve event record
+            cycle_waits = [round((t_admit - it[2]) * 1e3, 3)
+                           for it in batch]
             # deadline shed BEFORE compilation: an entry that expired
             # while queued resolves typed and never costs a compile
             live = []
+            misses = 0
             for it in batch:
                 dl = it[4]
                 if dl is not None and dl.expired():
                     _fail(it[1], DeadlineExceeded(
                         dl.budget_ms, dl.elapsed_ms(),
                         context="queued query"))
+                    misses += 1
                 else:
                     live.append(it)
-            t_admit = time.perf_counter()  # matlint: disable=ML006 queue-wait timestamp — lands in the serve event record
+            self.deadline_misses += misses
+            # circuit breakers: an entry whose plan class is OPEN
+            # fails fast (typed, probe schedule attached) instead of
+            # riding — and poisoning — a batch
+            if self._breakers is not None:
+                admitted = []
+                for it in live:
+                    try:
+                        self._breakers.admit(
+                            self._breakers.plan_class(it[0]))
+                    except CircuitOpen as ex:
+                        _fail(it[1], ex)
+                    else:
+                        admitted.append(it)
+                live = admitted
+            # per-tenant queue waits AT ADMISSION (t_admit) — both the
+            # controller and the overload event read these; measuring
+            # at emission time would fold compile/dispatch time into
+            # a number named "queue wait"
+            tenant_waits: dict = {}
+            for it, w in zip(batch, cycle_waits):
+                tenant_waits.setdefault(it[5] or "", []).append(w)
+            # brownout: ONE load sample per admission cycle (late
+            # deadline misses from earlier batches fold in here), then
+            # act on the (possibly new) rung
+            rung = 0
+            ctl = self._brownout
+            if ctl is not None:
+                late, self._late_misses = self._late_misses, 0
+                rung = ctl.observe(depth=self._q.qsize(),
+                                   waits_ms=cycle_waits,
+                                   misses=misses + late,
+                                   admitted=len(live))
+            stale_served = 0
+            if (rung >= brownout_lib.STALE_RUNG
+                    and self.session._rc_enabled()):
+                # rung 2: a query that DECLARED a staleness tolerance
+                # may be answered by the stale ghost of a rebind-
+                # invalidated entry — exact answer, slightly old
+                # catalog; nothing compiles, nothing executes
+                remaining = []
+                for it in live:
+                    ent = (self.session._rc_stale_probe(
+                        it[0], it[3], it[6]) if it[6] else None)
+                    if ent is not None:
+                        if not it[1].done():
+                            it[1].set_result(ent.result)
+                        stale_served += 1
+                        # a cache hit says NOTHING about the class's
+                        # execution health — release the (possibly
+                        # half-open probe) slot without a transition,
+                        # never close a breaker on work that never ran
+                        self._breaker_done(it[0], None)
+                    else:
+                        remaining.append(it)
+                live = remaining
+                self.stale_served += stale_served
+            if rung >= brownout_lib.TIER_RUNG:
+                # rung 1: default-SLA queries downshift to the "fast"
+                # tier, STAMPED on the expr root so MV112 can verify
+                # the claim and the prec:fast| key prefix isolates the
+                # browned-out plan/result from full-fidelity ones
+                live = [self._downshift(it, rung) for it in live]
             # same-SLA sub-batches, admission order preserved: one
             # MultiPlan compiles under ONE planning config, so a
             # "fast" submission must never ride an "exact" query's
@@ -227,18 +355,103 @@ class ServePipeline:
                 groups.setdefault(it[3], []).append(it)
             try:
                 for sla, part in groups.items():
-                    self._admit_group(sla, part, t_admit)
+                    self._admit_group(sla, part, t_admit, rung)
             finally:
                 for _ in pulled:
                     self._q.task_done()
+                if self._overload_active:
+                    self._emit_overload(rung, tenant_waits, misses,
+                                        stale_served)
 
-    def _admit_group(self, sla: str, batch: list,
-                     t_admit: float) -> None:
+    @staticmethod
+    def _downshift(it, rung: int):
+        """Rung >= 1: rewrite one entry's expr/sla for the fast tier.
+        Non-default SLAs pass through untouched — an explicit accuracy
+        ask is an ask, brownout only downgrades the defaults. The
+        stamp carries the AUTHORIZING rung (brownout.downshift_stamp),
+        so every downshifted plan shares one cache key regardless of
+        the controller's instantaneous rung."""
+        if it[3] != "default":
+            return it
+        stamp = brownout_lib.downshift_stamp(
+            it[6] if rung >= brownout_lib.STALE_RUNG else None)
+        e = it[0].with_attrs(brownout=stamp)
+        return (e, it[1], it[2], "fast", it[4], it[5], it[6])
+
+    def _breaker_done(self, expr, ok, ex: BaseException = None) -> None:
+        """Record one admitted entry's terminal outcome against its
+        plan-class breaker (no-op when breakers are off). Outcomes
+        that say nothing about the class — deadline, shed, abort —
+        release the probe slot without a transition."""
+        if self._breakers is None:
+            return
+        cls = self._breakers.plan_class(expr)
+        if ok:
+            self._breakers.record(cls, True)
+        elif ex is not None and breaker_lib.counts_as_failure(ex):
+            self._breakers.record(cls, False)
+        else:
+            self._breakers.record(cls, None)
+
+    def _emit_overload(self, rung: int, tenant_waits: dict,
+                       misses: int, stale_served: int) -> None:
+        """One ``overload`` record per admission cycle while the
+        control plane is active: instantaneous rung/depths, this
+        cycle's per-tenant ADMISSION-TIME waits (the same numbers the
+        controller sampled), and shed/purge/breaker-transition DELTAS
+        (cumulative counters diffed against the last cycle — the
+        multi-session-log discipline of the serve roll-up)."""
+        sess = self.session
+        if not (sess._obs_enabled() or sess._flight is not None):
+            return
+        try:
+            counters = self._q.counters()
+            last = self._overload_last
+            shed_delta = {
+                t: n - last.get("sheds", {}).get(t, 0)
+                for t, n in counters["sheds"].items()
+                if n - last.get("sheds", {}).get(t, 0)}
+            admitted = {t: len(ws) for t, ws in tenant_waits.items()}
+            rec = {
+                "rung": rung,
+                "rung_label": brownout_lib.rung_label(rung),
+                "queue_depth": self._q.qsize(),
+                "tenant_depths": self._q.tenant_depths(),
+                "admitted": admitted,
+                "tenant_waits_ms": tenant_waits,
+                "sheds": shed_delta,
+                "purged_expired": (counters["purged_expired"]
+                                   - last.get("purged_expired", 0)),
+                "deadline_misses": misses,
+                "stale_served": stale_served,
+            }
+            if self._brownout is not None:
+                rec["brownout"] = self._brownout.snapshot()
+            if self._breakers is not None:
+                snap = self._breakers.snapshot()
+                lt = last.get("breaker_transitions", {})
+                rec["breakers"] = {
+                    "open": snap["open"],
+                    "half_open": snap["half_open"],
+                    "transitions": {
+                        k: v - lt.get(k, 0)
+                        for k, v in snap["transitions"].items()},
+                }
+                counters["breaker_transitions"] = snap["transitions"]
+            self._overload_last = counters
+            sess._emit_overload_event(rec)
+        except Exception:   # the never-fail obs contract
+            log.warning("obs: overload event dropped", exc_info=True)
+
+    def _admit_group(self, sla: str, batch: list, t_admit: float,
+                     rung: int = 0) -> None:
         self._run_group(sla, batch, t_admit, depth=0,
-                        retries=self.session.config.retry_max_attempts)
+                        retries=self.session.config.retry_max_attempts,
+                        rung=rung)
 
     def _run_group(self, sla: str, batch: list, t_admit: float,
-                   depth: int, retries: int = 0) -> None:
+                   depth: int, retries: int = 0,
+                   rung: int = 0) -> None:
         """Run one same-SLA sub-batch through session.run_many and
         resolve its futures. A failing batch BISECTS: the halves
         re-admit independently, so one poison query fails only its own
@@ -250,7 +463,7 @@ class ServePipeline:
         if not batch:
             return
         waits_ms = [round((t_admit - t_enq) * 1e3, 3)
-                    for _, _, t_enq, _, _ in batch]
+                    for _, _, t_enq, *_ in batch]
         try:
             # fault site "serve_admit" INSIDE the try: an injected
             # admission fault exercises the same bisection/re-admission
@@ -270,10 +483,12 @@ class ServePipeline:
                         max_wait_ms=(max(waits_ms)
                                      if waits_ms else 0.0)):
                 outs = self.session.run_many(
-                    [e for e, _, _, _, _ in batch],
+                    [it[0] for it in batch],
                     precision=sla,
                     _queue_wait_ms=waits_ms,
-                    _inflight_depth=len(self._inflight))
+                    _inflight_depth=len(self._inflight),
+                    _tenants=[it[5] for it in batch],
+                    _brownout_rung=rung or None)
         except Exception as ex:  # noqa: BLE001 — any planning/
             # compile/execute failure either bisects (isolating the
             # poison query), re-admits a transient single, or fails
@@ -292,8 +507,11 @@ class ServePipeline:
                         emit(ex, attempt=depth + 1, rung=0,
                              scope="serve_readmit")
                     self._run_group(sla, batch, t_admit, depth + 1,
-                                    retries=retries - 1)
+                                    retries=retries - 1, rung=rung)
                 else:
+                    # TERMINAL single-query failure: the breaker's
+                    # class-health signal (retry budget already spent)
+                    self._breaker_done(batch[0][0], False, ex)
                     _fail(batch[0][1], ex)
                 return
             # POISON ISOLATION: split and re-admit each half — only
@@ -304,21 +522,30 @@ class ServePipeline:
                      scope="serve_bisect")
             mid = len(batch) // 2
             self._run_group(sla, batch[:mid], t_admit, depth + 1,
-                            retries=retries)
+                            retries=retries, rung=rung)
             self._run_group(sla, batch[mid:], t_admit, depth + 1,
-                            retries=retries)
+                            retries=retries, rung=rung)
         else:
-            for (_, fut, _, _, dl), out in zip(batch, outs):
+            for it, out in zip(batch, outs):
+                fut, dl = it[1], it[4]
                 if dl is not None and dl.expired():
                     # the batch finished past this query's deadline:
                     # the future resolves TYPED (the result exists but
                     # the caller's SLA already failed — honoring it
-                    # beats handing back a late answer marked on-time)
+                    # beats handing back a late answer marked on-time).
+                    # The miss folds into the NEXT cycle's controller
+                    # sample (one observe per cycle — the hysteresis
+                    # dwell must not be advanced mid-batch).
+                    self.deadline_misses += 1
+                    self._late_misses += 1
+                    self._breaker_done(it[0], None)
                     _fail(fut, DeadlineExceeded(
                         dl.budget_ms, dl.elapsed_ms(),
                         context="served query"))
-                elif not fut.done():
-                    fut.set_result(out)
+                else:
+                    self._breaker_done(it[0], True)
+                    if not fut.done():
+                        fut.set_result(out)
             if outs:
                 self._inflight.append(outs)
             while len(self._inflight) > self.max_inflight:

@@ -35,6 +35,7 @@ from typing import FrozenSet, Optional, Tuple
 import numpy as np
 
 from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.resilience.retry import now as _now
 
 
 def result_nbytes(result: BlockMatrix) -> int:
@@ -94,6 +95,18 @@ class ResultCache:
         self.interior_hits = 0
         self.evicted = 0
         self.invalidated = 0
+        # brownout stale graveyard (docs/OVERLOAD.md): entries a
+        # rebind invalidated, kept with their invalidation timestamp
+        # so rung >= 2 can serve them to queries declaring a
+        # staleness_ms tolerance. Populated ONLY when the session asks
+        # (keep_stale=True — a brownout controller exists); the
+        # default path drops invalidated entries exactly as before.
+        # Bounded in ENTRIES and BYTES (stale results stay device-
+        # pinned — an entry-only bound would let a few huge ghosts
+        # retain device memory far past the live cache's byte budget).
+        self._stale: "OrderedDict[str, tuple]" = OrderedDict()
+        self._stale_bytes = 0
+        self.stale_hits = 0
 
     def lookup(self, key: str) -> Optional[CacheEntry]:
         with self._lock:
@@ -131,6 +144,11 @@ class ResultCache:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old.nbytes
+            # a fresh result supersedes any stale ghost of the key
+            ghost = self._stale.pop(key, None)
+            if ghost is not None:
+                self._stale_bytes = max(
+                    self._stale_bytes - ghost[0].nbytes, 0)
             self._entries[key] = entry
             self._bytes += entry.nbytes
             while self._entries and (
@@ -143,24 +161,73 @@ class ResultCache:
             self._bytes = max(self._bytes, 0)
             return True
 
-    def invalidate_deps(self, matrix_ids) -> int:
+    def invalidate_deps(self, matrix_ids, keep_stale: bool = False,
+                        stale_max: int = 0,
+                        stale_max_bytes: int = 0) -> int:
         """Drop every entry whose dep set intersects ``matrix_ids``
         (id() values of LIVE matrices — see module docstring for why
-        this comparison is safe). Returns the number dropped."""
+        this comparison is safe). Returns the number dropped.
+
+        ``keep_stale`` moves the invalidated entries into the stale
+        graveyard (stamped with the invalidation clock) instead of
+        discarding them — the brownout rung-2 substrate — bounded to
+        the newest ``stale_max`` entries AND ``stale_max_bytes``
+        device bytes (stale results stay device-pinned; the session
+        passes the live cache's own byte budget, so ghosts can never
+        retain more device memory than the cache itself is allowed).
+        The default (False) is bit-identical to the historical drop."""
         ids = frozenset(matrix_ids)
         with self._lock:
             stale = [k for k, e in self._entries.items()
                      if e.dep_ids & ids]
+            t = _now()
             for k in stale:
-                self._bytes -= self._entries.pop(k).nbytes
+                ent = self._entries.pop(k)
+                self._bytes -= ent.nbytes
+                if keep_stale and stale_max > 0 \
+                        and 0 < ent.nbytes <= stale_max_bytes:
+                    old = self._stale.pop(k, None)
+                    if old is not None:
+                        self._stale_bytes -= old[0].nbytes
+                    self._stale[k] = (ent, t)
+                    self._stale_bytes += ent.nbytes
+                    while self._stale and (
+                            len(self._stale) > stale_max
+                            or self._stale_bytes > stale_max_bytes):
+                        _, (dropped, _t) = self._stale.popitem(
+                            last=False)
+                        self._stale_bytes -= dropped.nbytes
+                    self._stale_bytes = max(self._stale_bytes, 0)
             self.invalidated += len(stale)
             self._bytes = max(self._bytes, 0)
             return len(stale)
 
+    def lookup_stale(self, key: str, max_age_ms: float
+                     ) -> Optional[CacheEntry]:
+        """Brownout rung-2 consult: the STALE entry for ``key``, iff
+        its age since invalidation fits the query's declared
+        ``staleness_ms`` tolerance. Entries older than the asking
+        query's tolerance stay (a later query may tolerate more);
+        the graveyard stays bounded by the insert-side cap."""
+        if max_age_ms is None or max_age_ms <= 0:
+            return None
+        with self._lock:
+            got = self._stale.get(key)
+            if got is None:
+                return None
+            ent, t_stale = got
+            if (_now() - t_stale) * 1e3 > max_age_ms:
+                return None
+            self._stale.move_to_end(key)
+            self.stale_hits += 1
+            return ent
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._stale.clear()
             self._bytes = 0
+            self._stale_bytes = 0
 
     def info(self) -> dict:
         """``plan_cache_info``-style observability snapshot."""
@@ -171,4 +238,7 @@ class ResultCache:
                     "misses": self.misses,
                     "interior_hits": self.interior_hits,
                     "evicted": self.evicted,
-                    "invalidated": self.invalidated}
+                    "invalidated": self.invalidated,
+                    "stale_entries": len(self._stale),
+                    "stale_bytes": self._stale_bytes,
+                    "stale_hits": self.stale_hits}

@@ -31,6 +31,8 @@ from matrel_tpu.core import mesh as mesh_lib
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.ir.expr import MatExpr, as_expr
 from matrel_tpu.obs import trace as trace_lib
+from matrel_tpu.resilience import breaker as breaker_lib
+from matrel_tpu.resilience import brownout as brownout_lib
 from matrel_tpu.resilience import degrade as degrade_lib
 from matrel_tpu.resilience import errors as rerrors
 from matrel_tpu.resilience import faults as faults_lib
@@ -83,6 +85,13 @@ class MatrelSession:
                         if (self._flight is not None
                             or self.config.obs_level != "off")
                         else None)
+        # overload control plane (docs/OVERLOAD.md): adaptive brownout
+        # controller + per-plan-class circuit breakers — both None for
+        # the default config (the structural zero-object contract the
+        # faults harness set: nothing constructed, nothing consulted)
+        self._brownout = brownout_lib.from_config(self.config)
+        self._breakers = breaker_lib.BreakerRegistry.from_config(
+            self.config)
 
     # -- builder (MatfastSession.builder().getOrCreate() analogue) ---------
 
@@ -137,8 +146,17 @@ class MatrelSession:
             # — drop them (and their pinned device bytes) now, not at
             # some later false hit. Dep sets are transitive, so results
             # built FROM cached intermediates of the old binding drop
-            # too. Safe when the cache is off/empty (no-op).
-            self._result_cache.invalidate_deps({id(old)})
+            # too. Safe when the cache is off/empty (no-op). With a
+            # brownout controller the invalidated entries move to the
+            # bounded STALE graveyard instead: rung 2 may serve them to
+            # queries declaring a staleness_ms tolerance
+            # (docs/OVERLOAD.md); the default path drops them exactly
+            # as before.
+            self._result_cache.invalidate_deps(
+                {id(old)},
+                keep_stale=self._brownout is not None,
+                stale_max=self.config.result_cache_max_entries,
+                stale_max_bytes=self.config.result_cache_max_bytes)
 
     def table(self, name: str) -> BlockMatrix:
         return self.catalog[name]
@@ -473,6 +491,20 @@ class MatrelSession:
         walk(e)
         return frozenset(deps)
 
+    def _rc_stale_probe(self, e: MatExpr, sla: str,
+                        staleness_ms: Optional[float]):
+        """Brownout rung-2 consult (docs/OVERLOAD.md): the STALE
+        result-cache entry for this query, iff the query declared a
+        ``staleness_ms`` tolerance its age fits. Same structural key +
+        precision prefix as a live consult, so a stale "fast" result
+        can never answer an "exact" query either."""
+        if (not self._rc_enabled() or not staleness_ms
+                or staleness_ms <= 0):
+            return None
+        parts, _pins, _spans = _plan_key_spans(e)
+        key = _prec_prefix(sla) + "|".join(parts)
+        return self._result_cache.lookup_stale(key, staleness_ms)
+
     def _rc_insert(self, key: str, pins: list, executed: MatExpr,
                    out: BlockMatrix) -> None:
         """Cache one executed query result under its structural key.
@@ -561,7 +593,8 @@ class MatrelSession:
     def _emit_query_event(self, e: MatExpr, plan, hit: bool, key: str,
                           execute_ms: float, first_execution: bool,
                           out: BlockMatrix, matmuls=None,
-                          rule_hits=None, batch=None) -> None:
+                          rule_hits=None, batch=None,
+                          tenant: Optional[str] = None) -> None:
         """One event-log record + metrics-registry updates per query run.
         Assembled entirely OUTSIDE jitted code, from data the compile
         path already produced (plan.meta) — the only device sync the obs
@@ -604,6 +637,10 @@ class MatrelSession:
         }
         if batch is not None:
             record["batch"] = batch
+        if tenant:
+            # multi-tenant attribution (docs/OVERLOAD.md): absent for
+            # untagged queries, so historical records are unchanged
+            record["tenant"] = tenant
         if meta.get("fusion"):
             # plan-level fusion roll-up (executor._fusion_meta):
             # regions, member census, est saved dispatches/HBM — the
@@ -676,7 +713,8 @@ class MatrelSession:
         return analysis.verify_plan(opt, self.mesh, self.config)
 
     def _emit_rc_hit_event(self, e: MatExpr, key: str,
-                           out: BlockMatrix) -> None:
+                           out: BlockMatrix,
+                           tenant: Optional[str] = None) -> None:
         """Query record for a WHOLE-query result-cache hit: nothing
         compiled, nothing executed — the record says so (``cache:
         "rc_hit"``, no matmuls, zero execute) and carries the cache
@@ -684,6 +722,7 @@ class MatrelSession:
         from matrel_tpu.obs.metrics import REGISTRY
         sql_hash = getattr(e, "_sql_hash", None)
         self._obs_emit("query", {
+            **({"tenant": tenant} if tenant else {}),
             "query_id": f"q{os.getpid()}-{next(_query_seq)}",
             "source": "sql" if sql_hash else "dsl",
             "source_hash": sql_hash
@@ -723,8 +762,22 @@ class MatrelSession:
         REGISTRY.gauge("result_cache.bytes").set(
             record["result_cache"]["bytes"])
 
-    def _run_observed(self, e: MatExpr, plan, hit: bool, key: str
-                      ) -> BlockMatrix:
+    def _emit_overload_event(self, record: dict) -> None:
+        """One ``overload`` record per admission cycle while the
+        control plane is active (serve/pipeline.py assembles it:
+        rung, tenant depths/waits, shed/purge/stale deltas, breaker
+        state) — the feed for ``history --summary``'s overload
+        roll-up. Never fails a query."""
+        from matrel_tpu.obs.metrics import REGISTRY
+        try:
+            self._obs_emit("overload", record)
+            REGISTRY.gauge("overload.rung").set(
+                record.get("rung", 0))
+        except Exception:
+            log.warning("obs: overload event dropped", exc_info=True)
+
+    def _run_observed(self, e: MatExpr, plan, hit: bool, key: str,
+                      tenant: Optional[str] = None) -> BlockMatrix:
         """Execute one compiled plan with the obs timing/emission
         wrapper (the obs-on half of compute())."""
         first = not getattr(plan, "_obs_executed", False)
@@ -738,7 +791,7 @@ class MatrelSession:
         plan._obs_executed = True
         try:
             self._emit_query_event(e, plan, hit, key, execute_ms, first,
-                                   out)
+                                   out, tenant=tenant)
             self._emit_verify_event(plan)
         except Exception:   # the result is already computed — keep the
             # never-fail-a-query contract (obs/events.py) even when
@@ -748,13 +801,17 @@ class MatrelSession:
 
     def compute(self, expr: MatExpr,
                 precision: Optional[str] = None,
-                deadline_ms: Optional[float] = None) -> BlockMatrix:
+                deadline_ms: Optional[float] = None,
+                tenant: Optional[str] = None) -> BlockMatrix:
         """Execute one query. ``precision`` is the per-query accuracy
         SLA ("exact"/"high"/"fast"/explicit dtype — docs/PRECISION.md);
         None defers to a SQL PRECISION clause, then
         ``config.precision_sla``. ``deadline_ms`` is the per-query
         deadline (None defers to ``config.deadline_ms``; expiry raises
-        the typed ``DeadlineExceeded`` — docs/RESILIENCE.md)."""
+        the typed ``DeadlineExceeded`` — docs/RESILIENCE.md).
+        ``tenant`` tags the query's obs records for the multi-tenant
+        roll-up (admission fairness itself lives in the async
+        ``submit`` pipeline — docs/OVERLOAD.md)."""
         e = as_expr(expr)
         sla = self._resolve_sla(precision, e)
         # resilience gate (retry/deadline/fault-injection): None for
@@ -762,8 +819,30 @@ class MatrelSession:
         # path is never entered and costs nothing
         pol = RetryPolicy.from_config(self.config, deadline_ms)
         rc = self._rc_enabled()
+        if self._breakers is None:
+            return self._compute_dispatch(e, sla, pol, rc, tenant)
+        # circuit breakers (resilience/breaker.py): an OPEN plan class
+        # fails fast typed; terminal outcomes feed the class's health
+        bclass = self._breakers.plan_class(e)
+        self._breakers.admit(bclass)
+        try:
+            out = self._compute_dispatch(e, sla, pol, rc, tenant)
+        except Exception as ex:
+            self._breakers.record(
+                bclass,
+                False if breaker_lib.counts_as_failure(ex) else None)
+            raise
+        self._breakers.record(bclass, True)
+        return out
+
+    def _compute_dispatch(self, e: MatExpr, sla: str,
+                          pol: Optional[RetryPolicy], rc: bool,
+                          tenant: Optional[str]) -> BlockMatrix:
+        """compute() behind the breaker gate: the resilient / fast /
+        observed three-way the engine has always had."""
         if pol is not None:
-            return self._compute_resilient(e, rc, sla, pol)
+            return self._compute_resilient(e, rc, sla, pol,
+                                           tenant=tenant)
         if (not rc and not self._obs_enabled()
                 and self._tracer is None):
             # the production path: zero event assembly, zero extra
@@ -776,11 +855,12 @@ class MatrelSession:
         # every span below parent-link into this query's trail
         with trace_lib.activate(self._tracer), \
                 trace_lib.span("query", root_kind=e.kind):
-            return self._compute_observed(e, rc, sla)
+            return self._compute_observed(e, rc, sla, tenant=tenant)
 
     def _compute_observed(self, e: MatExpr, rc: bool,
                           sla: Optional[str] = None,
-                          rung: int = 0) -> BlockMatrix:
+                          rung: int = 0,
+                          tenant: Optional[str] = None) -> BlockMatrix:
         """compute() behind the fast-path gate: result-cache admission,
         compile, execute — each scoped by a tracing span. ``rung`` is
         the resilient path's degradation-ladder step (0 = none)."""
@@ -795,7 +875,8 @@ class MatrelSession:
                 # cache — no optimize, no trace, no device work
                 if self._obs_enabled():
                     try:
-                        self._emit_rc_hit_event(e, key, ent.result)
+                        self._emit_rc_hit_event(e, key, ent.result,
+                                                tenant=tenant)
                     except Exception:
                         log.warning("obs: query event dropped",
                                     exc_info=True)
@@ -806,7 +887,7 @@ class MatrelSession:
         # retryable site (per attempt, unlike the trace-time sites)
         faults_lib.check("execute", self.config)
         if self._obs_enabled():
-            out = self._run_observed(e, plan, hit, pkey)
+            out = self._run_observed(e, plan, hit, pkey, tenant=tenant)
         else:
             # flight-recorder-only tier: the span marks DISPATCH (JAX
             # async — deliberately no added sync; always-cheap)
@@ -820,7 +901,8 @@ class MatrelSession:
 
     def _compute_resilient(self, e: MatExpr, rc: bool, sla: str,
                            pol: RetryPolicy,
-                           should_abort=None) -> BlockMatrix:
+                           should_abort=None,
+                           tenant: Optional[str] = None) -> BlockMatrix:
         """The attempt loop: run the query; on a TRANSIENT failure
         (errors.classify) retry with backoff, climbing one rung of the
         plan-degradation ladder per retry (resilience/degrade.py) —
@@ -839,7 +921,7 @@ class MatrelSession:
                                        attempt=attempt, rung=rung):
                     out = self._compute_observed(
                         e, rc and rung < degrade_lib.RC_BYPASS_RUNG,
-                        sla, rung=rung)
+                        sla, rung=rung, tenant=tenant)
                 # deadline holds on SUCCESS too: a result delivered
                 # past the SLA raises typed, matching submit()'s
                 # late-batch semantics (one meaning per knob)
@@ -901,8 +983,12 @@ class MatrelSession:
 
     def run_many(self, exprs, precision: Optional[str] = None,
                  deadline_ms: Optional[float] = None,
+                 tenant: Optional[str] = None,
                  _queue_wait_ms=None,
-                 _inflight_depth: int = 0) -> List[BlockMatrix]:
+                 _inflight_depth: int = 0,
+                 _tenants=None,
+                 _brownout_rung: Optional[int] = None
+                 ) -> List[BlockMatrix]:
         """Execute several queries as ONE micro-batched admission: the
         batch compiles into a single MultiPlan (one fusion and CSE
         domain, shared leaf transfers — duplicate roots dedupe on their
@@ -921,30 +1007,42 @@ class MatrelSession:
         ``config.deadline_ms``): expiry between retry attempts raises
         the typed ``DeadlineExceeded`` for the whole batch.
 
+        ``tenant`` tags the whole batch for the multi-tenant obs
+        roll-up (the serve pipeline instead passes per-query
+        ``_tenants``).
+
         The underscore parameters are the serve pipeline's channel for
-        queue-wait/in-flight observability; direct callers leave them
-        alone."""
+        queue-wait/in-flight/tenant/brownout observability; direct
+        callers leave them alone."""
         es = [as_expr(x) for x in exprs]
         if not es:
             return []
+        if _tenants is None and tenant:
+            _tenants = [tenant] * len(es)
         sla = (normalize_sla(precision) if precision is not None
                else self.config.precision_sla)
         pol = RetryPolicy.from_config(self.config, deadline_ms)
         if pol is not None:
             return self._run_many_resilient(es, sla, pol,
                                             _queue_wait_ms,
-                                            _inflight_depth)
+                                            _inflight_depth,
+                                            _tenants=_tenants,
+                                            _brownout_rung=_brownout_rung)
         rc = self._rc_enabled()
         obs = self._obs_enabled()
         with trace_lib.activate(self._tracer), \
                 trace_lib.span("serve.batch", size=len(es)) as sp_batch:
             return self._run_many_observed(es, rc, obs, sp_batch,
                                            _queue_wait_ms,
-                                           _inflight_depth, sla)
+                                           _inflight_depth, sla,
+                                           _tenants=_tenants,
+                                           _brownout_rung=_brownout_rung)
 
     def _run_many_resilient(self, es, sla: str, pol: RetryPolicy,
                             _queue_wait_ms, _inflight_depth,
-                            should_abort=None) -> List[BlockMatrix]:
+                            should_abort=None, _tenants=None,
+                            _brownout_rung: Optional[int] = None
+                            ) -> List[BlockMatrix]:
         """``_compute_resilient``'s batch twin: the whole MultiPlan
         retries as one unit, climbing the same ladder (poison-query
         ISOLATION is the serve worker's bisection, not this loop —
@@ -964,7 +1062,9 @@ class MatrelSession:
                                        rung=rung) as sp_batch:
                     outs = self._run_many_observed(
                         es, rc, obs, sp_batch, _queue_wait_ms,
-                        _inflight_depth, sla, rung=rung)
+                        _inflight_depth, sla, rung=rung,
+                        _tenants=_tenants,
+                        _brownout_rung=_brownout_rung)
                 # SLA semantics match _compute_resilient/submit: a
                 # batch finishing past its deadline raises typed
                 deadline.raise_if_expired(context="batch")
@@ -985,8 +1085,14 @@ class MatrelSession:
     def _run_many_observed(self, es, rc, obs, sp_batch, _queue_wait_ms,
                            _inflight_depth,
                            sla: Optional[str] = None,
-                           rung: int = 0) -> List[BlockMatrix]:
+                           rung: int = 0, _tenants=None,
+                           _brownout_rung: Optional[int] = None
+                           ) -> List[BlockMatrix]:
         sla = sla if sla is not None else self.config.precision_sla
+
+        def _tenant_of(i):
+            return (_tenants[i] if _tenants is not None
+                    and i < len(_tenants) else None)
         results: dict = {}
         rc_meta: dict = {}
         pend: list = []
@@ -1000,7 +1106,9 @@ class MatrelSession:
                     results[i] = ent.result
                     if obs:
                         try:
-                            self._emit_rc_hit_event(e, key, ent.result)
+                            self._emit_rc_hit_event(
+                                e, key, ent.result,
+                                tenant=_tenant_of(i))
                         except Exception:
                             log.warning("obs: query event dropped",
                                         exc_info=True)
@@ -1048,7 +1156,8 @@ class MatrelSession:
                             rule_hits=({} if (j > 0 or plan_hit)
                                        else (plan.meta or {}).get(
                                            "rule_hits", {})),
-                            batch={"size": len(es), "index": i})
+                            batch={"size": len(es), "index": i},
+                            tenant=_tenant_of(i))
                     except Exception:
                         log.warning("obs: query event dropped",
                                     exc_info=True)
@@ -1060,7 +1169,7 @@ class MatrelSession:
                                 exc_info=True)
         if obs:
             try:
-                self._emit_serve_event({
+                record = {
                     "batch_size": len(es),
                     "executed": len(pend),
                     "rc_hits": len(es) - len(pend),
@@ -1069,13 +1178,27 @@ class MatrelSession:
                     "inflight_depth": _inflight_depth,
                     "execute_ms": round(execute_ms, 3),
                     "wall_ms": round(sp_batch.elapsed_ms() or 0.0, 3),
-                })
+                }
+                if _tenants is not None:
+                    # per-tenant batch census (docs/OVERLOAD.md):
+                    # absent for untagged batches — historical records
+                    # unchanged
+                    census: dict = {}
+                    for t in _tenants:
+                        key_t = t or ""
+                        census[key_t] = census.get(key_t, 0) + 1
+                    record["tenants"] = census
+                if _brownout_rung:
+                    record["brownout_rung"] = _brownout_rung
+                self._emit_serve_event(record)
             except Exception:
                 log.warning("obs: serve event dropped", exc_info=True)
         return [results[i] for i in range(len(es))]
 
     def submit(self, expr, precision: Optional[str] = None,
-               deadline_ms: Optional[float] = None):
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None,
+               staleness_ms: Optional[float] = None):
         """Asynchronous query admission: returns a
         ``concurrent.futures.Future`` resolving to the BlockMatrix.
         Concurrent submissions coalesce into micro-batches
@@ -1091,7 +1214,15 @@ class MatrelSession:
         queued — or whose batch finishes past it — resolves with the
         typed ``DeadlineExceeded``. Submitting into a CLOSED pipeline
         raises the typed ``PipelineClosed``; a full bounded queue
-        (``config.serve_queue_max``) raises ``AdmissionShed``."""
+        (per-tenant ``config.serve_tenant_queue_max`` quota first,
+        then the global ``config.serve_queue_max``) raises the typed
+        ``AdmissionShed``.
+
+        ``tenant`` names the submitting tenant for weighted-fair
+        admission (``config.serve_tenant_weights`` —
+        docs/OVERLOAD.md); ``staleness_ms`` declares how old a STALE
+        result-cache answer this query tolerates (consumed only at
+        brownout rung >= 2; None/0 = never served stale)."""
         if self._serve is None:
             from matrel_tpu.serve.pipeline import ServePipeline
             # under the lock: two concurrent FIRST submissions must not
@@ -1104,7 +1235,9 @@ class MatrelSession:
         if deadline_ms is None and self.config.deadline_ms > 0:
             deadline_ms = self.config.deadline_ms
         return self._serve.submit(e, self._resolve_sla(precision, e),
-                                  deadline_ms=deadline_ms)
+                                  deadline_ms=deadline_ms,
+                                  tenant=tenant,
+                                  staleness_ms=staleness_ms)
 
     def serve_drain(self, timeout: Optional[float] = None) -> None:
         """Block until every submitted query has been dispatched and

@@ -29,7 +29,7 @@ def dry_batch(tmp_path_factory):
     env["MATREL_BATCH_DRY_DIR"] = str(art)
     proc = subprocess.run(
         ["sh", os.path.join(REPO, "tools", "tpu_batch.sh"), "--dry"],
-        capture_output=True, text=True, timeout=420, env=env)
+        capture_output=True, text=True, timeout=560, env=env)
     records = []
     for line in proc.stdout.splitlines():
         line = line.strip()
@@ -147,6 +147,42 @@ def test_fusion_row_artifact(dry_batch):
         assert row["outputs_agree"] is True
     assert rec["off_constructs_nothing"] is True
     assert rec["mv111_quiet"] is True, rec["mv111"]
+
+
+def test_traffic_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    # twice in the dry batch, like its sibling rows: the wedge-safe
+    # tools/traffic.py step AND bench_all's dry-enabled row
+    recs = [r for r in records
+            if r.get("metric") == "traffic_overload_harness"
+            and "tenants" in r]
+    assert len(recs) == 2, f"expected 2 traffic artifacts, got {recs}"
+    rec = recs[0]
+    # the round-13 acceptance at ~2x sustained overload over 3
+    # weighted tenants (docs/OVERLOAD.md): goodput holds >= 80% of
+    # measured closed-loop capacity, every refusal typed, zero wrong
+    # answers, admitted-and-met p99 inside the declared deadline,
+    # weighted fairness strict (gold misses less than bronze), and
+    # brownout provably enters AND exits
+    assert rec["ok"] is True, rec
+    assert rec["wrong_answers"] == 0
+    assert rec["untyped_errors"] == 0
+    assert rec["goodput_ratio"] >= 0.8, rec["goodput_ratio"]
+    assert rec["p99_within_deadline"] is True
+    assert 0.0 < rec["fairness_jain"] <= 1.0
+    tenants = rec["tenants"]
+    assert set(tenants) == {"gold", "silver", "bronze"}
+    for t, row in tenants.items():
+        assert row["arrivals"] > 0
+        # per-tenant percentile columns present (p50/p95/p99)
+        assert {"p50_ms", "p95_ms", "p99_ms"} <= set(row)
+        # typed-shed counts present
+        assert row["sheds"] >= 0 and row["deadline_misses"] >= 0
+    assert tenants["gold"]["miss_rate"] < tenants["bronze"]["miss_rate"]
+    assert rec["brownout"]["entered"] is True
+    assert rec["brownout"]["exited"] is True
+    # overload plus sheds means the typed counts actually fired
+    assert sum(t["sheds"] for t in tenants.values()) > 0
 
 
 def test_serve_row_artifact(dry_batch):

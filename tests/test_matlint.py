@@ -566,6 +566,109 @@ class TestML010JitSeam:
             assert [f for f in got if f.rule == "ML010"] == []
 
 
+class TestML011UnboundedQueue:
+    def test_fires_on_unbounded_deque_in_serve(self, tmp_path):
+        src = """
+            from collections import deque
+            def build():
+                q = deque()
+                return q
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newqueue.py")
+        assert _rules(got) == ["ML011"]
+
+    def test_fires_on_deque_with_iterable_but_no_maxlen(self,
+                                                        tmp_path):
+        # deque(iterable)'s first positional is the ITERABLE, not a
+        # bound — the exact unbounded idiom the rule exists to catch
+        src = """
+            from collections import deque
+            def build(items):
+                return deque(items)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newqueue.py")
+        assert _rules(got) == ["ML011"]
+
+    def test_fires_on_unbounded_queue_in_serve(self, tmp_path):
+        src = """
+            import queue
+            def build():
+                return queue.Queue()
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newqueue.py")
+        assert _rules(got) == ["ML011"]
+
+    def test_bounded_forms_pass(self, tmp_path):
+        src = """
+            import queue
+            from collections import deque
+            def build(n):
+                a = deque(maxlen=n)
+                b = deque([1, 2], n)
+                c = queue.Queue(maxsize=n)
+                d = queue.Queue(n)
+                return a, b, c, d
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newqueue.py") == []
+
+    def test_queues_outside_serve_out_of_scope(self, tmp_path):
+        # the queue half is contextual: obs rings / host-side tooling
+        # aren't on the admission path (the Thread half still applies
+        # package-wide — keep the fixture thread-free)
+        src = """
+            from collections import deque
+            def ring():
+                return deque()
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/obs/newring.py") == []
+        assert _lint(tmp_path, src, "tools/newtool.py") == []
+
+    def test_fires_on_thread_without_daemon(self, tmp_path):
+        src = """
+            import threading
+            def start(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                return t
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/utils/newhelper.py")
+        assert _rules(got) == ["ML011"]
+
+    def test_thread_with_daemon_passes(self, tmp_path):
+        src = """
+            import threading
+            def start(fn):
+                t = threading.Thread(target=fn, daemon=True)
+                t.start()
+                return t
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/utils/newhelper.py") == []
+
+    def test_suppression_with_justification(self, tmp_path):
+        src = """
+            from collections import deque
+            def build():
+                return deque()  # matlint: disable=ML011 bounded by the typed shed checks in put()
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newqueue.py") == []
+
+    def test_admission_queue_carries_justified_suppressions(self):
+        # the sanctioned sites: the AdmissionQueue's per-tenant deques
+        # (bounded by typed shed logic, not maxlen — a maxlen deque
+        # DROPS silently) and the pipeline's inflight deque (bounded
+        # by the serve_max_inflight sync loop)
+        import os
+        for mod in ("admission.py", "pipeline.py"):
+            path = os.path.join(matlint.REPO, "matrel_tpu", "serve",
+                                mod)
+            assert "disable=ML011" in open(path).read(), mod
+            got = matlint.lint_file(path)
+            assert [f for f in got if f.rule == "ML011"] == []
+
+
 def test_repo_lints_clean():
     """`make lint`'s contract, enforced from inside tier-1: the whole
     default scan set (package, tools, examples, bench harnesses) has

@@ -1,0 +1,874 @@
+"""Overload control plane (docs/OVERLOAD.md): per-tenant weighted-fair
+admission (serve/admission.py), adaptive brownout with hysteresis
+(resilience/brownout.py), per-plan-class circuit breakers
+(resilience/breaker.py), the MV112 verifier pass, the overload obs
+roll-up — and the off-by-default contracts: no tenants + brownout off
++ breakers off must construct ZERO controller/breaker objects and keep
+admission bit-identical FIFO."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from matrel_tpu.config import MatrelConfig, parse_tenant_weights
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.resilience import breaker as breaker_lib
+from matrel_tpu.resilience import brownout as brownout_lib
+from matrel_tpu.resilience import errors as rerrors
+from matrel_tpu.resilience.breaker import BreakerRegistry, CircuitBreaker
+from matrel_tpu.resilience.brownout import LoadController
+from matrel_tpu.resilience.retry import Deadline
+from matrel_tpu.serve.admission import AdmissionQueue
+from matrel_tpu.session import MatrelSession
+
+
+def _mat(rng, n, m, mesh):
+    return BlockMatrix.from_numpy(
+        rng.standard_normal((n, m)).astype(np.float32), mesh=mesh)
+
+
+def _sess(mesh, **cfg):
+    return MatrelSession(mesh=mesh, config=MatrelConfig(**cfg))
+
+
+def _entry(expr=None, fut=None, deadline=None, sla="default",
+           tenant="", staleness=None):
+    from concurrent.futures import Future
+    return (expr, fut if fut is not None else Future(),
+            time.perf_counter(), sla, deadline, tenant, staleness)
+
+
+#: Aggressive-but-valid brownout knobs for controller unit tests.
+BROWNOUT = dict(brownout_enable=True, brownout_window=8,
+                brownout_dwell=2, brownout_wait_high_ms=100.0,
+                brownout_wait_low_ms=10.0, brownout_depth_high=10,
+                brownout_depth_low=2, brownout_miss_high=0.5,
+                brownout_miss_low=0.05)
+
+
+class _StubController:
+    """A brownout controller pinned at one rung — rung-action tests
+    must not depend on driving real load through thresholds."""
+
+    def __init__(self, rung):
+        self._rung = rung
+        self.samples = []
+
+    def rung(self):
+        return self._rung
+
+    def observe(self, depth, waits_ms=(), misses=0, admitted=0):
+        self.samples.append((depth, tuple(waits_ms), misses, admitted))
+        return self._rung
+
+    def snapshot(self):
+        return {"rung": self._rung, "max_rung_seen": self._rung,
+                "entered": 0, "exited": 0}
+
+
+# ---------------------------------------------------------------------------
+# config validation
+
+
+class TestConfigValidation:
+    def test_tenant_weights_parse(self):
+        assert parse_tenant_weights("") == {}
+        assert parse_tenant_weights("gold:4,silver:2,bronze:1") == {
+            "gold": 4.0, "silver": 2.0, "bronze": 1.0}
+        assert parse_tenant_weights(" a : 1.5 ") == {"a": 1.5}
+
+    @pytest.mark.parametrize("bad", [
+        "bad", "a:", ":2", "a:0", "a:-1", "a:x", "a:1,a:2", ","])
+    def test_tenant_weights_reject_malformed(self, bad):
+        with pytest.raises(ValueError):
+            parse_tenant_weights(bad)
+
+    def test_config_validates_tenant_weights_at_construction(self):
+        with pytest.raises(ValueError):
+            MatrelConfig(serve_tenant_weights="a:0")
+        with pytest.raises(ValueError):
+            MatrelConfig(serve_tenant_queue_max=-1)
+
+    @pytest.mark.parametrize("kw", [
+        dict(brownout_wait_low_ms=300.0),      # low >= high
+        dict(brownout_depth_low=64, brownout_depth_high=64),
+        dict(brownout_miss_low=0.3, brownout_miss_high=0.2),
+        dict(brownout_miss_high=1.5),
+        dict(brownout_window=0),
+        dict(brownout_dwell=0),
+    ])
+    def test_brownout_thresholds_validated(self, kw):
+        with pytest.raises(ValueError):
+            MatrelConfig(**kw)
+
+    @pytest.mark.parametrize("kw", [
+        dict(breaker_threshold=-1),
+        dict(breaker_cooldown_ms=0.0),
+        dict(breaker_half_open_probes=0),
+    ])
+    def test_breaker_knobs_validated(self, kw):
+        with pytest.raises(ValueError):
+            MatrelConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair admission queue
+
+
+class TestAdmissionQueue:
+    def test_implicit_tenant_is_fifo(self):
+        q = AdmissionQueue(MatrelConfig())
+        for i in range(6):
+            q.put(_entry(expr=i))
+        got = [q.get_nowait()[0] for i in range(6)]
+        assert got == [0, 1, 2, 3, 4, 5]   # bit-identical FIFO order
+
+    def test_weighted_fair_pop_is_proportional(self):
+        q = AdmissionQueue(MatrelConfig(
+            serve_tenant_weights="a:3,b:1"))
+        for i in range(12):
+            q.put(_entry(expr=("a", i), tenant="a"), "a")
+            q.put(_entry(expr=("b", i), tenant="b"), "b")
+        # over any backlogged window of 8 pops, service is 6:2
+        first8 = [q.get_nowait()[0][0] for _ in range(8)]
+        assert first8.count("a") == 6
+        assert first8.count("b") == 2
+
+    def test_fair_batch_formation(self):
+        # the worker's coalescing loop is just repeated pops: a batch
+        # of 4 over a backlog cannot be monopolized by the chatty
+        # tenant (weights 3:1 -> 3 a's + 1 b per 4)
+        q = AdmissionQueue(MatrelConfig(
+            serve_tenant_weights="a:3,b:1"))
+        for i in range(20):
+            q.put(_entry(expr=("a", i), tenant="a"), "a")
+        for i in range(20):
+            q.put(_entry(expr=("b", i), tenant="b"), "b")
+        batch = [q.get_nowait()[0][0] for _ in range(4)]
+        assert batch.count("a") == 3 and batch.count("b") == 1
+
+    def test_tenant_order_within_tenant_is_fifo(self):
+        q = AdmissionQueue(MatrelConfig(
+            serve_tenant_weights="a:2,b:1"))
+        for i in range(4):
+            q.put(_entry(expr=("a", i)), "a")
+        seq = []
+        while True:
+            try:
+                seq.append(q.get_nowait()[0])
+            except queue.Empty:
+                break
+        assert [i for t, i in seq if t == "a"] == [0, 1, 2, 3]
+
+    def test_tenant_quota_sheds_before_global(self):
+        q = AdmissionQueue(MatrelConfig(
+            serve_tenant_weights="a:2,b:1",
+            serve_tenant_queue_max=2, serve_queue_max=100))
+        q.put(_entry(), "a")
+        q.put(_entry(), "a")
+        with pytest.raises(rerrors.AdmissionShed) as ei:
+            q.put(_entry(), "a")
+        assert ei.value.tenant == "a"
+        assert ei.value.scope == "tenant"
+        # the OTHER tenant's share is untouched
+        q.put(_entry(), "b")
+        assert q.counters()["sheds"] == {"a": 1}
+
+    def test_global_bound_sheds_typed(self):
+        q = AdmissionQueue(MatrelConfig(serve_queue_max=2))
+        q.put(_entry())
+        q.put(_entry())
+        with pytest.raises(rerrors.AdmissionShed) as ei:
+            q.put(_entry())
+        assert ei.value.scope == "queue"
+
+    def test_full_of_expired_queue_admits_fresh(self):
+        # the ride-along regression (ISSUE 12 satellite 1): dead
+        # entries used to hold their slots until the worker reached
+        # them, shedding LIVE traffic from a queue of corpses
+        q = AdmissionQueue(MatrelConfig(serve_queue_max=3))
+        dead = []
+        for _ in range(3):
+            e = _entry(deadline=Deadline(0.0))   # expired immediately
+            dead.append(e[1])
+            q.put(e)
+        time.sleep(0.005)
+        live = _entry()
+        q.put(live)              # purge at the shed decision point
+        assert q.qsize() == 1
+        for fut in dead:
+            assert isinstance(fut.exception(timeout=1),
+                              rerrors.DeadlineExceeded)
+        assert q.counters()["purged_expired"] == 3
+        # live future untouched, drain accounting consistent
+        assert not live[1].done()
+        assert q.unfinished_tasks == 1
+
+    def test_tenant_quota_purges_expired_first(self):
+        q = AdmissionQueue(MatrelConfig(
+            serve_tenant_weights="a:2,b:1",
+            serve_tenant_queue_max=2))
+        q.put(_entry(deadline=Deadline(0.0)), "a")
+        q.put(_entry(deadline=Deadline(0.0)), "a")
+        time.sleep(0.01)
+        q.put(_entry(), "a")      # admits: both corpses purged
+        assert q.tenant_depths() == {"a": 1}
+
+    def test_idle_tenant_banks_no_credit(self):
+        q = AdmissionQueue(MatrelConfig(
+            serve_tenant_weights="a:1,b:1"))
+        for i in range(8):
+            q.put(_entry(expr=("a", i)), "a")
+        for _ in range(6):
+            q.get_nowait()
+        # b goes active LATE: it re-enters at the current virtual
+        # time, not at 0 — it must not get 6 make-up pops in a row
+        q.put(_entry(expr=("b", 0)), "b")
+        q.put(_entry(expr=("b", 1)), "b")
+        got = [q.get_nowait()[0][0] for _ in range(4)]
+        assert got.count("b") <= 2 and got.count("a") >= 2
+
+    def test_lowest_weight_tenant_set(self):
+        q = AdmissionQueue(MatrelConfig(
+            serve_tenant_weights="a:4,b:1"))
+        assert q.lowest_weight_tenant("b") is True
+        assert q.lowest_weight_tenant("a") is False
+        # unknown tenants carry implicit weight 1.0 — the bottom
+        assert q.lowest_weight_tenant("zzz") is True
+        # no weights / all-equal weights: nobody is lowest
+        assert AdmissionQueue(
+            MatrelConfig()).lowest_weight_tenant("x") is False
+        assert AdmissionQueue(MatrelConfig(
+            serve_tenant_weights="a:2,b:2")).lowest_weight_tenant(
+                "a") is False
+
+
+# ---------------------------------------------------------------------------
+# brownout controller
+
+
+class TestLoadController:
+    def _ctl(self, **kw):
+        return LoadController(MatrelConfig(**{**BROWNOUT, **kw}))
+
+    def test_enters_under_sustained_wait_pressure(self):
+        ctl = self._ctl()
+        for _ in range(3):
+            ctl.observe(depth=0, waits_ms=[500.0] * 4, admitted=4)
+        assert ctl.rung() >= 1
+        assert ctl.snapshot()["entered"] >= 1
+
+    def test_hysteresis_band_holds_the_rung(self):
+        ctl = self._ctl()
+        for _ in range(4):
+            ctl.observe(depth=0, waits_ms=[500.0] * 8, admitted=8)
+        r = ctl.rung()
+        assert r >= 1
+        # waits BETWEEN low (10) and high (100): neither hot nor cold
+        # — the rung must hold exactly where it is, indefinitely
+        for _ in range(20):
+            ctl.observe(depth=0, waits_ms=[50.0] * 8, admitted=8)
+        assert ctl.rung() == r
+
+    def test_exits_only_when_every_signal_cold(self):
+        ctl = self._ctl()
+        for _ in range(4):
+            ctl.observe(depth=20, waits_ms=[500.0] * 8, admitted=8)
+        assert ctl.rung() >= 1
+        # waits cold but DEPTH still hot: no exit
+        for _ in range(6):
+            ctl.observe(depth=20, waits_ms=[1.0] * 8, admitted=8)
+        assert ctl.rung() >= 1
+        # everything cold: descends to 0 (and counts the exits)
+        for _ in range(30):
+            ctl.observe(depth=0, waits_ms=[1.0] * 8, admitted=8)
+        assert ctl.rung() == 0
+        snap = ctl.snapshot()
+        assert snap["exited"] >= 1
+        assert snap["max_rung_seen"] >= 1
+
+    def test_dwell_bounds_climb_rate(self):
+        ctl = self._ctl(brownout_dwell=5)
+        for _ in range(4):
+            ctl.observe(depth=100, waits_ms=[999.0] * 8, admitted=8)
+        # 4 hot samples with dwell 5: at most ONE move has happened
+        assert ctl.rung() <= 1
+
+    def test_climbs_to_max_rung_and_saturates(self):
+        ctl = self._ctl(brownout_dwell=1)
+        for _ in range(12):
+            ctl.observe(depth=100, waits_ms=[999.0] * 8, admitted=8)
+        assert ctl.rung() == brownout_lib.MAX_RUNG
+
+    def test_miss_rate_signal(self):
+        ctl = self._ctl(brownout_dwell=1)
+        for _ in range(4):
+            ctl.observe(depth=0, waits_ms=[1.0] * 2, misses=3,
+                        admitted=1)
+        assert ctl.rung() >= 1
+
+    def test_from_config_off_constructs_nothing(self, monkeypatch):
+        def poisoned(self, *a, **k):
+            raise AssertionError(
+                "LoadController constructed with brownout off")
+        monkeypatch.setattr(LoadController, "__init__", poisoned)
+        assert brownout_lib.from_config(MatrelConfig()) is None
+
+    def test_downshift_stamp_authorizing_rungs(self):
+        assert brownout_lib.downshift_stamp() == {
+            "rung": brownout_lib.TIER_RUNG, "sla": "fast"}
+        st = brownout_lib.downshift_stamp(2000.0)
+        assert st["rung"] == brownout_lib.STALE_RUNG
+        # the CLAIM rides the stamp, never the caller's raw tolerance:
+        # the stamp forms the plan key, and per-value stamps would
+        # compile one plan per distinct tolerance for byte-identical
+        # programs
+        assert st["stale_ok"] is True
+        assert "staleness_ms" not in st
+        assert (brownout_lib.downshift_stamp(100.0)
+                == brownout_lib.downshift_stamp(9999.0))
+
+
+# ---------------------------------------------------------------------------
+# brownout rung actions through the serve pipeline
+
+
+class TestBrownoutActions:
+    def test_rung1_downshifts_default_sla(self, mesh8, rng):
+        sess = _sess(mesh8, **BROWNOUT)
+        sess._brownout = _StubController(1)
+        A = _mat(rng, 32, 32, mesh8)
+        an = A.to_numpy()
+        fut = sess.submit(A.expr().multiply(A.expr()))
+        got = fut.result(timeout=60).to_numpy()
+        scale = float(np.max(np.abs(an @ an)))
+        assert np.max(np.abs(got - an @ an)) <= 2e-2 * max(scale, 1.0)
+        # the downshifted plan compiled under the fast-SLA-isolated
+        # key prefix — it can never answer a default-SLA query later
+        assert any(k.startswith("prec:fast|") or "prec:fast|" in k
+                   for k in sess._plan_cache)
+
+    def test_rung1_leaves_explicit_sla_alone(self, mesh8, rng):
+        sess = _sess(mesh8, **BROWNOUT)
+        sess._brownout = _StubController(1)
+        A = _mat(rng, 32, 32, mesh8)
+        an = A.to_numpy()
+        fut = sess.submit(A.expr().multiply(A.expr()),
+                          precision="exact")
+        got = fut.result(timeout=60).to_numpy()
+        # an explicit accuracy ask is an ask: full fidelity
+        np.testing.assert_allclose(got, an @ an, rtol=1e-5, atol=1e-5)
+        assert not any("prec:fast|" in k for k in sess._plan_cache)
+
+    def test_rung2_serves_stale_to_tolerant_queries(self, mesh8, rng):
+        sess = _sess(mesh8, result_cache_max_bytes=64 << 20,
+                     **BROWNOUT)
+        sess._brownout = _StubController(2)
+        a_old = rng.standard_normal((32, 32)).astype(np.float32)
+        A_old = BlockMatrix.from_numpy(a_old, mesh=mesh8)
+        sess.register("A", A_old)
+        e = A_old.expr().multiply_scalar(2.0)
+        old = sess.run(e)                      # cached
+        # catalog rebind: the entry is STALE now, not gone (a brownout
+        # controller exists)
+        sess.register("A", _mat(rng, 32, 32, mesh8))
+        assert sess.result_cache_info()["stale_entries"] == 1
+        # a tolerant query gets the stale answer with zero compute
+        fut = sess.submit(e, staleness_ms=60_000.0)
+        assert fut.result(timeout=60) is old
+        assert sess.result_cache_info()["stale_hits"] == 1
+        # an intolerant query recomputes (fresh result, not the ghost)
+        fut2 = sess.submit(e)
+        np.testing.assert_allclose(fut2.result(timeout=60).to_numpy(),
+                                   a_old * 2.0, rtol=1e-5, atol=1e-5)
+
+    def test_stale_age_respects_tolerance(self, mesh8, rng):
+        sess = _sess(mesh8, result_cache_max_bytes=64 << 20,
+                     **BROWNOUT)
+        sess._brownout = _StubController(2)
+        a_old = rng.standard_normal((32, 32)).astype(np.float32)
+        A_old = BlockMatrix.from_numpy(a_old, mesh=mesh8)
+        sess.register("A", A_old)
+        e = A_old.expr().multiply_scalar(3.0)
+        sess.run(e)
+        sess.register("A", _mat(rng, 32, 32, mesh8))
+        time.sleep(0.03)
+        # tolerance smaller than the entry's age: recompute
+        fut = sess.submit(e, staleness_ms=1.0)
+        np.testing.assert_allclose(fut.result(timeout=60).to_numpy(),
+                                   a_old * 3.0, rtol=1e-5, atol=1e-5)
+        assert sess.result_cache_info()["stale_hits"] == 0
+
+    def test_below_stale_rung_never_serves_stale(self, mesh8, rng):
+        sess = _sess(mesh8, result_cache_max_bytes=64 << 20,
+                     **BROWNOUT)
+        sess._brownout = _StubController(1)   # rung 1 < STALE_RUNG
+        a_old = rng.standard_normal((32, 32)).astype(np.float32)
+        A_old = BlockMatrix.from_numpy(a_old, mesh=mesh8)
+        sess.register("A", A_old)
+        e = A_old.expr().multiply_scalar(4.0)
+        sess.run(e)
+        sess.register("A", _mat(rng, 32, 32, mesh8))
+        fut = sess.submit(e, staleness_ms=60_000.0)
+        fut.result(timeout=60)
+        assert sess.result_cache_info()["stale_hits"] == 0
+
+    def test_stale_graveyard_byte_bounded(self, mesh8, rng):
+        # stale ghosts stay device-pinned: the graveyard is bounded by
+        # the live cache's own byte budget, so repeated rebinds can
+        # never retain more device memory than the cache is allowed
+        from matrel_tpu.serve.result_cache import (CacheEntry,
+                                                   ResultCache)
+        rc = ResultCache()
+        budget = 1000
+        for i in range(8):
+            key = f"k{i}"
+            m = object()
+            ent = CacheEntry(key_hash=key, result=None, pins=(),
+                             dep_ids=frozenset({id(m)}), layout="rep",
+                             dtype="float32", nbytes=400)
+            rc._entries[key] = ent
+            rc._bytes += ent.nbytes
+            rc.invalidate_deps({id(m)}, keep_stale=True,
+                               stale_max=256, stale_max_bytes=budget)
+        info = rc.info()
+        assert info["stale_bytes"] <= budget
+        assert info["stale_entries"] == 2      # 2 x 400 <= 1000
+
+    def test_default_config_drops_stale_on_rebind(self, mesh8, rng):
+        # no brownout controller -> invalidation drops entries exactly
+        # as before (the bit-identity contract: no graveyard grows)
+        sess = _sess(mesh8, result_cache_max_bytes=64 << 20)
+        A_old = _mat(rng, 32, 32, mesh8)
+        sess.register("A", A_old)
+        sess.run(A_old.expr().multiply_scalar(2.0))
+        sess.register("A", _mat(rng, 32, 32, mesh8))
+        info = sess.result_cache_info()
+        assert info["stale_entries"] == 0
+
+    def test_rung3_sheds_lowest_weight_tenant(self, mesh8, rng):
+        sess = _sess(mesh8,
+                     serve_tenant_weights="gold:4,bronze:1",
+                     **BROWNOUT)
+        sess._brownout = _StubController(3)
+        A = _mat(rng, 32, 32, mesh8)
+        e = A.expr().multiply_scalar(2.0)
+        with pytest.raises(rerrors.AdmissionShed) as ei:
+            sess.submit(e, tenant="bronze")
+        assert ei.value.scope == "brownout"
+        assert ei.value.tenant == "bronze"
+        # the high-weight tenant still admits and completes
+        fut = sess.submit(e, tenant="gold")
+        fut.result(timeout=60)
+
+    def test_rung3_single_implicit_tenant_sheds_nobody(self, mesh8,
+                                                       rng):
+        sess = _sess(mesh8, **BROWNOUT)
+        sess._brownout = _StubController(3)
+        A = _mat(rng, 32, 32, mesh8)
+        fut = sess.submit(A.expr().multiply_scalar(2.0))
+        fut.result(timeout=60)    # no tenants configured: no shed set
+
+
+# ---------------------------------------------------------------------------
+# circuit breakers
+
+
+class TestCircuitBreaker:
+    def _reg(self, clock, threshold=2, cooldown_ms=1000.0, probes=1):
+        return BreakerRegistry(threshold, cooldown_ms, probes,
+                               clock=clock)
+
+    def test_state_machine_transitions(self):
+        t = [0.0]
+        reg = self._reg(lambda: t[0])
+        cls = "matmul:<=64"
+        reg.admit(cls)
+        reg.record(cls, False)
+        reg.admit(cls)                  # one failure: still closed
+        reg.record(cls, False)          # second consecutive: OPEN
+        with pytest.raises(rerrors.CircuitOpen) as ei:
+            reg.admit(cls)
+        assert ei.value.plan_class == cls
+        assert 0 < ei.value.retry_after_ms <= 1000.0
+        # cooldown elapses: half-open admits exactly one probe
+        t[0] = 1.1
+        reg.admit(cls)                  # the probe
+        with pytest.raises(rerrors.CircuitOpen):
+            reg.admit(cls)              # probe budget spent
+        # probe success closes; failures reset
+        reg.record(cls, True)
+        assert reg.state(cls) == "closed"
+        reg.admit(cls)
+        snap = reg.snapshot()
+        assert snap["transitions"] == {"open": 1, "half_open": 1,
+                                       "close": 1}
+
+    def test_probe_failure_reopens_with_fresh_cooldown(self):
+        t = [0.0]
+        reg = self._reg(lambda: t[0])
+        cls = "matmul:<=64"
+        for _ in range(2):
+            reg.admit(cls)
+            reg.record(cls, False)
+        t[0] = 1.1
+        reg.admit(cls)                  # half-open probe
+        reg.record(cls, False)          # probe fails: re-open
+        assert reg.state(cls) == "open"
+        with pytest.raises(rerrors.CircuitOpen):
+            reg.admit(cls)
+        t[0] = 1.5                      # old cooldown would be over;
+        with pytest.raises(rerrors.CircuitOpen):
+            reg.admit(cls)              # the RESTARTED one is not
+        t[0] = 2.2
+        reg.admit(cls)
+        reg.record(cls, True)
+        assert reg.state(cls) == "closed"
+
+    def test_success_resets_consecutive_failures(self):
+        t = [0.0]
+        reg = self._reg(lambda: t[0], threshold=2)
+        cls = "c"
+        reg.admit(cls)
+        reg.record(cls, False)
+        reg.admit(cls)
+        reg.record(cls, True)           # streak broken
+        reg.admit(cls)
+        reg.record(cls, False)          # 1 consecutive again
+        reg.admit(cls)                  # still closed
+
+    def test_none_outcome_releases_probe_slot(self):
+        t = [0.0]
+        reg = self._reg(lambda: t[0])
+        cls = "c"
+        for _ in range(2):
+            reg.admit(cls)
+            reg.record(cls, False)
+        t[0] = 1.1
+        reg.admit(cls)                  # probe out
+        reg.record(cls, None)           # deadline/shed: says nothing
+        reg.admit(cls)                  # slot released: probe again
+        reg.record(cls, True)
+        assert reg.state(cls) == "closed"
+
+    def test_counts_as_failure_taxonomy(self):
+        assert not breaker_lib.counts_as_failure(
+            rerrors.DeadlineExceeded(1.0, 2.0))
+        assert not breaker_lib.counts_as_failure(
+            rerrors.AdmissionShed(4))
+        assert not breaker_lib.counts_as_failure(
+            rerrors.CircuitOpen("c", 10.0))
+        assert not breaker_lib.counts_as_failure(
+            rerrors.QueryAborted("x"))
+        assert breaker_lib.counts_as_failure(ValueError("boom"))
+        assert breaker_lib.counts_as_failure(
+            rerrors.InjectedFault("execute", "fatal", 1))
+
+    def test_plan_class_is_kind_plus_shape_bucket(self, mesh8, rng):
+        A = _mat(rng, 48, 64, mesh8)
+        e = A.expr().multiply(A.expr().t())
+        assert breaker_lib.plan_class(e) == "matmul:<=64"
+
+    def test_from_config_off_constructs_nothing(self, monkeypatch):
+        def poisoned(self, *a, **k):
+            raise AssertionError(
+                "CircuitBreaker constructed with breakers off")
+        monkeypatch.setattr(CircuitBreaker, "__init__", poisoned)
+        assert BreakerRegistry.from_config(MatrelConfig()) is None
+        # and a default-config session serves without one
+        from matrel_tpu.core import mesh as mesh_lib
+        sess = MatrelSession(mesh=mesh_lib.make_mesh((2, 4)),
+                             config=MatrelConfig())
+        assert sess._breakers is None
+
+
+class TestBreakerSessionWiring:
+    def _poison(self, mesh8, rng):
+        """A deterministically-failing query class: mixed-mesh
+        multiply raises ValueError at compile, every attempt."""
+        import jax
+        from matrel_tpu.core import mesh as mesh_lib
+        other = mesh_lib.make_mesh((1, 1), devices=jax.devices()[:1])
+        P = BlockMatrix.from_numpy(
+            rng.standard_normal((256, 256)).astype(np.float32),
+            mesh=mesh8)
+        M = BlockMatrix.from_numpy(
+            rng.standard_normal((256, 256)).astype(np.float32),
+            mesh=other)
+        return P.expr().multiply(M.expr())
+
+    def test_run_fails_fast_after_threshold(self, mesh8, rng):
+        sess = _sess(mesh8, breaker_threshold=2,
+                     breaker_cooldown_ms=80.0)
+        poison = self._poison(mesh8, rng)
+        for _ in range(2):
+            with pytest.raises(ValueError):
+                sess.run(poison)
+        # third call: typed fast-fail, no compile attempted
+        with pytest.raises(rerrors.CircuitOpen):
+            sess.run(poison)
+        # a DIFFERENT class (other shape bucket) is unaffected
+        A = _mat(rng, 32, 32, mesh8)
+        sess.run(A.expr().multiply(A.expr()))
+        # cooldown over: the probe runs (and fails again, re-opening)
+        time.sleep(0.1)
+        with pytest.raises(ValueError):
+            sess.run(poison)
+        assert sess._breakers.state("matmul:<=256") == "open"
+
+    def test_breaker_closes_after_class_heals(self, mesh8, rng):
+        sess = _sess(mesh8, breaker_threshold=2,
+                     breaker_cooldown_ms=40.0,
+                     fault_inject="execute:fatal:n=1;execute:fatal:n=2",
+                     fault_inject_seed=7)
+        from matrel_tpu.resilience import faults
+        faults.reset()
+        A = _mat(rng, 32, 32, mesh8)
+        an = A.to_numpy()
+        e = A.expr().multiply(A.expr())
+        for _ in range(2):
+            with pytest.raises(rerrors.InjectedFault):
+                sess.run(e)
+        with pytest.raises(rerrors.CircuitOpen):
+            sess.run(e)
+        time.sleep(0.06)
+        # fault window over (both n-rules fired): the probe SUCCEEDS
+        # and closes the breaker — the class is healthy again
+        got = sess.run(e).to_numpy()
+        np.testing.assert_allclose(got, an @ an, rtol=1e-4, atol=1e-4)
+        assert sess._breakers.state(
+            breaker_lib.plan_class(e)) == "closed"
+        snap = sess._breakers.snapshot()
+        assert snap["transitions"]["close"] == 1
+        faults.reset()
+
+    def test_serve_open_class_fails_future_fast(self, mesh8, rng):
+        sess = _sess(mesh8, breaker_threshold=1,
+                     breaker_cooldown_ms=60_000.0)
+        poison = self._poison(mesh8, rng)
+        f1 = sess.submit(poison)
+        assert isinstance(f1.exception(timeout=60), ValueError)
+        # the class is open now: the next submission fails typed at
+        # BATCH FORMATION — no compile, no bisection, no retry burn
+        f2 = sess.submit(poison)
+        assert isinstance(f2.exception(timeout=60),
+                          rerrors.CircuitOpen)
+        # a healthy class rides through the same worker untouched
+        A = _mat(rng, 32, 32, mesh8)
+        f3 = sess.submit(A.expr().multiply(A.expr()))
+        f3.result(timeout=60)
+
+    def test_deadline_outcomes_do_not_trip_breakers(self, mesh8, rng):
+        sess = _sess(mesh8, breaker_threshold=1,
+                     breaker_cooldown_ms=60_000.0)
+        A = _mat(rng, 32, 32, mesh8)
+        e = A.expr().multiply(A.expr())
+        with pytest.raises(rerrors.DeadlineExceeded):
+            sess.run(e, deadline_ms=1e-6)
+        # starvation says nothing about the class: still closed
+        sess.run(e)
+
+
+# ---------------------------------------------------------------------------
+# MV112
+
+
+class TestMV112:
+    def _verify(self, e, mesh, cfg):
+        from matrel_tpu import analysis
+        from matrel_tpu.ir import rules
+        from matrel_tpu.parallel import planner
+        from matrel_tpu.core import mesh as mesh_lib
+        grid = mesh_lib.mesh_grid_shape(mesh)
+        opt = planner.annotate_strategies(
+            rules.optimize(e, cfg, grid=grid, mesh=mesh), mesh, cfg)
+        return [d for d in analysis.verify_plan(opt, mesh, cfg)
+                if d.code == "MV112"]
+
+    def test_fresh_plans_quiet(self, mesh8, rng):
+        A = _mat(rng, 32, 32, mesh8)
+        e = A.expr().multiply(A.expr())
+        assert self._verify(e, mesh8, MatrelConfig()) == []
+
+    def test_worker_stamp_verifies_clean(self, mesh8, rng):
+        # exactly what the serve worker produces at rung >= 1: the
+        # downshift stamp on a plan compiled under the fast SLA with
+        # brownout on. Epilogue-rooted tree: stamps ride expr attrs,
+        # and the rewrite pass RECONSTRUCTS bare matmul roots (the
+        # stamp drops with the node — the conservative direction, see
+        # the pass docstring), so the positive fixtures use the root
+        # kinds real downshifted dashboard queries end in.
+        A = _mat(rng, 32, 32, mesh8)
+        e = A.expr().multiply(A.expr()).multiply_scalar(2.0).with_attrs(
+            brownout=brownout_lib.downshift_stamp())
+        cfg = MatrelConfig(precision_sla="fast", **BROWNOUT)
+        assert self._verify(e, mesh8, cfg) == []
+
+    def test_bad_rung_flagged(self, mesh8, rng):
+        A = _mat(rng, 32, 32, mesh8)
+        e = A.expr().multiply(A.expr()).multiply_scalar(2.0).with_attrs(
+            brownout={"rung": 9, "sla": "fast"})
+        cfg = MatrelConfig(precision_sla="fast", **BROWNOUT)
+        diags = self._verify(e, mesh8, cfg)
+        assert diags and "rung 9" in diags[0].message
+
+    def test_sla_mismatch_flagged(self, mesh8, rng):
+        # stamp claims a downshift the plan's config does not run
+        A = _mat(rng, 32, 32, mesh8)
+        e = A.expr().multiply(A.expr()).multiply_scalar(2.0).with_attrs(
+            brownout=brownout_lib.downshift_stamp())
+        cfg = MatrelConfig(**BROWNOUT)     # compiles at "default"
+        diags = self._verify(e, mesh8, cfg)
+        assert diags and "disagree" in diags[0].message
+
+    def test_staleness_below_stale_rung_flagged(self, mesh8, rng):
+        A = _mat(rng, 32, 32, mesh8)
+        e = A.expr().multiply(A.expr()).multiply_scalar(2.0).with_attrs(
+            brownout={"rung": 1, "sla": "fast",
+                      "staleness_ms": 500.0})
+        cfg = MatrelConfig(precision_sla="fast", **BROWNOUT)
+        diags = self._verify(e, mesh8, cfg)
+        assert diags and "staleness" in diags[0].message
+
+    def test_stamp_with_controller_off_flagged(self, mesh8, rng):
+        A = _mat(rng, 32, 32, mesh8)
+        e = A.expr().multiply(A.expr()).multiply_scalar(2.0).with_attrs(
+            brownout=brownout_lib.downshift_stamp())
+        cfg = MatrelConfig(precision_sla="fast")   # brownout OFF
+        diags = self._verify(e, mesh8, cfg)
+        assert diags and "OFF" in diags[0].message
+        assert all(d.severity == "warning" for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# obs: overload events, tenant tags, history roll-up
+
+
+class TestOverloadObs:
+    def test_overload_events_and_rollup(self, mesh8, rng, tmp_path):
+        log = tmp_path / "events.jsonl"
+        sess = _sess(mesh8, obs_level="on", obs_event_log=str(log),
+                     serve_tenant_weights="gold:4,bronze:1",
+                     serve_tenant_queue_max=4,
+                     breaker_threshold=4, **BROWNOUT)
+        A = _mat(rng, 32, 32, mesh8)
+        e = A.expr().multiply_scalar(2.0)
+        futs = [sess.submit(e, tenant=("gold" if i % 2 else "bronze"))
+                for i in range(8)]
+        sess.serve_drain(timeout=60)
+        for f in futs:
+            f.result(timeout=60)
+        from matrel_tpu.obs.events import read_events
+        from matrel_tpu.obs.history import render_summary, summarize
+        events = read_events(str(log))
+        ov = [ev for ev in events if ev.get("kind") == "overload"]
+        assert ov, "no overload events from an active control plane"
+        rec = ov[0]
+        assert {"rung", "queue_depth", "tenant_depths", "admitted",
+                "tenant_waits_ms", "sheds", "purged_expired",
+                "stale_served", "brownout", "breakers"} <= set(rec)
+        s = summarize(events)
+        assert s["overload"] is not None
+        assert s["overload"]["cycles"] == len(ov)
+        tenants = s["overload"]["tenants"]
+        assert set(tenants) >= {"gold", "bronze"}
+        assert sum(t["admitted"] for t in tenants.values()) == 8
+        text = render_summary(events)
+        assert "overload:" in text
+        assert "gold" in text
+
+    def test_serve_events_carry_tenant_census(self, mesh8, rng,
+                                              tmp_path):
+        log = tmp_path / "events.jsonl"
+        sess = _sess(mesh8, obs_level="on", obs_event_log=str(log),
+                     serve_tenant_weights="a:2,b:1")
+        A = _mat(rng, 32, 32, mesh8)
+        sess.submit(A.expr().multiply_scalar(2.0),
+                    tenant="a").result(timeout=60)
+        sess.serve_drain(timeout=60)
+        from matrel_tpu.obs.events import read_events
+        sv = read_events(str(log), kinds=("serve",))
+        assert sv and sv[-1].get("tenants") == {"a": 1}
+
+    def test_query_event_carries_tenant_tag(self, mesh8, rng,
+                                            tmp_path):
+        log = tmp_path / "events.jsonl"
+        sess = _sess(mesh8, obs_level="on", obs_event_log=str(log))
+        A = _mat(rng, 32, 32, mesh8)
+        sess.run(A.expr().multiply_scalar(2.0), tenant="team-x")
+        from matrel_tpu.obs.events import read_events
+        qs = read_events(str(log), kinds=("query",))
+        assert qs and qs[-1].get("tenant") == "team-x"
+
+    def test_default_serve_emits_no_overload_events(self, mesh8, rng,
+                                                    tmp_path):
+        # control plane inactive (no tenants/brownout/breakers): obs
+        # on must see ZERO overload records — historical logs unchanged
+        log = tmp_path / "events.jsonl"
+        sess = _sess(mesh8, obs_level="on", obs_event_log=str(log))
+        A = _mat(rng, 32, 32, mesh8)
+        sess.submit(A.expr().multiply_scalar(2.0)).result(timeout=60)
+        sess.serve_drain(timeout=60)
+        from matrel_tpu.obs.events import read_events
+        assert read_events(str(log), kinds=("overload",)) == []
+
+
+# ---------------------------------------------------------------------------
+# default-config bit-identity
+
+
+class TestOffContracts:
+    def test_default_session_owns_no_controllers(self, mesh8):
+        sess = _sess(mesh8)
+        assert sess._brownout is None
+        assert sess._breakers is None
+
+    def test_default_serve_constructs_no_controller_objects(
+            self, mesh8, rng, monkeypatch):
+        def poisoned(self, *a, **k):
+            raise AssertionError("controller built on default path")
+        monkeypatch.setattr(LoadController, "__init__", poisoned)
+        monkeypatch.setattr(CircuitBreaker, "__init__", poisoned)
+        sess = _sess(mesh8)
+        A = _mat(rng, 32, 32, mesh8)
+        fut = sess.submit(A.expr().multiply_scalar(2.0))
+        an = A.to_numpy()
+        np.testing.assert_allclose(fut.result(timeout=60).to_numpy(),
+                                   an * 2.0, rtol=1e-6, atol=1e-6)
+        sess.serve_drain(timeout=60)
+
+    def test_legacy_short_entries_still_served(self, mesh8, rng):
+        # white-box callers enqueue 3-tuples straight into the queue;
+        # the worker right-pads to the 7-tuple shape
+        from concurrent.futures import Future
+        sess = _sess(mesh8)
+        A = _mat(rng, 32, 32, mesh8)
+        fut = sess.submit(A.expr().multiply_scalar(2.0))
+        fut.result(timeout=60)
+        pl = sess._serve
+        f = Future()
+        pl._q.put((A.expr().multiply_scalar(3.0), f,
+                   time.perf_counter()))
+        deadline = time.time() + 30
+        while not f.done() and time.time() < deadline:
+            time.sleep(0.01)
+        np.testing.assert_allclose(f.result(timeout=1).to_numpy(),
+                                   A.to_numpy() * 3.0,
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_weighted_queue_preserves_drain_contract(self, mesh8,
+                                                     rng):
+        sess = _sess(mesh8, serve_tenant_weights="a:2,b:1")
+        A = _mat(rng, 32, 32, mesh8)
+        futs = [sess.submit(A.expr().multiply_scalar(float(i + 1)),
+                            tenant=("a" if i % 2 else "b"))
+                for i in range(6)]
+        sess.serve_drain(timeout=60)
+        an = A.to_numpy()
+        for i, f in enumerate(futs):
+            np.testing.assert_allclose(
+                f.result(timeout=1).to_numpy(), an * (i + 1),
+                rtol=1e-5, atol=1e-5)

@@ -518,7 +518,8 @@ class TestResultCacheInfoSurface:
         info = sess.result_cache_info()
         assert set(info) == {"entries", "bytes", "hits", "misses",
                              "interior_hits", "evicted", "invalidated",
-                             "max_bytes", "max_entries"}
+                             "stale_entries", "stale_bytes",
+                             "stale_hits", "max_bytes", "max_entries"}
         assert info["max_bytes"] == RC["result_cache_max_bytes"]
         assert info["max_entries"] == 256
 

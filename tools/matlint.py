@@ -34,6 +34,9 @@ Rule catalogue (each rule's class docstring is the authority):
   ML010  jax.jit call site outside the executor's region-emission
          seam (executor.py) and utils/ — jitted-program emission is
          one compilation seam (the ML009 idiom for programs)
+  ML011  unbounded-queue growth idiom: deque()/queue.Queue() without
+         a bound in matrel_tpu/serve/, or threading.Thread without
+         an explicit daemon= anywhere in the package
 """
 
 from __future__ import annotations
@@ -605,11 +608,82 @@ class JitSeamRule(Rule):
                             "an inline suppression)")
 
 
+class UnboundedQueueRule(Rule):
+    """ML011: unbounded-queue growth idioms in the serve plane.
+
+    The overload control plane (docs/OVERLOAD.md) exists because an
+    unbounded queue turns overload into memory exhaustion plus
+    unbounded latency — the exact failure the typed AdmissionShed
+    contract replaces with refusal. Two idioms are pinned:
+
+    - ``deque()`` / ``queue.Queue()`` (or LifoQueue/PriorityQueue)
+      constructed WITHOUT a bound (no maxlen/maxsize argument) inside
+      ``matrel_tpu/serve/`` — the modules whose queues sit on the
+      admission path. A queue that is bounded by surrounding shed
+      logic rather than by its constructor carries a justified inline
+      suppression (the AdmissionQueue's per-tenant deques: a maxlen
+      deque DROPS silently, and refusal must be typed).
+    - ``threading.Thread(...)`` without an explicit ``daemon=``
+      anywhere in ``matrel_tpu/``: a non-daemon worker left running
+      wedges interpreter shutdown — every sanctioned worker/helper
+      thread in the package states its daemon-ness at the call site.
+    """
+
+    id = "ML011"
+    _QUEUE_TAILS = ("Queue", "LifoQueue", "PriorityQueue")
+    _BOUND_KW = ("maxlen", "maxsize")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("matrel_tpu/")
+
+    @staticmethod
+    def _has_bound(node: ast.Call, kw_names, bound_pos: int) -> bool:
+        """An explicit bound: the named keyword, or enough positional
+        args to reach the bound's slot — ``deque(iterable)`` is NOT
+        bounded (the first positional is the iterable; maxlen is the
+        second), while ``queue.Queue(n)``'s first positional IS
+        maxsize."""
+        if any(k.arg in kw_names for k in node.keywords):
+            return True
+        return len(node.args) >= bound_pos
+
+    def check(self, tree, relpath):
+        in_serve = relpath.startswith("matrel_tpu/serve/")
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _call_name(node.func).rsplit(".", 1)[-1]
+            if in_serve and tail == "deque" \
+                    and not self._has_bound(node, ("maxlen",), 2):
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    "unbounded deque() on the serve path — bound it "
+                    "(maxlen=) or shed typed past an explicit bound "
+                    "(AdmissionShed), with a justified suppression "
+                    "when the bound lives in surrounding logic")
+            elif in_serve and tail in self._QUEUE_TAILS \
+                    and not self._has_bound(node, ("maxsize",), 1):
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    f"unbounded queue.{tail}() on the serve path — "
+                    "pass maxsize (or shed typed past an explicit "
+                    "bound)")
+            elif tail == "Thread" and not any(
+                    k.arg == "daemon" for k in node.keywords):
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    "threading.Thread without an explicit daemon= — "
+                    "a non-daemon worker wedges interpreter "
+                    "shutdown; state the thread's lifecycle at the "
+                    "call site")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
                         SpecKeyedCacheRule(), RawTimingRule(),
                         BroadSwallowRule(), DevicePutRule(),
-                        KernelSeamRule(), JitSeamRule())
+                        KernelSeamRule(), JitSeamRule(),
+                        UnboundedQueueRule())
 
 
 def _suppressed_codes(line: str) -> set:

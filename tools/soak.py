@@ -785,6 +785,122 @@ def soak_chaos(n_trials: int, base: int, tol: float):
     return fails
 
 
+def soak_overload(n_trials: int, base: int, tol: float):
+    """Randomized overload-control soak (docs/OVERLOAD.md): each trial
+    drives seeded open-loop-ish bursts of tenant-tagged submissions
+    through a session with weighted-fair admission, tight quotas, an
+    aggressive brownout controller, circuit breakers AND a PR 8 fault
+    schedule (capped transient fires + fatal execute fires). The
+    contract under soak: every admitted query either matches its
+    numpy oracle or fails TYPED (shed / deadline / circuit / injected
+    — never a wrong answer, never an unclassified crash), and after
+    the fault window every breaker closes again (a probe success must
+    re-admit the class)."""
+    import numpy as np
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.resilience import errors as rerrors, faults
+    from matrel_tpu.session import MatrelSession
+
+    mesh = mesh_lib.make_mesh()
+    fails = []
+    typed_kinds = (rerrors.ResilienceError,)
+    for trial in range(base, base + n_trials):
+        rng = np.random.default_rng(trial)
+        try:
+            faults.reset()
+            # fatal fires are CAPPED so the fault window provably
+            # ends; transient budget stays strictly below the retry
+            # budget (the soak_chaos discipline)
+            rules = ["execute:fatal:p=0.25:max=3",
+                     "serve_admit:transient:p=0.1:max=2"]
+            cfg = MatrelConfig(
+                serve_tenant_weights="a:3,b:1",
+                serve_tenant_queue_max=4,
+                serve_queue_max=10,
+                serve_max_batch=int(rng.integers(1, 4)),
+                brownout_enable=True,
+                brownout_window=8, brownout_dwell=2,
+                brownout_wait_high_ms=5.0, brownout_wait_low_ms=1.0,
+                brownout_depth_high=6, brownout_depth_low=1,
+                breaker_threshold=2, breaker_cooldown_ms=30.0,
+                retry_max_attempts=4, retry_backoff_ms=1.0,
+                fault_inject=";".join(rules),
+                fault_inject_seed=trial,
+                result_cache_max_bytes=(1 << 24 if trial % 2 else 0))
+            sess = MatrelSession(mesh=mesh, config=cfg)
+            n = int(rng.choice([16, 32]))
+            an = rng.standard_normal((n, n)).astype(np.float32)
+            bn = rng.standard_normal((n, n)).astype(np.float32)
+            A, B = sess.from_numpy(an), sess.from_numpy(bn)
+            pool = [(A.expr().multiply(B.expr())
+                     .multiply_scalar(float(s + 1)),
+                     an @ bn * (s + 1)) for s in range(3)]
+            futs = []
+            # seeded bursts: submit without waiting (open loop), gaps
+            # from an exponential draw — admission pressure is the
+            # point, so most trials overrun the tiny quotas
+            for q in range(28):
+                e, want = pool[q % len(pool)]
+                tenant = "a" if rng.random() < 0.5 else "b"
+                try:
+                    futs.append(
+                        (sess.submit(e, tenant=tenant,
+                                     deadline_ms=5_000.0), want))
+                except rerrors.AdmissionShed:
+                    continue       # typed refusal IS the contract
+                if rng.random() < 0.3:
+                    __import__("time").sleep(
+                        float(rng.exponential(0.004)))
+            sess.serve_drain(timeout=120)
+            for fut, want in futs:
+                ex = fut.exception(timeout=60)
+                if ex is None:
+                    got = fut.result().to_numpy()
+                    # brownout rung 1 legitimately runs default-SLA
+                    # queries at the bf16 fast tier: the oracle bound
+                    # is the FAST tier's documented max-norm error,
+                    # not f32's (docs/PRECISION.md / OVERLOAD.md)
+                    scale = max(1.0, float(np.max(np.abs(want))))
+                    np.testing.assert_allclose(got, want, rtol=0,
+                                               atol=2e-2 * scale)
+                elif not isinstance(ex, typed_kinds):
+                    raise AssertionError(
+                        f"untyped failure escaped: "
+                        f"{type(ex).__name__}: {ex}") from ex
+            # the fault window is over (max= caps reached): the
+            # breaker must close again — settle with single queries,
+            # waiting out cooldowns on typed CircuitOpen refusals
+            e, want = pool[0]
+            for _ in range(12):
+                try:
+                    got = sess.run(e)
+                    scale = max(1.0, float(np.max(np.abs(want))))
+                    np.testing.assert_allclose(got.to_numpy(), want,
+                                               rtol=0,
+                                               atol=2e-2 * scale)
+                    break
+                except rerrors.CircuitOpen:
+                    __import__("time").sleep(0.04)
+                except rerrors.InjectedFault as ex:
+                    if ex.transient:
+                        raise AssertionError(
+                            "transient escaped the retry loop") from ex
+                    __import__("time").sleep(0.01)
+            else:
+                raise AssertionError(
+                    "breaker never re-admitted the class after the "
+                    "fault window")
+            snap = sess._breakers.snapshot()
+            assert not snap["open"], (
+                f"breaker still open after settle: {snap}")
+        except Exception as ex:  # noqa: BLE001 — soak collects all
+            fails.append(("overload", trial, type(ex).__name__,
+                          str(ex)[:200]))
+    faults.reset()
+    return fails
+
+
 def soak_checkpoint(n_trials: int, base: int, tol: float):
     """Randomized checkpoint/restore: matrices with random specs, sparse
     tile stacks, loop state — restored values AND shardings must match;
@@ -849,7 +965,8 @@ def main():
     p.add_argument("battery",
                    choices=["fuzz", "deep", "spmv", "sharded", "routed",
                             "ckpt", "serve", "precision", "chaos",
-                            "sparse_kernels", "fusion", "all"])
+                            "sparse_kernels", "fusion", "overload",
+                            "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -874,6 +991,8 @@ def main():
         fails += soak_serve(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("chaos", "all"):
         fails += soak_chaos(max(args.seeds // 4, 5), args.base, tol)
+    if args.battery in ("overload", "all"):
+        fails += soak_overload(max(args.seeds // 5, 5), args.base, tol)
     if args.battery in ("precision", "all"):
         fails += soak_precision(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("sharded", "all"):

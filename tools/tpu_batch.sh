@@ -49,6 +49,8 @@ if [ "$DRY" = 1 ]; then
            MATREL_FUSION_REPEATS=5 MATREL_FUSION_INNER=4
     export MATREL_SERVE_N=256 MATREL_SERVE_K=64 \
            MATREL_SERVE_QUERIES=18 MATREL_SERVE_MEAS=3
+    export MATREL_TRAFFIC_SECONDS=5 MATREL_TRAFFIC_TAIL_SECONDS=2.5 \
+           MATREL_TRAFFIC_CAL=300 MATREL_TRAFFIC_N=48
     export MATREL_PRECISION_N=256 MATREL_PRECISION_REPEATS=3
     export MATREL_RESHARD_N=256 MATREL_RESHARD_REPEATS=3
     export MATREL_NS_N=2048
@@ -86,6 +88,8 @@ log "--- flight_drill (obs tier 2: flight recorder + chrome trace + drift smoke,
 python tools/flight_drill.py
 log "--- chaos_drill (resilience: seeded fault schedule over a mixed serve stream, staged this round)"
 python tools/chaos_drill.py
+log "--- traffic (open-loop overload harness: weighted tenants, brownout, typed shed, staged this round)"
+python tools/traffic.py
 log "--- north_star_sweep (VERDICT #10 residual)"
 python tools/north_star_sweep.py
 log "--- gram_manual3 (symmetric-Gram microbench, BASELINE row 3 support)"
