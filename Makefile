@@ -72,6 +72,6 @@ tpu-batch-dry:
 
 obs-report:
 	$(PY) -m matrel_tpu history --summary --log $(OBS_LOG)
-	$(PY) -m matrel_tpu history --drift --log $(OBS_LOG)
+	$(PY) -m matrel_tpu history --drift --check --log $(OBS_LOG)
 	$(PY) -m matrel_tpu trace --export chrome --log $(OBS_LOG) \
 		--out $(OBS_LOG).chrome.json

@@ -582,6 +582,110 @@ def measure_fusion() -> dict:
             "ok": bool(all_ok and off_clean and not mv111)}
 
 
+def measure_stream() -> dict:
+    """Streaming IVM sweep (ROADMAP item 2, the round-14 acceptance
+    row): the sliding-window streaming-graph dashboard
+    (workloads/streaming.py) run through BOTH maintenance modes over
+    the same seeded stream — delta-patch (``register_delta``: cached
+    entries patched in place, repeats answer from the cache) vs full
+    recompute (a plain rebind per tick: transitive kill, every repeat
+    recompiles and re-executes). Reports steady-state per-update
+    latency (median ± half-width over the measured ticks, the first
+    patch-mode tick excluded — it compiles the patch plans the steady
+    state reuses) and the speedup; the acceptance number is
+    delta-patch >= 3x on the small-delta stream, with MV113's dynamic
+    check proving every surviving patched entry within its stamped
+    bound and ZERO wrong answers (integer queries bit-exact) in both
+    modes. CPU backend is acceptable: the win is algebraic work
+    avoided plus compiles avoided, which the CPU pays like the TPU."""
+    import jax
+    from matrel_tpu.analysis import delta_pass
+    from matrel_tpu.config import MatrelConfig, set_default_config
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.session import MatrelSession
+    from matrel_tpu.workloads.streaming import StreamingGraph
+
+    n = _env_int("MATREL_STREAM_N", 1024)
+    edges = _env_int("MATREL_STREAM_EDGES", 16)
+    window = _env_int("MATREL_STREAM_WINDOW", 6)
+    updates = _env_int("MATREL_STREAM_UPDATES", 5)
+    feat_k = _env_int("MATREL_STREAM_K", 32)
+    seed = _env_int("MATREL_STREAM_SEED", 0)
+    cfg = MatrelConfig(obs_level="off",
+                       result_cache_max_bytes=1 << 30)
+    set_default_config(cfg)
+    mesh = mesh_lib.make_mesh()
+
+    def check(g) -> float:
+        got = g.run_all()
+        want = g.oracle()
+        worst = 0.0
+        for k, v in got.items():
+            w = np.asarray(want[k], np.float32).reshape(v.shape)
+            err = float(np.abs(v - w).max())
+            if k != "feature_product" and err != 0.0:
+                raise AssertionError(
+                    f"integer query {k} not bit-exact: {err}")
+            worst = max(worst, err / max(float(np.abs(w).max()), 1.0))
+        return worst
+
+    def run_mode(mode: str) -> dict:
+        sess = MatrelSession(mesh=mesh, config=cfg)
+        g = StreamingGraph(sess, n=n, batch_edges=edges,
+                           window=window, feature_k=feat_k, seed=seed)
+        g.run_all()                              # cold dashboard
+        if mode == "patch":
+            t0 = time.perf_counter()
+            g.step_delta()                       # tick 0 compiles the
+            g.run_all()                          # patch plans — warm,
+            warm_ms = (time.perf_counter() - t0) * 1e3   # reported
+        else:                                    # separately
+            warm_ms = None
+        ts = []
+        worst = 0.0
+        summaries = []
+        for _ in range(max(updates, 2)):
+            t0 = time.perf_counter()
+            s = (g.step_delta() if mode == "patch"
+                 else g.step_rebind())
+            g.run_all()
+            ts.append((time.perf_counter() - t0) * 1e3)
+            summaries.append(s)
+            worst = max(worst, check(g))
+        ts.sort()
+        out = {"median_ms": round(ts[len(ts) // 2], 3),
+               "half_width_ms": round((ts[-1] - ts[0]) / 2, 3),
+               "updates": len(ts), "worst_rel_err": worst}
+        if mode == "patch":
+            out["warm_ms"] = round(warm_ms, 3)
+            out["patched_per_update"] = summaries[-1]["patched"]
+            out["killed_per_update"] = summaries[-1]["killed"]
+            out["reused_plans"] = summaries[-1]["reused_plans"]
+            out["est_saved_flops"] = summaries[-1]["est_saved_flops"]
+            out["mv113"] = [d.render()[:160] for d in
+                            delta_pass.verify_patched_entries(sess)]
+            out["rc"] = {k: v for k, v in
+                         sess.result_cache_info().items()
+                         if k in ("entries", "hits", "patched",
+                                  "rekeyed", "invalidated")}
+        return out
+
+    patch = run_mode("patch")
+    recompute = run_mode("recompute")
+    speedup = (round(recompute["median_ms"] / patch["median_ms"], 2)
+               if patch["median_ms"] > 0 else None)
+    ok = (speedup is not None and speedup >= 3.0
+          and not patch["mv113"]
+          and patch["reused_plans"] > 0
+          and patch["patched_per_update"] > 0)
+    return {"n": n, "edges_per_update": edges, "window": window,
+            "backend": jax.default_backend(),
+            "patch": patch, "recompute": recompute,
+            "speedup": speedup,
+            "value": speedup, "unit": "x recompute",
+            "ok": bool(ok)}
+
+
 def measure_precision() -> dict:
     """Precision-tier sweep (the ROADMAP item-3 acceptance row): the
     dense flagship multiply at f32 vs bf16×1 vs bf16×3 vs int32, each
@@ -1396,6 +1500,24 @@ def main_fusion() -> None:
     print(json.dumps(record))
 
 
+def main_stream() -> None:
+    """Wedge-safe streaming-IVM row capture (tools/tpu_batch.sh step):
+    probe, then the measurement child under a hard timeout; one
+    parseable JSON line either way, rc 0 — same contract as the
+    headline metric."""
+    ok, payload = _run_child("probe", PROBE_TIMEOUT_S)
+    if ok:
+        ok, payload = _run_child("stream", MEASURE_TIMEOUT_S)
+    record = {"metric": "stream_update_latency"}
+    if ok and isinstance(payload, dict):
+        record.update(payload)
+        _emit_bench_event(dict(record))
+    else:
+        record.update({"value": None, "error": str(payload)[:500]})
+        _emit_bench_error(record["metric"], str(payload))
+    print(json.dumps(record))
+
+
 def main_spgemm() -> None:
     """Wedge-safe SpGEMM row capture (tools/tpu_batch.sh step): probe,
     then the measurement child under a hard timeout; one parseable JSON
@@ -1431,6 +1553,10 @@ if __name__ == "__main__":
         print(json.dumps(measure_sparse_kernels()))
     elif "--_fusion" in sys.argv:
         print(json.dumps(measure_fusion()))
+    elif "--_stream" in sys.argv:
+        print(json.dumps(measure_stream()))
+    elif "--stream" in sys.argv:
+        main_stream()
     elif "--fusion" in sys.argv:
         main_fusion()
     elif "--sparse-kernels" in sys.argv:

@@ -110,6 +110,15 @@ def bench_traffic(mesh, cfg):
     return json.loads(lines[-1])
 
 
+def bench_stream(mesh, cfg):
+    """Streaming IVM row: the sliding-window graph dashboard's
+    steady-state per-update latency, delta-patch vs full recompute
+    (see bench.measure_stream; docs/IVM.md)."""
+    import bench
+    payload = bench.measure_stream()
+    return {"metric": "stream_update_latency", **payload}
+
+
 def bench_reshard(mesh, cfg):
     """Reshard-planner sweep: planned staged step sequences vs the
     naive one-shot constraint per src→dst layout move, {ms, bytes
@@ -429,11 +438,12 @@ def main():
     dry = bool(os.environ.get("MATREL_DRY"))
     dry_rows = (bench_dense_4k, bench_chain, bench_spgemm,
                 bench_sparse_kernels, bench_fusion, bench_serve,
-                bench_precision, bench_reshard, bench_traffic)
+                bench_stream, bench_precision, bench_reshard,
+                bench_traffic)
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
                bench_spgemm, bench_sparse_kernels, bench_fusion,
-               bench_serve, bench_precision, bench_reshard,
-               bench_traffic,
+               bench_serve, bench_stream, bench_precision,
+               bench_reshard, bench_traffic,
                bench_pagerank, bench_pagerank_10x, bench_cg,
                bench_eigen, bench_triangles, bench_north_star):
         if dry and fn not in dry_rows:

@@ -162,6 +162,10 @@ def main(argv=None):
     hi.add_argument("--no-save", action="store_true",
                     help="with --drift: report only, don't update the "
                          "persisted calibration table")
+    hi.add_argument("--check", action="store_true",
+                    help="with --drift: exit nonzero when any DRIFT "
+                         "rank-order flag fires — the CI/make "
+                         "obs-report gate on cost-model drift")
     hi.set_defaults(fn=cmd_history)
     tr = sub.add_parser("trace")
     tr.add_argument("--export", default="chrome",

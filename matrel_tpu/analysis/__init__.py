@@ -44,6 +44,12 @@ codes documented in :mod:`matrel_tpu.analysis.diagnostics`):
                     them (tier downshift matches the compile SLA,
                     staleness only at rung >= 2, no stamps with the
                     controller off)
+  delta      MV113  delta-patched result-cache provenance is coherent
+                    (rule in ir/delta.DELTA_RULES, generation >= 1,
+                    finite composed bound); the DYNAMIC half
+                    (delta_pass.verify_patched_entries) proves every
+                    surviving patched entry against fresh execution
+                    within that bound — docs/IVM.md
 """
 
 from __future__ import annotations
@@ -52,6 +58,7 @@ import logging
 from typing import List, Optional
 
 from matrel_tpu.analysis.brownout_pass import check_brownout_stamps
+from matrel_tpu.analysis.delta_pass import check_delta_stamps
 from matrel_tpu.analysis.diagnostics import (  # noqa: F401 (re-export)
     Diagnostic, VerificationError)
 from matrel_tpu.analysis.fusion_pass import check_fusion_stamps
@@ -85,6 +92,7 @@ PASSES = (
     ("reshard", check_reshard_peaks),
     ("fusion", check_fusion_stamps),
     ("brownout", check_brownout_stamps),
+    ("delta", check_delta_stamps),
 )
 
 

@@ -317,6 +317,23 @@ class MatrelConfig:
         (est saved dispatches / HBM bytes), and MV111 verifies every
         stamp. The degradation ladder's rung 3 forces this off so a
         miscompiling fused region cannot survive retry.
+      delta_patch_mode: how ``session.register_delta`` maintains
+        dependent result-cache entries (serve/ivm.py; docs/IVM.md).
+        "auto" (the default): patch when a delta rule applies AND the
+        flop estimate (or a measured autotune ``ivm|`` winner, which
+        overrides it) says the patch beats recompute — everything
+        else falls back to the historical transitive kill. "force":
+        patch every eligible entry regardless of pricing (test /
+        bench forcing knob). "off": register_delta rebinds and kills
+        like a plain register() — the escape hatch. Inert until
+        register_delta is ever called: the default path constructs no
+        delta objects and every cache key keeps its historical format
+        (test-enforced bit-identity).
+      delta_rank_max: largest factored rank a delta is worth keeping
+        in thin ``U·Vᵀ`` form (ir/delta.py): a c-edge COO batch is
+        exactly a rank-c update, and above this bound the thin
+        products stop being thin — the delta then enters patches as
+        its dense/sparse materialization (or prices out entirely).
       axis_cost_weights: per-mesh-axis relative inverse-bandwidth
         weights for the planner's comm model (core/mesh.MeshTopology):
         a collective leg over axis i is billed bytes × weights[i], so
@@ -394,6 +411,8 @@ class MatrelConfig:
     precision_enable_bf16: bool = True
     precision_enable_int: bool = True
     fusion_enable: bool = False
+    delta_patch_mode: str = "auto"
+    delta_rank_max: int = 512
 
     def __post_init__(self):
         # enablement is "anything != off", so an unvalidated typo/case
@@ -534,6 +553,21 @@ class MatrelConfig:
         # construction (case-insensitive, "bf16" normalised).
         object.__setattr__(self, "precision_sla",
                            normalize_sla(self.precision_sla))
+        # IVM knobs (docs/IVM.md): a typo'd mode ("of", "forced")
+        # would silently run "auto" while the operator believes the
+        # ladder's escape hatch is in force — the obs_level precedent;
+        # a non-positive rank bound would disable the factored form
+        # while reading as "unlimited"
+        mode = self.delta_patch_mode.lower()
+        if mode not in ("auto", "force", "off"):
+            raise ValueError(
+                f"delta_patch_mode must be one of 'auto'/'force'/"
+                f"'off', got {self.delta_patch_mode!r}")
+        object.__setattr__(self, "delta_patch_mode", mode)
+        if self.delta_rank_max < 1:
+            raise ValueError(
+                f"delta_rank_max must be >= 1, "
+                f"got {self.delta_rank_max!r}")
         # same hazard for the kernel forcing knob: a typo'd override
         # would surface only as a mid-traffic ValueError on the first
         # dispatching query — or never, while the operator believes

@@ -1630,6 +1630,17 @@ def plan_matmul_decisions(plan) -> List[dict]:
         meta["matmuls"] = [
             d for o in roots
             for d in planner.matmul_decisions(o, plan.mesh, plan.config)]
+        ivm = meta.get("ivm")
+        if isinstance(ivm, dict):
+            # delta-patch plans (serve/ivm.py; docs/IVM.md): the
+            # optimizer may rebuild the stamped root, so the pricing
+            # provenance rides plan.meta and is threaded onto the
+            # decision records here (planner.matmul_decisions also
+            # reads a surviving root stamp — one meaning, two feeds)
+            for d in meta["matmuls"]:
+                d.setdefault("delta_rule", ivm.get("rule"))
+                d.setdefault("delta_est_saved_flops",
+                             ivm.get("est_saved_flops"))
     return meta["matmuls"]
 
 

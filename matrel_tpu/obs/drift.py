@@ -370,6 +370,17 @@ def report(events: List[dict],
            persist: bool = True) -> str:
     """The drift-audit text: calibration rows, rank-order flags, and
     (when ``persist``) the table merge."""
+    return audit(events, table_path_str, persist)[0]
+
+
+def audit(events: List[dict],
+          table_path_str: Optional[str] = None,
+          persist: bool = True):
+    """(report text, rank-order flags) — the machine-checkable face of
+    the drift audit: ``history --drift --check`` exits nonzero when
+    any flag fired, so ``make obs-report`` (and CI) gate on cost-model
+    drift instead of a human reading the table (ROADMAP item 4's first
+    consumable bite)."""
     samples = list(iter_samples(events))
     calib = calibrate(samples)
     flags = rank_flags(samples)
@@ -417,4 +428,4 @@ def report(events: List[dict],
                          f"({len(table['entries'])} entries)")
         except OSError as e:     # auditing must not fail on a bad disk
             lines.append(f"calibration table NOT persisted: {e}")
-    return "\n".join(lines)
+    return "\n".join(lines), flags

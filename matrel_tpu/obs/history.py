@@ -160,6 +160,7 @@ def summarize(events: List[dict]) -> dict:
         "cache_hits": hits,
         "cache_hit_rate": round(hits / len(qs), 3) if qs else None,
         "rc_hits": sum(1 for e in qs if e.get("cache") == "rc_hit"),
+        "ivm": _summarize_ivm(events),
         "serve": _summarize_serve(events),
         "resilience": _summarize_resilience(events, len(qs)),
         "overload": _summarize_overload(events),
@@ -311,6 +312,51 @@ def _summarize_resilience(events: List[dict], n_queries: int) -> dict:
     }
 
 
+def _summarize_ivm(events: List[dict]) -> Optional[dict]:
+    """Roll up ``delta`` records (one per session.register_delta —
+    serve/ivm.py; docs/IVM.md) into the incremental-view-maintenance
+    headline: how many cached entries were patched in place vs killed
+    (the historical behaviour), how often a compiled patch plan was
+    REUSED with rebound leaves (the steady-state stream path), the
+    per-rule census, and the modelled FLOPs the patches avoided.
+    Per-record fields are per-generation deltas, so summing is correct
+    across sessions (the serve roll-up's discipline). None when the
+    delta plane was never used — the summary stays byte-identical for
+    historical logs."""
+    dv = [e for e in events if e.get("kind") == "delta"]
+    if not dv:
+        return None
+    rules: Dict[str, int] = {}
+    patched = killed = rekeyed = priced_out = reused = 0
+    saved = 0.0
+    names: Dict[str, int] = {}
+    for e in dv:
+        patched += int(e.get("patched") or 0)
+        killed += int(e.get("killed") or 0)
+        rekeyed += int(e.get("rekeyed") or 0)
+        priced_out += int(e.get("priced_out") or 0)
+        reused += int(e.get("reused_plans") or 0)
+        saved += float(e.get("est_saved_flops") or 0.0)
+        names[str(e.get("name") or "?")] = \
+            names.get(str(e.get("name") or "?"), 0) + 1
+        for r, n in (e.get("rules") or {}).items():
+            rules[r] = rules.get(r, 0) + int(n)
+    examined = patched + killed
+    return {
+        "registers": len(dv),
+        "patched": patched,
+        "killed": killed,
+        "priced_out": priced_out,
+        "rekeyed": rekeyed,
+        "reused_plans": reused,
+        "patch_rate": (round(patched / examined, 3) if examined
+                       else None),
+        "est_saved_gflops": round(saved / 1e9, 3),
+        "rules": rules,
+        "names": names,
+    }
+
+
 def _summarize_overload(events: List[dict]) -> Optional[dict]:
     """Roll up ``overload`` records (one per admission cycle while the
     control plane is active — serve/pipeline.py; docs/OVERLOAD.md)
@@ -451,6 +497,18 @@ def render_summary(events: List[dict]) -> str:
                     f"{t:<14}{d['admitted']:>9}{d['sheds']:>7}"
                     f"{_fmt(d['shed_rate'], 3):>11}"
                     f"{_fmt(d['queue_wait_p99_ms']):>10} ms")
+    ivm = s.get("ivm")
+    if ivm:
+        lines.append(
+            f"ivm: {ivm['registers']} delta(s), {ivm['patched']} "
+            f"patched / {ivm['killed']} killed "
+            f"({ivm['priced_out']} priced out; patch rate "
+            f"{_fmt(ivm['patch_rate'], 3)}), {ivm['reused_plans']} "
+            f"plan reuse(s), {ivm['rekeyed']} rekeyed, est saved "
+            f"{_fmt(ivm['est_saved_gflops'])} GFLOPs"
+            + ("; rules: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(ivm["rules"].items()))
+               if ivm.get("rules") else ""))
     sv = s.get("serve") or {}
     if sv.get("batches"):
         lines.append(
@@ -542,12 +600,20 @@ def main(args) -> int:
     if getattr(args, "drift", False):
         # the cost-model drift auditor (obs/drift.py): calibration
         # ratios + rank-order flags, table persisted next to the
-        # autotune tables
+        # autotune tables. --check turns the flags into an exit code
+        # so `make obs-report` / CI gate on drift instead of a human
+        # reading the table (ROADMAP item 4's first consumable bite)
         from matrel_tpu.obs import drift
-        print(drift.report(
+        text, flags = drift.audit(
             events,
             table_path_str=getattr(args, "drift_table", None),
-            persist=not getattr(args, "no_save", False)))
+            persist=not getattr(args, "no_save", False))
+        print(text)
+        if getattr(args, "check", False) and flags:
+            print(f"DRIFT CHECK FAILED: {len(flags)} rank-order "
+                  f"flag(s) — the planner prefers a strategy that "
+                  f"measures slower")
+            return 1
     elif args.summary:
         print(render_summary(events))
     else:

@@ -126,6 +126,12 @@ def _current_key_format(key: str) -> bool:
             return False
     elif key.startswith("fuse|"):
         base = 5         # fuse|<sig>|<=side|grid|backend (round 12)
+    elif key.startswith("ivm|"):
+        base = 5         # ivm|<rule>|<=side|grid|backend (round 14);
+        # rules from a retired vocabulary prune like spgemm structures
+        from matrel_tpu.ir import delta as delta_lib
+        if n >= 2 and fields[1] not in delta_lib.DELTA_RULES:
+            return False
     else:
         base = 4
     if n == base:
@@ -888,6 +894,78 @@ def lookup_or_measure_reshard(plan, mesh,
         return None
     best = _pick_winner(results)
     _RESHARD_CACHE[key] = best
+    if cfg.autotune or cfg.autotune_table_path:
+        _persist(_table_path(cfg), key, best, results)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# IVM patch-vs-recompute measurement (round 14) — the closed loop for the
+# delta plane (serve/ivm.py; docs/IVM.md): per (delta rule, shape class,
+# grid, backend), time the compiled patch plan's steady-state run against
+# a fresh full-recompute plan's run and persist the winner like every
+# other table family, so a backend where recompute beats the algebraic
+# patch (tiny shapes, fat deltas) KILLS the entry instead of patching at
+# a loss — the measured winner overrides the flop estimate, the `fuse|`
+# precedent.
+# ---------------------------------------------------------------------------
+
+_IVM_CACHE: Dict[str, Optional[str]] = {}
+
+IVM_VARIANTS = ("patch", "recompute")
+
+
+def _ivm_key(rule: str, side: int, gx: int, gy: int,
+             weights: Tuple[float, float] = (1.0, 1.0)) -> str:
+    """``ivm|<rule>|<=side|gxXgy|backend[|w..]`` — side bucketed to the
+    power of two at or above it (the drift auditor's shape-class
+    granularity), rule from ir/delta.DELTA_RULES."""
+    cls = 1 << max(0, math.ceil(math.log2(max(side, 1))))
+    key = f"ivm|{rule}|{cls}|{gx}x{gy}|{jax.default_backend()}"
+    if weights != (1.0, 1.0):
+        key += f"|w{weights[0]:g}x{weights[1]:g}"
+    return key
+
+
+def lookup_or_measure_ivm(rule: str, side: int, mesh,
+                          config: Optional[MatrelConfig] = None,
+                          patch_s=None, full_s=None) -> Optional[str]:
+    """Measured patch-vs-recompute winner for one (rule, shape class):
+    "patch" / "recompute" / None (no measured preference — the flop
+    estimate decides). ``patch_s``/``full_s`` are zero-arg callables
+    returning median steady-state seconds for the two forms, invoked
+    at most once each (the delta plane passes timed runs of plans it
+    holds anyway); lookups without runners never measure. Ties and
+    one-variant sets resolve to None and are never fake winners —
+    the fusion loop's discipline verbatim."""
+    cfg = config or default_config()
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    key = _ivm_key(rule, side, gx, gy, mesh_lib.axis_weights(mesh, cfg))
+    if key in _IVM_CACHE:
+        return _IVM_CACHE[key]
+    entry = _load_table_cached(_table_path(cfg)).get(key)
+    if isinstance(entry, dict) and entry.get("times"):
+        best = entry.get("best")
+        best = best if isinstance(best, str) else None
+        _IVM_CACHE[key] = best
+        return best
+    if patch_s is None or full_s is None or side > cfg.autotune_max_dim:
+        # no negative caching without a measurement: a later call that
+        # CAN measure (runners in hand) must still get its chance
+        return None
+    results: Dict[str, float] = {}
+    for name, fn in (("patch", patch_s), ("recompute", full_s)):
+        try:
+            t = float(fn())
+        except Exception:  # noqa: BLE001  # matlint: disable=ML007 measurement loop — a variant failing on this backend drops out of the table
+            continue
+        if t > 0.0:
+            results[name] = t
+    if len(results) < 2:
+        _IVM_CACHE[key] = None
+        return None
+    best = _pick_winner(results)
+    _IVM_CACHE[key] = best
     if cfg.autotune or cfg.autotune_table_path:
         _persist(_table_path(cfg), key, best, results)
     return best

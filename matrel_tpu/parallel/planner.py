@@ -1621,6 +1621,16 @@ def matmul_decisions(root: MatExpr, mesh: Mesh,
                         rec["reshard"] = rr
             except ValueError:       # an override string the model
                 rec["est_ici_bytes"] = None   # doesn't know
+        ivm = root.attrs.get("ivm_patch")
+        if isinstance(ivm, dict):
+            # this plan IS a delta patch (serve/ivm.py stamps the root;
+            # docs/IVM.md): every decision record carries the pricing
+            # that chose patching over recompute, so the obs surfaces
+            # (query events, explain(analyze=True), the history IVM
+            # roll-up) can audit the patch-vs-recompute call the way
+            # they audit strategy choices
+            rec["delta_rule"] = ivm.get("rule")
+            rec["delta_est_saved_flops"] = ivm.get("est_saved_flops")
         fr = fused_of.get(n.uid)
         if fr is not None:
             # this matmul anchors a fused region: the decision record

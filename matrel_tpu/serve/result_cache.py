@@ -28,6 +28,7 @@ the caller's thread share one cache.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import threading
 from collections import OrderedDict
 from typing import FrozenSet, Optional, Tuple
@@ -66,6 +67,23 @@ class CacheEntry:
       claims to the planner, and what MV107 re-checks.
     dtype: canonical numpy dtype name of the result at insertion.
     nbytes: device bytes the entry pins (eviction accounting).
+    expr: the query expression this result computed (PRE-substitution,
+      rebased onto the live binding when patched) — what the delta
+      plane (ir/delta.py; docs/IVM.md) derives patches from and what
+      MV113's dynamic check re-executes fresh. A plain reference; no
+      extra device memory, no behavior change when deltas are unused.
+    prec: the precision-tier key prefix this entry keyed under (the
+      ``prec:<sla>|`` idiom) — patching re-keys under the SAME tier,
+      so SLA isolation survives a delta generation.
+    err_bound: composed numeric error bound of the stored result
+      (the stamped tier's bound at insertion, PLUS each patch's
+      contribution — docs/IVM.md error-bound composition). MV113's
+      dynamic check verifies patched results within it; 0 = exact.
+    delta_gen: delta generation of the last patch (0 = fresh
+      execution, never patched) — the provenance stamp.
+    delta_rule: ir/delta.DELTA_RULES member of the last patch.
+    ivm_id: stable identity across patch generations (the delta
+      plane's patch-plan reuse key; None until first patched).
     """
 
     key_hash: str
@@ -75,6 +93,12 @@ class CacheEntry:
     layout: str
     dtype: str
     nbytes: int
+    expr: Optional[object] = None
+    prec: str = ""
+    err_bound: float = 0.0
+    delta_gen: int = 0
+    delta_rule: Optional[str] = None
+    ivm_id: Optional[int] = None
 
 
 class ResultCache:
@@ -107,6 +131,12 @@ class ResultCache:
         self._stale: "OrderedDict[str, tuple]" = OrderedDict()
         self._stale_bytes = 0
         self.stale_hits = 0
+        # incremental view maintenance (docs/IVM.md): lifetime counts
+        # of entries PATCHED in place by a registered delta and of
+        # entries renamed across a delta generation — both zero until
+        # register_delta is ever used (the bit-identity contract)
+        self.patched = 0
+        self.rekeyed = 0
 
     def lookup(self, key: str) -> Optional[CacheEntry]:
         with self._lock:
@@ -222,6 +252,102 @@ class ResultCache:
             self.stale_hits += 1
             return ent
 
+    # -- incremental view maintenance — the ONE sanctioned patch/apply
+    # -- seam (docs/IVM.md; matlint ML012 pins entry mutation here) ----
+
+    def items_snapshot(self):
+        """(key, entry) pairs in LRU order — the delta plane's (and
+        MV113's dynamic check's) read surface. A list copy: the plane
+        mutates the cache through the seam while iterating."""
+        with self._lock:
+            return list(self._entries.items())
+
+    def drop(self, key: str, keep_stale: bool = False,
+             stale_max: int = 0, stale_max_bytes: int = 0) -> bool:
+        """Invalidate ONE entry by key (the per-entry face of
+        ``invalidate_deps`` — same counting, same brownout-graveyard
+        semantics) — the delta plane's ineligible-entry fallback, so
+        a kill here is indistinguishable from today's rebind kill."""
+        with self._lock:
+            ent = self._entries.pop(key, None)
+            if ent is None:
+                return False
+            self._bytes = max(self._bytes - ent.nbytes, 0)
+            self.invalidated += 1
+            if keep_stale and stale_max > 0 \
+                    and 0 < ent.nbytes <= stale_max_bytes:
+                old = self._stale.pop(key, None)
+                if old is not None:
+                    self._stale_bytes -= old[0].nbytes
+                self._stale[key] = (ent, _now())
+                self._stale_bytes += ent.nbytes
+                while self._stale and (
+                        len(self._stale) > stale_max
+                        or self._stale_bytes > stale_max_bytes):
+                    _, (dropped, _t) = self._stale.popitem(last=False)
+                    self._stale_bytes -= dropped.nbytes
+                self._stale_bytes = max(self._stale_bytes, 0)
+            return True
+
+    def rekey(self, old_key: str, new_key: str) -> bool:
+        """Rename a LIVE entry across a delta generation (payload
+        untouched; key_hash re-derived so obs/MV107 stamps keep naming
+        the key that actually maps to the entry). LRU position is
+        preserved by insertion order of the rename pass."""
+        with self._lock:
+            ent = self._entries.pop(old_key, None)
+            if ent is None:
+                return False
+            self._entries[new_key] = dataclasses.replace(
+                ent, key_hash=hashlib.sha1(
+                    new_key.encode()).hexdigest()[:16])
+            self.rekeyed += 1
+            return True
+
+    def apply_patch(self, old_key: str, new_key: str,
+                    entry: CacheEntry, max_bytes: int,
+                    max_entries: int = 0) -> bool:
+        """Replace a cached entry with its delta-PATCHED successor
+        under the new generation's key — the in-place maintenance the
+        transitive kill used to be. The old slot is removed without
+        counting an invalidation (nothing was lost — the value was
+        maintained); insertion goes through :meth:`put`, so byte/entry
+        budgets and LRU eviction apply to patched entries exactly as
+        to fresh ones. Returns False when the patched result no longer
+        fits the budget — the OLD entry is then restored untouched, so
+        the caller's fallback kill routes it through :meth:`drop` with
+        the normal invalidation accounting and brownout-graveyard
+        semantics (silently vanishing would undercount ``invalidated``
+        and starve rung-2 stale serving of an entry it was owed)."""
+        with self._lock:
+            old = self._entries.pop(old_key, None)
+            if old is not None:
+                self._bytes = max(self._bytes - old.nbytes, 0)
+            ok = self.put(new_key, entry, max_bytes, max_entries)
+            if ok:
+                self.patched += 1
+            elif old is not None:
+                self._entries[old_key] = old
+                self._bytes += old.nbytes
+            return ok
+
+    def rebuild_stale(self, rename, dep_ids: FrozenSet[int]) -> None:
+        """Carry the brownout graveyard across a delta generation:
+        ghosts depending on the rebound matrix drop (their values are
+        two bindings stale), the rest rename via ``rename(key) ->
+        new_key`` so a later brownout can still serve them under the
+        new generation's key format."""
+        ids = frozenset(dep_ids)
+        with self._lock:
+            fresh: "OrderedDict[str, tuple]" = OrderedDict()
+            for k, (ent, t) in self._stale.items():
+                if ent.dep_ids & ids:
+                    self._stale_bytes -= ent.nbytes
+                    continue
+                fresh[rename(k)] = (ent, t)
+            self._stale = fresh
+            self._stale_bytes = max(self._stale_bytes, 0)
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
@@ -241,4 +367,6 @@ class ResultCache:
                     "invalidated": self.invalidated,
                     "stale_entries": len(self._stale),
                     "stale_bytes": self._stale_bytes,
-                    "stale_hits": self.stale_hits}
+                    "stale_hits": self.stale_hits,
+                    "patched": self.patched,
+                    "rekeyed": self.rekeyed}

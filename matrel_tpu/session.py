@@ -92,6 +92,13 @@ class MatrelSession:
         self._brownout = brownout_lib.from_config(self.config)
         self._breakers = breaker_lib.BreakerRegistry.from_config(
             self.config)
+        # incremental view maintenance (serve/ivm.py; docs/IVM.md):
+        # the delta plane is built lazily on the FIRST register_delta
+        # — generation 0 means it was never used, every result-cache
+        # key keeps the historical format, and zero delta-plane
+        # objects exist (the brownout/breaker zero-object contract)
+        self._delta_plane = None
+        self._delta_gen = 0
 
     # -- builder (MatfastSession.builder().getOrCreate() analogue) ---------
 
@@ -160,6 +167,41 @@ class MatrelSession:
 
     def table(self, name: str) -> BlockMatrix:
         return self.catalog[name]
+
+    def register_delta(self, name: str, delta, kind: str = "auto"
+                       ) -> dict:
+        """Rebind a catalog name to ``A + ΔA`` and MAINTAIN dependent
+        cached results instead of invalidating them (incremental view
+        maintenance — serve/ivm.py, ir/delta.py; docs/IVM.md).
+
+        ``delta`` is the update in whichever form the caller has it:
+        ``(rows, cols[, vals])`` edge arrays or a COOMatrix (``kind=
+        "coo"``), a ``(U, V)`` pair with ``ΔA = U·Vᵀ`` (``kind=
+        "lowrank"``), or a same-shaped array (``kind="dense"``);
+        ``kind="auto"`` disambiguates by shape. Each cached entry
+        depending on the old binding is patched in place through the
+        delta algebra where a rule applies AND the patch prices below
+        recompute (``config.delta_patch_mode``; a measured autotune
+        ``ivm|`` winner overrides the estimate); everything else falls
+        back to exactly the historical transitive kill, so answers are
+        never wrong — at worst a repeat pays recompute like today.
+
+        Patched entries carry ``delta:<gen>|`` provenance in their
+        cache keys and a composed error bound MV113 verifies against
+        fresh execution. Returns the maintenance summary (also emitted
+        as a ``delta`` obs event)."""
+        old = self.catalog.get(name)
+        if old is None:
+            raise KeyError(
+                f"register_delta: {name!r} is not a bound catalog "
+                f"name — register() it first")
+        from matrel_tpu.ir import delta as delta_lib
+        d = delta_lib.as_delta(delta, old, kind, self.config)
+        with self._compile_lock:
+            if self._delta_plane is None:
+                from matrel_tpu.serve.ivm import DeltaPlane
+                self._delta_plane = DeltaPlane(self)
+            return self._delta_plane.apply(name, old, d)
 
     def save_catalog(self, directory: str,
                      step: Optional[int] = None) -> str:
@@ -392,6 +434,18 @@ class MatrelSession:
         info["max_entries"] = self.config.result_cache_max_entries
         return info
 
+    def _rc_key_prefix(self, sla: str) -> str:
+        """The full result-cache key prefix of one query: the delta
+        GENERATION prefix (``delta:<gen>|`` — docs/IVM.md; empty until
+        ``register_delta`` is ever used, so the historical key format
+        is bit-identical) composed with the precision-tier isolation
+        prefix. Generations partition the cache the way SLAs do: a
+        patched entry from generation N can never answer a query at
+        N+1 without having been re-patched (or re-executed)."""
+        gen = self._delta_gen
+        return (("" if not gen else f"delta:{gen}|")
+                + _prec_prefix(sla))
+
     def _rc_admit(self, e: MatExpr, prefix: str = ""):
         """One result-cache admission for a query: (entry-or-None,
         root key, pins, possibly-substituted expr). ONE structural walk
@@ -425,12 +479,21 @@ class MatrelSession:
         the cache still agree, plus the transitive dep ids consumers
         fold into their own invalidation sets."""
         from matrel_tpu.ir import expr as expr_mod
-        return expr_mod.leaf(ent.result).with_attrs(result_cache={
+        stamp = {
             "key_hash": ent.key_hash,
             "layout": ent.layout,
             "dtype": ent.dtype,
             "deps": sorted(ent.dep_ids),
-        })
+        }
+        if ent.delta_gen:
+            # IVM provenance (docs/IVM.md): the consumed value was
+            # delta-PATCHED, not freshly executed — MV113's static
+            # half checks the stamp's coherence, its dynamic half
+            # re-proves the value against fresh execution
+            stamp["delta"] = {"gen": ent.delta_gen,
+                              "rule": ent.delta_rule,
+                              "err_bound": ent.err_bound}
+        return expr_mod.leaf(ent.result).with_attrs(result_cache=stamp)
 
     def _rc_substitute(self, e: MatExpr, parts: Optional[list] = None,
                        spans: Optional[dict] = None,
@@ -502,18 +565,27 @@ class MatrelSession:
                 or staleness_ms <= 0):
             return None
         parts, _pins, _spans = _plan_key_spans(e)
-        key = _prec_prefix(sla) + "|".join(parts)
+        key = self._rc_key_prefix(sla) + "|".join(parts)
         return self._result_cache.lookup_stale(key, staleness_ms)
 
     def _rc_insert(self, key: str, pins: list, executed: MatExpr,
-                   out: BlockMatrix) -> None:
+                   out: BlockMatrix, orig: Optional[MatExpr] = None,
+                   prec: str = "", plan=None) -> None:
         """Cache one executed query result under its structural key.
         ``executed`` is the (possibly substituted) tree that actually
         ran — its leaves name the dep matrices; ``pins`` are the key's
         id()-referenced objects (kept alive with the entry so the key
-        can never falsely hit a recycled address)."""
+        can never falsely hit a recycled address). ``orig`` is the
+        PRE-substitution query tree (what the delta plane derives
+        patches from — docs/IVM.md); ``prec`` the tier prefix the key
+        carries; ``plan`` supplies the stamped tier's error bound so
+        patched descendants compose bounds from the right floor."""
         from matrel_tpu.parallel import planner
         from matrel_tpu.ir import expr as expr_mod
+        bound = 0.0
+        if plan is not None:
+            bound = float(((plan.meta or {}).get("precision") or {})
+                          .get("est_rel_err_bound") or 0.0)
         ent = CacheEntry(
             key_hash=hashlib.sha1(key.encode()).hexdigest()[:16],
             result=out,
@@ -522,6 +594,9 @@ class MatrelSession:
             layout=planner._layout_of(expr_mod.leaf(out), self.mesh),
             dtype=str(np.dtype(out.dtype)),
             nbytes=result_nbytes(out),
+            expr=orig if orig is not None else executed,
+            prec=prec,
+            err_bound=bound,
         )
         self._result_cache.put(key, ent,
                                self.config.result_cache_max_bytes,
@@ -743,6 +818,27 @@ class MatrelSession:
         REGISTRY.counter("query.count").inc()
         REGISTRY.counter("result_cache.hit").inc()
 
+    def _emit_delta_event(self, record: dict) -> None:
+        """One ``delta`` record per register_delta (obs on / flight
+        recorder on; no-op otherwise — the default path emits nothing):
+        the maintenance summary — entries patched / killed / rekeyed,
+        per-rule census, modelled FLOPs saved — the ``history
+        --summary`` IVM roll-up's feed. Never fails the register."""
+        if not self._obs_enabled() and self._flight is None:
+            return
+        from matrel_tpu.obs.metrics import REGISTRY
+        try:
+            rec = dict(record)
+            if self._rc_enabled():
+                rec["result_cache"] = self._result_cache.info()
+            self._obs_emit("delta", rec)
+            REGISTRY.counter("ivm.registered").inc()
+            REGISTRY.counter("ivm.patched").inc(
+                record.get("patched", 0))
+            REGISTRY.counter("ivm.killed").inc(record.get("killed", 0))
+        except Exception:
+            log.warning("obs: delta event dropped", exc_info=True)
+
     def _emit_serve_event(self, record: dict) -> None:
         """One ``serve`` record per micro-batched admission (obs on
         only): batch size, queue-wait per query, result-cache state,
@@ -866,9 +962,11 @@ class MatrelSession:
         the resilient path's degradation-ladder step (0 = none)."""
         sla = sla if sla is not None else self.config.precision_sla
         key = pins = None
+        orig = e
         if rc:
             with trace_lib.span("rc.probe") as sp:
-                ent, key, pins, e = self._rc_admit(e, _prec_prefix(sla))
+                ent, key, pins, e = self._rc_admit(
+                    e, self._rc_key_prefix(sla))
                 sp.set(hit=ent is not None)
             if ent is not None:
                 # repeated query: answered from the materialized-result
@@ -894,7 +992,8 @@ class MatrelSession:
             with trace_lib.span("query.execute"):
                 out = plan.run()
         if rc:
-            self._rc_insert(key, pins, e, out)
+            self._rc_insert(key, pins, e, out, orig=orig,
+                            prec=_prec_prefix(sla), plan=plan)
         return out
 
     # -- resilient execution (matrel_tpu/resilience/) ----------------------
@@ -1097,10 +1196,11 @@ class MatrelSession:
         rc_meta: dict = {}
         pend: list = []
         for i, e in enumerate(es):
+            orig = e
             if rc:
                 with trace_lib.span("rc.probe", index=i) as sp:
                     ent, key, pins, e = self._rc_admit(
-                        e, _prec_prefix(sla))
+                        e, self._rc_key_prefix(sla))
                     sp.set(hit=ent is not None)
                 if ent is not None:
                     results[i] = ent.result
@@ -1113,7 +1213,7 @@ class MatrelSession:
                             log.warning("obs: query event dropped",
                                         exc_info=True)
                     continue
-                rc_meta[i] = (key, pins)
+                rc_meta[i] = (key, pins, orig)
             pend.append((i, e))
         execute_ms = 0.0
         plan_hit = None
@@ -1141,8 +1241,9 @@ class MatrelSession:
                 out = outs[pos[k]]
                 results[i] = out
                 if rc:
-                    key, pins = rc_meta[i]
-                    self._rc_insert(key, pins, e, out)
+                    key, pins, orig = rc_meta[i]
+                    self._rc_insert(key, pins, e, out, orig=orig,
+                                    prec=_prec_prefix(sla), plan=plan)
                 if obs:
                     try:
                         per_root = executor_lib.multiplan_root_decisions(

@@ -204,6 +204,29 @@ def test_serve_row_artifact(dry_batch):
                             "half_width_frac", "replays"}
 
 
+def test_stream_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records,
+               lambda r: r.get("metric") == "stream_update_latency"
+               and "speedup" in r, "bench.py --stream")
+    # the round-14 acceptance number (docs/IVM.md): delta-patch
+    # steady-state update latency >= 3x faster than full recompute on
+    # the small-delta stream, CPU backend, with MV113 proving every
+    # surviving patched entry and zero wrong answers (the measurement
+    # child bit-exact-asserts the integer queries itself — rec["ok"]
+    # carries that verdict)
+    assert rec["speedup"] is not None and rec["speedup"] >= 3.0, rec
+    assert rec["ok"] is True, rec
+    assert rec["patch"]["mv113"] == [], rec["patch"]["mv113"]
+    assert rec["patch"]["patched_per_update"] > 0
+    assert rec["patch"]["reused_plans"] > 0
+    assert rec["patch"]["median_ms"] > 0
+    assert rec["recompute"]["median_ms"] > rec["patch"]["median_ms"]
+    for side in ("patch", "recompute"):
+        assert set(rec[side]) >= {"median_ms", "half_width_ms",
+                                  "updates"}
+
+
 def test_precision_row_artifact(dry_batch):
     _, records, _ = dry_batch
     rec = _one(records,

@@ -669,6 +669,77 @@ class TestML011UnboundedQueue:
             assert [f for f in got if f.rule == "ML011"] == []
 
 
+class TestML012ResultCacheSeam:
+    def test_fires_on_entry_field_store(self, tmp_path):
+        src = """
+            def poke(ent, bm):
+                ent.result = bm
+                return ent
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newplane.py")
+        assert _rules(got) == ["ML012"]
+
+    def test_fires_on_augassign_and_del(self, tmp_path):
+        src = """
+            def poke(ent):
+                ent.err_bound += 1.0
+                del ent.delta_rule
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/session_helper.py")
+        assert [f.rule for f in got] == ["ML012", "ML012"]
+
+    def test_fires_on_internal_store_access(self, tmp_path):
+        src = """
+            def sneak(cache, key):
+                cache._entries.pop(key, None)
+                return cache._stale
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newplane.py")
+        assert _rules(got) == ["ML012"]
+        assert len(got) == 2
+
+    def test_replace_and_seam_calls_pass(self, tmp_path):
+        # dataclasses.replace builds a NEW entry (the seam inserts
+        # it), and the sanctioned seam methods are the whole point
+        src = """
+            import dataclasses
+            def patch(cache, key, new_key, ent, bm, nb):
+                new = dataclasses.replace(ent, result=bm, nbytes=nb)
+                cache.apply_patch(key, new_key, new, 1 << 20)
+                cache.rekey(key, new_key)
+                cache.drop(key)
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newplane.py") == []
+
+    def test_owning_module_exempt(self, tmp_path):
+        src = """
+            def inside(self, key):
+                self._entries[key] = 1
+                self._stale.clear()
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/result_cache.py") == []
+
+    def test_suppression_with_justification(self, tmp_path):
+        src = """
+            def poke(cache):
+                return len(cache._entries)  # matlint: disable=ML012 test-only census helper, lock held by caller
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newplane.py") == []
+
+    def test_ivm_plane_is_seam_clean(self):
+        # the delta plane is the rule's raison d'être — it must route
+        # every mutation through the seam with ZERO suppressions
+        import os
+        path = os.path.join(matlint.REPO, "matrel_tpu", "serve",
+                            "ivm.py")
+        assert "disable=ML012" not in open(path).read()
+        got = matlint.lint_file(path)
+        assert [f for f in got if f.rule == "ML012"] == []
+
+
 def test_repo_lints_clean():
     """`make lint`'s contract, enforced from inside tier-1: the whole
     default scan set (package, tools, examples, bench harnesses) has

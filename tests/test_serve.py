@@ -519,7 +519,8 @@ class TestResultCacheInfoSurface:
         assert set(info) == {"entries", "bytes", "hits", "misses",
                              "interior_hits", "evicted", "invalidated",
                              "stale_entries", "stale_bytes",
-                             "stale_hits", "max_bytes", "max_entries"}
+                             "stale_hits", "max_bytes", "max_entries",
+                             "patched", "rekeyed"}
         assert info["max_bytes"] == RC["result_cache_max_bytes"]
         assert info["max_entries"] == 256
 

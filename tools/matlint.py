@@ -37,6 +37,9 @@ Rule catalogue (each rule's class docstring is the authority):
   ML011  unbounded-queue growth idiom: deque()/queue.Queue() without
          a bound in matrel_tpu/serve/, or threading.Thread without
          an explicit daemon= anywhere in the package
+  ML012  ResultCache entry payloads mutated outside the sanctioned
+         patch/apply seam in serve/result_cache.py (the ML009/ML010
+         one-seam idiom applied to cached state)
 """
 
 from __future__ import annotations
@@ -678,12 +681,81 @@ class UnboundedQueueRule(Rule):
                     "call site")
 
 
+@dataclasses.dataclass(frozen=True)
+class ResultCacheSeamRule(Rule):
+    """ML012: ResultCache entry payloads mutate ONLY through the
+    sanctioned patch/apply seam in serve/result_cache.py.
+
+    The IVM plane (serve/ivm.py; docs/IVM.md) made cached entries
+    LONG-LIVED MUTABLE STATE: a patched entry's result/deps/bound
+    must change together, under the cache lock, with the byte
+    accounting and the provenance stamp kept coherent — so every
+    mutation goes through ResultCache.apply_patch / rekey / drop /
+    put (the ML009 one-kernel-seam and ML010 one-jit-seam idiom,
+    applied to cached state). A module that pokes an entry's fields
+    or the cache's internal stores directly produces answers whose
+    provenance nobody can verify (MV113 would assert a bound the
+    mutation silently voided) and byte accounting that drifts from
+    the entries it claims to bound. Pinned, in matrel_tpu/ outside
+    serve/result_cache.py:
+
+    - attribute ASSIGNMENT (plain, augmented, or del) to a CacheEntry
+      payload field — result, dep_ids, pins, nbytes, key_hash,
+      err_bound, delta_gen, delta_rule, prec, ivm_id — on any object
+      (``dataclasses.replace`` builds a NEW entry and is fine; the
+      seam inserts it);
+    - any use of an attribute named ``_entries`` / ``_stale`` (the
+      cache's internal stores): subscript stores/deletes, mutating
+      method calls (pop/popitem/clear/update/setdefault/move_to_end),
+      or reads — outside the owning module even a read races the
+      serve worker without the cache lock.
+    """
+
+    id = "ML012"
+    _ENTRY_FIELDS = ("result", "dep_ids", "pins", "nbytes", "key_hash",
+                     "err_bound", "delta_gen", "delta_rule", "prec",
+                     "ivm_id")
+    _STORES = ("_entries", "_stale")
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("matrel_tpu/")
+                and relpath != "matrel_tpu/serve/result_cache.py")
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = node.targets
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and t.attr in self._ENTRY_FIELDS:
+                    yield Finding(
+                        relpath, node.lineno, self.id,
+                        f"direct store to a cache-entry payload field "
+                        f".{t.attr} — mutate entries only through the "
+                        f"ResultCache patch/apply seam "
+                        f"(apply_patch/rekey/drop/put in "
+                        f"serve/result_cache.py)")
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in self._STORES:
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    f"direct access to the result cache's internal "
+                    f".{node.attr} store — the entries mutate only "
+                    f"under the cache lock through the sanctioned "
+                    f"seam (serve/result_cache.py)")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
                         SpecKeyedCacheRule(), RawTimingRule(),
                         BroadSwallowRule(), DevicePutRule(),
                         KernelSeamRule(), JitSeamRule(),
-                        UnboundedQueueRule())
+                        UnboundedQueueRule(), ResultCacheSeamRule())
 
 
 def _suppressed_codes(line: str) -> set:

@@ -615,6 +615,90 @@ def soak_serve(n_trials: int, base: int, tol: float):
     return fails
 
 
+def soak_stream(n_trials: int, base: int, tol: float):
+    """Streaming-graph IVM battery (docs/IVM.md): a sliding-window
+    edge stream (workloads/streaming.py) drives register_delta ticks
+    over the dashboard query set, and EVERY tick's every answer is
+    checked against the numpy oracle — the integer queries (degrees,
+    label counts, common neighbors, trace(A³)) BIT-EXACTLY, so a
+    wrong patch can never hide in a tolerance. Also covered per
+    trial: an INELIGIBLE query (select_value — no delta rule) rides
+    the stream and must fall back to kill-and-recompute correctly;
+    MV113's dynamic check proves every surviving patched entry
+    against fresh execution; the PageRank warm restart lands on the
+    cold-start fixed point; and at least one entry actually PATCHED
+    (a battery that silently recomputed everything proves nothing)."""
+    import numpy as np
+    from matrel_tpu.analysis import delta_pass
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.ir.delta import pagerank_warm_restart
+    from matrel_tpu.session import MatrelSession
+    from matrel_tpu.workloads.streaming import StreamingGraph
+
+    mesh = mesh_lib.make_mesh()
+    fails = []
+    for trial in range(n_trials):
+        rng = np.random.default_rng(base + trial)
+        try:
+            n = int(rng.choice([96, 128, 160]))
+            batch = int(rng.choice([2, 3, 4]))
+            sess = MatrelSession(mesh=mesh, config=MatrelConfig(
+                result_cache_max_bytes=256 << 20))
+            g = StreamingGraph(sess, n=n, batch_edges=batch,
+                               window=int(rng.integers(3, 7)),
+                               feature_k=16, seed=base + trial)
+            thresh = float(rng.uniform(0.5, 1.5))
+            def ineligible():
+                # select_value has no delta rule — this entry MUST
+                # fall back to the transitive kill and recompute
+                return sess.table(g.name).expr().select_value(
+                    lambda v: v > thresh).sum()
+            g.run_all()
+            sess.run(ineligible())
+            g.pagerank()        # seed the cached vector: the check
+            total_patched = 0   # after the ticks must be a WARM call
+            for _tick in range(int(rng.integers(3, 6))):
+                s = g.step_delta()
+                total_patched += s["patched"]
+                got = g.run_all()
+                want = g.oracle()
+                for k in got:
+                    w = np.asarray(want[k], np.float32).reshape(
+                        got[k].shape)
+                    err = float(np.abs(got[k] - w).max())
+                    exact = k != "feature_product"
+                    if (err != 0.0) if exact else (err > tol):
+                        raise AssertionError(
+                            f"tick answer wrong: {k} err={err}")
+                ineo = sess.run(ineligible()).to_numpy()
+                wo = (g.adj * (g.adj > thresh)).sum()
+                if abs(float(ineo[0, 0]) - float(wo)) > tol * max(
+                        abs(wo), 1.0):
+                    raise AssertionError(
+                        "ineligible-query fallback answered wrong")
+                diags = delta_pass.verify_patched_entries(sess)
+                if diags:
+                    raise AssertionError(
+                        f"MV113: {diags[0].render()[:140]}")
+            if total_patched == 0:
+                raise AssertionError(
+                    "stream never patched a single entry — the "
+                    "battery exercised nothing")
+            assert g._pr is not None   # seeded above — this IS warm
+            pr = g.pagerank(rounds=80)
+            cold = pagerank_warm_restart(
+                g.adj.astype(np.float64),
+                np.full(g.n, 1.0 / g.n), rounds=300)
+            if float(np.abs(pr - cold).sum()) > 1e-5:
+                raise AssertionError("pagerank warm restart drifted "
+                                     "off the cold fixed point")
+        except Exception as ex:  # noqa: BLE001
+            fails.append(("stream", trial, type(ex).__name__,
+                          str(ex)[:150]))
+    return fails
+
+
 def soak_precision(n_trials: int, base: int, tol: float):
     """Precision-SLA battery: random matmul-shaped queries executed at
     every SLA tier against an f64 numpy oracle, asserting the
@@ -966,7 +1050,7 @@ def main():
                    choices=["fuzz", "deep", "spmv", "sharded", "routed",
                             "ckpt", "serve", "precision", "chaos",
                             "sparse_kernels", "fusion", "overload",
-                            "all"])
+                            "stream", "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -993,6 +1077,8 @@ def main():
         fails += soak_chaos(max(args.seeds // 4, 5), args.base, tol)
     if args.battery in ("overload", "all"):
         fails += soak_overload(max(args.seeds // 5, 5), args.base, tol)
+    if args.battery in ("stream", "all"):
+        fails += soak_stream(max(args.seeds // 5, 4), args.base, tol)
     if args.battery in ("precision", "all"):
         fails += soak_precision(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("sharded", "all"):

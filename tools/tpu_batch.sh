@@ -49,6 +49,8 @@ if [ "$DRY" = 1 ]; then
            MATREL_FUSION_REPEATS=5 MATREL_FUSION_INNER=4
     export MATREL_SERVE_N=256 MATREL_SERVE_K=64 \
            MATREL_SERVE_QUERIES=18 MATREL_SERVE_MEAS=3
+    export MATREL_STREAM_N=256 MATREL_STREAM_EDGES=8 \
+           MATREL_STREAM_UPDATES=3 MATREL_STREAM_K=16
     export MATREL_TRAFFIC_SECONDS=5 MATREL_TRAFFIC_TAIL_SECONDS=2.5 \
            MATREL_TRAFFIC_CAL=300 MATREL_TRAFFIC_N=48
     export MATREL_PRECISION_N=256 MATREL_PRECISION_REPEATS=3
@@ -76,6 +78,8 @@ log "--- bench.py --fusion (fused-vs-staged region sweep, staged this round)"
 python bench.py --fusion
 log "--- bench.py --serve (repeated-traffic serving QPS row, staged this round)"
 python bench.py --serve
+log "--- bench.py --stream (streaming IVM delta-patch vs recompute row, staged this round)"
+python bench.py --stream
 log "--- bench.py --precision (bf16/int precision-tier sweep + error bounds, staged this round)"
 python bench.py --precision
 log "--- bench.py --reshard (staged-vs-naive reshard sweep, staged this round)"
