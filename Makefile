@@ -16,9 +16,11 @@
 # native     — build the C++ optimizer/ingestion core
 # bench      — the driver's headline metric (TPU; wedge-safe)
 # obs-report — aggregate the repo's query/bench/soak event log
-#              (.matrel_events.jsonl — the history-server analogue),
-#              then the round-9 smokes over the same log: the
-#              cost-model drift audit (history --drift) and a
+#              (.matrel_events.jsonl — the history-server analogue);
+#              --check on the summary exits nonzero on any UN-CLEARED
+#              SLO alert (a log ending mid-incident must not read
+#              green), then the round-9 smokes over the same log: the
+#              cost-model drift audit (history --drift --check) and a
 #              chrome-trace export of the tracing spans. Point it at a
 #              dry-drill log with OBS_LOG=/tmp/matrel_batch_dry/events.jsonl
 
@@ -71,7 +73,7 @@ tpu-batch-dry:
 	sh tools/tpu_batch.sh --dry
 
 obs-report:
-	$(PY) -m matrel_tpu history --summary --log $(OBS_LOG)
+	$(PY) -m matrel_tpu history --summary --check --log $(OBS_LOG)
 	$(PY) -m matrel_tpu history --drift --check --log $(OBS_LOG)
 	$(PY) -m matrel_tpu trace --export chrome --log $(OBS_LOG) \
 		--out $(OBS_LOG).chrome.json

@@ -16,6 +16,12 @@ Commands:
                         render the log's tracing spans as a
                         Chrome/Perfetto trace_event JSON (load in
                         https://ui.perfetto.dev)
+  top [--url U | --port P | --log PATH] [--interval S] [--once]
+                        live operator console: per-tenant QPS /
+                        p50/p95/p99 / goodput / shed rate / SLO burn
+                        rate + active alerts, polling a session's
+                        metrics endpoint (config.obs_metrics_port) or
+                        tailing an event log
 """
 
 from __future__ import annotations
@@ -91,6 +97,12 @@ def cmd_trace(args):
     sys.exit(trace.main(args))
 
 
+def cmd_top(args):
+    import sys
+    from matrel_tpu.obs import top
+    sys.exit(top.main(args))
+
+
 def cmd_pagerank(args):
     import numpy as np
     from matrel_tpu import io as mio
@@ -164,9 +176,27 @@ def main(argv=None):
                          "persisted calibration table")
     hi.add_argument("--check", action="store_true",
                     help="with --drift: exit nonzero when any DRIFT "
-                         "rank-order flag fires — the CI/make "
-                         "obs-report gate on cost-model drift")
+                         "rank-order flag fires; with --summary: exit "
+                         "nonzero on any UN-CLEARED SLO alert — the "
+                         "CI/make obs-report gates")
     hi.set_defaults(fn=cmd_history)
+    tp = sub.add_parser("top")
+    tp.add_argument("--url", default=None,
+                    help="metrics-endpoint base URL "
+                         "(http://127.0.0.1:<obs_metrics_port>)")
+    tp.add_argument("--port", type=int, default=None,
+                    help="shorthand for --url http://127.0.0.1:PORT")
+    tp.add_argument("--log", default=None,
+                    help="event-log path to tail instead of polling "
+                         "an endpoint (same resolution as history)")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh interval in seconds (default 2)")
+    tp.add_argument("--once", action="store_true",
+                    help="render one frame and exit (scripting/tests)")
+    tp.add_argument("--iterations", type=int, default=None,
+                    help="stop after N frames (default: run until "
+                         "interrupted)")
+    tp.set_defaults(fn=cmd_top)
     tr = sub.add_parser("trace")
     tr.add_argument("--export", default="chrome",
                     help="output format (chrome: trace_event JSON for "

@@ -113,6 +113,46 @@ class MatrelConfig:
       obs_event_log: JSONL event-log path (the Spark event-log
         analogue). Empty → ".matrel_events.jsonl" in the working
         directory. Read it back with ``python -m matrel_tpu history``.
+      obs_metrics_port: in-process live metrics endpoint
+        (matrel_tpu/obs/export.py; docs/OBSERVABILITY.md tier 3) — a
+        stdlib-only background HTTP server on 127.0.0.1 serving
+        ``/metrics`` (Prometheus text format) and ``/json`` (a JSON
+        snapshot of the metrics registry's sketches, SLO states,
+        brownout rung, breaker states, result-cache/IVM counters and
+        drift flags — what ``python -m matrel_tpu top`` polls). 0
+        (the default) starts NOTHING: zero exporter threads, zero
+        endpoint objects (test-enforced, the flight-recorder
+        structural-off precedent).
+      slo_targets: declarative per-tenant service-level objectives
+        (matrel_tpu/obs/slo.py; docs/OBSERVABILITY.md tier 3) —
+        ``"gold:p95_ms=50,avail=0.999;bronze:avail=0.99"``. Each
+        objective is tracked with multi-window burn-rate alerting
+        (Google-SRE style: the fast window catches an incident while
+        it burns, the slow window confirms it is sustained; see
+        slo_fast_window_s / slo_slow_window_s / slo_burn_threshold);
+        alert TRANSITIONS emit an ``alert`` event and land in the
+        flight-recorder ring regardless of ``obs_level``. Latency
+        objectives (``p50_ms``/``p90_ms``/``p95_ms``/``p99_ms``)
+        count a served query against its budget when it resolves
+        slower than the target; ``avail`` counts sheds, deadline
+        misses and terminal errors. The pseudo-tenant ``ivm`` is fed
+        by ``register_delta`` patch latency. "" (the default)
+        constructs NO monitor objects and the query path is
+        bit-identical (test-enforced). Validated at construction.
+      slo_fast_window_s / slo_slow_window_s: the two burn-rate
+        windows (seconds; fast < slow, validated). Defaults 60 s /
+        1800 s — the 1 m / 30 m pairing; the traffic harness shrinks
+        them to fit its phases.
+      slo_burn_threshold: burn-rate multiple (error-budget
+        consumption rate vs the sustainable rate 1.0) at which an
+        objective FIRES — both windows must exceed it. Default 14.4
+        (the Google SRE fast-page number: 2% of a 30-day budget in
+        an hour).
+      slo_burn_exit: the alert CLEARS when the fast window's burn
+        falls below this (< slo_burn_threshold, validated — the
+        separation is the hysteresis, the brownout-threshold
+        discipline). Default 1.0: clear only once the budget stops
+        shrinking.
       obs_flight_recorder: capacity of the in-memory flight-recorder
         ring (obs/trace.py) — the last N span/event records, kept
         INDEPENDENTLY of ``obs_level`` (an always-cheap deque append;
@@ -378,6 +418,12 @@ class MatrelConfig:
     serve_max_inflight: int = 2
     obs_level: str = "off"
     obs_event_log: str = ""
+    obs_metrics_port: int = 0
+    slo_targets: str = ""
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 1800.0
+    slo_burn_threshold: float = 14.4
+    slo_burn_exit: float = 1.0
     obs_flight_recorder: int = 0
     obs_flight_recorder_path: str = ""
     drift_table_path: str = ""
@@ -434,6 +480,33 @@ class MatrelConfig:
                 f"verify_plans must be one of 'off'/'warn'/'error', "
                 f"got {self.verify_plans!r}")
         object.__setattr__(self, "verify_plans", vp)
+        # live telemetry plane (docs/OBSERVABILITY.md tier 3): an
+        # out-of-range port would surface only as an OSError at the
+        # first session construction; a malformed SLO spec must fail
+        # HERE (the fault_inject/tenant-weights precedent — silently
+        # monitoring nothing while the operator believes objectives
+        # are in force is the worst failure an SLO knob can have);
+        # un-separated burn thresholds would flap alerts on every
+        # sample (the brownout hysteresis argument)
+        if not (0 <= self.obs_metrics_port <= 65535):
+            raise ValueError(
+                f"obs_metrics_port must be a port in [0, 65535] "
+                f"(0 disables the endpoint), "
+                f"got {self.obs_metrics_port!r}")
+        if self.slo_targets:
+            parse_slo_targets(self.slo_targets)
+        if not (0.0 < self.slo_fast_window_s < self.slo_slow_window_s):
+            raise ValueError(
+                "slo windows need 0 < slo_fast_window_s < "
+                "slo_slow_window_s, got "
+                f"({self.slo_fast_window_s!r}, "
+                f"{self.slo_slow_window_s!r})")
+        if not (0.0 < self.slo_burn_exit < self.slo_burn_threshold):
+            raise ValueError(
+                "slo burn thresholds need 0 < slo_burn_exit < "
+                "slo_burn_threshold (the hysteresis separation), got "
+                f"({self.slo_burn_exit!r}, "
+                f"{self.slo_burn_threshold!r})")
         # a negative ring capacity would silently build a deque with
         # maxlen=None — an UNBOUNDED recorder, the opposite of the
         # always-cheap contract — reject it at construction
@@ -690,6 +763,74 @@ def parse_tenant_weights(spec) -> dict:
     if not out:
         raise ValueError(
             f"serve_tenant_weights {spec!r} names no tenants")
+    return out
+
+
+#: The SLO objective vocabulary (docs/OBSERVABILITY.md tier 3):
+#: latency targets at named quantiles (milliseconds) plus availability.
+SLO_OBJECTIVES = ("avail", "p50_ms", "p90_ms", "p95_ms", "p99_ms")
+
+
+def parse_slo_targets(spec) -> dict:
+    """Validate + parse an ``slo_targets`` spec
+    (``"gold:p95_ms=50,avail=0.999;bronze:avail=0.99"``) into
+    ``{tenant: {objective: float target}}``. Empty/None → {} (no
+    objectives, no monitors). Raises ``ValueError`` on unknown
+    objectives, duplicate tenants, availability targets outside (0, 1)
+    or non-positive latency targets — config.__post_init__ calls this
+    so a typo fails at construction (the tenant-weights precedent)."""
+    if not spec:
+        return {}
+    out: dict = {}
+    for tpart in (p.strip() for p in str(spec).split(";")):
+        if not tpart:
+            continue
+        tenant, sep, objs = tpart.partition(":")
+        tenant = tenant.strip()
+        if not sep or not tenant:
+            raise ValueError(
+                f"slo_targets entry {tpart!r} must be "
+                f"'tenant:objective=target[,objective=target...]'")
+        if tenant in out:
+            raise ValueError(
+                f"slo_targets names tenant {tenant!r} twice")
+        targets: dict = {}
+        for opart in (p.strip() for p in objs.split(",")):
+            if not opart:
+                continue
+            obj, osep, val = opart.partition("=")
+            obj = obj.strip()
+            if not osep or obj not in SLO_OBJECTIVES:
+                raise ValueError(
+                    f"slo_targets objective {opart!r} (tenant "
+                    f"{tenant!r}) must be one of {SLO_OBJECTIVES} "
+                    f"with '=target'")
+            if obj in targets:
+                raise ValueError(
+                    f"slo_targets names objective {obj!r} twice for "
+                    f"tenant {tenant!r}")
+            try:
+                target = float(val)
+            except ValueError:
+                raise ValueError(
+                    f"slo_targets target {val!r} (tenant {tenant!r}, "
+                    f"objective {obj!r}) is not a number") from None
+            if obj == "avail":
+                if not (0.0 < target < 1.0):
+                    raise ValueError(
+                        f"slo_targets avail target for {tenant!r} "
+                        f"must be in (0, 1), got {target!r}")
+            elif not target > 0.0:
+                raise ValueError(
+                    f"slo_targets latency target {obj} for "
+                    f"{tenant!r} must be > 0 ms, got {target!r}")
+            targets[obj] = target
+        if not targets:
+            raise ValueError(
+                f"slo_targets entry {tpart!r} declares no objectives")
+        out[tenant] = targets
+    if not out:
+        raise ValueError(f"slo_targets {spec!r} names no tenants")
     return out
 
 

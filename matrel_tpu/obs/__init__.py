@@ -33,6 +33,24 @@ Tier 2 (round 9) adds the runtime-behaviour surfaces on top:
   per-op times, calibration ratios persisted per (strategy,
   shape-class, backend), rank-order disagreements flagged.
 
+Tier 3 (round 15) is the LIVE plane — the operator tier the reference
+gets from Spark's live UI + metrics sink:
+
+- :mod:`matrel_tpu.obs.metrics` gained :class:`QuantileSketch` — a
+  bounded-memory, mergeable DDSketch-style quantile sketch with a
+  proven relative-error bound backing every timing histogram, and
+  :func:`percentile`, the ONE quantile definition history's replay,
+  the endpoint and ``top`` all report through.
+- :mod:`matrel_tpu.obs.slo` — declarative per-tenant SLOs
+  (``config.slo_targets``) tracked by multi-window burn-rate
+  monitors; alert transitions emit ``alert`` events that land in the
+  flight-recorder ring regardless of ``obs_level``.
+- :mod:`matrel_tpu.obs.export` — the in-process metrics endpoint
+  (``config.obs_metrics_port``): ``/metrics`` Prometheus text +
+  ``/json`` snapshot, zero threads at the default port 0.
+- :mod:`matrel_tpu.obs.top` — ``python -m matrel_tpu top``, the live
+  per-tenant QPS/latency/burn console.
+
 Instrumentation is off-hot-path by contract: event assembly happens
 outside jitted code, per-op timing only under ``analyze=True``, and with
 ``config.obs_level == "off"`` (the default) plus the flight recorder

@@ -113,28 +113,42 @@ def emit_tool_event(kind: str, record: dict,
 
 
 def read_events(path: Optional[str] = None,
-                kinds: Optional[tuple] = None) -> List[dict]:
+                kinds: Optional[tuple] = None,
+                tail_bytes: Optional[int] = None) -> List[dict]:
     """Parse an event-log file. Unparseable lines and unknown schema
     versions are skipped (a reader must survive a log written by a
-    crashed process mid-line). Missing file → empty list."""
+    crashed process mid-line). Missing file → empty list.
+    ``tail_bytes`` bounds the read to the file's last N bytes — the
+    live readers' contract (the metrics endpoint's drift view, `top`
+    refresh frames): a multi-GB host log must cost a scrape O(tail),
+    not O(history)."""
     out: List[dict] = []
-    for rec in iter_events(path):
+    for rec in iter_events(path, tail_bytes=tail_bytes):
         if kinds is None or rec.get("kind") in kinds:
             out.append(rec)
     return out
 
 
-def iter_events(path: Optional[str] = None) -> Iterator[dict]:
+def iter_events(path: Optional[str] = None,
+                tail_bytes: Optional[int] = None) -> Iterator[dict]:
     """Yield parsed records, skipping anything unreadable. Corrupt
     lines are COUNTED and warned about once per read (the robust-
     reader contract, docs/RESILIENCE.md): a log truncated mid-line by
     a crashed process must never take the reader down with it — but a
-    silently shrinking history would hide the corruption entirely."""
+    silently shrinking history would hide the corruption entirely.
+    With ``tail_bytes`` the read starts at most N bytes before EOF
+    (the first, almost-surely partial line is dropped, not counted
+    corrupt)."""
     p = resolve_path(path)
     if not os.path.exists(p):
         return
     skipped = 0
     with open(p) as f:
+        if tail_bytes is not None:
+            size = os.fstat(f.fileno()).st_size
+            if size > tail_bytes:
+                f.seek(size - tail_bytes)
+                f.readline()       # discard the cut-off line
         for line in f:
             line = line.strip()
             if not line:

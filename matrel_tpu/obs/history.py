@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from matrel_tpu.obs.events import read_events, resolve_path
+from matrel_tpu.obs import metrics as metrics_lib
 
 
 def _fmt(v, nd=2) -> str:
@@ -161,6 +162,7 @@ def summarize(events: List[dict]) -> dict:
         "cache_hit_rate": round(hits / len(qs), 3) if qs else None,
         "rc_hits": sum(1 for e in qs if e.get("cache") == "rc_hit"),
         "ivm": _summarize_ivm(events),
+        "alerts": _summarize_alerts(events),
         "serve": _summarize_serve(events),
         "resilience": _summarize_resilience(events, len(qs)),
         "overload": _summarize_overload(events),
@@ -238,13 +240,15 @@ def _last_bench_errors(events: List[dict]) -> Dict[str, dict]:
     return out
 
 
-def _pctile(sorted_vals: List[float], q: float):
-    """Nearest-rank percentile over an already-sorted list (the
-    metrics-registry convention), None when empty."""
-    if not sorted_vals:
-        return None
-    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
-    return sorted_vals[idx]
+def _pctile(vals: List[float], q: float):
+    """Quantile through the SHARED sketch definition
+    (obs/metrics.percentile) — the round-15 fix: history used to
+    nearest-rank over raw lists per invocation while the live plane
+    reported sketch estimates, so the offline replay and `top` could
+    disagree on the same data. Now both report ONE definition, pinned
+    to agree with the nearest-rank oracle within the sketch's
+    documented relative error (tests). None when empty."""
+    return metrics_lib.percentile(vals, q)
 
 
 def _summarize_serve(events: List[dict]) -> dict:
@@ -355,6 +359,45 @@ def _summarize_ivm(events: List[dict]) -> Optional[dict]:
         "rules": rules,
         "names": names,
     }
+
+
+def _summarize_alerts(events: List[dict]) -> Optional[dict]:
+    """Roll up ``alert`` records (SLO burn-rate alert TRANSITIONS —
+    obs/slo.py fire/clear edges) into the per-tenant SLO view: alert
+    counts, last-known state per (tenant, objective), the last
+    reported attainment (worst across a tenant's objectives), and the
+    un-cleared set — what ``history --summary --check`` (and `make
+    obs-report`) exits nonzero on. None when no alert ever fired —
+    historical logs summarize byte-identically."""
+    al = [e for e in events if e.get("kind") == "alert"]
+    if not al:
+        return None
+    last: Dict[tuple, dict] = {}
+    fired_by_tenant: Dict[str, int] = {}
+    fired = cleared = 0
+    for e in al:
+        tenant = str(e.get("tenant") or "?")
+        last[(tenant, str(e.get("objective") or "?"))] = e
+        if e.get("state") == "firing":
+            fired += 1
+            fired_by_tenant[tenant] = \
+                fired_by_tenant.get(tenant, 0) + 1
+        elif e.get("state") == "clear":
+            cleared += 1
+    tenants: Dict[str, dict] = {}
+    for (t, o), e in sorted(last.items()):
+        row = tenants.setdefault(
+            t, {"fired": fired_by_tenant.get(t, 0),
+                "attainment": None, "objectives": {}})
+        row["objectives"][o] = str(e.get("state") or "?")
+        att = e.get("attainment")
+        if isinstance(att, (int, float)):
+            row["attainment"] = (att if row["attainment"] is None
+                                 else min(row["attainment"], att))
+    uncleared = [f"{t}:{o}" for (t, o), e in sorted(last.items())
+                 if e.get("state") == "firing"]
+    return {"events": len(al), "fired": fired, "cleared": cleared,
+            "uncleared": uncleared, "tenants": tenants}
 
 
 def _summarize_overload(events: List[dict]) -> Optional[dict]:
@@ -488,15 +531,31 @@ def render_summary(events: List[dict]) -> str:
                          + ", ".join(ov["breakers_open_now"]) + ")")
         lines.append(line)
         if ov.get("tenants"):
+            # SLO-attainment + alert-count columns (round 15) ride
+            # the per-tenant roll-up, sourced from the `alert` events
+            al = s.get("alerts") or {}
+            al_t = al.get("tenants") or {}
             header = (f"{'tenant':<14}{'admitted':>9}{'sheds':>7}"
-                      f"{'shed rate':>11}{'wait p99':>10}")
+                      f"{'shed rate':>11}{'wait p99':>10}"
+                      f"{'slo attain':>12}{'alerts':>8}")
             lines += [header, "-" * len(header)]
             for t in sorted(ov["tenants"]):
                 d = ov["tenants"][t]
+                a = al_t.get(t, {})
                 lines.append(
                     f"{t:<14}{d['admitted']:>9}{d['sheds']:>7}"
                     f"{_fmt(d['shed_rate'], 3):>11}"
-                    f"{_fmt(d['queue_wait_p99_ms']):>10} ms")
+                    f"{_fmt(d['queue_wait_p99_ms']):>7} ms"
+                    f"{_fmt(a.get('attainment'), 4):>12}"
+                    f"{_fmt(a.get('fired') if a else None):>8}")
+    al = s.get("alerts")
+    if al:
+        line = (f"slo alerts: {al['fired']} fired / {al['cleared']} "
+                f"cleared")
+        if al["uncleared"]:
+            line += ("; UNCLEARED: " + ", ".join(al["uncleared"])
+                     + " (--check exits nonzero)")
+        lines.append(line)
     ivm = s.get("ivm")
     if ivm:
         lines.append(
@@ -616,6 +675,17 @@ def main(args) -> int:
             return 1
     elif args.summary:
         print(render_summary(events))
+        if getattr(args, "check", False):
+            # the --drift --check idiom applied to SLO alerts: an
+            # alert whose LAST transition is "firing" means the log
+            # ends mid-incident — `make obs-report` / CI must not
+            # read green over it
+            al = _summarize_alerts(events)
+            if al and al["uncleared"]:
+                print(f"SLO CHECK FAILED: {len(al['uncleared'])} "
+                      f"un-cleared alert(s): "
+                      + ", ".join(al["uncleared"]))
+                return 1
     else:
         print(render_queries(events, last=args.last))
     return 0

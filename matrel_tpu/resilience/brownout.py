@@ -37,6 +37,8 @@ import threading
 from collections import deque
 from typing import Optional
 
+from matrel_tpu.obs.metrics import percentile
+
 #: The rung vocabulary (cumulative; labels ride obs events and docs).
 MAX_RUNG = 3
 TIER_RUNG = 1
@@ -116,7 +118,7 @@ class LoadController:
         with self._lock:
             self._depth = int(depth)
             for w in waits_ms or ():
-                self._waits.append(float(w))
+                self._waits.append(float(w))  # matlint: disable=ML013 the controller's own bounded sliding window — measurement IS this subsystem (the ML006 autotune precedent); its p95 reads through the shared sketch definition
             for _ in range(max(int(misses), 0)):
                 self._outcomes.append(1)
             for _ in range(max(int(admitted), 0)):
@@ -127,10 +129,12 @@ class LoadController:
             return self._rung
 
     def _p95_wait(self) -> float:
-        if not self._waits:
-            return 0.0
-        vals = sorted(self._waits)
-        return vals[min(int(0.95 * len(vals)), len(vals) - 1)]
+        # the shared quantile definition (obs/metrics.percentile):
+        # the threshold this signal is compared against is the same
+        # number the SLO plane / endpoint / history report, within the
+        # sketch's documented relative error
+        est = percentile(self._waits, 0.95)
+        return 0.0 if est is None else est
 
     def _miss_rate(self) -> float:
         if not self._outcomes:

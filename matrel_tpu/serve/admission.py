@@ -72,12 +72,17 @@ class AdmissionQueue:
     inspects ``entry[1]`` (the future) and ``entry[4]`` (the deadline)
     — both present from the 5-tuple shape on."""
 
-    def __init__(self, config):
+    def __init__(self, config, slo=None):
         self.weights: Dict[str, float] = parse_tenant_weights(
             getattr(config, "serve_tenant_weights", ""))
         self.global_max = int(getattr(config, "serve_queue_max", 0))
         self.tenant_max = int(getattr(config,
                                       "serve_tenant_queue_max", 0))
+        # SLO feed (obs/slo.py; None when off — zero per-event cost):
+        # typed sheds and purged-expired entries are availability
+        # budget burn, reported per tenant OUTSIDE the queue lock
+        # (the monitor's emit callback does event-log I/O)
+        self.slo = slo
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         # queue.Queue-compatible drain surface (pipeline.drain waits
@@ -128,6 +133,7 @@ class AdmissionQueue:
         key = tenant if tenant is not None else self._entry_tenant(
             entry)
         to_fail: list = []
+        shed = False
         try:
             with self._lock:
                 dq = self._queues.get(key)
@@ -138,6 +144,7 @@ class AdmissionQueue:
                     self._purge_expired_locked(key, to_fail)
                     if len(dq) >= self.tenant_max:
                         self.sheds[key] = self.sheds.get(key, 0) + 1
+                        shed = True
                         raise AdmissionShed(self.tenant_max,
                                             tenant=key or None,
                                             scope="tenant")
@@ -146,6 +153,7 @@ class AdmissionQueue:
                     self._purge_expired_locked(None, to_fail)
                     if self._size >= self.global_max:
                         self.sheds[key] = self.sheds.get(key, 0) + 1
+                        shed = True
                         raise AdmissionShed(self.global_max,
                                             tenant=key or None,
                                             scope="queue")
@@ -160,12 +168,20 @@ class AdmissionQueue:
                 self.unfinished_tasks += 1
                 self._not_empty.notify()
         finally:
-            for fut, ex in to_fail:
+            for fut, ex, _t in to_fail:
                 # RUNNING first (the worker's own discipline): a
                 # future the caller cancelled concurrently drops out
                 # instead of racing set_exception
                 if fut.set_running_or_notify_cancel():
                     fut.set_exception(ex)
+            # SLO burn (outside the lock — the monitor's alert
+            # emission does I/O): a typed shed and every purged
+            # expired entry are availability bad events
+            if self.slo is not None:
+                if shed:
+                    self.slo.record_shed(key or None)
+                for _f, _ex, t in to_fail:
+                    self.slo.record_miss(t or None)
 
     # queue.Queue compat (tests enqueue legacy short tuples directly)
     put_nowait = put
@@ -176,6 +192,8 @@ class AdmissionQueue:
         key = tenant or ""
         with self._lock:
             self.sheds[key] = self.sheds.get(key, 0) + 1
+        if self.slo is not None:
+            self.slo.record_shed(tenant)
 
     @staticmethod
     def _entry_tenant(entry) -> str:
@@ -185,9 +203,10 @@ class AdmissionQueue:
                               to_fail: list) -> int:
         """Drop every queued entry whose deadline already expired —
         from one tenant's queue or all of them — collecting
-        (future, typed error) pairs into ``to_fail`` for the caller to
-        resolve OUTSIDE the lock. Runs at the shed decision points so
-        dead entries can never hold slots against live traffic."""
+        (future, typed error, tenant) triples into ``to_fail`` for the
+        caller to resolve OUTSIDE the lock. Runs at the shed decision
+        points so dead entries can never hold slots against live
+        traffic."""
         t = _now()
         if t - self._last_purge < PURGE_INTERVAL_S:
             return 0
@@ -204,7 +223,7 @@ class AdmissionQueue:
                 if dl is not None and dl.expired():
                     to_fail.append((it[1], DeadlineExceeded(
                         dl.budget_ms, dl.elapsed_ms(),
-                        context="queued query (purged)")))
+                        context="queued query (purged)"), key))
                     purged += 1
                     self._size -= 1
                     self.unfinished_tasks -= 1

@@ -102,7 +102,12 @@ class ServePipeline:
         self.max_batch = session.config.serve_max_batch
         self.max_inflight = session.config.serve_max_inflight
         self.queue_max = session.config.serve_queue_max
-        self._q = AdmissionQueue(session.config)
+        # SLO plane (obs/slo.py; None when off): the queue reports
+        # typed sheds / purges, this pipeline reports resolution
+        # latency and deadline misses — together the full outcome
+        # stream the burn-rate monitors watch
+        self._slo = getattr(session, "_slo", None)
+        self._q = AdmissionQueue(session.config, slo=self._slo)
         self._inflight: "collections.deque" = collections.deque()  # matlint: disable=ML011 bounded by the serve_max_inflight sync loop in _run_group
         self._worker: threading.Thread = None
         self._stop = threading.Event()
@@ -118,7 +123,7 @@ class ServePipeline:
         self._breakers = getattr(session, "_breakers", None)
         self._overload_active = (
             self._brownout is not None or self._breakers is not None
-            or bool(self._q.weights))
+            or self._slo is not None or bool(self._q.weights))
         self._overload_last: dict = {}
         self.stale_served = 0
         self.deadline_misses = 0
@@ -248,6 +253,11 @@ class ServePipeline:
             try:
                 first = self._q.get(timeout=0.05)
             except queue.Empty:
+                if self._slo is not None:
+                    # burn decays as the windows slide: a drained
+                    # plane must CLEAR its alerts without waiting for
+                    # the next query (obs/slo.py tick contract)
+                    self._slo.tick()
                 continue
             pulled = [first]
             while len(pulled) < self.max_batch:
@@ -281,6 +291,8 @@ class ServePipeline:
                         dl.budget_ms, dl.elapsed_ms(),
                         context="queued query"))
                     misses += 1
+                    if self._slo is not None:
+                        self._slo.record_miss(it[5] or None)
                 else:
                     live.append(it)
             self.deadline_misses += misses
@@ -295,6 +307,10 @@ class ServePipeline:
                             self._breakers.plan_class(it[0]))
                     except CircuitOpen as ex:
                         _fail(it[1], ex)
+                        if self._slo is not None:
+                            # a breaker refusal is a shed the tenant
+                            # sees — availability budget burn
+                            self._slo.record_shed(it[5] or None)
                     else:
                         admitted.append(it)
                 live = admitted
@@ -304,7 +320,7 @@ class ServePipeline:
             # a number named "queue wait"
             tenant_waits: dict = {}
             for it, w in zip(batch, cycle_waits):
-                tenant_waits.setdefault(it[5] or "", []).append(w)
+                tenant_waits.setdefault(it[5] or "", []).append(w)  # matlint: disable=ML013 one admission cycle's event-record assembly — these waits land in the overload event and the controller sample, not a private stopwatch
             # brownout: ONE load sample per admission cycle (late
             # deadline misses from earlier batches fold in here), then
             # act on the (possibly new) rung
@@ -331,6 +347,11 @@ class ServePipeline:
                         if not it[1].done():
                             it[1].set_result(ent.result)
                         stale_served += 1
+                        if self._slo is not None:
+                            self._slo.record_ok(
+                                it[5] or None,
+                                (time.perf_counter() - it[2]) * 1e3)  # matlint: disable=ML006 SLO resolution-latency sample — lands in the slo plane's sketches and alert records
+
                         # a cache hit says NOTHING about the class's
                         # execution health — release the (possibly
                         # half-open probe) slot without a transition,
@@ -427,6 +448,12 @@ class ServePipeline:
             }
             if self._brownout is not None:
                 rec["brownout"] = self._brownout.snapshot()
+            if self._slo is not None:
+                # the SLO plane's live state rides the overload
+                # stream, so `top --log` (and any offline replay)
+                # reconstructs burn rates/alert states without the
+                # endpoint (obs/top.py snapshot_from_log)
+                rec["slo"] = self._slo.snapshot()
             if self._breakers is not None:
                 snap = self._breakers.snapshot()
                 lt = last.get("breaker_transitions", {})
@@ -513,6 +540,9 @@ class ServePipeline:
                     # class-health signal (retry budget already spent)
                     self._breaker_done(batch[0][0], False, ex)
                     _fail(batch[0][1], ex)
+                    if self._slo is not None:
+                        self._slo.record_bad(batch[0][5] or None,
+                                             "error")
                 return
             # POISON ISOLATION: split and re-admit each half — only
             # the failing query's own future ends up carrying the
@@ -542,10 +572,20 @@ class ServePipeline:
                     _fail(fut, DeadlineExceeded(
                         dl.budget_ms, dl.elapsed_ms(),
                         context="served query"))
+                    if self._slo is not None:
+                        self._slo.record_miss(it[5] or None)
                 else:
                     self._breaker_done(it[0], True)
                     if not fut.done():
                         fut.set_result(out)
+                    if self._slo is not None:
+                        # resolution latency = enqueue → dispatch-
+                        # complete, the serve plane's own SLA clock
+                        # since PR 5 (what the traffic harness
+                        # measures too)
+                        self._slo.record_ok(
+                            it[5] or None,
+                            (time.perf_counter() - it[2]) * 1e3)  # matlint: disable=ML006 SLO resolution-latency sample — lands in the slo plane's sketches and alert records
             if outs:
                 self._inflight.append(outs)
             while len(self._inflight) > self.max_inflight:

@@ -30,6 +30,8 @@ from matrel_tpu.config import MatrelConfig, default_config, normalize_sla
 from matrel_tpu.core import mesh as mesh_lib
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.ir.expr import MatExpr, as_expr
+from matrel_tpu.obs import export as export_lib
+from matrel_tpu.obs import slo as slo_lib
 from matrel_tpu.obs import trace as trace_lib
 from matrel_tpu.resilience import breaker as breaker_lib
 from matrel_tpu.resilience import brownout as brownout_lib
@@ -99,6 +101,17 @@ class MatrelSession:
         # objects exist (the brownout/breaker zero-object contract)
         self._delta_plane = None
         self._delta_gen = 0
+        # live telemetry plane (obs/slo.py, obs/export.py;
+        # docs/OBSERVABILITY.md tier 3): per-tenant SLO burn-rate
+        # monitors + the in-process metrics endpoint — both None for
+        # the default config (no slo_targets / port 0: zero monitor
+        # objects, zero exporter threads — the brownout/breaker
+        # structural-zero contract, test-enforced). The exporter is
+        # built LAST: its handler snapshots session state, so every
+        # subsystem it reads must already exist.
+        self._slo = slo_lib.from_config(self.config,
+                                        emit=self._emit_alert_event)
+        self._exporter = export_lib.from_config(self)
 
     # -- builder (MatfastSession.builder().getOrCreate() analogue) ---------
 
@@ -201,7 +214,17 @@ class MatrelSession:
             if self._delta_plane is None:
                 from matrel_tpu.serve.ivm import DeltaPlane
                 self._delta_plane = DeltaPlane(self)
-            return self._delta_plane.apply(name, old, d)
+            out = self._delta_plane.apply(name, old, d)
+        # SLO feed (obs/slo.py): patch latency reports under the
+        # pseudo-tenant "ivm", so a dashboard stream's maintenance
+        # path can carry its own latency objective (docs/IVM.md
+        # events are the offline view of the same number). No-op
+        # without a declared ivm target.
+        if self._slo is not None and isinstance(out.get("ms"),
+                                                (int, float)):
+            self._slo.observe_latency(slo_lib.IVM_TENANT,
+                                      float(out["ms"]))
+        return out
 
     def save_catalog(self, directory: str,
                      step: Optional[int] = None) -> str:
@@ -858,6 +881,25 @@ class MatrelSession:
         REGISTRY.gauge("result_cache.bytes").set(
             record["result_cache"]["bytes"])
 
+    def _emit_alert_event(self, record: dict) -> None:
+        """One ``alert`` record per SLO alert TRANSITION (obs/slo.py
+        fire/clear edges — never steady state): tenant, objective,
+        burn rates, attainment. Lands in the event log when obs is on
+        AND in the flight-recorder ring whenever the ring exists —
+        REGARDLESS of ``obs_level`` (the _obs_emit funnel's existing
+        split): an alert edge is exactly the record a post-mortem
+        needs. Never fails the query/outcome that triggered it."""
+        from matrel_tpu.obs.metrics import REGISTRY
+        try:
+            self._obs_emit("alert", record)
+            REGISTRY.counter(
+                "slo.alerts.fired" if record.get("state") == "firing"
+                else "slo.alerts.cleared").inc()
+            REGISTRY.gauge("slo.alerts.active").set(
+                record.get("active", 0))
+        except Exception:   # the never-fail obs contract
+            log.warning("obs: alert event dropped", exc_info=True)
+
     def _emit_overload_event(self, record: dict) -> None:
         """One ``overload`` record per admission cycle while the
         control plane is active (serve/pipeline.py assembles it:
@@ -1352,9 +1394,13 @@ class MatrelSession:
     def serve_close(self, timeout: Optional[float] = None) -> None:
         """Drain then stop the admission worker. A later ``submit``
         raises the typed ``PipelineClosed`` (never enqueues into a
-        dead worker)."""
+        dead worker). Also stops the live metrics exporter when one
+        is running — "done serving" frees the port deterministically
+        (a GC finalizer covers sessions that are simply dropped)."""
         if self._serve is not None:
             self._serve.close(timeout=timeout)
+        if self._exporter is not None:
+            self._exporter.stop()
 
     def explain(self, expr: MatExpr, physical: bool = True,
                 analyze: bool = False,

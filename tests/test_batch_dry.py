@@ -185,6 +185,30 @@ def test_traffic_row_artifact(dry_batch):
     assert sum(t["sheds"] for t in tenants.values()) > 0
 
 
+def test_traffic_slo_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records,
+               lambda r: r.get("metric") == "traffic_slo_harness"
+               and "prometheus" in r, "tools/traffic.py --slo")
+    # the round-15 acceptance (docs/OBSERVABILITY.md tier 3): at ~2x
+    # sustained overload under declared per-tenant objectives, the
+    # violated (lowest-weight) tenant's fast-window burn-rate alert
+    # FIRES during saturation and every alert CLEARS after the load
+    # drops, with the live Prometheus endpoint strict-parsing clean on
+    # every poll throughout and still zero wrong answers
+    assert rec["ok"] is True, rec
+    assert rec["violated_tenant_fired_in_window"] is True
+    assert rec["alerts_fired"] >= 1
+    assert rec["uncleared"] == []
+    assert rec["alerts_active_final"] == 0
+    assert rec["prometheus"]["ok"] is True
+    assert rec["prometheus"]["polls"] > 0
+    assert rec["prometheus"]["parse_failures"] == 0
+    assert rec["wrong_answers"] == 0
+    assert rec["untyped_errors"] == 0
+    assert "bronze:avail" in rec["fired_objectives"]
+
+
 def test_serve_row_artifact(dry_batch):
     _, records, _ = dry_batch
     rec = _one(records,

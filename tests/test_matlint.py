@@ -740,6 +740,77 @@ class TestML012ResultCacheSeam:
         assert [f for f in got if f.rule == "ML012"] == []
 
 
+class TestML013TimingAccumulation:
+    def test_fires_on_latency_list_append(self, tmp_path):
+        src = """
+            def resolve(latencies, ms):
+                latencies.append(ms)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newplane.py")
+        assert _rules(got) == ["ML013"]
+
+    def test_fires_on_ms_suffix_attr_and_extend(self, tmp_path):
+        src = """
+            class W:
+                def feed(self, more):
+                    self.queue_wait_ms.extend(more)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/session_helper.py")
+        assert _rules(got) == ["ML013"]
+
+    def test_fires_on_string_subscript_target(self, tmp_path):
+        src = """
+            def tally(row, ms):
+                row["waits"].append(ms)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newplane.py")
+        assert _rules(got) == ["ML013"]
+
+    def test_non_timing_names_pass(self, tmp_path):
+        src = """
+            def collect(entries, pulled, it):
+                entries.append(it)
+                pulled.extend(entries)
+                items = []
+                items.append(it)
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newplane.py") == []
+
+    def test_registry_api_passes(self, tmp_path):
+        # the sanctioned path: record through the sketch/histogram API
+        src = """
+            from matrel_tpu.obs.metrics import REGISTRY
+            def resolve(ms):
+                REGISTRY.histogram("serve.latency_ms").observe(ms)
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newplane.py") == []
+
+    def test_obs_package_exempt(self, tmp_path):
+        src = """
+            def aggregate(waits, ms):
+                waits.append(ms)
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/obs/history.py") == []
+
+    def test_tools_out_of_scope(self, tmp_path):
+        # harnesses ARE measurement (the ML006 autotune precedent)
+        src = """
+            def tally(row, ms):
+                row["latencies"].append(ms)
+        """
+        assert _lint(tmp_path, src, "tools/traffic.py") == []
+
+    def test_suppression_silences(self, tmp_path):
+        src = """
+            def observe(self, w):
+                self._waits.append(w)  # matlint: disable=ML013 bounded controller window
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/resilience/brownout.py") == []
+
+
 def test_repo_lints_clean():
     """`make lint`'s contract, enforced from inside tier-1: the whole
     default scan set (package, tools, examples, bench harnesses) has

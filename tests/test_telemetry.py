@@ -1,0 +1,703 @@
+"""Live telemetry plane (round 15, docs/OBSERVABILITY.md tier 3):
+per-tenant SLO burn-rate monitors (obs/slo.py), the in-process
+metrics endpoint (obs/export.py), the `top` operator console
+(obs/top.py), the history alert roll-up + --check gate, and the
+default-config structural-zero contract."""
+
+import json
+import re
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from matrel_tpu.config import MatrelConfig, parse_slo_targets
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.obs import slo as slo_lib
+from matrel_tpu.obs.events import EventLog, read_events
+from matrel_tpu.session import MatrelSession
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _mat(rng, n, m, mesh):
+    return BlockMatrix.from_numpy(
+        rng.standard_normal((n, m)).astype(np.float32), mesh=mesh)
+
+
+#: Small windows so monitor tests run in wall-clock milliseconds with
+#: the injected clock.
+SLO_CFG = dict(slo_targets="gold:avail=0.9,p95_ms=50;bronze:avail=0.9",
+               slo_fast_window_s=1.0, slo_slow_window_s=4.0,
+               slo_burn_threshold=3.0, slo_burn_exit=1.0)
+
+
+def _plane(emit=None, clock=None, **over):
+    cfg = MatrelConfig(**{**SLO_CFG, **over})
+    return slo_lib.SLOPlane(cfg, emit=emit, clock=clock)
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+
+class TestSLOConfig:
+    def test_parse_targets(self):
+        t = parse_slo_targets(
+            "gold:p95_ms=50,avail=0.999; bronze:avail=0.99")
+        assert t == {"gold": {"p95_ms": 50.0, "avail": 0.999},
+                     "bronze": {"avail": 0.99}}
+        assert parse_slo_targets("") == {}
+        assert parse_slo_targets(None) == {}
+
+    @pytest.mark.parametrize("spec", [
+        "gold",                       # no objectives
+        "gold:p95_ms",                # no target
+        "gold:p77_ms=5",              # unknown objective
+        "gold:avail=1.5",             # avail outside (0,1)
+        "gold:avail=0",               # avail outside (0,1)
+        "gold:p95_ms=-3",             # non-positive latency
+        "gold:p95_ms=x",              # not a number
+        "gold:avail=0.9;gold:avail=0.8",          # duplicate tenant
+        "gold:avail=0.9,avail=0.99",  # duplicate objective
+        ";",                          # no tenants
+    ])
+    def test_parse_targets_rejects(self, spec):
+        with pytest.raises(ValueError):
+            parse_slo_targets(spec)
+
+    def test_config_validates_at_construction(self):
+        with pytest.raises(ValueError, match="slo_targets"):
+            MatrelConfig(slo_targets="gold:p77_ms=5")
+        with pytest.raises(ValueError, match="slo windows"):
+            MatrelConfig(slo_fast_window_s=60.0,
+                         slo_slow_window_s=60.0)
+        with pytest.raises(ValueError, match="hysteresis"):
+            MatrelConfig(slo_burn_exit=20.0)   # >= threshold
+        with pytest.raises(ValueError, match="obs_metrics_port"):
+            MatrelConfig(obs_metrics_port=-1)
+        with pytest.raises(ValueError, match="obs_metrics_port"):
+            MatrelConfig(obs_metrics_port=70000)
+
+    def test_defaults_are_off(self):
+        cfg = MatrelConfig()
+        assert cfg.obs_metrics_port == 0
+        assert cfg.slo_targets == ""
+
+
+# ---------------------------------------------------------------------------
+# burn-rate monitors (deterministic injected clock)
+# ---------------------------------------------------------------------------
+
+
+class TestBurnRateMonitor:
+    def _clocked_plane(self, emit=None, **over):
+        t = [1000.0]
+        plane = _plane(emit=emit, clock=lambda: t[0], **over)
+        return plane, t
+
+    def test_fires_on_sustained_burn_and_emits_transition(self):
+        alerts = []
+        plane, t = self._clocked_plane(emit=alerts.append)
+        # budget 0.1 (avail=0.9), threshold 3 => bad fraction >= 0.3
+        # over BOTH windows fires
+        for _ in range(10):
+            plane.record_shed("gold")
+        st = plane.snapshot()["tenants"]["gold"]["objectives"]["avail"]
+        assert st["state"] == "firing"
+        assert st["burn_fast"] >= 3.0 and st["burn_slow"] >= 3.0
+        (fire,) = [a for a in alerts if a["state"] == "firing"]
+        assert fire["tenant"] == "gold"
+        assert fire["objective"] == "avail"
+        assert fire["burn_fast"] >= 3.0
+        assert fire["attainment"] == 0.0
+        assert fire["window_fast_s"] == 1.0
+
+    def test_slow_window_dilution_blocks_one_bad_second(self):
+        # a long healthy history inside the slow window keeps
+        # burn_slow below threshold: a short burst must NOT page —
+        # the multi-window point (fast detects, slow confirms)
+        plane, t = self._clocked_plane()
+        for _ in range(200):
+            plane.record_ok("gold", latency_ms=1.0)
+        t[0] += 2.0        # past the fast window, inside the slow one
+        for _ in range(10):
+            plane.record_shed("gold")
+        st = plane.snapshot()["tenants"]["gold"]["objectives"]["avail"]
+        assert st["burn_fast"] >= 3.0       # fast window is all-bad
+        assert st["burn_slow"] < 3.0        # diluted by history
+        assert st["state"] == "ok"
+
+    def test_clears_when_fast_window_slides_past(self):
+        alerts = []
+        plane, t = self._clocked_plane(emit=alerts.append)
+        for _ in range(10):
+            plane.record_shed("gold")
+        assert plane.snapshot()["alerts_active"] == 1
+        t[0] += 1.5                          # fast window now empty
+        plane.tick()
+        assert plane.snapshot()["alerts_active"] == 0
+        states = [a["state"] for a in alerts]
+        assert states == ["firing", "clear"]
+
+    def test_exit_hysteresis_holds_between_exit_and_threshold(self):
+        # burn between exit (1.0) and threshold (3.0) HOLDS the alert:
+        # neither re-fires nor clears — the separated-threshold band.
+        # The bad events stay INSIDE the fast window while good
+        # traffic dilutes the fraction into the band (an emptied
+        # window would legally clear).
+        plane, t = self._clocked_plane()
+        for _ in range(10):
+            plane.record_shed("gold")
+        assert plane.snapshot()["alerts_active"] == 1
+        t[0] += 0.5        # half the fast window: bad still inside
+        # 10 bad / 57 good -> fraction ~0.149 -> burn ~1.49, inside
+        # (exit, threshold)
+        for _ in range(57):
+            plane.record_ok("gold", latency_ms=1.0)
+        st = plane.snapshot()["tenants"]["gold"]["objectives"]["avail"]
+        assert 1.0 <= st["burn_fast"] < 3.0
+        assert st["state"] == "firing"       # held, not cleared
+        t[0] += 0.7        # bad events age out -> burn under exit
+        plane.tick()
+        st = plane.snapshot()["tenants"]["gold"]["objectives"]["avail"]
+        assert st["state"] == "ok"
+
+    def test_latency_objective_counts_slow_queries(self):
+        plane, t = self._clocked_plane()
+        # p95_ms=50, budget 0.05: >= 15% slow queries burns at >= 3x
+        for _ in range(8):
+            plane.record_ok("gold", latency_ms=10.0)
+        for _ in range(2):
+            plane.record_ok("gold", latency_ms=500.0)
+        st = plane.snapshot()["tenants"]["gold"]["objectives"]
+        assert st["p95_ms"]["state"] == "firing"
+        assert st["avail"]["state"] == "ok"   # all queries SERVED
+
+    def test_sheds_do_not_pollute_latency_objectives(self):
+        plane, t = self._clocked_plane()
+        for _ in range(50):
+            plane.record_shed("gold")
+        st = plane.snapshot()["tenants"]["gold"]["objectives"]
+        assert st["avail"]["state"] == "firing"
+        assert st["p95_ms"]["burn_fast"] == 0.0   # never resolved
+
+    def test_undeclared_tenant_costs_and_counts_nothing(self):
+        plane, t = self._clocked_plane()
+        plane.record_shed("nobody")
+        plane.record_ok("nobody", latency_ms=1.0)
+        snap = plane.snapshot()
+        assert "nobody" not in snap["tenants"]
+        assert snap["alerts_active"] == 0
+
+    def test_from_config_off_returns_none(self):
+        assert slo_lib.from_config(MatrelConfig()) is None
+
+
+# ---------------------------------------------------------------------------
+# serve-plane wiring (real session)
+# ---------------------------------------------------------------------------
+
+
+def _sess(mesh, tmp_path=None, **cfg):
+    if tmp_path is not None:
+        cfg.setdefault("obs_event_log", str(tmp_path / "ev.jsonl"))
+    return MatrelSession(mesh=mesh, config=MatrelConfig(**cfg))
+
+
+class TestServeWiring:
+    def test_ok_latency_and_counters_flow(self, mesh8, rng):
+        sess = _sess(mesh8, **SLO_CFG)
+        A = _mat(rng, 32, 32, mesh8)
+        an = A.to_numpy()
+        futs = [sess.submit(A.expr().multiply_scalar(2.0),
+                            tenant="gold") for _ in range(4)]
+        for f in futs:
+            np.testing.assert_allclose(
+                f.result(timeout=60).to_numpy(), an * 2.0,
+                rtol=1e-5, atol=1e-5)
+        sess.serve_drain(timeout=60)
+        time.sleep(0.1)
+        snap = sess._slo.snapshot()
+        gold = snap["tenants"]["gold"]
+        assert gold["counts"]["ok"] == 4
+        assert gold["latency_ms"]["count"] == 4
+        assert gold["latency_ms"]["p95"] > 0
+
+    def test_quota_shed_burns_availability(self, mesh8, rng):
+        # tenant quota 1 + a slow stream: excess submissions shed
+        # typed AND burn the tenant's availability budget
+        sess = _sess(mesh8, serve_tenant_weights="gold:2,bronze:1",
+                     serve_tenant_queue_max=1, **SLO_CFG)
+        from matrel_tpu.resilience.errors import AdmissionShed
+        A = _mat(rng, 32, 32, mesh8)
+        sheds = 0
+        for i in range(40):
+            try:
+                sess.submit(A.expr().multiply_scalar(float(i % 7)),
+                            tenant="bronze")
+            except AdmissionShed:
+                sheds += 1
+        sess.serve_drain(timeout=60)
+        assert sheds > 0
+        snap = sess._slo.snapshot()
+        assert snap["tenants"]["bronze"]["counts"]["shed"] == sheds
+
+    def test_deadline_miss_burns_availability(self, mesh8, rng):
+        sess = _sess(mesh8, **SLO_CFG)
+        A = _mat(rng, 32, 32, mesh8)
+        fut = sess.submit(A.expr().multiply(A.expr()), tenant="gold",
+                          deadline_ms=0.0001)
+        with pytest.raises(Exception):
+            fut.result(timeout=60)
+        sess.serve_drain(timeout=60)
+        time.sleep(0.1)
+        assert sess._slo.snapshot()["tenants"]["gold"]["counts"][
+            "miss"] >= 1
+
+    def test_register_delta_feeds_ivm_pseudo_tenant(self, mesh8, rng):
+        sess = _sess(mesh8, slo_targets="ivm:p95_ms=60000",
+                     slo_fast_window_s=1.0, slo_slow_window_s=4.0,
+                     slo_burn_threshold=3.0, slo_burn_exit=1.0)
+        an = (rng.random((32, 32)) < 0.2).astype(np.float32)
+        sess.register("A", sess.from_numpy(an))
+        out = sess.register_delta(
+            "A", (np.array([1, 2]), np.array([3, 4])), kind="coo")
+        assert isinstance(out["ms"], float)
+        lat = sess._slo.snapshot()["tenants"]["ivm"]["latency_ms"]
+        assert lat["count"] == 1
+
+    def test_overload_event_carries_slo_snapshot(self, mesh8, rng,
+                                                 tmp_path):
+        sess = _sess(mesh8, tmp_path, obs_level="on", **SLO_CFG)
+        A = _mat(rng, 32, 32, mesh8)
+        sess.submit(A.expr().multiply_scalar(2.0),
+                    tenant="gold").result(timeout=60)
+        sess.serve_drain(timeout=60)
+        time.sleep(0.1)
+        ov = read_events(sess.config.obs_event_log,
+                         kinds=("overload",))
+        assert ov, "slo-active pipeline must emit overload cycles"
+        assert "slo" in ov[-1]
+        assert "gold" in ov[-1]["slo"]["tenants"]
+
+
+class TestAlertEventContract:
+    def test_alert_lands_in_event_log_when_obs_on(self, mesh8,
+                                                  tmp_path):
+        sess = _sess(mesh8, tmp_path, obs_level="on", **SLO_CFG)
+        for _ in range(10):
+            sess._slo.record_shed("gold")
+        al = read_events(sess.config.obs_event_log, kinds=("alert",))
+        assert [e["state"] for e in al] == ["firing"]
+        assert al[0]["tenant"] == "gold"
+        assert al[0]["objective"] == "avail"
+
+    def test_alert_lands_in_flight_ring_regardless_of_obs_level(
+            self, mesh8, tmp_path):
+        # THE tier-3 contract: obs_level OFF, flight recorder on —
+        # alert transitions still enter the post-mortem ring
+        sess = _sess(mesh8, tmp_path, obs_level="off",
+                     obs_flight_recorder=64, **SLO_CFG)
+        for _ in range(10):
+            sess._slo.record_shed("gold")
+        kinds = [r.get("kind") for r in sess._flight.snapshot()]
+        assert "alert" in kinds
+        # and nothing was written to the event log (obs off)
+        assert read_events(sess.config.obs_event_log,
+                           kinds=("alert",)) == []
+
+    def test_alert_metrics_counters(self, mesh8, tmp_path):
+        from matrel_tpu.obs.metrics import REGISTRY
+        REGISTRY.reset()
+        sess = _sess(mesh8, tmp_path, obs_level="on", **SLO_CFG)
+        t0 = time.time()
+        for _ in range(10):
+            sess._slo.record_shed("gold")
+        while (REGISTRY.counter("slo.alerts.cleared").value < 1
+               and time.time() - t0 < 10):
+            time.sleep(0.2)
+            sess._slo.tick()
+        # >= — the registry is process-global and earlier tests'
+        # still-ticking sessions may clear their own alerts into it
+        assert REGISTRY.counter("slo.alerts.fired").value >= 1
+        assert REGISTRY.counter("slo.alerts.cleared").value >= 1
+
+
+# ---------------------------------------------------------------------------
+# metrics endpoint
+# ---------------------------------------------------------------------------
+
+#: Strict Prometheus text-format line grammar (the traffic harness
+#: applies the same check on every poll).
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})?\s"
+    r"[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|NaN|[Ii]nf)$")
+
+
+def _prom_ok(text: str) -> bool:
+    saw = False
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if not re.match(r"^# (TYPE|HELP) [a-zA-Z_:]", line):
+                return False
+            continue
+        if not _PROM_SAMPLE.match(line):
+            return False
+        saw = True
+    return saw
+
+
+class TestMetricsEndpoint:
+    @pytest.fixture
+    def served(self, mesh8, rng, tmp_path):
+        port = _free_port()
+        sess = _sess(mesh8, tmp_path, obs_level="on",
+                     obs_metrics_port=port,
+                     result_cache_max_bytes=1 << 20, **SLO_CFG)
+        A = _mat(rng, 32, 32, mesh8)
+        for _ in range(3):
+            sess.submit(A.expr().multiply_scalar(2.0),
+                        tenant="gold").result(timeout=60)
+        sess.serve_drain(timeout=60)
+        time.sleep(0.1)
+        yield sess, port
+        sess._exporter.stop()
+
+    def test_prometheus_endpoint_parses_strict(self, served):
+        sess, port = served
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics",
+            timeout=10).read().decode()
+        assert _prom_ok(txt), txt[:600]
+        assert "matrel_query_count" in txt
+        assert 'matrel_slo_burn_rate{tenant="gold"' in txt
+        assert "matrel_serve_queue_depth" in txt
+
+    def test_json_endpoint_sections(self, served):
+        sess, port = served
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/json",
+            timeout=10).read().decode())
+        assert snap["slo"]["tenants"]["gold"]["counts"]["ok"] == 3
+        assert snap["metrics"]["counters"]["query.count"] >= 3
+        # the repeated query hits the result cache after its first
+        # execution, so only the real runs land in the histogram
+        h = snap["metrics"]["histograms"]["query.execute_ms"]
+        assert h["count"] >= 1 and h["p95"] is not None
+        assert snap["plan_cache"]["plans"] >= 1
+        assert snap["result_cache"]["entries"] >= 0
+        assert snap["serve"]["queue_depth"] == 0
+        # drift section present (obs on) even when no flags fire
+        assert snap["drift"] is None or "flag_count" in snap["drift"]
+
+    def test_unknown_path_404(self, served):
+        sess, port = served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+        assert ei.value.code == 404
+
+    def test_exporter_thread_named_and_stoppable(self, mesh8,
+                                                 tmp_path):
+        port = _free_port()
+        sess = _sess(mesh8, tmp_path, obs_metrics_port=port)
+        names = [t.name for t in threading.enumerate()]
+        assert "matrel-metrics" in names
+        sess._exporter.stop()
+        time.sleep(0.1)
+        names = [t.name for t in threading.enumerate()]
+        assert "matrel-metrics" not in names
+
+    def test_bind_conflict_raises_at_construction(self, mesh8,
+                                                  tmp_path):
+        port = _free_port()
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", port))
+        blocker.listen(1)
+        try:
+            with pytest.raises(OSError):
+                _sess(mesh8, tmp_path, obs_metrics_port=port)
+        finally:
+            blocker.close()
+
+    def test_serve_close_stops_exporter(self, mesh8, rng, tmp_path):
+        # review fix: "done serving" frees the port deterministically
+        port = _free_port()
+        sess = _sess(mesh8, tmp_path, obs_metrics_port=port)
+        A = _mat(rng, 32, 32, mesh8)
+        sess.submit(A.expr().multiply_scalar(2.0)).result(timeout=60)
+        sess.serve_close(timeout=60)
+        time.sleep(0.1)
+        assert "matrel-metrics" not in {
+            t.name for t in threading.enumerate()}
+        # the port is reusable immediately — no EADDRINUSE leak
+        s2 = _sess(mesh8, tmp_path, obs_metrics_port=port)
+        s2._exporter.stop()
+
+    def test_dropped_session_frees_port_via_finalizer(self, mesh8,
+                                                      tmp_path):
+        # review fix: a session that is simply dropped (no serve
+        # traffic, so no worker thread roots it) must not pin its
+        # bound port for process lifetime
+        import gc
+        port = _free_port()
+        sess = _sess(mesh8, tmp_path, obs_metrics_port=port)
+        del sess
+        gc.collect()
+        time.sleep(0.2)
+        assert "matrel-metrics" not in {
+            t.name for t in threading.enumerate()}
+        s2 = _sess(mesh8, tmp_path, obs_metrics_port=port)
+        s2._exporter.stop()
+
+    def test_events_tail_bytes_reads_only_the_tail(self, tmp_path):
+        # review fix: live readers (scrape drift view, `top` frames)
+        # cost O(tail), not O(history) — and the cut-off first line
+        # is dropped, not mis-parsed
+        log = EventLog(str(tmp_path / "big.jsonl"))
+        for i in range(200):
+            log.emit("query", {"i": i})
+        full = read_events(log.path)
+        assert len(full) == 200
+        tail = read_events(log.path, tail_bytes=2000)
+        assert 0 < len(tail) < 200
+        assert tail[-1]["i"] == 199            # newest records kept
+        assert [e["i"] for e in tail] == sorted(
+            e["i"] for e in tail)              # contiguous tail
+        # a bound larger than the file reads everything
+        assert len(read_events(log.path, tail_bytes=1 << 30)) == 200
+
+    def test_render_prometheus_escapes_labels(self):
+        from matrel_tpu.obs.export import render_prometheus
+        snap = {"metrics": {"counters": {}, "gauges": {},
+                            "histograms": {}},
+                "serve": {"queue_depth": 1,
+                          "tenant_depths": {'we"ird\nname': 2},
+                          "inflight": 0}}
+        txt = render_prometheus(snap)
+        assert _prom_ok(txt), txt
+        assert r'tenant="we\"ird\nname"' in txt
+
+
+# ---------------------------------------------------------------------------
+# top — the operator console
+# ---------------------------------------------------------------------------
+
+
+class TestTopConsole:
+    def test_render_from_live_endpoint(self, mesh8, rng, tmp_path):
+        from matrel_tpu.obs import top
+        port = _free_port()
+        sess = _sess(mesh8, tmp_path, obs_level="on",
+                     obs_metrics_port=port, **SLO_CFG)
+        try:
+            A = _mat(rng, 32, 32, mesh8)
+            sess.submit(A.expr().multiply_scalar(2.0),
+                        tenant="gold").result(timeout=60)
+            sess.serve_drain(timeout=60)
+            time.sleep(0.1)
+            snap = top.snapshot_from_url(f"http://127.0.0.1:{port}")
+            frame = top.render(snap)
+            assert "gold" in frame
+            assert "qps" in frame and "p95" in frame
+            assert "active alerts" in frame
+        finally:
+            sess._exporter.stop()
+
+    def test_render_from_log(self, tmp_path):
+        from matrel_tpu.obs import top
+        log = EventLog(str(tmp_path / "ev.jsonl"))
+        log.emit("overload", {
+            "rung": 2, "rung_label": "stale-serve",
+            "queue_depth": 7, "tenant_depths": {"gold": 3},
+            "admitted": {"gold": 4, "bronze": 1},
+            "tenant_waits_ms": {"gold": [5.0, 9.0], "bronze": [80.0]},
+            "sheds": {"bronze": 3}})
+        log.emit("alert", {"tenant": "bronze", "objective": "avail",
+                           "state": "firing", "burn_fast": 9.0})
+        snap = top.snapshot_from_log(log.path)
+        frame = top.render(snap)
+        assert "stale-serve" in frame
+        assert "bronze" in frame and "FIRING:avail" in frame
+        assert "gold" in frame
+
+    def test_log_mode_alert_reconciliation(self, tmp_path):
+        # an alert CLEAR newer than the last overload record's slo
+        # snapshot must win — the header can never show a stale FIRING
+        from matrel_tpu.obs import top
+        log = EventLog(str(tmp_path / "ev.jsonl"))
+        log.emit("overload", {
+            "rung": 0, "queue_depth": 0, "admitted": {"gold": 1},
+            "tenant_waits_ms": {"gold": [2.0]},
+            "slo": {"tenants": {"gold": {"objectives": {
+                "avail": {"state": "firing", "burn_fast": 9.0}},
+                "latency_ms": {}, "qps": 1.0, "shed_rate": 0.0,
+                "counts": {}}},
+                "alerts_active": 1, "alerts_fired": 1,
+                "alerts_cleared": 0}})
+        log.emit("alert", {"tenant": "gold", "objective": "avail",
+                           "state": "clear", "burn_fast": 0.0})
+        snap = top.snapshot_from_log(log.path)
+        assert snap["slo"]["alerts_active"] == 0
+        st = snap["slo"]["tenants"]["gold"]["objectives"]["avail"]
+        assert st["state"] == "ok"
+
+    def test_cli_once_against_log(self, tmp_path, capsys):
+        import argparse
+        from matrel_tpu.obs import top
+        log = EventLog(str(tmp_path / "ev.jsonl"))
+        log.emit("overload", {"rung": 0, "queue_depth": 0,
+                              "admitted": {"gold": 2},
+                              "tenant_waits_ms": {"gold": [1.0]}})
+        args = argparse.Namespace(url=None, port=None, log=log.path,
+                                  interval=0.1, once=True,
+                                  iterations=None)
+        assert top.main(args) == 0
+        out = capsys.readouterr().out
+        assert "matrel_tpu top" in out and "gold" in out
+
+    def test_cli_unreachable_endpoint_exits_nonzero(self, capsys):
+        import argparse
+        from matrel_tpu.obs import top
+        args = argparse.Namespace(url=None, port=_free_port(),
+                                  log=None, interval=0.1, once=True,
+                                  iterations=None)
+        assert top.main(args) == 1
+
+
+# ---------------------------------------------------------------------------
+# history: alert roll-up + --check gate
+# ---------------------------------------------------------------------------
+
+
+class TestHistoryAlertRollup:
+    def _seed(self, tmp_path, cleared=True):
+        log = EventLog(str(tmp_path / "ev.jsonl"))
+        log.emit("overload", {
+            "rung": 1, "queue_depth": 5,
+            "admitted": {"gold": 10, "bronze": 4},
+            "tenant_waits_ms": {"gold": [3.0], "bronze": [50.0]},
+            "sheds": {"bronze": 6}})
+        log.emit("alert", {"tenant": "bronze", "objective": "avail",
+                           "state": "firing", "burn_fast": 8.0,
+                           "attainment": 0.41})
+        if cleared:
+            log.emit("alert", {"tenant": "bronze",
+                               "objective": "avail",
+                               "state": "clear", "burn_fast": 0.2,
+                               "attainment": 0.77})
+        return log.path
+
+    def test_summarize_alert_counts_and_attainment(self, tmp_path):
+        from matrel_tpu.obs.history import summarize
+        s = summarize(read_events(self._seed(tmp_path)))
+        al = s["alerts"]
+        assert al["fired"] == 1 and al["cleared"] == 1
+        assert al["uncleared"] == []
+        assert al["tenants"]["bronze"]["attainment"] == 0.77
+        assert al["tenants"]["bronze"]["fired"] == 1
+
+    def test_no_alert_events_summarize_none(self, tmp_path):
+        from matrel_tpu.obs.history import summarize
+        log = EventLog(str(tmp_path / "e2.jsonl"))
+        log.emit("query", {"query_id": "q", "cache": "miss",
+                           "execute_ms": 1.0, "out_shape": [1, 1],
+                           "plan_cache": {}, "matmuls": []})
+        assert summarize(read_events(log.path))["alerts"] is None
+
+    def test_render_has_slo_columns_and_line(self, tmp_path):
+        from matrel_tpu.obs.history import render_summary
+        out = render_summary(read_events(self._seed(tmp_path)))
+        assert "slo attain" in out and "alerts" in out
+        assert "slo alerts: 1 fired / 1 cleared" in out
+        # bronze row carries its attainment + alert count
+        row = [ln for ln in out.splitlines()
+               if ln.startswith("bronze")][0]
+        assert "0.7700" in row
+
+    def test_render_flags_uncleared(self, tmp_path):
+        from matrel_tpu.obs.history import render_summary
+        out = render_summary(
+            read_events(self._seed(tmp_path, cleared=False)))
+        assert "UNCLEARED: bronze:avail" in out
+
+    def _args(self, path, check):
+        import argparse
+        return argparse.Namespace(log=path, summary=True, last=None,
+                                  drift=False, check=check,
+                                  drift_table=None, no_save=True)
+
+    def test_check_exits_zero_when_cleared(self, tmp_path, capsys):
+        from matrel_tpu.obs import history
+        assert history.main(
+            self._args(self._seed(tmp_path), True)) == 0
+
+    def test_check_exits_nonzero_on_uncleared(self, tmp_path,
+                                              capsys):
+        from matrel_tpu.obs import history
+        rc = history.main(
+            self._args(self._seed(tmp_path, cleared=False), True))
+        assert rc == 1
+        assert "SLO CHECK FAILED" in capsys.readouterr().out
+
+    def test_no_check_ignores_uncleared(self, tmp_path, capsys):
+        from matrel_tpu.obs import history
+        assert history.main(
+            self._args(self._seed(tmp_path, cleared=False),
+                       False)) == 0
+
+
+# ---------------------------------------------------------------------------
+# default-config structural zero (the PR 6 idiom)
+# ---------------------------------------------------------------------------
+
+
+class TestZeroOverheadContract:
+    def test_default_session_owns_no_telemetry_objects(self, mesh8):
+        sess = MatrelSession(mesh=mesh8, config=MatrelConfig())
+        assert sess._slo is None
+        assert sess._exporter is None
+
+    def test_default_path_constructs_no_sketch_monitor_exporter(
+            self, mesh8, rng, monkeypatch):
+        # the poisoned-__init__ idiom: a default-config session over
+        # REAL serve traffic (submit + run + drain) must never build a
+        # sketch, a monitor, a plane or an exporter — the query path
+        # is structurally identical to round 14
+        from matrel_tpu.obs.export import MetricsExporter
+        from matrel_tpu.obs.metrics import QuantileSketch
+        from matrel_tpu.obs.slo import SLOMonitor, SLOPlane, _Window
+
+        def poisoned(self, *a, **k):
+            raise AssertionError(
+                "telemetry object built on the default path")
+        for cls in (QuantileSketch, SLOMonitor, SLOPlane, _Window,
+                    MetricsExporter):
+            monkeypatch.setattr(cls, "__init__", poisoned)
+        sess = MatrelSession(mesh=mesh8, config=MatrelConfig())
+        A = _mat(rng, 32, 32, mesh8)
+        an = A.to_numpy()
+        fut = sess.submit(A.expr().multiply_scalar(2.0))
+        np.testing.assert_allclose(fut.result(timeout=60).to_numpy(),
+                                   an * 2.0, rtol=1e-6, atol=1e-6)
+        sess.compute(A.expr().multiply(A.expr()))
+        sess.serve_drain(timeout=60)
+
+    def test_default_session_starts_no_exporter_thread(self, mesh8):
+        before = {t.name for t in threading.enumerate()}
+        MatrelSession(mesh=mesh8, config=MatrelConfig())
+        after = {t.name for t in threading.enumerate()}
+        assert "matrel-metrics" not in after - before

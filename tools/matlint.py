@@ -40,6 +40,10 @@ Rule catalogue (each rule's class docstring is the authority):
   ML012  ResultCache entry payloads mutated outside the sanctioned
          patch/apply seam in serve/result_cache.py (the ML009/ML010
          one-seam idiom applied to cached state)
+  ML013  ad-hoc timing accumulation (append/extend onto latency-named
+         lists) in matrel_tpu/ outside obs/ — timing metrics flow
+         through the registry's sketch/histogram API so live and
+         offline quantiles share one definition
 """
 
 from __future__ import annotations
@@ -750,12 +754,90 @@ class ResultCacheSeamRule(Rule):
                     f"seam (serve/result_cache.py)")
 
 
+class TimingAccumulationRule(Rule):
+    """ML013: ad-hoc latency accumulation outside the metrics
+    registry — ``.append()``/``.extend()`` onto a latency-named list
+    in ``matrel_tpu/`` outside ``matrel_tpu/obs/``.
+
+    The live telemetry plane (obs/metrics.py round 15) made quantiles
+    a SHARED definition: every timing metric flows through the
+    registry's sketch/histogram API (or ``obs.metrics.percentile``),
+    so the live endpoint, ``history``'s replay and ``top`` can never
+    disagree beyond the sketch's documented relative error — and
+    memory stays bounded by construction. A private
+    ``latencies.append(ms)`` list is the pre-sketch anti-pattern
+    wearing new clothes: unbounded on a long-lived server, invisible
+    to the endpoint, and quantiled by whatever ad-hoc rank math its
+    author re-derives (the exact drift the history-vs-live fix
+    removed). ML006 pins the CLOCK CALLS; this rule pins the
+    ACCUMULATION — both ends of a private stopwatch.
+
+    Scope: the package minus ``obs/`` (the registry and its readers
+    ARE the sanctioned accumulation) ; harness scripts (bench/tools/
+    tests) are out of scope — measurement is their output (the ML006
+    autotune precedent). The two legitimate in-scope sites — the
+    brownout controller's bounded sliding window (measurement IS that
+    subsystem, and its p95 reads through the shared definition) and
+    the serve worker's per-cycle overload-event assembly (the values
+    land in the event log) — carry justified inline suppressions.
+
+    Matched names: the append target's variable/attribute name (or a
+    string subscript key) containing a latency-ish token — ``lat``/
+    ``latency``/``latencies``, ``wait``/``waits``, ``duration(s)``,
+    ``elapsed``, ``timing(s)`` — or ending in ``_ms``.
+    """
+
+    id = "ML013"
+    _TIMING_RE = re.compile(
+        r"(?i)(?:^|_)(lat|lats|latency|latencies|wait|waits|"
+        r"dur|durs|duration|durations|elapsed|timing|timings)(?:$|_)"
+        r"|_ms$")
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("matrel_tpu/")
+                and not relpath.startswith("matrel_tpu/obs/"))
+
+    @classmethod
+    def _target_name(cls, node: ast.AST) -> str:
+        """The accumulation target's human name: ``waits`` for
+        ``waits.append``, ``_waits`` for ``self._waits.append``,
+        ``latencies`` for ``row["latencies"].append``."""
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Subscript):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value,
+                                                           str):
+                return sl.value
+        return ""
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in ("append", "extend"):
+                continue
+            name = self._target_name(node.func.value)
+            if name and self._TIMING_RE.search(name):
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    f"ad-hoc timing accumulation `{name}."
+                    f"{node.func.attr}(...)` — record through the "
+                    "metrics registry's sketch/histogram API "
+                    "(obs/metrics.py) so live and offline quantiles "
+                    "share one bounded-memory definition")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
                         SpecKeyedCacheRule(), RawTimingRule(),
                         BroadSwallowRule(), DevicePutRule(),
                         KernelSeamRule(), JitSeamRule(),
-                        UnboundedQueueRule(), ResultCacheSeamRule())
+                        UnboundedQueueRule(), ResultCacheSeamRule(),
+                        TimingAccumulationRule())
 
 
 def _suppressed_codes(line: str) -> set:

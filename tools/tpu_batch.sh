@@ -94,6 +94,8 @@ log "--- chaos_drill (resilience: seeded fault schedule over a mixed serve strea
 python tools/chaos_drill.py
 log "--- traffic (open-loop overload harness: weighted tenants, brownout, typed shed, staged this round)"
 python tools/traffic.py
+log "--- traffic --slo (SLO burn-rate alert fire/clear proof + live metrics endpoint, staged this round)"
+python tools/traffic.py --slo
 log "--- north_star_sweep (VERDICT #10 residual)"
 python tools/north_star_sweep.py
 log "--- gram_manual3 (symmetric-Gram microbench, BASELINE row 3 support)"

@@ -40,6 +40,16 @@ relay is wedged (this drills the control plane, not the chip):
   - the Jain fairness index over weight-normalised per-tenant goodput
     is reported (1.0 = perfectly weight-proportional service).
 
+``--slo`` (round 15, docs/OBSERVABILITY.md tier 3) runs the SAME
+three phases under declared per-tenant objectives with the live
+metrics endpoint on, and its acceptance is the alerting loop instead
+of goodput: the violated (lowest-weight) tenant's fast-window
+burn-rate alert must FIRE during saturation and every alert must
+CLEAR after the load drops, with the Prometheus endpoint strict-
+parsing clean on every poll throughout and still zero wrong answers.
+One parseable ``traffic_slo_harness`` JSON artifact (tpu_batch.sh
+stages both modes; test_batch_dry asserts both).
+
 Latency is measured to future RESOLUTION (dispatch-complete — the
 serve plane's own SLA semantics since PR 5). The workload mix reuses
 ``workloads/`` (triangle counting) and the kernel registry's
@@ -243,16 +253,19 @@ def drive_phase(sess, pool, schedule, tenants, rng, deadline_ms,
     return time.perf_counter() - t0
 
 
-def measure_capacity(sess, pool, tenants, cal_n) -> float:
+def measure_capacity(sess, pool, tenants, cal_n,
+                     windows: int = 3) -> float:
     """Closed-loop capacity: one submit-wait client PER TENANT running
     concurrently (the faithful closed-loop definition for a 3-tenant
     plane — each tenant always has exactly one query in the system),
     through the SAME serve path the open-loop phase drives. Returns
-    the MINIMUM of 3 windows: window-to-window spread on a small
-    shared host is scheduling noise, and the goodput criterion is a
-    congestion-collapse detector — it compares against the slowest
-    capacity the host actually demonstrated, not against one lucky
-    alignment of the three clients."""
+    the MINIMUM of ``windows`` runs: window-to-window spread on a
+    small shared host is scheduling noise, and the goodput criterion
+    is a congestion-collapse detector — it compares against the
+    slowest capacity the host actually demonstrated, not against one
+    lucky alignment of the three clients. (--slo mode passes
+    windows=1: its acceptance is alert behaviour, not goodput, and
+    capacity only sets the offered rate.)"""
 
     def window() -> float:
         per = max(cal_n // len(tenants), 8)
@@ -275,10 +288,82 @@ def measure_capacity(sess, pool, tenants, cal_n) -> float:
         sess.serve_drain(timeout=60)
         return sum(done) / max(time.perf_counter() - t0, 1e-9)
 
-    return min(window() for _ in range(3))
+    return min(window() for _ in range(windows))
 
 
-def main() -> int:
+# ---------------------------------------------------------------------------
+# --slo mode support: endpoint polling + strict Prometheus parsing
+# ---------------------------------------------------------------------------
+
+#: Strict text-exposition line grammar (version 0.0.4): metric name,
+#: optional {labels}, one float (NaN/inf included). Anything else —
+#: including a malformed # comment — fails the poll.
+import re  # noqa: E402
+
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})?\s"
+    r"[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|NaN|[Ii]nf)$")
+
+
+def prometheus_parse_ok(text: str) -> bool:
+    saw_sample = False
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if not re.match(r"^# (TYPE|HELP) [a-zA-Z_:]", line):
+                return False
+            continue
+        if not _PROM_SAMPLE.match(line):
+            return False
+        saw_sample = True
+    return saw_sample
+
+
+class PrometheusPoller:
+    """Background scraper for --slo mode: GETs /metrics on an
+    interval, strict-parses every response, and keeps the violated
+    tenant's burn gauge trail — the 'endpoint parses clean
+    THROUGHOUT' half of the acceptance."""
+
+    def __init__(self, port, interval_s=0.4):
+        self.url = f"http://127.0.0.1:{port}/metrics"
+        self.interval_s = interval_s
+        self.polls = 0
+        self.parse_failures = 0
+        self.errors = 0
+        self.last_error = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="traffic-prom-poll",
+                                        daemon=True)
+
+    def _run(self):
+        import urllib.request
+        while not self._stop.is_set():
+            try:
+                with urllib.request.urlopen(self.url,
+                                            timeout=5) as resp:
+                    text = resp.read().decode()
+                self.polls += 1
+                if not prometheus_parse_ok(text):
+                    self.parse_failures += 1
+                    self.last_error = "parse failure: " + text[:200]
+            except Exception as ex:  # noqa: BLE001 — tallied, the
+                # record's ok goes false on any scrape error
+                self.errors += 1
+                self.last_error = repr(ex)[:200]
+            self._stop.wait(self.interval_s)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def main(slo: bool = False) -> int:
     from matrel_tpu.config import MatrelConfig
     from matrel_tpu.core import mesh as mesh_lib
     from matrel_tpu.resilience import faults
@@ -294,9 +379,36 @@ def main() -> int:
     process = os.environ.get("MATREL_TRAFFIC_PROCESS", "poisson")
     faults.reset()
     weights = ",".join(f"{t['name']}:{t['weight']:g}" for t in TENANTS)
+    # --slo mode (round 15, docs/OBSERVABILITY.md tier 3): declare
+    # per-tenant availability objectives sized so ~2x overload BURNS
+    # them (budget 10%, fire at 3x sustainable consumption), shrink
+    # the burn windows to fit the phases, turn the live metrics
+    # endpoint + obs event log on, and prove: the violated (lowest-
+    # weight) tenant's fast-window alert FIRES during saturation,
+    # every alert CLEARS after the load drops, and the Prometheus
+    # endpoint parses clean on every poll throughout.
+    slo_fast_s = _env_f("MATREL_TRAFFIC_SLO_FAST_S", 1.5)
+    slo_kw: dict = {}
+    if slo:
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        slo_port = s.getsockname()[1]
+        s.close()
+        slo_kw = dict(
+            obs_level="on",
+            obs_metrics_port=slo_port,
+            slo_targets=(f"gold:avail=0.9,p95_ms={deadline_ms:g};"
+                         f"silver:avail=0.9;bronze:avail=0.9"),
+            slo_fast_window_s=slo_fast_s,
+            slo_slow_window_s=max(4 * slo_fast_s, seconds + tail_s),
+            slo_burn_threshold=3.0,
+            slo_burn_exit=1.0,
+        )
     # env (MATREL_*) overrides flow over the base config so the dry
     # batch's redirects land every artifact outside the repo
     cfg = MatrelConfig.from_env(MatrelConfig(
+        **slo_kw,
         serve_tenant_weights=weights,
         serve_tenant_queue_max=16,
         serve_queue_max=48,
@@ -336,9 +448,14 @@ def main() -> int:
                                in ("tpu", "axon")),
     ))
     mesh = mesh_lib.make_mesh((2, 4))
+    t_session_start = time.time()
     sess = MatrelSession(mesh=mesh, config=cfg)
     rng = np.random.default_rng(seed)
     pool = build_pool(sess, rng)
+    poller = None
+    if slo:
+        poller = PrometheusPoller(sess._exporter.port)
+        poller.start()
 
     # -- phase 0: prewarm the MultiPlan composition space ------------------
     # the worker coalesces up to serve_max_batch queries into one
@@ -365,15 +482,18 @@ def main() -> int:
     # dispatch
     for _name, expr, _o in pool:
         sess.submit(expr).result(timeout=60)
-    capacity_pre = measure_capacity(sess, pool, TENANTS, cal_n)
+    capacity_pre = measure_capacity(sess, pool, TENANTS, cal_n,
+                                    windows=(1 if slo else 3))
 
     # -- phase 2: open-loop overload --------------------------------------
     outcomes: list = []
     rung_samples: list = []
     rate = rate_x * capacity_pre
     sched = arrival_schedule(rng, rate, seconds, process)
+    t_overload_wall = time.time()
     wall = drive_phase(sess, pool, sched, TENANTS, rng, deadline_ms,
                        outcomes, rung_samples)
+    t_overload_end_wall = time.time()
     overload_n = len(outcomes) + 0   # marker index: overload arrivals
     overload_sched = len(sched)
     max_rung_mid = (sess._brownout.snapshot()["max_rung_seen"]
@@ -390,12 +510,21 @@ def main() -> int:
     except Exception as ex:  # noqa: BLE001 — tallied as a failure
         print(f"# DRAIN FAILED: {ex!r}", file=sys.stderr)
     time.sleep(0.2)          # let the last done-callbacks land
-    # post-phase capacity window: the goodput denominator is the MIN
-    # of the bracketing measurements — on a small shared host the
-    # closed-loop number drifts with scheduling noise, and a pre-only
-    # denominator would let host slowdown masquerade as congestion
-    # collapse (or mask a real one)
-    capacity_post = measure_capacity(sess, pool, TENANTS, cal_n)
+    if slo:
+        # let the fast burn window slide past the last bad event so
+        # the CLEAR transition provably happens (the worker's idle
+        # tick evaluates the monitors while the queue is empty);
+        # goodput is not this mode's acceptance, so the post capacity
+        # window is skipped and the denominator is the pre number
+        time.sleep(slo_fast_s + 1.0)
+        capacity_post = capacity_pre
+    else:
+        # post-phase capacity window: the goodput denominator is the
+        # MIN of the bracketing measurements — on a small shared host
+        # the closed-loop number drifts with scheduling noise, and a
+        # pre-only denominator would let host slowdown masquerade as
+        # congestion collapse (or mask a real one)
+        capacity_post = measure_capacity(sess, pool, TENANTS, cal_n)
     capacity_qps = min(capacity_pre, capacity_post)
     snap = sess._brownout.snapshot() if sess._brownout else {}
     brownout_entered = snap.get("max_rung_seen", 0) >= 1
@@ -470,6 +599,85 @@ def main() -> int:
     miss_hi = tenant_rows["gold"]["miss_rate"] or 0.0
     miss_lo = tenant_rows["bronze"]["miss_rate"] or 0.0
 
+    if slo:
+        # -- slo-mode verdict: alert fired during saturation, cleared
+        # after, endpoint clean throughout, zero wrong answers -------------
+        poller.stop()
+        from matrel_tpu.obs.events import read_events, resolve_path
+        plane = sess._slo.snapshot()
+        al = [e for e in read_events(resolve_path(cfg.obs_event_log),
+                                     kinds=("alert",))
+              if (e.get("ts") or 0) >= t_session_start]
+        fired = [e for e in al if e.get("state") == "firing"]
+        # the violated tenant: bronze is weight-lowest — quota sheds,
+        # rung-3 brownout sheds and deadline misses all land on it
+        # first; its alert must fire DURING the overload phase (one
+        # fast window of detection latency allowed)
+        bronze_fired_in_window = any(
+            e.get("tenant") == "bronze"
+            and e.get("objective") == "avail"
+            and (t_overload_wall - 1.0 <= (e.get("ts") or 0)
+                 <= t_overload_end_wall + slo_fast_s + 1.0)
+            for e in fired)
+        last_state: dict = {}
+        for e in al:
+            last_state[(str(e.get("tenant")),
+                        str(e.get("objective")))] = e.get("state")
+        uncleared = sorted(f"{t}:{o}"
+                           for (t, o), st in last_state.items()
+                           if st == "firing")
+        prom_ok = (poller.polls > 0 and poller.parse_failures == 0
+                   and poller.errors == 0)
+        record = {
+            "metric": "traffic_slo_harness",
+            "seed": seed,
+            "process": process,
+            "backend": jax.default_backend(),
+            "slo_targets": cfg.slo_targets,
+            "windows_s": [cfg.slo_fast_window_s,
+                          cfg.slo_slow_window_s],
+            "burn_threshold": cfg.slo_burn_threshold,
+            "burn_exit": cfg.slo_burn_exit,
+            "capacity_qps_closed_loop": round(capacity_qps, 2),
+            "offered_qps": round(rate, 2),
+            "arrivals": overload_sched,
+            "alert_events": len(al),
+            "alerts_fired": len(fired),
+            "alerts_cleared": sum(1 for e in al
+                                  if e.get("state") == "clear"),
+            "fired_objectives": sorted(
+                {f"{e.get('tenant')}:{e.get('objective')}"
+                 for e in fired}),
+            "violated_tenant_fired_in_window":
+                bronze_fired_in_window,
+            "uncleared": uncleared,
+            "alerts_active_final": plane["alerts_active"],
+            "tenants": {t: {"miss_rate": r["miss_rate"],
+                            "arrivals": r["arrivals"],
+                            "sheds": r["sheds"]}
+                        for t, r in tenant_rows.items()},
+            "prometheus": {"polls": poller.polls,
+                           "parse_failures": poller.parse_failures,
+                           "errors": poller.errors,
+                           "last_error": poller.last_error,
+                           "ok": prom_ok},
+            "brownout": {"entered": brownout_entered,
+                         "exited": brownout_exited,
+                         "max_rung": snap.get("max_rung_seen", 0)},
+            "wrong_answers": wrong,
+            "untyped_errors": untyped,
+        }
+        record["ok"] = bool(
+            bronze_fired_in_window
+            and fired
+            and not uncleared
+            and plane["alerts_active"] == 0
+            and prom_ok
+            and wrong == 0
+            and untyped == 0)
+        print(json.dumps(record))
+        return 0 if record["ok"] else 1
+
     record = {
         "metric": "traffic_overload_harness",
         "seed": seed,
@@ -516,4 +724,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(slo="--slo" in sys.argv[1:]))
