@@ -582,6 +582,156 @@ def measure_fusion() -> dict:
             "ok": bool(all_ok and off_clean and not mv111)}
 
 
+def measure_fleet() -> dict:
+    """Multi-slice serving-fleet scale-out row (docs/FLEET.md;
+    ROADMAP item 1): a repeated-traffic stream of distinct queries
+    whose WORKING SET exceeds one slice's result-cache budget but
+    fits the fleet's aggregate — the distributed-cache capacity
+    story, measured. ``fleet_slices=1`` thrashes its LRU on every
+    replay (cyclic access over a 0.6x-capacity set: every consult
+    misses and recomputes); ``fleet_slices=2`` splits ownership
+    across slices, the global directory routes every replay to its
+    owning slice's cache, and the stream answers without recompute —
+    the acceptance number is the aggregate-QPS ratio going 1 -> 2
+    virtual slices, with a directory hit on a NON-owning slice
+    proven recompute-free.
+
+    Phase three is the failover drill: a 2-slice fleet serving the
+    stream has slice 0 killed mid-stream; the stream must complete
+    with ZERO wrong answers (each future's result checked against
+    the numpy oracle) and only typed failures.
+
+    Single-query admission (``serve_max_batch=1``) in every config so
+    the ratio measures CACHE CAPACITY, not MultiPlan composition
+    churn (the traffic-harness precedent on CPU hosts)."""
+    import jax  # noqa: F401  (backend registration)
+    from matrel_tpu.config import MatrelConfig, set_default_config
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.resilience.errors import ResilienceError
+    from matrel_tpu.session import MatrelSession
+
+    set_default_config(MatrelConfig(obs_level="off"))
+    mesh = mesh_lib.make_mesh()
+    # ODD stream length: round-robin placement then lands each
+    # replay's asks on alternating slices relative to ownership, so
+    # the row PROVES the remote-hit path (an even count parity-aligns
+    # placement with ownership and never exercises it)
+    n = _env_int("MATREL_FLEET_N", 512)
+    n_q = _env_int("MATREL_FLEET_QUERIES", 13)
+    replays = _env_int("MATREL_FLEET_REPLAYS", 3)
+    rng = np.random.default_rng(7)
+    A_np = rng.standard_normal((n, n)).astype(np.float32)
+    B_np = rng.standard_normal((n, n)).astype(np.float32)
+    # per-slice budget: 60% of the working set — one slice thrashes,
+    # two slices (each owning ~half the stream) hold their share
+    budget = int(0.6 * n_q * n * n * 4)
+
+    def build_session(slices: int) -> MatrelSession:
+        cfg = MatrelConfig(obs_level="off", fleet_slices=slices,
+                           result_cache_max_bytes=budget,
+                           serve_max_batch=1)
+        sess = MatrelSession(mesh=mesh, config=cfg)
+        sess.register("A", sess.from_numpy(A_np))
+        sess.register("B", sess.from_numpy(B_np))
+        return sess
+
+    def stream_exprs(sess):
+        base = sess.table("A").expr().multiply(
+            sess.table("B").expr())
+        return [base.multiply_scalar(1.0 + 0.5 * i)
+                for i in range(n_q)]
+
+    def replay(sess, qs):
+        futs = [sess.submit(q) for q in qs]
+        outs = [f.result(timeout=600) for f in futs]
+        for o in outs:
+            o.data.block_until_ready()
+
+    def run_config(slices: int) -> dict:
+        sess = build_session(slices)
+        qs = stream_exprs(sess)
+        replay(sess, qs)      # warm: compiles + populates the caches
+        sess.serve_drain()
+        info0 = sess.fleet_info()
+        sub0 = sum(sl["submitted"] for sl in info0["slices"])
+        ts = []
+        for _ in range(replays):
+            t0 = time.perf_counter()
+            replay(sess, qs)
+            ts.append(time.perf_counter() - t0)
+        sess.serve_drain()
+        info = sess.fleet_info()
+        sub1 = sum(sl["submitted"] for sl in info["slices"])
+        ts.sort()
+        med = ts[len(ts) // 2]
+        half = (ts[-1] - ts[0]) / 2
+        row = {"qps": round(n_q / med, 2),
+               "median_ms": round(med * 1e3, 3),
+               "half_width_ms": round(half * 1e3, 3),
+               "replays": replays,
+               "directory": info["directory"],
+               "placed": info["placed"],
+               # "answered without recompute": the measured replays
+               # never re-entered a slice pipeline — every answer
+               # came from the directory's front door
+               "recompute_free_replays": sub1 == sub0}
+        sess.serve_close()
+        return row
+
+    def kill_drill() -> dict:
+        sess = build_session(2)
+        qs = stream_exprs(sess)
+        oracle = A_np @ B_np
+        futs = []
+        for r in range(3):
+            for i, q in enumerate(qs):
+                futs.append((i, sess.submit(q)))
+                if r == 1 and i == n_q // 2:
+                    sess._fleet.kill_slice(0)
+        try:
+            sess.serve_drain(timeout=600)
+        except ResilienceError:
+            pass          # a wedged drain still counts below, typed
+        completed = wrong = typed = untyped = 0
+        for i, f in futs:
+            try:
+                o = f.result(timeout=600)
+                got = np.asarray(o.to_numpy())
+                want = oracle * (1.0 + 0.5 * i)
+                if np.allclose(got, want, rtol=2e-3, atol=2e-3):
+                    completed += 1
+                else:
+                    wrong += 1
+            except ResilienceError:
+                typed += 1
+            except Exception:
+                untyped += 1
+        info = sess.fleet_info()
+        out = {"submitted": len(futs), "completed": completed,
+               "wrong": wrong, "typed_failures": typed,
+               "untyped_failures": untyped,
+               "failovers": info["failovers"],
+               "requeued": info["requeued"]}
+        sess.serve_close()
+        return out
+
+    out: dict = {"n": n, "queries": n_q, "replays": replays,
+                 "cache_budget_bytes": budget, "configs": {}}
+    out["configs"]["slices1"] = run_config(1)
+    out["configs"]["slices2"] = run_config(2)
+    q1 = out["configs"]["slices1"]["qps"]
+    q2 = out["configs"]["slices2"]["qps"]
+    out["slices1_qps"] = q1
+    out["slices2_qps"] = q2
+    out["speedup"] = round(q2 / q1, 2) if q1 else None
+    d2 = out["configs"]["slices2"]["directory"]
+    out["remote_hit_no_recompute"] = bool(
+        d2["remote_hits"] >= 1
+        and out["configs"]["slices2"]["recompute_free_replays"])
+    out["kill"] = kill_drill()
+    return out
+
+
 def measure_stream() -> dict:
     """Streaming IVM sweep (ROADMAP item 2, the round-14 acceptance
     row): the sliding-window streaming-graph dashboard
@@ -1500,6 +1650,24 @@ def main_fusion() -> None:
     print(json.dumps(record))
 
 
+def main_fleet() -> None:
+    """Wedge-safe multi-slice fleet scale-out row capture
+    (tools/tpu_batch.sh step): probe, then the measurement child under
+    a hard timeout; one parseable JSON line either way, rc 0 — same
+    contract as the headline metric."""
+    ok, payload = _run_child("probe", PROBE_TIMEOUT_S)
+    if ok:
+        ok, payload = _run_child("fleet", MEASURE_TIMEOUT_S)
+    record = {"metric": "fleet_scaleout_qps"}
+    if ok and isinstance(payload, dict):
+        record.update(payload)
+        _emit_bench_event(dict(record))
+    else:
+        record.update({"value": None, "error": str(payload)[:500]})
+        _emit_bench_error(record["metric"], str(payload))
+    print(json.dumps(record))
+
+
 def main_stream() -> None:
     """Wedge-safe streaming-IVM row capture (tools/tpu_batch.sh step):
     probe, then the measurement child under a hard timeout; one
@@ -1555,6 +1723,10 @@ if __name__ == "__main__":
         print(json.dumps(measure_fusion()))
     elif "--_stream" in sys.argv:
         print(json.dumps(measure_stream()))
+    elif "--_fleet" in sys.argv:
+        print(json.dumps(measure_fleet()))
+    elif "--fleet" in sys.argv:
+        main_fleet()
     elif "--stream" in sys.argv:
         main_stream()
     elif "--fusion" in sys.argv:

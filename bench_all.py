@@ -110,6 +110,17 @@ def bench_traffic(mesh, cfg):
     return json.loads(lines[-1])
 
 
+def bench_fleet(mesh, cfg):
+    """Multi-slice serving-fleet scale-out row (serve/fleet.py;
+    docs/FLEET.md): aggregate QPS going 1 -> 2 virtual slices on the
+    repeated-traffic stream whose working set only fits the fleet's
+    AGGREGATE cache, plus the mid-stream slice-kill drill (see
+    bench.measure_fleet)."""
+    import bench
+    payload = bench.measure_fleet()
+    return {"metric": "fleet_scaleout_qps", **payload}
+
+
 def bench_stream(mesh, cfg):
     """Streaming IVM row: the sliding-window graph dashboard's
     steady-state per-update latency, delta-patch vs full recompute
@@ -438,12 +449,12 @@ def main():
     dry = bool(os.environ.get("MATREL_DRY"))
     dry_rows = (bench_dense_4k, bench_chain, bench_spgemm,
                 bench_sparse_kernels, bench_fusion, bench_serve,
-                bench_stream, bench_precision, bench_reshard,
-                bench_traffic)
+                bench_fleet, bench_stream, bench_precision,
+                bench_reshard, bench_traffic)
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
                bench_spgemm, bench_sparse_kernels, bench_fusion,
-               bench_serve, bench_stream, bench_precision,
-               bench_reshard, bench_traffic,
+               bench_serve, bench_fleet, bench_stream,
+               bench_precision, bench_reshard, bench_traffic,
                bench_pagerank, bench_pagerank_10x, bench_cg,
                bench_eigen, bench_triangles, bench_north_star):
         if dry and fn not in dry_rows:

@@ -65,6 +65,7 @@ from matrel_tpu.analysis.fusion_pass import check_fusion_stamps
 from matrel_tpu.analysis.hbm_pass import check_hbm_feasibility
 from matrel_tpu.analysis.layout_pass import check_layout_claims
 from matrel_tpu.analysis.padding_pass import check_padding_flow
+from matrel_tpu.analysis.placement_pass import check_placement_stamps
 from matrel_tpu.analysis.precision_pass import check_precision_stamps
 from matrel_tpu.analysis.reshard_pass import check_reshard_peaks
 from matrel_tpu.analysis.result_cache_pass import check_result_cache_stamps
@@ -93,6 +94,7 @@ PASSES = (
     ("fusion", check_fusion_stamps),
     ("brownout", check_brownout_stamps),
     ("delta", check_delta_stamps),
+    ("placement", check_placement_stamps),
 )
 
 

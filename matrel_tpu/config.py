@@ -385,6 +385,46 @@ class MatrelConfig:
         DCN-crossing axes are auto-weighted DCN_AXIS_WEIGHT. Setting
         anything ≠ (1.0, 1.0) is the calibration hook — it overrides
         detection (docs/TOPOLOGY.md).
+      fleet_slices: multi-slice serving fleet (serve/fleet.py;
+        docs/FLEET.md). 0 (the default) = off: no fleet objects are
+        ever constructed and ``submit`` runs the historical
+        single-controller pipeline bit-identically (test-enforced).
+        >= 1 partitions the session mesh into that many serving
+        slices (real ``device.slice_index`` boundaries when they
+        match the count, contiguous virtual sub-meshes otherwise;
+        degenerate shared-device slices when the mesh is too small),
+        each with its own admission queue, worker, brownout state and
+        slice-local result cache; ``session.submit`` routes each
+        query through the fleet's placement policy.
+      fleet_span_margin: placement bias toward slice-local execution:
+        a query SPANS the whole mesh (one program over every slice,
+        DCN-crossing collectives included) only when the byte model's
+        estimated span cost is strictly below ``margin`` x the best
+        slice-local estimate. 1.0 = neutral; < 1.0 demands a real
+        win before paying DCN traffic (docs/FLEET.md placement
+        derivation).
+      fleet_directory_max: entry bound on the fleet's global
+        structural-key directory (plan key -> owning slice). LRU past
+        it — the directory is an affinity HINT, never a correctness
+        surface, so eviction only costs a recompute.
+      fleet_replicate_hits: remote-demand threshold for hot-entry
+        replication: once a non-owning slice has taken this many
+        directory hits on one key, the entry is replicated into it —
+        priced and staged through the reshard planner under
+        ``reshard_peak_budget_bytes`` (docs/FLEET.md migration
+        pricing). 0 disables replication (directory hits still
+        answer from the owning slice's cache).
+      fleet_failover: dead/wedged-slice failover — a killed slice's
+        queued entries re-admit onto surviving slices (deadlines and
+        tenant attribution intact, refusals typed). Off = queued
+        entries on a killed slice fail typed instead.
+      fleet_placement_calibration: let the placement cost model read
+        the drift auditor's calibration table
+        (``drift_table_path``): per-(shape-class, backend, tier)
+        measured ms/GFLOP + ms/MiB coefficients are consulted AHEAD
+        of the analytic closed forms, provenance-stamped "measured"
+        like autotune winners; classes with no calibration row fall
+        back to the analytic model (docs/FLEET.md).
     """
 
     block_size: int = 512
@@ -459,6 +499,12 @@ class MatrelConfig:
     fusion_enable: bool = False
     delta_patch_mode: str = "auto"
     delta_rank_max: int = 512
+    fleet_slices: int = 0
+    fleet_span_margin: float = 1.0
+    fleet_directory_max: int = 4096
+    fleet_replicate_hits: int = 3
+    fleet_failover: bool = True
+    fleet_placement_calibration: bool = True
 
     def __post_init__(self):
         # enablement is "anything != off", so an unvalidated typo/case
@@ -655,6 +701,30 @@ class MatrelConfig:
                 f"spgemm_kernel_override must be one of "
                 f"{SPGEMM_KERNEL_IDS} (or '' to disable), got "
                 f"{self.spgemm_kernel_override!r}")
+        # fleet knobs (docs/FLEET.md): a negative slice count would
+        # silently read as "off" while the operator believes a fleet
+        # is serving (the obs_level typo precedent); a non-positive
+        # span margin makes spanning unreachable while reading as
+        # "neutral"; a zero directory bound would evict every
+        # ownership record at insert and turn the hit-anywhere
+        # protocol into a permanent miss
+        if self.fleet_slices < 0:
+            raise ValueError(
+                f"fleet_slices must be >= 0 (0 disables the fleet), "
+                f"got {self.fleet_slices!r}")
+        if self.fleet_span_margin <= 0:
+            raise ValueError(
+                f"fleet_span_margin must be > 0, "
+                f"got {self.fleet_span_margin!r}")
+        if self.fleet_directory_max < 1:
+            raise ValueError(
+                f"fleet_directory_max must be >= 1, "
+                f"got {self.fleet_directory_max!r}")
+        if self.fleet_replicate_hits < 0:
+            raise ValueError(
+                f"fleet_replicate_hits must be >= 0 (0 disables "
+                f"hot-entry replication), "
+                f"got {self.fleet_replicate_hits!r}")
 
     def replace(self, **kw: Any) -> "MatrelConfig":
         return dataclasses.replace(self, **kw)

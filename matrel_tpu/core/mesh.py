@@ -176,6 +176,52 @@ def axis_weights(mesh: Mesh, config=None) -> Tuple[float, float]:
     return mesh_topology(mesh, config).axis_weights
 
 
+# -- slice views (multi-slice serving fleet — serve/fleet.py) ---------------
+
+
+def slice_device_groups(mesh: Mesh, n: int):
+    """Partition a mesh's devices into ``n`` serving-slice groups:
+    ``(groups, source)`` with ``source`` naming how the boundary was
+    drawn.
+
+    - ``"detected"``: the devices carry ``slice_index`` values and the
+      distinct indices match ``n`` exactly — the groups ARE the real
+      TPU slices, so intra-group collectives ride ICI and only
+      cross-group traffic rides DCN.
+    - ``"virtual"``: no (matching) hardware boundary; the flat device
+      list splits into ``n`` equal contiguous runs. Row-major
+      contiguity keeps each virtual slice a compact neighbourhood of
+      the parent grid — the CPU-testable stand-in the whole fleet
+      subsystem runs on in tier-1.
+    - ``"shared"``: fewer devices than would split evenly; every
+      group is the full device set (oversubscribed virtual slices —
+      the 1-chip dev loop). Still a valid fleet: the slices share
+      hardware but keep independent queues/workers/caches.
+    """
+    if n < 1:
+        raise ValueError(f"slice count must be >= 1, got {n!r}")
+    devs = [d for row in mesh.devices for d in row]
+    by_slice: dict = {}
+    for d in devs:
+        by_slice.setdefault(getattr(d, "slice_index", None),
+                            []).append(d)
+    if None not in by_slice and len(by_slice) == n:
+        return [by_slice[k] for k in sorted(by_slice)], "detected"
+    if len(devs) >= n and len(devs) % n == 0:
+        c = len(devs) // n
+        return [devs[i * c:(i + 1) * c] for i in range(n)], "virtual"
+    return [list(devs) for _ in range(n)], "shared"
+
+
+def slice_meshes(mesh: Mesh, n: int):
+    """``n`` near-square sub-meshes over :func:`slice_device_groups`'
+    partition (same axis names as the parent, so specs/strategies are
+    vocabulary-compatible): ``(meshes, source)``."""
+    groups, source = slice_device_groups(mesh, n)
+    return [make_mesh(axis_names=mesh.axis_names, devices=g)
+            for g in groups], source
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -191,6 +191,16 @@ def snapshot(session) -> dict:
         }
     else:
         snap["serve"] = None
+    fleet = getattr(sess, "_fleet", None)
+    if fleet is not None:
+        # the fleet tier (docs/FLEET.md): per-slice state (queue
+        # depths, caches, per-slice SLO snapshots — the PR 14
+        # monitors aggregated per slice) + directory/placement
+        # counters, so `top` and any scraper see the whole fleet
+        # from the parent session's one endpoint
+        snap["fleet"] = fleet.info()
+    else:
+        snap["fleet"] = None
     return snap
 
 

@@ -163,6 +163,7 @@ def summarize(events: List[dict]) -> dict:
         "rc_hits": sum(1 for e in qs if e.get("cache") == "rc_hit"),
         "ivm": _summarize_ivm(events),
         "alerts": _summarize_alerts(events),
+        "fleet": _summarize_fleet(events),
         "serve": _summarize_serve(events),
         "resilience": _summarize_resilience(events, len(qs)),
         "overload": _summarize_overload(events),
@@ -361,6 +362,53 @@ def _summarize_ivm(events: List[dict]) -> Optional[dict]:
     }
 
 
+def _summarize_fleet(events: List[dict]) -> Optional[dict]:
+    """Multi-slice fleet roll-up (docs/FLEET.md): placement census
+    from the per-submission ``placement`` records, lifecycle counts
+    from ``fleet`` records, and a PER-SLICE query/serve breakdown
+    from the slice tags every slice session stamps on its events.
+    None when the log carries no fleet traffic — the summary stays
+    byte-identical for single-controller logs."""
+    placements = [e for e in events if e.get("kind") == "placement"]
+    fleet_evs = [e for e in events if e.get("kind") == "fleet"]
+    tagged = [e for e in events
+              if e.get("kind") == "query" and e.get("slice")
+              is not None]
+    if not placements and not fleet_evs and not tagged:
+        return None
+    routed: Dict[str, int] = {}
+    coeff: Dict[str, int] = {}
+    for e in placements:
+        r = str(e.get("routed") or "?")
+        routed[r] = routed.get(r, 0) + 1
+        c = str(e.get("coeff_source") or "?")
+        coeff[c] = coeff.get(c, 0) + 1
+    slices: Dict[str, dict] = {}
+    for e in tagged:
+        s = slices.setdefault(str(e["slice"]),
+                              {"queries": 0, "rc_hits": 0,
+                               "execute_ms": 0.0})
+        s["queries"] += 1
+        if e.get("cache") == "rc_hit":
+            s["rc_hits"] += 1
+        if isinstance(e.get("execute_ms"), (int, float)):
+            s["execute_ms"] += e["execute_ms"]
+    lifecycle: Dict[str, int] = {}
+    for e in fleet_evs:
+        k = str(e.get("event") or "?")
+        lifecycle[k] = lifecycle.get(k, 0) + 1
+    return {
+        "placements": len(placements),
+        "routed": routed,
+        "coeff_sources": coeff,
+        "directory_hits": routed.get("directory", 0)
+        + routed.get("directory_remote", 0),
+        "remote_hits": routed.get("directory_remote", 0),
+        "lifecycle": lifecycle,
+        "slices": slices,
+    }
+
+
 def _summarize_alerts(events: List[dict]) -> Optional[dict]:
     """Roll up ``alert`` records (SLO burn-rate alert TRANSITIONS —
     obs/slo.py fire/clear edges) into the per-tenant SLO view: alert
@@ -514,6 +562,33 @@ def render_summary(events: List[dict]) -> str:
                 f"{k}={v}" for k, v in sorted(
                     rs["fault_sites"].items()))
         lines.append(line)
+    fl = s.get("fleet")
+    if fl:
+        line = (f"fleet: {fl['placements']} placement(s)"
+                + ("; routed: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(fl["routed"].items()))
+                   if fl["routed"] else "")
+                + (f"; {fl['directory_hits']} directory hit(s) "
+                   f"({fl['remote_hits']} remote)"
+                   if fl["directory_hits"] else ""))
+        if fl.get("coeff_sources"):
+            line += "; coeffs: " + ", ".join(
+                f"{k}={v}"
+                for k, v in sorted(fl["coeff_sources"].items()))
+        if fl.get("lifecycle"):
+            line += "; events: " + ", ".join(
+                f"{k}={v}"
+                for k, v in sorted(fl["lifecycle"].items()))
+        lines.append(line)
+        if fl.get("slices"):
+            header = (f"{'slice':<8}{'queries':>9}{'rc hits':>9}"
+                      f"{'exec ms':>11}")
+            lines += [header, "-" * len(header)]
+            for sid in sorted(fl["slices"]):
+                d = fl["slices"][sid]
+                lines.append(
+                    f"{sid:<8}{d['queries']:>9}{d['rc_hits']:>9}"
+                    f"{_fmt(d['execute_ms']):>11}")
     ov = s.get("overload")
     if ov:
         line = (f"overload: {ov['cycles']} cycle(s), max rung "

@@ -243,6 +243,30 @@ def render(snap: dict) -> str:
         + (f"   ivm gen: {ivm.get('generation')}" if ivm else "")
         + (f"   DRIFT flags: {dr.get('flag_count')}"
            if dr.get("flag_count") else ""))
+    fl = snap.get("fleet") or {}
+    if fl.get("slices"):
+        d = fl.get("directory") or {}
+        pl = fl.get("placed") or {}
+        lines.append(
+            f"fleet: {len(fl['slices'])} slice(s) "
+            f"({sum(1 for s in fl['slices'] if s.get('alive'))} "
+            f"alive)   placed: slice={pl.get('slice', 0)} "
+            f"span={pl.get('span', 0)}   dir hits: {d.get('hits', 0)}"
+            f" ({d.get('remote_hits', 0)} remote)   "
+            f"migrations: {fl.get('migrations', 0)}   "
+            f"failovers: {fl.get('failovers', 0)}")
+        for s in fl["slices"]:
+            rc = s.get("result_cache") or {}
+            slo = s.get("slo") or {}
+            lines.append(
+                f"  slice {s['id']}: "
+                f"{'up' if s.get('alive') else 'DEAD'}   "
+                f"dev {_f(s.get('devices'), 0)}   "
+                f"queued {_f(s.get('queued'), 0)}   "
+                f"submitted {_f(s.get('submitted'), 0)}   "
+                f"rc {_f(rc.get('entries'), 0)} entries"
+                + (f"   alerts {slo.get('alerts_active')}"
+                   if slo else ""))
     rows = _tenant_rows(snap)
     if rows:
         header = (f"{'tenant':<14}{'qps':>8}{'goodput':>9}"

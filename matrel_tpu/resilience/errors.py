@@ -124,6 +124,25 @@ class AdmissionShed(ResilienceError):
         super().__init__(msg)
 
 
+class FleetSliceLost(ResilienceError):
+    """A serving slice died (or was killed) with this query queued on
+    it and the fleet could not re-admit it elsewhere — failover is
+    off (``config.fleet_failover=False``), no surviving slice exists,
+    or the query's leaves could not be rebound onto a survivor's
+    catalog. The refusal is TYPED like every other fleet-plane
+    failure: the caller knows the answer was never computed, never a
+    silent drop (docs/FLEET.md failover semantics)."""
+
+    def __init__(self, slice_id: int, detail: str = ""):
+        self.slice_id = slice_id
+        self.detail = detail
+        super().__init__(
+            f"serving slice {slice_id} lost"
+            + (f": {detail}" if detail else "")
+            + " — query could not be re-admitted onto a surviving "
+              "slice")
+
+
 class CircuitOpen(ResilienceError):
     """A plan class's circuit breaker is OPEN
     (resilience/breaker.py): the class kept failing after the retry

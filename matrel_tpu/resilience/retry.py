@@ -49,6 +49,13 @@ def now() -> float:
     return time.monotonic()
 
 
+def deadline_left(t_end: Optional[float]) -> Optional[float]:
+    """Time left until an absolute :func:`now`-based deadline (None =
+    unbounded) — the shared-budget form multi-step drains use so one
+    documented timeout bounds the WHOLE call, not each sub-wait."""
+    return None if t_end is None else max(t_end - now(), 0.0)
+
+
 class Deadline:
     """An absolute per-query deadline. ``None``-budget deadlines never
     expire (the common case costs two attribute reads)."""

@@ -23,6 +23,16 @@ serving analogue, three coordinated pieces the session wires together:
                 expired entries purged at the shed decision points.
                 With no tenant weights configured it is bit-identical
                 to the historical FIFO.
+  fleet         multi-slice serving fleet (round 16, docs/FLEET.md):
+                ``config.fleet_slices`` partitions the mesh into
+                serving slices — per-slice queues/workers/brownout/
+                result caches, a global structural-key directory
+                (hit anywhere avoids recompute), reshard-priced
+                hot-entry replication, typed cross-slice failover.
+  placement     the fleet's routing policy: slice-local vs full-mesh
+                span by the topology byte model, drift-calibrated
+                per-(class, backend, tier) cost coefficients ahead
+                of the analytic closed forms.
 
 ``session.run_many`` is the synchronous batch surface (one MultiPlan,
 session-plan-cached); ``session.submit`` the asynchronous one. See

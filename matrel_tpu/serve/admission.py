@@ -276,6 +276,28 @@ class AdmissionQueue:
             if self.unfinished_tasks <= 0:
                 self.all_tasks_done.notify_all()
 
+    # -- failover (serve/fleet.py is the ONE caller) -----------------------
+
+    def steal_entries(self) -> list:
+        """Remove and return every queued entry as ``(entry, tenant)``
+        pairs — the dead/wedged-slice failover surface (docs/FLEET.md):
+        the fleet re-admits the stolen entries onto surviving slices
+        with their futures, deadlines and tenant attribution intact.
+        Unfinished-task accounting is released for the stolen entries
+        (their completion is now another queue's business), so a drain
+        against the dead pipeline never waits on work that moved."""
+        with self._lock:
+            out = []
+            for key, dq in self._queues.items():
+                while dq:
+                    out.append((dq.popleft(), key))
+            self._size = 0
+            self.unfinished_tasks = max(
+                self.unfinished_tasks - len(out), 0)
+            if self.unfinished_tasks <= 0:
+                self.all_tasks_done.notify_all()
+            return out
+
     # -- observability -----------------------------------------------------
 
     def qsize(self) -> int:

@@ -248,6 +248,23 @@ class ServePipeline:
                     target=self._run, name="matrel-serve", daemon=True)
                 self._worker.start()
 
+    def readmit_entry(self, entry, tenant: str) -> None:
+        """Fleet-failover seam (serve/fleet.py is the one caller):
+        enqueue an already-built entry under the SAME closed-check +
+        enqueue + worker-ensure atomicity ``submit`` enforces — a
+        stolen future re-admitted into a pipeline that a concurrent
+        ``close()`` just flipped would otherwise strand in a closed,
+        workerless queue (``_ensure_worker`` no-ops once ``_closed``
+        is set). Raises ``PipelineClosed``/``AdmissionShed`` typed;
+        the fleet turns either into a typed refusal."""
+        with self._lock:
+            if self._closed:
+                raise PipelineClosed(
+                    "re-admission after close(): the admission "
+                    "worker is stopped")
+            self._q.put(entry, tenant)
+            self._ensure_worker()
+
     def _run(self) -> None:
         while not self._stop.is_set():
             try:

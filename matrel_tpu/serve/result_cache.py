@@ -84,6 +84,13 @@ class CacheEntry:
     delta_rule: ir/delta.DELTA_RULES member of the last patch.
     ivm_id: stable identity across patch generations (the delta
       plane's patch-plan reuse key; None until first patched).
+    fleet: multi-slice provenance (serve/fleet.py; docs/FLEET.md) for
+      entries REPLICATED into this slice's cache from another slice:
+      ``{"owner": slice_id, "layout": ..., "dtype": ...}`` — the
+      owning slice's recorded layout/dtype at replication, which
+      MV114 re-checks against the entry's own claims (the MV107
+      stale-stamp idiom applied across slices). None (the default)
+      for every locally-computed entry — the historical shape.
     """
 
     key_hash: str
@@ -99,6 +106,7 @@ class CacheEntry:
     delta_gen: int = 0
     delta_rule: Optional[str] = None
     ivm_id: Optional[int] = None
+    fleet: Optional[dict] = None
 
 
 class ResultCache:

@@ -38,6 +38,7 @@ from matrel_tpu.resilience import brownout as brownout_lib
 from matrel_tpu.resilience import degrade as degrade_lib
 from matrel_tpu.resilience import errors as rerrors
 from matrel_tpu.resilience import faults as faults_lib
+from matrel_tpu.resilience import retry as retry_lib
 from matrel_tpu.resilience.retry import RetryPolicy
 from matrel_tpu.serve.result_cache import (CacheEntry, ResultCache,
                                            result_nbytes)
@@ -45,6 +46,9 @@ from matrel_tpu.serve.result_cache import (CacheEntry, ResultCache,
 log = logging.getLogger("matrel_tpu")
 
 _active: Optional["MatrelSession"] = None
+
+
+_deadline_left = retry_lib.deadline_left
 
 _query_seq = itertools.count()
 
@@ -111,6 +115,24 @@ class MatrelSession:
         # subsystem it reads must already exist.
         self._slo = slo_lib.from_config(self.config,
                                         emit=self._emit_alert_event)
+        # multi-slice serving fleet (serve/fleet.py; docs/FLEET.md):
+        # built lazily on the first submit when config.fleet_slices
+        # >= 1 — None for the default config (the structural
+        # zero-object contract: no slice sessions, no directory,
+        # poisoned-init test-enforced). _slice_tag marks THIS session
+        # as slice N of a fleet: its obs events carry the tag so the
+        # per-slice roll-up can attribute them.
+        self._fleet = None
+        self._slice_tag: Optional[int] = None
+        # fleet device arbitration (serve/fleet.py): an RLock SHARED
+        # by the parent and every slice session whose execution
+        # domains overlap — collective programs from two sessions
+        # sharing devices must never be in flight together (colliding
+        # run-ids over the same device list deadlock the
+        # cross-program rendezvous; the classic multi-program
+        # collective hazard). None (the default) = plain async
+        # dispatch, bit-identical.
+        self._exec_lock = None
         self._exporter = export_lib.from_config(self)
 
     # -- builder (MatfastSession.builder().getOrCreate() analogue) ---------
@@ -160,6 +182,17 @@ class MatrelSession:
     def register(self, name: str, matrix: BlockMatrix) -> None:
         old = self.catalog.get(name)
         self.catalog[name] = matrix
+        if self._fleet is not None and old is not matrix:
+            # fleet write-through (docs/FLEET.md): the table
+            # replicates into every slice, slice caches invalidate
+            # through each slice session's own rebind path, and
+            # directory records naming it drop. Gated like the
+            # single-controller rebind below: an idempotent
+            # re-register of the SAME object is a no-op there and
+            # must be one here too — unconditional it would wipe the
+            # directory and every slice cache and re-replicate the
+            # table on every no-op call
+            self._fleet.on_register(name, matrix)
         if old is not None and old is not matrix:
             # catalog REBIND: every cached result computed from the old
             # binding is stale the moment the name means something else
@@ -215,6 +248,14 @@ class MatrelSession:
                 from matrel_tpu.serve.ivm import DeltaPlane
                 self._delta_plane = DeltaPlane(self)
             out = self._delta_plane.apply(name, old, d)
+        if self._fleet is not None:
+            # fleet slices hold REPLICAS of the old binding: the delta
+            # plane patched the parent's caches in place, but a slice
+            # replica cannot be patched remotely — re-replicate the
+            # new binding (slice caches invalidate through their own
+            # rebind path, directory records naming it drop). Answers
+            # stay correct; a slice repeat pays one recompute.
+            self._fleet.on_register(name, self.catalog[name])
         # SLO feed (obs/slo.py): patch latency reports under the
         # pseudo-tenant "ivm", so a dashboard stream's maintenance
         # path can carry its own latency objective (docs/IVM.md
@@ -516,6 +557,13 @@ class MatrelSession:
             stamp["delta"] = {"gen": ent.delta_gen,
                               "rule": ent.delta_rule,
                               "err_bound": ent.err_bound}
+        if ent.fleet:
+            # fleet provenance (docs/FLEET.md): the consumed value was
+            # REPLICATED from another slice's cache — MV114 re-checks
+            # the owning slice's recorded layout/dtype against the
+            # entry's own claims (the MV107 stale-stamp idiom across
+            # slices)
+            stamp["fleet"] = dict(ent.fleet)
         return expr_mod.leaf(ent.result).with_attrs(result_cache=stamp)
 
     def _rc_substitute(self, e: MatExpr, parts: Optional[list] = None,
@@ -643,6 +691,12 @@ class MatrelSession:
         when configured — each independently (flight recording with
         obs off keeps spans in memory only; the ring then holds the
         bare record stamped the way the log would have)."""
+        if self._slice_tag is not None and "slice" not in record:
+            # fleet attribution (docs/FLEET.md): every event a slice
+            # session emits carries its slice id, so history's
+            # per-slice roll-up (and top) can tell the slices apart
+            # in the shared log. Non-fleet sessions are unchanged.
+            record = {**record, "slice": self._slice_tag}
         full = None
         if self._obs_enabled():
             full = self._obs_event_log().emit(kind, record)
@@ -914,6 +968,59 @@ class MatrelSession:
         except Exception:
             log.warning("obs: overload event dropped", exc_info=True)
 
+    def _arbitrated_run(self, plan):
+        """Dispatch one compiled program under the fleet's execution
+        arbitration (see ``_exec_lock``): dispatch-to-COMPLETION is
+        serialized across the sessions sharing the lock, because an
+        async dispatch would leave the program's collectives in
+        flight when the lock dropped — exactly the overlap the lock
+        exists to prevent. Cache hits, planning and admission never
+        come here, so the fleet's host-side parallelism survives;
+        only device programs serialize. Without a lock (every
+        non-fleet session) this IS ``plan.run()``."""
+        if self._exec_lock is None:
+            return plan.run()
+        with self._exec_lock:
+            out = plan.run()
+            for o in (out if isinstance(out, (list, tuple))
+                      else (out,)):
+                o.data.block_until_ready()
+            return out
+
+    def _emit_placement_event(self, record: dict) -> None:
+        """One ``placement`` record per fleet-routed submission
+        (serve/fleet.py assembles it: mode, routed target, directory
+        outcome, coefficient provenance, the two cost estimates) —
+        the feed for ``history --summary``'s fleet roll-up. Never
+        fails a query."""
+        from matrel_tpu.obs.metrics import REGISTRY
+        try:
+            self._obs_emit("placement", record)
+            REGISTRY.counter(
+                f"fleet.placed.{record.get('routed', '?')}").inc()
+        except Exception:
+            log.warning("obs: placement event dropped", exc_info=True)
+
+    def _emit_fleet_event(self, record: dict) -> None:
+        """One ``fleet`` record per fleet lifecycle event (slice
+        kill/failover, hot-entry migration, priced-out migration) —
+        carried with the fleet snapshot so offline replay can
+        reconstruct the fleet's state transitions."""
+        from matrel_tpu.obs.metrics import REGISTRY
+        try:
+            rec = dict(record)
+            if self._fleet is not None:
+                rec["fleet"] = {
+                    "placed": dict(self._fleet.placed),
+                    "failovers": self._fleet.failovers,
+                    "migrations": self._fleet.migrations,
+                }
+            self._obs_emit("fleet", rec)
+            REGISTRY.counter(
+                f"fleet.event.{record.get('event', '?')}").inc()
+        except Exception:
+            log.warning("obs: fleet event dropped", exc_info=True)
+
     def _run_observed(self, e: MatExpr, plan, hit: bool, key: str,
                       tenant: Optional[str] = None) -> BlockMatrix:
         """Execute one compiled plan with the obs timing/emission
@@ -923,7 +1030,7 @@ class MatrelSession:
         # query record AND (tracer active here) as an "execute" span
         with trace_lib.phase("query.execute",
                              cache="hit" if hit else "miss") as sp:
-            out = plan.run()
+            out = self._arbitrated_run(plan)
             out.data.block_until_ready()
         execute_ms = sp.dur_ms
         plan._obs_executed = True
@@ -988,7 +1095,8 @@ class MatrelSession:
             # beyond the plan cache's own (the obs_level="off" /
             # result_cache_max_bytes=0 / flight-recorder-off contract
             # bench.py relies on)
-            return self._compile_entry(e, sla=sla)[0].run()
+            return self._arbitrated_run(
+                self._compile_entry(e, sla=sla)[0])
         # per-thread tracer activation: executor compile phases and
         # every span below parent-link into this query's trail
         with trace_lib.activate(self._tracer), \
@@ -1032,7 +1140,7 @@ class MatrelSession:
             # flight-recorder-only tier: the span marks DISPATCH (JAX
             # async — deliberately no added sync; always-cheap)
             with trace_lib.span("query.execute"):
-                out = plan.run()
+                out = self._arbitrated_run(plan)
         if rc:
             self._rc_insert(key, pins, e, out, orig=orig,
                             prec=_prec_prefix(sla), plan=plan)
@@ -1271,7 +1379,7 @@ class MatrelSession:
             # mark dispatch without adding a sync
             with trace_lib.span("serve.execute",
                                 executed=len(pend)) as sp_ex:
-                outs = plan.run()
+                outs = self._arbitrated_run(plan)
                 if obs:
                     for o in outs:
                         o.data.block_until_ready()
@@ -1365,7 +1473,29 @@ class MatrelSession:
         admission (``config.serve_tenant_weights`` —
         docs/OVERLOAD.md); ``staleness_ms`` declares how old a STALE
         result-cache answer this query tolerates (consumed only at
-        brownout rung >= 2; None/0 = never served stale)."""
+        brownout rung >= 2; None/0 = never served stale).
+
+        With ``config.fleet_slices >= 1`` the submission routes
+        through the multi-slice serving fleet (serve/fleet.py;
+        docs/FLEET.md): placement decides slice-local vs spanning
+        execution, the global directory answers repeats from ANY
+        slice's cache, and a dead slice's queue fails over. The
+        default (0) runs the historical single-controller pipeline
+        bit-identically."""
+        e = as_expr(expr)
+        if deadline_ms is None and self.config.deadline_ms > 0:
+            deadline_ms = self.config.deadline_ms
+        sla = self._resolve_sla(precision, e)
+        if self.config.fleet_slices >= 1:
+            return self._ensure_fleet().submit(
+                e, sla, deadline_ms=deadline_ms, tenant=tenant,
+                staleness_ms=staleness_ms)
+        return self._submit_pipeline(e, sla, deadline_ms=deadline_ms,
+                                     tenant=tenant,
+                                     staleness_ms=staleness_ms)
+
+    def _ensure_serve(self):
+        """This session's (lazily built) admission pipeline."""
         if self._serve is None:
             from matrel_tpu.serve.pipeline import ServePipeline
             # under the lock: two concurrent FIRST submissions must not
@@ -1374,33 +1504,74 @@ class MatrelSession:
             with self._compile_lock:
                 if self._serve is None:
                     self._serve = ServePipeline(self)
-        e = as_expr(expr)
-        if deadline_ms is None and self.config.deadline_ms > 0:
-            deadline_ms = self.config.deadline_ms
-        return self._serve.submit(e, self._resolve_sla(precision, e),
-                                  deadline_ms=deadline_ms,
-                                  tenant=tenant,
-                                  staleness_ms=staleness_ms)
+        return self._serve
+
+    def _ensure_fleet(self):
+        if self._fleet is None:
+            from matrel_tpu.serve.fleet import FleetController
+            with self._compile_lock:     # the _ensure_serve discipline
+                if self._fleet is None:
+                    self._fleet = FleetController(self)
+        return self._fleet
+
+    def _submit_pipeline(self, e: MatExpr, sla: str,
+                         deadline_ms: Optional[float] = None,
+                         tenant: Optional[str] = None,
+                         staleness_ms: Optional[float] = None):
+        """The single-controller admission path — submit()'s historical
+        body, also the fleet's SPAN executor (a span-placed query is
+        one program over the full mesh, i.e. exactly this pipeline)."""
+        return self._ensure_serve().submit(e, sla,
+                                           deadline_ms=deadline_ms,
+                                           tenant=tenant,
+                                           staleness_ms=staleness_ms)
+
+    def fleet_info(self) -> Optional[dict]:
+        """Fleet observability snapshot (None when the fleet is off or
+        not yet built): per-slice state, directory counters, placement
+        census, migration/failover counts (docs/FLEET.md)."""
+        return self._fleet.info() if self._fleet is not None else None
 
     def serve_drain(self, timeout: Optional[float] = None) -> None:
         """Block until every submitted query has been dispatched and
         every in-flight batch has materialised. ``timeout`` (seconds)
         bounds the wait: a wedged admission worker raises the typed
         ``DrainTimeout`` instead of hanging the caller forever; the
-        queue state is untouched, so a later drain can still finish."""
+        queue state is untouched, so a later drain can still finish.
+        ONE absolute deadline spans the fleet AND the parent pipeline
+        — the documented bound holds however many waits run."""
+        t_end = (None if timeout is None
+                 else retry_lib.now() + timeout)
+        if self._fleet is not None:
+            self._fleet.drain(timeout=_deadline_left(t_end))
         if self._serve is not None:
-            self._serve.drain(timeout=timeout)
+            self._serve.drain(timeout=_deadline_left(t_end))
 
     def serve_close(self, timeout: Optional[float] = None) -> None:
         """Drain then stop the admission worker. A later ``submit``
         raises the typed ``PipelineClosed`` (never enqueues into a
         dead worker). Also stops the live metrics exporter when one
         is running — "done serving" frees the port deterministically
-        (a GC finalizer covers sessions that are simply dropped)."""
-        if self._serve is not None:
-            self._serve.close(timeout=timeout)
-        if self._exporter is not None:
-            self._exporter.stop()
+        (a GC finalizer covers sessions that are simply dropped).
+        Like :meth:`serve_drain`, ``timeout`` is ONE shared absolute
+        deadline across the fleet and parent waits."""
+        t_end = (None if timeout is None
+                 else retry_lib.now() + timeout)
+        # teardown must not stop at the first typed failure: a wedged
+        # slice's DrainTimeout would otherwise leave the parent
+        # pipeline's worker running and the metrics port bound until
+        # GC — the exporter EADDRINUSE class. Close everything, then
+        # let the first failure propagate.
+        try:
+            if self._fleet is not None:
+                self._fleet.close(timeout=_deadline_left(t_end))
+        finally:
+            try:
+                if self._serve is not None:
+                    self._serve.close(timeout=_deadline_left(t_end))
+            finally:
+                if self._exporter is not None:
+                    self._exporter.stop()
 
     def explain(self, expr: MatExpr, physical: bool = True,
                 analyze: bool = False,
@@ -1642,7 +1813,8 @@ def _attr_token(v, pins: list, seen: frozenset = frozenset()) -> str:
     return f"obj:{type(v).__name__}:{id(v)}"
 
 
-def _plan_key_spans(e: MatExpr) -> Tuple[list, list, dict]:
+def _plan_key_spans(e: MatExpr, leaf_token=None
+                    ) -> Tuple[list, list, dict]:
     """(parts, pins, spans) in ONE walk. ``"|".join(parts)`` is the
     root's structural key; ``spans[uid] = (start, end)`` slices
     ``parts`` so that ``"|".join(parts[start:end])`` is EXACTLY the
@@ -1650,13 +1822,30 @@ def _plan_key_spans(e: MatExpr) -> Tuple[list, list, dict]:
     closing part, so a subtree's parts are one contiguous run). This
     is what lets the result cache probe every interior node of a query
     without re-walking each subtree through ``_attr_token`` — O(nodes)
-    key work per admission instead of O(nodes x depth)."""
+    key work per admission instead of O(nodes x depth).
+
+    ``leaf_token`` (serve/placement.py) substitutes the leaf-part
+    emission: ``leaf_token(node) -> str or None`` replaces the
+    id()-based leaf tokens with session-independent ones (catalog
+    names — the fleet directory's cross-slice key), ``None`` meaning
+    the leaf has no stable name and the whole key is ineligible
+    (signalled by raising :class:`KeyError` from the walk). Interior
+    tokens are byte-identical either way — ONE structural-walk
+    implementation for every key the engine makes."""
     parts: list = []
     pins: list = []
     spans: dict = {}
 
     def walk(n: MatExpr):
         start = len(parts)
+        if n.kind in ("leaf", "sparse_leaf", "coo_leaf"):
+            if leaf_token is not None:
+                tok = leaf_token(n)
+                if tok is None:
+                    raise KeyError(n.kind)
+                parts.append(tok)
+                spans[n.uid] = (start, len(parts))
+                return
         if n.kind == "leaf":
             m = n.attrs["matrix"]
             pins.append(m)

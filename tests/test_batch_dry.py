@@ -228,6 +228,62 @@ def test_serve_row_artifact(dry_batch):
                             "half_width_frac", "replays"}
 
 
+def test_fleet_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    # twice in the dry batch, like its sibling rows: the wedge-safe
+    # bench.py --fleet step AND bench_all's dry-enabled row
+    recs = [r for r in records
+            if r.get("metric") == "fleet_scaleout_qps"
+            and "speedup" in r]
+    assert len(recs) == 2, f"expected 2 fleet artifacts, got {recs}"
+    rec = recs[0]
+    # the round-16 acceptance (docs/FLEET.md): >= 1.5x aggregate QPS
+    # going 1 -> 2 virtual slices on the repeated-traffic stream
+    # whose working set only fits the fleet's AGGREGATE cache, with a
+    # directory hit on a NON-owning slice answering without recompute
+    assert rec["speedup"] is not None and rec["speedup"] >= 1.5, rec
+    assert rec["slices1_qps"] > 0
+    assert rec["slices2_qps"] > rec["slices1_qps"]
+    assert rec["remote_hit_no_recompute"] is True
+    s2 = rec["configs"]["slices2"]
+    assert s2["directory"]["remote_hits"] >= 1
+    assert s2["recompute_free_replays"] is True
+    for name in ("slices1", "slices2"):
+        cfg = rec["configs"][name]
+        assert cfg["qps"] > 0
+        assert set(cfg) >= {"median_ms", "half_width_ms", "replays",
+                            "directory", "placed"}
+    # the mid-stream slice-kill drill: the stream completes with
+    # ZERO wrong answers and only typed failures
+    kill = rec["kill"]
+    assert kill["wrong"] == 0
+    assert kill["untyped_failures"] == 0
+    assert kill["completed"] + kill["typed_failures"] \
+        == kill["submitted"]
+    assert kill["completed"] > 0
+    assert kill["failovers"] == 1
+
+
+def test_traffic_slices_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records,
+               lambda r: r.get("metric") == "traffic_fleet_harness"
+               and "directory" in r, "tools/traffic.py --slices")
+    # the open-loop fleet drill (docs/FLEET.md): placement spreads
+    # the stream over both slices, the directory answers repeats,
+    # span-pinned pool entries exercise the full-mesh path, and the
+    # mid-stream kill completes the stream with zero wrong answers
+    # and only typed failures
+    assert rec["ok"] is True, rec
+    assert rec["wrong_answers"] == 0
+    assert rec["untyped_errors"] == 0
+    assert rec["failovers"] == 1
+    assert rec["completed"] > 0
+    assert len(rec["slices_served_before_kill"]) >= 2
+    assert rec["directory"]["hits"] >= 1
+    assert rec["placed"]["slice"] > 0 and rec["placed"]["span"] > 0
+
+
 def test_stream_row_artifact(dry_batch):
     _, records, _ = dry_batch
     rec = _one(records,

@@ -811,6 +811,77 @@ class TestML013TimingAccumulation:
                      "matrel_tpu/resilience/brownout.py") == []
 
 
+class TestML014FleetSeam:
+    def test_fires_on_cross_slice_cache_write(self, tmp_path):
+        src = """
+            def poke(fleet, key, ent, cfg):
+                fleet.slices[0].session._result_cache.put(
+                    key, ent, cfg.result_cache_max_bytes)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newplane.py")
+        assert _rules(got) == ["ML014"]
+
+    def test_fires_on_foreign_session_invalidate(self, tmp_path):
+        src = """
+            def drop_all(other, ids):
+                other.session._result_cache.invalidate_deps(ids)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newplane.py")
+        assert _rules(got) == ["ML014"]
+
+    def test_own_cache_mutation_passes(self, tmp_path):
+        src = """
+            class Plane:
+                def insert(self, key, ent, cfg):
+                    self.session._result_cache.put(
+                        key, ent, cfg.result_cache_max_bytes)
+                def drop(self, key):
+                    self._result_cache.drop(key)
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newplane.py") == []
+
+    def test_sess_alias_passes(self, tmp_path):
+        # the IVM plane's idiom: sess = self.session; sess._result_
+        # cache.apply_patch(...) — a session mutating its OWN cache
+        src = """
+            def patch(self, key, new_key, ent, cfg):
+                sess = self.session
+                ok = sess._result_cache.apply_patch(
+                    key, new_key, ent, cfg.result_cache_max_bytes)
+                return ok
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newplane.py") == []
+
+    def test_reads_and_lookups_pass(self, tmp_path):
+        # the rule pins MUTATION: the fleet's hit-anywhere protocol
+        # reads other caches through the public lookup surface
+        src = """
+            def peek(other, key):
+                return other.session._result_cache.lookup(key)
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newplane.py") == []
+
+    def test_fleet_module_is_the_sanctioned_seam(self, tmp_path):
+        src = """
+            def replicate(target, key, ent, cfg):
+                target.session._result_cache.put(
+                    key, ent, cfg.result_cache_max_bytes)
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/fleet.py") == []
+
+    def test_out_of_scope_modules_pass(self, tmp_path):
+        src = """
+            def poke(fleet, key, ent, cfg):
+                fleet.slices[0].session._result_cache.put(
+                    key, ent, cfg.result_cache_max_bytes)
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/obs/whatever.py") == []
+
+
 def test_repo_lints_clean():
     """`make lint`'s contract, enforced from inside tier-1: the whole
     default scan set (package, tools, examples, bench harnesses) has

@@ -831,13 +831,86 @@ class TimingAccumulationRule(Rule):
                     "share one bounded-memory definition")
 
 
+class FleetSeamRule(Rule):
+    """ML014: cross-slice state mutation pinned onto the fleet API
+    (serve/fleet.py; docs/FLEET.md).
+
+    The fleet made OTHER sessions' result caches reachable: every
+    slice owns one, and the directory/replication protocol depends on
+    exactly one module mutating them — a serve/ module that writes
+    another slice's cache directly produces entries the directory
+    never recorded (unreachable by the hit-anywhere protocol, wrong
+    ownership on failover) and bypasses the replication pricing that
+    keeps migrations under the HBM budget. Pinned, in
+    ``matrel_tpu/serve/`` outside ``fleet.py`` and the cache's own
+    module: a call to a MUTATING ResultCache method (put / drop /
+    apply_patch / rekey / invalidate_deps / clear / rebuild_stale)
+    whose receiver chain reaches ``._result_cache`` through anything
+    other than plain ``self`` / ``self.session`` — e.g.
+    ``fleet.slices[i].session._result_cache.put(...)``. A session
+    mutating ITS OWN cache (the IVM plane, the rebind path) is the
+    sanctioned single-slice seam and stays clean."""
+
+    id = "ML014"
+    _MUT = ("put", "drop", "apply_patch", "rekey", "invalidate_deps",
+            "clear", "rebuild_stale")
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("matrel_tpu/serve/")
+                and relpath not in ("matrel_tpu/serve/fleet.py",
+                                    "matrel_tpu/serve/result_cache.py"))
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute) \
+                    or f.attr not in self._MUT:
+                continue
+            chain = []
+            cur = f.value
+            through_subscript = False
+            while True:
+                if isinstance(cur, ast.Attribute):
+                    chain.append(cur.attr)
+                    cur = cur.value
+                elif isinstance(cur, ast.Subscript):
+                    through_subscript = True
+                    cur = cur.value
+                elif isinstance(cur, ast.Call):
+                    cur = cur.func
+                else:
+                    break
+            if "_result_cache" not in chain:
+                continue
+            # sanctioned receivers: a session mutating its OWN cache
+            # — self._result_cache / self.session._result_cache / the
+            # conventional sess/session local alias. Anything reached
+            # through a subscript (slices[i]) or a foreign object is
+            # another slice's state.
+            own_root = (isinstance(cur, ast.Name)
+                        and cur.id in ("self", "sess", "session"))
+            sanctioned = (own_root and not through_subscript
+                          and set(chain) <= {"_result_cache",
+                                             "session"})
+            if not sanctioned:
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    f"cross-slice result-cache mutation "
+                    f"`...{'.'.join(reversed(chain))}.{f.attr}(...)`"
+                    f" outside the fleet API — another slice's cache "
+                    f"mutates only through serve/fleet.py (the "
+                    f"directory/replication seam, docs/FLEET.md)")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
                         SpecKeyedCacheRule(), RawTimingRule(),
                         BroadSwallowRule(), DevicePutRule(),
                         KernelSeamRule(), JitSeamRule(),
                         UnboundedQueueRule(), ResultCacheSeamRule(),
-                        TimingAccumulationRule())
+                        TimingAccumulationRule(), FleetSeamRule())
 
 
 def _suppressed_codes(line: str) -> set:

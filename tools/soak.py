@@ -699,6 +699,127 @@ def soak_stream(n_trials: int, base: int, tol: float):
     return fails
 
 
+def soak_fleet(n_trials: int, base: int, tol: float):
+    """Multi-slice fleet battery (docs/FLEET.md): a randomized
+    catalog + query stream served through a 2-/3-slice fleet with a
+    random slice KILLED mid-stream. Every resolved answer is checked
+    against its numpy oracle (ZERO wrong answers — a failover that
+    rebinds onto the wrong replica would show up here, not as a
+    crash), every failure must be TYPED (ResilienceError family), the
+    directory must have answered repeats (hits > 0), and the stream
+    must COMPLETE: at least one post-kill answer resolves on a
+    survivor. Randomized per trial: slice count, replication
+    threshold, stream composition, kill point and victim."""
+    import numpy as np
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.resilience.errors import ResilienceError
+    from matrel_tpu.session import MatrelSession
+
+    mesh = mesh_lib.make_mesh()
+    fails = []
+    for trial in range(n_trials):
+        rng = np.random.default_rng(base + trial)
+        sess = None
+        try:
+            n = int(rng.choice([48, 64, 96]))
+            n_slices = int(rng.choice([2, 3]))
+            cfg = MatrelConfig(
+                fleet_slices=n_slices,
+                result_cache_max_bytes=128 << 20,
+                serve_max_batch=1,
+                fleet_replicate_hits=int(rng.choice([0, 1, 3])))
+            sess = MatrelSession(mesh=mesh, config=cfg)
+            mats = {}
+            for nm in ("A", "B", "C"):
+                arr = rng.standard_normal((n, n)).astype(np.float32)
+                mats[nm] = arr
+                sess.register(nm, sess.from_numpy(arr))
+            A = sess.table("A").expr()
+            B = sess.table("B").expr()
+            C = sess.table("C").expr()
+            oAB = mats["A"] @ mats["B"]
+            templates = [
+                (A.multiply(B), oAB),
+                (A.multiply(B).multiply_scalar(2.0), 2.0 * oAB),
+                (A.multiply(B.multiply(C)),
+                 mats["A"] @ (mats["B"] @ mats["C"])),
+                (A.add(B).multiply(C),
+                 (mats["A"] + mats["B"]) @ mats["C"]),
+                (A.t().multiply(B).add_scalar(1.0),
+                 mats["A"].T @ mats["B"] + 1.0),
+            ]
+            stream_len = int(rng.integers(20, 36))
+            picks = rng.integers(0, len(templates), size=stream_len)
+            kill_at = int(rng.integers(stream_len // 4,
+                                       3 * stream_len // 4))
+            victim = int(rng.integers(0, n_slices))
+            futs = []
+            for i, p in enumerate(picks):
+                futs.append((int(p), sess.submit(templates[p][0])))
+                if i % 6 == 5:
+                    # paced bursts: every sixth submission waits, so
+                    # directory inserts land mid-stream and later
+                    # repeats exercise the hit-anywhere protocol
+                    # (a fully-async stream would outrun every
+                    # insert and prove nothing about the directory)
+                    try:
+                        futs[-1][1].result(timeout=120)
+                    except ResilienceError:
+                        pass
+                if i == kill_at:
+                    sess._fleet.kill_slice(victim)
+            sess.serve_drain(timeout=120)
+            wrong = untyped = 0
+            post_kill_ok = 0
+            for j, (p, fut) in enumerate(futs):
+                try:
+                    out = fut.result(timeout=120)
+                    got = np.asarray(out.to_numpy())
+                    want = templates[p][1]
+                    err = float(np.abs(got - want).max())
+                    if err > tol * max(float(np.abs(want).max()),
+                                       1.0):
+                        wrong += 1
+                    elif j > kill_at:
+                        post_kill_ok += 1
+                except ResilienceError:
+                    pass                  # typed — the contract
+                except Exception:
+                    untyped += 1
+            info = sess.fleet_info()
+            if wrong:
+                raise AssertionError(f"{wrong} wrong answers")
+            if untyped:
+                raise AssertionError(f"{untyped} untyped failures")
+            if post_kill_ok == 0:
+                raise AssertionError(
+                    "stream did not complete past the kill")
+            if info["failovers"] != 1:
+                raise AssertionError(
+                    f"failovers={info['failovers']} (expected 1)")
+            if info["directory"]["hits"] == 0:
+                raise AssertionError("directory never answered")
+            alive = [sl for sl in info["slices"] if sl["alive"]]
+            if len(alive) != n_slices - 1:
+                raise AssertionError("wrong surviving-slice census")
+            sess.serve_close(timeout=60)
+            print(f"  fleet trial {trial + 1}/{n_trials} ok")
+        except Exception as e:  # noqa: BLE001 — tally and continue
+            fails.append(f"fleet trial {trial}: {type(e).__name__} {e}")
+            print(f"  FAIL {fails[-1]}")
+        finally:
+            # a FAILED trial must still tear its fleet down — leaked
+            # slice sessions (worker threads + replicated catalogs)
+            # would distort every later trial on the shared host
+            if sess is not None:
+                try:
+                    sess.serve_close(timeout=60)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+    return fails
+
+
 def soak_precision(n_trials: int, base: int, tol: float):
     """Precision-SLA battery: random matmul-shaped queries executed at
     every SLA tier against an f64 numpy oracle, asserting the
@@ -1050,7 +1171,7 @@ def main():
                    choices=["fuzz", "deep", "spmv", "sharded", "routed",
                             "ckpt", "serve", "precision", "chaos",
                             "sparse_kernels", "fusion", "overload",
-                            "stream", "all"])
+                            "stream", "fleet", "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -1079,6 +1200,8 @@ def main():
         fails += soak_overload(max(args.seeds // 5, 5), args.base, tol)
     if args.battery in ("stream", "all"):
         fails += soak_stream(max(args.seeds // 5, 4), args.base, tol)
+    if args.battery in ("fleet", "all"):
+        fails += soak_fleet(max(args.seeds // 5, 4), args.base, tol)
     if args.battery in ("precision", "all"):
         fails += soak_precision(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("sharded", "all"):

@@ -49,6 +49,9 @@ if [ "$DRY" = 1 ]; then
            MATREL_FUSION_REPEATS=5 MATREL_FUSION_INNER=4
     export MATREL_SERVE_N=256 MATREL_SERVE_K=64 \
            MATREL_SERVE_QUERIES=18 MATREL_SERVE_MEAS=3
+    export MATREL_FLEET_N=192 MATREL_FLEET_QUERIES=7 \
+           MATREL_FLEET_REPLAYS=2
+    export MATREL_TRAFFIC_SLICES=2
     export MATREL_STREAM_N=256 MATREL_STREAM_EDGES=8 \
            MATREL_STREAM_UPDATES=3 MATREL_STREAM_K=16
     export MATREL_TRAFFIC_SECONDS=5 MATREL_TRAFFIC_TAIL_SECONDS=2.5 \
@@ -78,6 +81,8 @@ log "--- bench.py --fusion (fused-vs-staged region sweep, staged this round)"
 python bench.py --fusion
 log "--- bench.py --serve (repeated-traffic serving QPS row, staged this round)"
 python bench.py --serve
+log "--- bench.py --fleet (multi-slice fleet scale-out QPS + kill drill, staged this round)"
+python bench.py --fleet
 log "--- bench.py --stream (streaming IVM delta-patch vs recompute row, staged this round)"
 python bench.py --stream
 log "--- bench.py --precision (bf16/int precision-tier sweep + error bounds, staged this round)"
@@ -96,6 +101,8 @@ log "--- traffic (open-loop overload harness: weighted tenants, brownout, typed 
 python tools/traffic.py
 log "--- traffic --slo (SLO burn-rate alert fire/clear proof + live metrics endpoint, staged this round)"
 python tools/traffic.py --slo
+log "--- traffic --slices (open-loop fleet drill: placement spread, directory hits, mid-stream slice kill, staged this round)"
+python tools/traffic.py --slices
 log "--- north_star_sweep (VERDICT #10 residual)"
 python tools/north_star_sweep.py
 log "--- gram_manual3 (symmetric-Gram microbench, BASELINE row 3 support)"

@@ -110,18 +110,27 @@ def _env_f(name, default):
     return float(os.environ.get(name, default))
 
 
-def build_pool(sess, rng):
+def build_pool(sess, rng, register=False):
     """The workload pool: (name, expr, numpy oracle) triples. Small by
     design — a bounded pool keeps the MultiPlan composition space
     finite so steady state is plan-cache-hitting (the serve plane's
     own operating point) and the harness measures ADMISSION, not
-    compilation."""
+    compilation.
+
+    ``register=True`` (--slices mode) binds the dense tables into the
+    session catalog so the fleet can replicate them per slice and key
+    the queries into its directory; the sparse/structured operands
+    stay unregistered — those queries PIN to the full-mesh span path,
+    so the fleet drill exercises both routings."""
     from matrel_tpu.ops import kernel_registry as kr
     from matrel_tpu.workloads.triangles import triangle_count_expr
     n = int(_env_f("MATREL_TRAFFIC_N", 48))
     an = rng.standard_normal((n, n + 16)).astype(np.float32)
     bn = rng.standard_normal((n + 16, n // 2)).astype(np.float32)
     A, B = sess.from_numpy(an), sess.from_numpy(bn)
+    if register:
+        sess.register("traffic_A", A)
+        sess.register("traffic_B", B)
     # dense scaled-matmul class (two variants: distinct plans)
     pool = [
         ("matmul_s2", A.expr().multiply(B.expr()).multiply_scalar(2.0),
@@ -723,5 +732,133 @@ def main(slo: bool = False) -> int:
     return 0 if record["ok"] else 1
 
 
+def main_slices() -> int:
+    """--slices mode (docs/FLEET.md): the SAME open-loop machinery
+    driven through a MULTI-SLICE fleet, with a mid-stream slice kill.
+    The acceptance is the fleet plane's, not the overload plane's:
+
+      - both slices serve traffic before the kill (placement spreads
+        the stream) and the directory answers repeats from wherever
+        placement lands them (>= 1 directory hit);
+      - pool entries over unregistered operands PIN to the span path
+        — both routings exercise under open-loop fire;
+      - slice 0 is killed at the phase midpoint: the stream completes
+        with ZERO wrong answers (every completed result checked
+        against its numpy oracle) and only TYPED failures, queued
+        entries re-admitted with deadlines/tenants intact.
+
+    One parseable ``traffic_fleet_harness`` JSON artifact (staged in
+    tpu_batch.sh; asserted by test_batch_dry)."""
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.resilience import faults
+    from matrel_tpu.session import MatrelSession
+
+    seed = int(os.environ.get("MATREL_TRAFFIC_SEED", "0"))
+    seconds = _env_f("MATREL_TRAFFIC_SECONDS", 8.0)
+    rate_x = _env_f("MATREL_TRAFFIC_RATE_X", 2.0)
+    cal_n = int(_env_f("MATREL_TRAFFIC_CAL", 300))
+    deadline_ms = _env_f("MATREL_TRAFFIC_DEADLINE_MS", 500.0)
+    n_slices = int(_env_f("MATREL_TRAFFIC_SLICES", 2))
+    process = os.environ.get("MATREL_TRAFFIC_PROCESS", "poisson")
+    faults.reset()
+    cfg = MatrelConfig.from_env(MatrelConfig(
+        fleet_slices=n_slices,
+        result_cache_max_bytes=1 << 28,
+        serve_max_batch=1,       # the CPU-host admission discipline
+        serve_queue_max=96,      # (see main()'s rationale)
+        plan_cache_max_plans=256,
+    ))
+    mesh = mesh_lib.make_mesh((2, 4))
+    sess = MatrelSession(mesh=mesh, config=cfg)
+    rng = np.random.default_rng(seed)
+    pool = build_pool(sess, rng, register=True)
+    # prewarm: builds the fleet (replicating the registered tables),
+    # compiles each pool entry once per routing
+    for _name, expr, _o in pool:
+        sess.submit(expr).result(timeout=120)
+    sess.serve_drain(timeout=60)
+    capacity = measure_capacity(sess, pool, TENANTS, cal_n,
+                                windows=1)
+    rate = rate_x * capacity
+    outcomes: list = []
+    rungs: list = []
+    half = max(seconds / 2.0, 0.5)
+    wall = drive_phase(sess, pool,
+                       arrival_schedule(rng, rate, half, process),
+                       TENANTS, rng, deadline_ms, outcomes, rungs)
+    placed_before = {sl["id"]: sl["submitted"]
+                     for sl in sess.fleet_info()["slices"]}
+    requeued = sess._fleet.kill_slice(0, reason="traffic_drill")
+    wall += drive_phase(sess, pool,
+                        arrival_schedule(rng, rate, half, process),
+                        TENANTS, rng, deadline_ms, outcomes, rungs)
+    try:
+        sess.serve_drain(timeout=60.0)
+    except Exception as ex:  # noqa: BLE001 — tallied below, typed
+        print(f"# DRAIN FAILED: {ex!r}", file=sys.stderr)
+    time.sleep(0.2)          # let the last done-callbacks land
+    ok_n = wrong = untyped = sheds = deadlines = typed = 0
+    for rec in outcomes:
+        st = rec["status"]
+        if st == "ok":
+            if oracle_ok(rec.pop("result").to_numpy(),
+                         rec["oracle"]):
+                ok_n += 1
+            else:
+                wrong += 1
+        elif st == "shed":
+            sheds += 1
+        elif st == "deadline":
+            deadlines += 1
+        elif st in ("circuit", "typed"):
+            typed += 1
+        elif st is None or str(st).startswith("untyped"):
+            untyped += 1
+    info = sess.fleet_info()
+    record = {
+        "metric": "traffic_fleet_harness",
+        "seed": seed,
+        "process": process,
+        "backend": jax.default_backend(),
+        "slices": n_slices,
+        "capacity_qps_closed_loop": round(capacity, 2),
+        "offered_qps": round(rate, 2),
+        "overload_seconds": round(wall, 2),
+        "submitted": len(outcomes),
+        "ok": None,           # verdict filled below
+        "completed": ok_n,
+        "wrong_answers": wrong,
+        "untyped_errors": untyped,
+        "sheds": sheds,
+        "deadline_misses": deadlines,
+        "other_typed": typed,
+        "goodput_qps": round(ok_n / max(wall, 1e-9), 2),
+        "placed": info["placed"],
+        "pinned": info["pinned"],
+        "directory": info["directory"],
+        "failovers": info["failovers"],
+        "requeued_on_kill": requeued,
+        "slices_served_before_kill": sorted(
+            sid for sid, n in placed_before.items() if n > 0),
+        "slice_state": [{"id": sl["id"], "alive": sl["alive"],
+                         "submitted": sl["submitted"]}
+                        for sl in info["slices"]],
+    }
+    record["ok"] = bool(
+        wrong == 0
+        and untyped == 0
+        and ok_n > 0
+        and info["failovers"] == 1
+        and len(record["slices_served_before_kill"]) >= 2
+        and info["directory"]["hits"] >= 1
+        and info["placed"]["slice"] > 0
+        and info["placed"]["span"] > 0)
+    print(json.dumps(record))
+    return 0 if record["ok"] else 1
+
+
 if __name__ == "__main__":
+    if "--slices" in sys.argv[1:]:
+        sys.exit(main_slices())
     sys.exit(main(slo="--slo" in sys.argv[1:]))
