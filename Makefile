@@ -21,8 +21,11 @@
 #              SLO alert (a log ending mid-incident must not read
 #              green), then the round-9 smokes over the same log: the
 #              cost-model drift audit (history --drift --check) and a
-#              chrome-trace export of the tracing spans. Point it at a
-#              dry-drill log with OBS_LOG=/tmp/matrel_batch_dry/events.jsonl
+#              chrome-trace export of the tracing spans, then the
+#              tier-4 audit-replay gate (why --audit: sampled served
+#              answers re-executed fresh and proved within their
+#              stamped bounds). Point it at a dry-drill log with
+#              OBS_LOG=/tmp/matrel_batch_dry/events.jsonl
 
 PY ?= python
 SEEDS ?= 10
@@ -77,3 +80,4 @@ obs-report:
 	$(PY) -m matrel_tpu history --drift --check --log $(OBS_LOG)
 	$(PY) -m matrel_tpu trace --export chrome --log $(OBS_LOG) \
 		--out $(OBS_LOG).chrome.json
+	$(PY) -m matrel_tpu why --audit --sample 8 --check

@@ -22,6 +22,15 @@ Commands:
                         rate + active alerts, polling a session's
                         metrics endpoint (config.obs_metrics_port) or
                         tailing an event log
+  why [--last N] [--key K] [--log PATH]
+                        render served answers' lineage trees from the
+                        event log's ``provenance`` records (written
+                        when config.obs_provenance > 0); --audit
+                        replays a sampled workload's lineages fresh
+                        (cache bypassed) and proves each served
+                        answer bit-equal / within its stamped
+                        err_bound — the audit-replay CI gate with
+                        --check
 """
 
 from __future__ import annotations
@@ -101,6 +110,12 @@ def cmd_top(args):
     import sys
     from matrel_tpu.obs import top
     sys.exit(top.main(args))
+
+
+def cmd_why(args):
+    import sys
+    from matrel_tpu.obs import provenance
+    sys.exit(provenance.main(args))
 
 
 def cmd_pagerank(args):
@@ -210,6 +225,27 @@ def main(argv=None):
                     help="keep only the last N root spans (+ their "
                          "descendants)")
     tr.set_defaults(fn=cmd_trace)
+    wy = sub.add_parser("why")
+    wy.add_argument("--last", type=int, default=10,
+                    help="show only the most recent N lineage records")
+    wy.add_argument("--key", default=None,
+                    help="filter by cache-key / key-hash substring or "
+                         "exact ledger query id")
+    wy.add_argument("--log", default=None,
+                    help="event-log path (same resolution as history)")
+    wy.add_argument("--audit", action="store_true",
+                    help="audit replay: run the built-in serve "
+                         "workload (cache hits, an interior hit, an "
+                         "IVM-patched serve), then re-execute sampled "
+                         "lineages fresh and compare against the "
+                         "served answers")
+    wy.add_argument("--sample", type=int, default=8,
+                    help="with --audit: number of lineages to replay "
+                         "(default 8)")
+    wy.add_argument("--check", action="store_true",
+                    help="with --audit: exit nonzero when any replay "
+                         "disagrees — the CI/make obs-report gate")
+    wy.set_defaults(fn=cmd_why)
     pr = sub.add_parser("pagerank")
     pr.add_argument("path", help=".mtx adjacency or 'src,dst' CSV edges")
     pr.add_argument("--rounds", type=int, default=30)

@@ -50,6 +50,12 @@ codes documented in :mod:`matrel_tpu.analysis.diagnostics`):
                     (delta_pass.verify_patched_entries) proves every
                     surviving patched entry against fresh execution
                     within that bound — docs/IVM.md
+  provenance MV115  answer-lineage stamps cohere with the mechanism
+                    stamps both directions (provenance ⇔ result_cache
+                    key hashes, ivm_patched ⇔ delta, fleet_replica
+                    backed by fleet; unknown paths/schemas warn); the
+                    DYNAMIC half (provenance_pass.verify_ledger)
+                    audits a live ledger's records — docs/OBSERVABILITY.md
 """
 
 from __future__ import annotations
@@ -67,6 +73,7 @@ from matrel_tpu.analysis.layout_pass import check_layout_claims
 from matrel_tpu.analysis.padding_pass import check_padding_flow
 from matrel_tpu.analysis.placement_pass import check_placement_stamps
 from matrel_tpu.analysis.precision_pass import check_precision_stamps
+from matrel_tpu.analysis.provenance_pass import check_provenance_stamps
 from matrel_tpu.analysis.reshard_pass import check_reshard_peaks
 from matrel_tpu.analysis.result_cache_pass import check_result_cache_stamps
 from matrel_tpu.analysis.strategy_pass import (check_spgemm_dispatch,
@@ -95,6 +102,7 @@ PASSES = (
     ("brownout", check_brownout_stamps),
     ("delta", check_delta_stamps),
     ("placement", check_placement_stamps),
+    ("provenance", check_provenance_stamps),
 )
 
 

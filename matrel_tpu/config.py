@@ -425,6 +425,19 @@ class MatrelConfig:
         of the analytic closed forms, provenance-stamped "measured"
         like autotune winners; classes with no calibration row fall
         back to the analytic model (docs/FLEET.md).
+      obs_provenance: answer provenance ledger capacity (obs tier 4,
+        docs/OBSERVABILITY.md). 0 (default) = off: zero ledger
+        objects constructed, no lineage capture anywhere on the
+        serve path (the brownout/breaker structural-zero contract).
+        N > 0 keeps the last N per-answer lineage records in memory
+        (``session.why()`` / ``python -m matrel_tpu why``) and emits
+        each as a ``provenance`` event when the event log is on.
+      obs_event_log_max_bytes: rotate the JSONL event log to a single
+        ``.1`` sibling once it reaches this size. 0 (default) = never
+        rotate (the historical unbounded-append behaviour,
+        byte-identical). Readers stitch ``<log>.1`` + ``<log>``
+        transparently, so rotation bounds the DISK while
+        ``tail_bytes`` keeps bounding each read.
     """
 
     block_size: int = 512
@@ -505,6 +518,8 @@ class MatrelConfig:
     fleet_replicate_hits: int = 3
     fleet_failover: bool = True
     fleet_placement_calibration: bool = True
+    obs_provenance: int = 0
+    obs_event_log_max_bytes: int = 0
 
     def __post_init__(self):
         # enablement is "anything != off", so an unvalidated typo/case
@@ -725,6 +740,21 @@ class MatrelConfig:
                 f"fleet_replicate_hits must be >= 0 (0 disables "
                 f"hot-entry replication), "
                 f"got {self.fleet_replicate_hits!r}")
+        # obs tier 4 (docs/OBSERVABILITY.md): a negative ledger
+        # capacity would silently read as "off" while the operator
+        # believes lineage is being captured (the fleet_slices
+        # precedent); a negative rotation threshold likewise reads as
+        # "never rotate" while the operator believes the disk is
+        # bounded
+        if self.obs_provenance < 0:
+            raise ValueError(
+                f"obs_provenance must be >= 0 (0 disables the "
+                f"provenance ledger), got {self.obs_provenance!r}")
+        if self.obs_event_log_max_bytes < 0:
+            raise ValueError(
+                f"obs_event_log_max_bytes must be >= 0 (0 disables "
+                f"event-log rotation), "
+                f"got {self.obs_event_log_max_bytes!r}")
 
     def replace(self, **kw: Any) -> "MatrelConfig":
         return dataclasses.replace(self, **kw)

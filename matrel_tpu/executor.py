@@ -1661,6 +1661,28 @@ def multiplan_root_decisions(plan: MultiPlan) -> List[List[dict]]:
     return meta["matmuls_per_root"]
 
 
+#: Decision-record columns the provenance ledger keeps (obs tier 4):
+#: the chosen strategy, WHY (autotune/model/override), and the
+#: precision tier — the coefficient provenance a lineage audit needs,
+#: without the per-matmul byte/FLOP estimates the query event carries.
+_PROVENANCE_KEEP = ("strategy", "source", "precision_tier",
+                    "delta_rule")
+
+
+def plan_provenance(plan, decisions: Optional[List[dict]] = None
+                    ) -> List[dict]:
+    """A compiled plan's strategy/tier/coefficient provenance,
+    projected for the answer ledger (obs/provenance.py). ``decisions``
+    lets MultiPlan callers pass ONE root's records
+    (``multiplan_root_decisions``) instead of the batch aggregate.
+    Same lazy-derivation contract as :func:`plan_matmul_decisions`:
+    the ledger-off path never calls this."""
+    if decisions is None:
+        decisions = plan_matmul_decisions(plan)
+    return [{k: d[k] for k in _PROVENANCE_KEEP
+             if d.get(k) is not None} for d in decisions]
+
+
 def execute(expr: MatExpr, mesh: Optional[Mesh] = None,
             config: Optional[MatrelConfig] = None) -> BlockMatrix:
     return compile_expr(expr, mesh, config).run()

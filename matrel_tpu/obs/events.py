@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from typing import Iterator, List, Optional
 
@@ -46,13 +47,40 @@ def resolve_path(path: Optional[str]) -> str:
     return path or DEFAULT_EVENT_LOG
 
 
+def rotated_path(path: Optional[str]) -> str:
+    """The single rotation sibling: ``<log>.1``."""
+    return resolve_path(path) + ".1"
+
+
+#: Serialises the size-check + rename of rotation across every writer
+#: thread in this process (fleet slices and the parent session share
+#: one log). Cross-process writers stay safe without it: each append
+#: is one O_APPEND write, and a concurrent rename at worst lands a
+#: line in the .1 sibling instead of the fresh main file — readers
+#: stitch both.
+_ROTATE_LOCK = threading.Lock()
+
+
 class EventLog:
     """Append-only JSONL writer. ``emit`` stamps schema/ts/kind and
     writes one line; it never raises (a broken disk must not break the
-    query that happened to be observed)."""
+    query that happened to be observed).
 
-    def __init__(self, path: Optional[str] = None):
+    Line atomicity: each record is ONE ``os.write`` on an O_APPEND
+    descriptor — POSIX appends are atomic for sane line sizes, so
+    fleet slices and the parent session interleaving on the same log
+    produce whole lines, never spliced ones. A torn line (crashed
+    writer, full disk) is the READER's problem and is counted + warned
+    there (:func:`iter_events`).
+
+    With ``max_bytes`` > 0 the log rotates to a single ``.1`` sibling
+    once it reaches the threshold (the previous ``.1`` is replaced) —
+    disk is bounded at ~2x max_bytes while readers stitch the pair.
+    0 keeps the historical unbounded append, byte-identical."""
+
+    def __init__(self, path: Optional[str] = None, max_bytes: int = 0):
         self.path = resolve_path(path)
+        self.max_bytes = max_bytes
         self._warned = False
 
     def emit(self, kind: str, record: dict) -> Optional[dict]:
@@ -67,12 +95,32 @@ class EventLog:
             self._warn(f"unserialisable event dropped: {e}")
             return None
         try:
-            with open(self.path, "a") as f:
-                f.write(line + "\n")
+            fd = os.open(self.path,
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                os.write(fd, (line + "\n").encode())
+            finally:
+                os.close(fd)
         except OSError as e:
             self._warn(f"could not append to {self.path}: {e}")
             return None
+        if self.max_bytes > 0:
+            self._maybe_rotate()
         return full
+
+    def _maybe_rotate(self) -> None:
+        """Rotate ``path`` → ``path.1`` once the threshold is reached.
+        Size is re-checked under the process-wide lock so concurrent
+        writers rotate exactly once per crossing; failures are
+        swallowed like emit's (rotation must never fail a query)."""
+        try:
+            if os.path.getsize(self.path) < self.max_bytes:
+                return
+            with _ROTATE_LOCK:
+                if os.path.getsize(self.path) >= self.max_bytes:
+                    os.replace(self.path, self.path + ".1")
+        except OSError as e:
+            self._warn(f"could not rotate {self.path}: {e}")
 
     def _warn(self, msg: str) -> None:
         if not self._warned:
@@ -138,32 +186,70 @@ def iter_events(path: Optional[str] = None,
     silently shrinking history would hide the corruption entirely.
     With ``tail_bytes`` the read starts at most N bytes before EOF
     (the first, almost-surely partial line is dropped, not counted
-    corrupt)."""
+    corrupt).
+
+    When rotation left a ``<log>.1`` sibling the pair is stitched
+    transparently — oldest first, and ``tail_bytes`` spans BOTH files
+    (the budget left after the main file reaches into the sibling's
+    tail), so every reader (history, top, drift, the scrape endpoint)
+    sees one continuous log regardless of when rotation fired."""
     p = resolve_path(path)
-    if not os.path.exists(p):
+    prev = p + ".1"
+    # (path, bytes-to-skip-from-its-start) pairs, oldest file first.
+    # A rotation between the two stat calls at worst re-reads a
+    # record's worth of history — never loses the tail.
+    plan: List[tuple] = []
+    main_size = os.path.getsize(p) if os.path.exists(p) else None
+    prev_size = os.path.getsize(prev) if os.path.exists(prev) else None
+    if tail_bytes is None:
+        if prev_size is not None:
+            plan.append((prev, 0))
+        if main_size is not None:
+            plan.append((p, 0))
+    elif main_size is not None and main_size > tail_bytes:
+        plan.append((p, main_size - tail_bytes))
+    else:
+        if prev_size is not None:
+            remain = tail_bytes - (main_size or 0)
+            plan.append((prev, max(0, prev_size - remain)))
+        if main_size is not None:
+            plan.append((p, 0))
+    if not plan:
         return
     skipped = 0
-    with open(p) as f:
-        if tail_bytes is not None:
-            size = os.fstat(f.fileno()).st_size
-            if size > tail_bytes:
-                f.seek(size - tail_bytes)
-                f.readline()       # discard the cut-off line
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
+    for fpath, start in plan:
+        try:
+            f = open(fpath)
+        except OSError:
+            if fpath != p:
+                continue           # sibling vanished; nothing to chase
             try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                skipped += 1
+                # the main file rotated away between the stat and the
+                # open — its bytes moved to the sibling, so follow
+                # them (at worst this re-reads a little history;
+                # never loses the tail)
+                f = open(prev)
+            except OSError:
                 continue
-            if not isinstance(rec, dict):
-                skipped += 1
-                continue
-            if rec.get("schema") != SCHEMA_VERSION:
-                continue
-            yield rec
+        with f:
+            if start > 0:
+                f.seek(start)
+                f.readline()       # discard the cut-off line
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    skipped += 1
+                    continue
+                if not isinstance(rec, dict):
+                    skipped += 1
+                    continue
+                if rec.get("schema") != SCHEMA_VERSION:
+                    continue
+                yield rec
     if skipped:
         log.warning("event log %s: skipped %d corrupt line(s) "
                     "(crashed-writer debris; readers continue)",

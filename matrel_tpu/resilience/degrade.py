@@ -57,6 +57,13 @@ def rung_label(rung: int) -> str:
     return RUNG_LABELS.get(rung, f"rung-{rung}")
 
 
+def rung_meta(rung: int) -> dict:
+    """The rung's stamp record — one shape everywhere it rides
+    (``plan.meta["degrade"]``, the ``degrade`` obs event, the answer
+    ledger's lineage records)."""
+    return {"rung": rung, "label": rung_label(rung)}
+
+
 def apply_rung(config, rung: int):
     """The compile config of one degraded attempt — CUMULATIVE: rung N
     includes every restriction below it. Rung 0 returns the config

@@ -199,6 +199,18 @@ class AdmissionQueue:
     def _entry_tenant(entry) -> str:
         return (entry[5] or "") if len(entry) > 5 else ""
 
+    @staticmethod
+    def entry_provenance(entry) -> dict:
+        """Project one queue tuple for a lineage record (the answer
+        provenance ledger, obs/provenance.py) — keeps the tuple-layout
+        knowledge here with the rest of the entry accessors, so the
+        capture sites never index the 7-tuple directly."""
+        return {
+            "tenant": (entry[5] or None) if len(entry) > 5 else None,
+            "sla": entry[3] if len(entry) > 3 else None,
+            "staleness_ms": entry[6] if len(entry) > 6 else None,
+        }
+
     def _purge_expired_locked(self, tenant: Optional[str],
                               to_fail: list) -> int:
         """Drop every queued entry whose deadline already expired —

@@ -706,6 +706,20 @@ class FleetController:
             return None
         remote = serving_id != preferred
         self.directory.record_hit(fkey, preferred, remote)
+        if self.session._prov is not None:
+            # lineage on the PARENT ledger (the fleet-facing surface
+            # the caller queries), with the SERVING slice's mesh and
+            # SLA config — that is the configuration an audit replay
+            # must reproduce the answer under
+            sl = self.slice_by_id(serving_id)
+            self.session._prov_capture(
+                "fleet_replica" if ent.fleet is not None
+                else "fleet_directory",
+                key, sla, ent=ent,
+                fleet={"owner": rec.owner, "serving": serving_id,
+                       "remote": remote},
+                mesh=sl.session.mesh,
+                config=sl.session._sla_config(sla))
         fut: Future = Future()
         fut.set_result(ent.result)
         slo = self.slice_by_id(serving_id).session._slo
@@ -839,6 +853,13 @@ class FleetController:
             err_bound=ent.err_bound,
             fleet={"owner": rec.owner, "layout": rec.layout,
                    "dtype": rec.dtype})
+        if self.session._prov is not None:
+            # the replica inherits the owner entry's ancestry: its
+            # stamp points back at the record that produced the
+            # owner's answer (sanctioned seam — obs/provenance.py)
+            self.session._prov.stamp_entry(
+                new_ent, "fleet_replica",
+                (ent.provenance or {}).get("query_id"))
         if target.session._result_cache.put(
                 key, new_ent, cfg.result_cache_max_bytes,
                 cfg.result_cache_max_entries):

@@ -276,6 +276,13 @@ class DeltaPlane:
         if not ok:
             self._programs.pop(ivm_id, None)
             return False, 0.0
+        if sess._prov is not None:
+            # one lineage link per applied patch: the chain (and the
+            # composed err_bound a later audit replays against) lives
+            # on the ledger, the stamp on the entry (sanctioned seam
+            # — obs/provenance.py)
+            sess._prov.stamp_patched(new_ent, gen, meta.rule,
+                                     meta.err_bound)
         if meta.plan is not None:
             self._programs[ivm_id] = meta
         counters["patched"] += 1

@@ -364,6 +364,10 @@ class ServePipeline:
                         if not it[1].done():
                             it[1].set_result(ent.result)
                         stale_served += 1
+                        if self.session._prov is not None:
+                            self.session._prov_capture_stale(
+                                it[0], ent,
+                                AdmissionQueue.entry_provenance(it))
                         if self._slo is not None:
                             self._slo.record_ok(
                                 it[5] or None,

@@ -91,6 +91,13 @@ class CacheEntry:
       MV114 re-checks against the entry's own claims (the MV107
       stale-stamp idiom applied across slices). None (the default)
       for every locally-computed entry — the historical shape.
+    provenance: compact lineage stamp (obs tier 4,
+      docs/OBSERVABILITY.md) written ONLY at the sanctioned seams —
+      ``session._rc_insert`` (fresh execution), the delta plane's
+      ``apply_patch`` commit (patch-chain append), and fleet
+      replication (ML015 pins every other writer). None (the
+      default) when ``obs_provenance`` is off — the historical
+      shape, zero objects.
     """
 
     key_hash: str
@@ -107,6 +114,7 @@ class CacheEntry:
     delta_rule: Optional[str] = None
     ivm_id: Optional[int] = None
     fleet: Optional[dict] = None
+    provenance: Optional[dict] = None
 
 
 class ResultCache:

@@ -31,6 +31,7 @@ from matrel_tpu.core import mesh as mesh_lib
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.ir.expr import MatExpr, as_expr
 from matrel_tpu.obs import export as export_lib
+from matrel_tpu.obs import provenance as provenance_lib
 from matrel_tpu.obs import slo as slo_lib
 from matrel_tpu.obs import trace as trace_lib
 from matrel_tpu.resilience import breaker as breaker_lib
@@ -133,6 +134,13 @@ class MatrelSession:
         # collective hazard). None (the default) = plain async
         # dispatch, bit-identical.
         self._exec_lock = None
+        # answer provenance ledger (obs/provenance.py;
+        # docs/OBSERVABILITY.md tier 4): None for the default config
+        # (obs_provenance = 0 — the brownout/breaker structural-zero
+        # contract: no ledger, no record objects, poisoned-init
+        # test-enforced). When on, every served answer appends one
+        # lineage record here and emits a ``provenance`` event.
+        self._prov = provenance_lib.from_config(self.config)
         self._exporter = export_lib.from_config(self)
 
     # -- builder (MatfastSession.builder().getOrCreate() analogue) ---------
@@ -391,8 +399,7 @@ class MatrelSession:
             if rung:
                 # the rung rides the plan so obs events / explain say
                 # WHICH ladder step produced this attempt's plan
-                plan.meta["degrade"] = {
-                    "rung": rung, "label": degrade_lib.rung_label(rung)}
+                plan.meta["degrade"] = degrade_lib.rung_meta(rung)
             self._plan_cache[key] = plan
             self._plan_cache_bytes += _plan_bytes(plan)
             self._evict_plans()
@@ -452,8 +459,7 @@ class MatrelSession:
                 self._flight_auto_dump(ex)   # same trail as the
                 raise                        # single-plan entry
             if rung:
-                plan.meta["degrade"] = {
-                    "rung": rung, "label": degrade_lib.rung_label(rung)}
+                plan.meta["degrade"] = degrade_lib.rung_meta(rung)
             plan._cache_pin = (tuple(uniq[k] for k in skeys), pins_all)
             plan._root_keys = tuple(skeys)
             self._plan_cache[mkey] = plan
@@ -564,7 +570,14 @@ class MatrelSession:
             # entry's own claims (the MV107 stale-stamp idiom across
             # slices)
             stamp["fleet"] = dict(ent.fleet)
-        return expr_mod.leaf(ent.result).with_attrs(result_cache=stamp)
+        node = expr_mod.leaf(ent.result).with_attrs(result_cache=stamp)
+        if self._prov is not None:
+            # lineage threading (obs tier 4): the consumed entry's
+            # provenance stamp rides the substitution leaf so MV115
+            # can cross-check it against the result_cache stamp — the
+            # attrs write lives in the ledger (ML015's one seam)
+            node = self._prov.stamp_leaf(node, ent)
+        return node
 
     def _rc_substitute(self, e: MatExpr, parts: Optional[list] = None,
                        spans: Optional[dict] = None,
@@ -641,7 +654,8 @@ class MatrelSession:
 
     def _rc_insert(self, key: str, pins: list, executed: MatExpr,
                    out: BlockMatrix, orig: Optional[MatExpr] = None,
-                   prec: str = "", plan=None) -> None:
+                   prec: str = "", plan=None,
+                   prov: Optional[dict] = None) -> None:
         """Cache one executed query result under its structural key.
         ``executed`` is the (possibly substituted) tree that actually
         ran — its leaves name the dep matrices; ``pins`` are the key's
@@ -669,6 +683,12 @@ class MatrelSession:
             prec=prec,
             err_bound=bound,
         )
+        if prov is not None and self._prov is not None:
+            # lineage stamp (obs tier 4): the producing query's ledger
+            # record names this entry and vice versa — the write
+            # itself lives in the ledger (the ML015 one-seam idiom)
+            self._prov.stamp_entry(ent, prov["path"],
+                                   prov["query_id"])
         self._result_cache.put(key, ent,
                                self.config.result_cache_max_bytes,
                                self.config.result_cache_max_entries)
@@ -681,8 +701,10 @@ class MatrelSession:
     def _obs_event_log(self):
         from matrel_tpu.obs.events import EventLog, resolve_path
         path = resolve_path(self.config.obs_event_log)
-        if self._event_log is None or self._event_log.path != path:
-            self._event_log = EventLog(path)
+        max_bytes = self.config.obs_event_log_max_bytes
+        if (self._event_log is None or self._event_log.path != path
+                or self._event_log.max_bytes != max_bytes):
+            self._event_log = EventLog(path, max_bytes=max_bytes)
         return self._event_log
 
     def _obs_emit(self, kind: str, record: dict) -> None:
@@ -707,6 +729,78 @@ class MatrelSession:
                         "ts": round(time.time(), 3), "kind": kind}  # matlint: disable=ML006 record timestamp — mirrors EventLog.emit's stamp for ring-only records
                 full.update(record)
             self._flight.add(full)
+
+    # -- answer provenance ledger (obs/provenance.py — tier 4) --------------
+
+    def _prov_capture(self, path: str, key: str, sla: str,
+                      rung: int = 0, expr=None, result=None, ent=None,
+                      executed=None, plan=None, strategies=None,
+                      fleet=None, stale=None, mesh=None,
+                      config=None) -> Optional[dict]:
+        """One lineage record + ``provenance`` event per served
+        answer. Callers guard on ``self._prov is not None`` (the off
+        path must not even assemble arguments); capture failures are
+        swallowed like every other obs emission — lineage must never
+        fail the answer it describes. The record keeps the compile
+        config the answer was produced under (SLA + degrade rung), so
+        audit replay reconstructs the producing configuration."""
+        try:
+            cfg = config if config is not None else \
+                degrade_lib.apply_rung(self._sla_config(sla), rung)
+            summary = self._prov.capture(
+                path, key, sla, rung=rung, expr=expr, result=result,
+                ent=ent, executed=executed, plan=plan,
+                strategies=strategies,
+                mesh=mesh if mesh is not None else self.mesh,
+                config=cfg, fleet=fleet, stale=stale)
+            self._obs_emit("provenance", summary)
+            return summary
+        except Exception:
+            log.warning("obs: provenance record dropped",
+                        exc_info=True)
+            return None
+
+    def _prov_capture_stale(self, e: MatExpr, ent,
+                            meta: dict) -> None:
+        """Rung-2 stale-serve capture (serve/pipeline.py): recompute
+        the structural key (the probe's own walk is gone by now —
+        only paid when the ledger is on) and record the staleness
+        grant the answer was served under. ``meta`` is the queue
+        tuple's ``AdmissionQueue.entry_provenance`` projection."""
+        sla = meta.get("sla") or self.config.precision_sla
+        parts, _pins, _spans = _plan_key_spans(e)
+        key = self._rc_key_prefix(sla) + "|".join(parts)
+        stale = {"staleness_ms": float(meta.get("staleness_ms")
+                                       or 0.0)}
+        if meta.get("tenant"):
+            stale["tenant"] = meta["tenant"]
+        self._prov_capture("stale", key, sla, ent=ent, stale=stale)
+
+    def why(self, query=None, last: int = 10) -> list:
+        """Lineage of recently served answers (obs tier 4,
+        docs/OBSERVABILITY.md): the JSON-safe summary dicts of the
+        in-memory ledger, newest last — ``python -m matrel_tpu why``
+        renders the same records from the event log. ``query`` filters
+        by key/key-hash substring or ledger query id, or by the ANSWER
+        itself (a BlockMatrix matches by identity). Empty when
+        ``config.obs_provenance`` is 0."""
+        if self._prov is None:
+            return []
+        if query is None:
+            recs = self._prov.last(last)
+        elif isinstance(query, BlockMatrix):
+            recs = [r for r in self._prov.records()
+                    if r.result is query]
+        else:
+            recs = self._prov.find(str(query))
+        return [r.summary for r in recs]
+
+    def provenance_info(self) -> dict:
+        """``plan_cache_info``-style surface for the ledger."""
+        if self._prov is None:
+            return {"records": 0, "cap": 0, "captured": 0,
+                    "chains": 0}
+        return self._prov.info()
 
     # -- flight recorder (obs/trace.py — post-mortem ring) ------------------
 
@@ -1128,6 +1222,9 @@ class MatrelSession:
                     except Exception:
                         log.warning("obs: query event dropped",
                                     exc_info=True)
+                if self._prov is not None:
+                    self._prov_capture("rc_hit", key, sla, rung=rung,
+                                       ent=ent)
                 return ent.result
         with trace_lib.span("plan"):
             plan, hit, pkey = self._compile_entry(e, sla=sla, rung=rung)
@@ -1141,9 +1238,19 @@ class MatrelSession:
             # async — deliberately no added sync; always-cheap)
             with trace_lib.span("query.execute"):
                 out = self._arbitrated_run(plan)
+        summary = None
+        if self._prov is not None:
+            # capture BEFORE the cache insert so the new CacheEntry's
+            # stamp can carry this record's query id (the ancestry
+            # link `why` follows from a later hit back to its producer)
+            summary = self._prov_capture(
+                "execute", key if key is not None else pkey, sla,
+                rung=rung, expr=orig, result=out, executed=e,
+                plan=plan)
         if rc:
             self._rc_insert(key, pins, e, out, orig=orig,
-                            prec=_prec_prefix(sla), plan=plan)
+                            prec=_prec_prefix(sla), plan=plan,
+                            prov=summary)
         return out
 
     # -- resilient execution (matrel_tpu/resilience/) ----------------------
@@ -1362,6 +1469,9 @@ class MatrelSession:
                         except Exception:
                             log.warning("obs: query event dropped",
                                         exc_info=True)
+                    if self._prov is not None:
+                        self._prov_capture("rc_hit", key, sla,
+                                           rung=rung, ent=ent)
                     continue
                 rc_meta[i] = (key, pins, orig)
             pend.append((i, e))
@@ -1390,10 +1500,23 @@ class MatrelSession:
             for j, ((i, e), k) in enumerate(zip(pend, keys)):
                 out = outs[pos[k]]
                 results[i] = out
+                summary = None
+                if self._prov is not None:
+                    if rc:
+                        p_key, _p, p_orig = rc_meta[i]
+                    else:
+                        p_key, p_orig = k, e
+                    summary = self._prov_capture(
+                        "execute", p_key, sla, rung=rung,
+                        expr=p_orig, result=out, executed=e,
+                        plan=plan,
+                        strategies=executor_lib.multiplan_root_decisions(
+                            plan)[pos[k]])
                 if rc:
                     key, pins, orig = rc_meta[i]
                     self._rc_insert(key, pins, e, out, orig=orig,
-                                    prec=_prec_prefix(sla), plan=plan)
+                                    prec=_prec_prefix(sla), plan=plan,
+                                    prov=summary)
                 if obs:
                     try:
                         per_root = executor_lib.multiplan_root_decisions(

@@ -427,6 +427,27 @@ def test_chaos_drill_artifact(dry_batch):
     assert rec["retries"] > 0 and rec["degrades"] > 0
 
 
+def test_provenance_drill_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records,
+               lambda r: r.get("metric") == "provenance_drill",
+               "provenance_drill")
+    # the obs tier-4 acceptance: every provenance-bearing serve path
+    # yields a lineage record (execute / whole hit / interior / IVM
+    # patch / fleet directory + replica / rung-4 degrade), the MV115
+    # dynamic ledger check is clean, and FULL audit replay proves
+    # every served answer against fresh execution
+    assert rec["ok"] is True, rec
+    assert rec["missing_paths"] == []
+    assert 4 in rec["degrade_rungs"]
+    assert rec["mv115_findings"] == 0
+    for name in ("serve", "fleet", "degrade"):
+        verdict = rec["audit"][name]
+        assert verdict["ok"] is True, (name, verdict)
+        assert verdict["failed"] == 0
+        assert verdict["sampled"] == verdict["replayable"] >= 1
+
+
 def test_sweep_and_gram_artifacts(dry_batch):
     _, records, _ = dry_batch
     verdict = _one(records, lambda r: "results" in r and "ok" in r,

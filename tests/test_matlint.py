@@ -882,6 +882,73 @@ class TestML014FleetSeam:
         assert _lint(tmp_path, src, "matrel_tpu/obs/whatever.py") == []
 
 
+class TestML015ProvenanceSeam:
+    def test_fires_on_attribute_store(self, tmp_path):
+        src = """
+            def stamp(ent, key_hash):
+                ent.provenance = {"schema": 1, "key_hash": key_hash}
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newplane.py")
+        assert _rules(got) == ["ML015"]
+
+    def test_fires_on_subscript_store(self, tmp_path):
+        # the attrs-dict route around the attribute check
+        src = """
+            def stamp(attrs, rec):
+                attrs["provenance"] = {"query_id": rec.query_id}
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/session.py")
+        assert _rules(got) == ["ML015"]
+
+    def test_fires_on_with_attrs_keyword(self, tmp_path):
+        # the immutable-expr route: threading a hand-built stamp onto
+        # a substitution leaf
+        src = """
+            def leaf_with_stamp(node, stamp):
+                return node.with_attrs(provenance=stamp)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/executor.py")
+        assert _rules(got) == ["ML015"]
+
+    def test_fires_on_del(self, tmp_path):
+        src = """
+            def scrub(ent):
+                del ent.provenance
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/fleet.py")
+        assert _rules(got) == ["ML015"]
+
+    def test_reads_and_calls_pass(self, tmp_path):
+        # the sanctioned idiom: modules READ stamps and CALL the
+        # ledger's writers; only the ledger builds the dict
+        src = """
+            def serve(sess, ent, key, parent):
+                if ent.provenance is not None:
+                    ancestry = ent.provenance.get("query_id")
+                sess._prov.stamp_entry(ent, "fleet_replica", parent)
+                return ent.provenance
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newplane.py") == []
+
+    def test_ledger_module_is_the_sanctioned_seam(self, tmp_path):
+        src = """
+            def stamp_entry(ent, path, parent):
+                ent.provenance = {"schema": 1, "path": path}
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/obs/provenance.py") == []
+
+    def test_out_of_scope_modules_pass(self, tmp_path):
+        # tools/ and tests build fixture stamps freely — the rule pins
+        # the library's serve path, not the harnesses around it
+        src = """
+            def fixture(ent):
+                ent.provenance = {"schema": 1}
+        """
+        assert _lint(tmp_path, src, "tools/some_drill.py") == []
+
+
 def test_repo_lints_clean():
     """`make lint`'s contract, enforced from inside tier-1: the whole
     default scan set (package, tools, examples, bench harnesses) has

@@ -3,6 +3,7 @@ log, explain(analyze=True) and the obs_level="off" zero-overhead
 contract the bench relies on."""
 
 import json
+import os
 import threading
 
 import numpy as np
@@ -168,6 +169,171 @@ class TestEventLog:
         [rec] = read_events(str(tmp_path / "anchor"
                                 / ".matrel_events.jsonl"))
         assert rec["kind"] == "soak"
+
+
+class TestEventLogRotation:
+    """obs_event_log_max_bytes: single-``.1``-sibling rotation with
+    transparent reader stitching; 0 (the default) keeps the historical
+    unbounded append byte-for-byte."""
+
+    def _emit_n(self, log, n, start=0):
+        for i in range(start, start + n):
+            log.emit("query", {"seq": i})
+
+    def test_off_path_never_rotates(self, tmp_path):
+        from matrel_tpu.obs.events import rotated_path
+        path = str(tmp_path / "ev.jsonl")
+        log = EventLog(path)               # max_bytes=0: historical
+        self._emit_n(log, 50)
+        assert not os.path.exists(rotated_path(path))
+        recs = read_events(path)
+        assert [r["seq"] for r in recs] == list(range(50))
+        # byte-identical off-path: exactly one line per record, no
+        # truncation, no sibling — the pre-rotation file shape
+        with open(path) as f:
+            assert sum(1 for _ in f) == 50
+
+    def test_rotates_to_single_sibling_and_readers_stitch(
+            self, tmp_path):
+        from matrel_tpu.obs.events import rotated_path
+        path = str(tmp_path / "ev.jsonl")
+        probe = EventLog(path)
+        probe.emit("query", {"seq": -1})
+        line_sz = os.path.getsize(path)
+        os.remove(path)
+        # threshold = ~8 lines: one crossing over a 12-record stream
+        log = EventLog(path, max_bytes=8 * line_sz)
+        self._emit_n(log, 12)
+        assert os.path.exists(rotated_path(path))
+        # the pair stitches oldest-first into one continuous history
+        recs = read_events(path)
+        assert [r["seq"] for r in recs] == list(range(12))
+        # and iter_events yields the same order
+        assert [r["seq"] for r in iter_events(path)] == list(range(12))
+
+    def test_rotation_bounds_disk_at_two_files(self, tmp_path):
+        from matrel_tpu.obs.events import rotated_path
+        path = str(tmp_path / "ev.jsonl")
+        probe = EventLog(path)
+        probe.emit("query", {"seq": -1})
+        line_sz = os.path.getsize(path)
+        os.remove(path)
+        log = EventLog(path, max_bytes=4 * line_sz)
+        self._emit_n(log, 40)              # many crossings
+        # a crossing rotates the main file away; the next emit
+        # recreates it — either way disk stays ~2x the threshold
+        main_sz = os.path.getsize(path) if os.path.exists(path) else 0
+        assert main_sz <= 5 * line_sz
+        assert os.path.getsize(rotated_path(path)) <= 5 * line_sz
+        # the history window is the newest suffix, ending at the last
+        # record — rotation REPLACES the sibling, never accumulates
+        seqs = [r["seq"] for r in read_events(path)]
+        assert seqs == list(range(seqs[0], 40))
+        assert not os.path.exists(path + ".2")
+
+    def test_tail_bytes_spans_both_files(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        probe = EventLog(path)
+        probe.emit("query", {"seq": -1})
+        line_sz = os.path.getsize(path)
+        os.remove(path)
+        log = EventLog(path, max_bytes=8 * line_sz)
+        self._emit_n(log, 10)              # .1 holds 0..7, main 8..9
+        # a tail budget bigger than the main file reaches into the
+        # sibling's tail (its cut-off first line dropped, not corrupt)
+        recs = read_events(path, tail_bytes=5 * line_sz + 10)
+        seqs = [r["seq"] for r in recs]
+        assert seqs == seqs and seqs[-1] == 9
+        assert 2 <= len(seqs) <= 6
+        assert seqs == list(range(10 - len(seqs), 10))
+        # a budget inside the main file never opens the sibling
+        recs = read_events(path, tail_bytes=line_sz + 5)
+        assert [r["seq"] for r in recs] == [9]
+
+    def test_rotate_mid_read_never_raises(self, tmp_path):
+        # the reader's stat/open race: the main file rotates away
+        # between the size probe and the open — the reader continues
+        # with what it can open, never raises
+        path = str(tmp_path / "ev.jsonl")
+        log = EventLog(path)
+        self._emit_n(log, 6)
+        real_open = open
+
+        def racing_open(fpath, *a, **kw):
+            if fpath == path and os.path.exists(path):
+                os.replace(path, path + ".1")  # rotation wins the race
+            return real_open(fpath, *a, **kw)
+
+        import builtins
+        orig = builtins.open
+        builtins.open = racing_open
+        try:
+            recs = list(iter_events(path))
+        finally:
+            builtins.open = orig
+        # .1 was read before the race hit the main file; nothing lost
+        assert [r["seq"] for r in recs] == list(range(6))
+
+    def test_many_writers_interleave_whole_lines(self, tmp_path,
+                                                 caplog):
+        # O_APPEND + one write() per record: 8 writers x 200 records
+        # on one path produce 1600 parseable lines and ZERO corrupt-
+        # line warnings from the reader
+        path = str(tmp_path / "ev.jsonl")
+
+        def work(w):
+            log = EventLog(path)
+            for i in range(200):
+                log.emit("query", {"w": w, "i": i})
+
+        ts = [threading.Thread(target=work, args=(w,))
+              for w in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        with caplog.at_level("WARNING", logger="matrel_tpu.obs"):
+            recs = read_events(path)
+        assert len(recs) == 1600
+        per_writer = {}
+        for r in recs:
+            per_writer.setdefault(r["w"], []).append(r["i"])
+        # every writer's records all landed, in ITS OWN order
+        assert all(v == list(range(200))
+                   for v in per_writer.values())
+        assert not [m for m in caplog.messages if "corrupt" in m]
+
+    def test_torn_line_counted_and_warned(self, tmp_path, caplog):
+        # a crashed writer's partial line: the reader skips it,
+        # COUNTS it, and warns once (the robust-reader contract) —
+        # same across the rotation pair
+        from matrel_tpu.obs.events import rotated_path
+        path = str(tmp_path / "ev.jsonl")
+        log = EventLog(path)
+        self._emit_n(log, 2)
+        with open(rotated_path(path), "w") as f:
+            f.write('{"schema": 1, "kind": "query", "seq": -2}\n')
+            f.write('{"torn mid-wri\n')
+        with caplog.at_level("WARNING", logger="matrel_tpu.obs"):
+            recs = read_events(path)
+        assert [r["seq"] for r in recs] == [-2, 0, 1]
+        assert any("1 corrupt line" in m for m in caplog.messages)
+
+    def test_session_knob_flows_and_log_rebuilds(self, mesh8,
+                                                 tmp_path, chain3):
+        from matrel_tpu.obs.events import rotated_path
+        sess = _session(mesh8, tmp_path, obs_event_log_max_bytes=600)
+        for _ in range(6):
+            sess.run(chain3)
+        path = str(tmp_path / "events.jsonl")
+        assert os.path.exists(rotated_path(path))
+        # the readers (history et al. route through read_events) see
+        # a continuous stitched history ending at the newest record
+        recs = read_events(path)
+        assert any(r["kind"] == "query" for r in recs)
+        # flipping the knob rebuilds the session's writer
+        sess.config = sess.config.replace(obs_event_log_max_bytes=0)
+        assert sess._obs_event_log().max_bytes == 0
 
 
 class TestSessionEvents:

@@ -1,0 +1,464 @@
+"""Answer provenance ledger (obs tier 4, matrel_tpu/obs/provenance.py)
+— per-path lineage records, the `why` console, audit replay (including
+a seeded-corruption catch), the obs_provenance=0 zero-overhead
+contract, and MV115 stamp coherence both statically and dynamically."""
+
+import dataclasses
+import types
+
+import numpy as np
+import pytest
+
+from matrel_tpu import analysis
+from matrel_tpu.analysis import provenance_pass
+from matrel_tpu.config import MatrelConfig
+from matrel_tpu.core.blockmatrix import BlockMatrix
+from matrel_tpu.ir import expr as E, rules
+from matrel_tpu.obs import provenance as provenance_lib
+from matrel_tpu.obs.events import read_events
+from matrel_tpu.parallel import planner
+from matrel_tpu.session import MatrelSession
+
+
+def _session(mesh, **cfg):
+    cfg.setdefault("obs_provenance", 64)
+    cfg.setdefault("result_cache_max_bytes", 1 << 26)
+    return MatrelSession(mesh=mesh, config=MatrelConfig(**cfg))
+
+
+def _dense(rng, n, m, mesh):
+    return BlockMatrix.from_numpy(
+        rng.standard_normal((n, m)).astype(np.float32), mesh=mesh)
+
+
+def _annotated(e, mesh, cfg=None):
+    cfg = cfg or MatrelConfig()
+    grid = (mesh.shape[mesh.axis_names[0]],
+            mesh.shape[mesh.axis_names[1]])
+    return planner.annotate_strategies(
+        rules.optimize(e, cfg, grid=grid, mesh=mesh), mesh, cfg)
+
+
+def _mv115(diags):
+    return [d for d in diags if d.code == "MV115"]
+
+
+def _paths(sess):
+    return [r.path for r in sess._prov.records()]
+
+
+class TestLedgerCapture:
+    """One record per served answer, path refined by the mechanism
+    stamps the entry carries."""
+
+    def test_execute_then_hit_then_interior(self, rng, mesh8):
+        sess = _session(mesh8)
+        A = _dense(rng, 48, 64, mesh8)
+        B = _dense(rng, 64, 32, mesh8)
+        q = A.expr().multiply(B.expr())
+        sess.run(q)
+        sess.run(A.expr().multiply(B.expr()))
+        sess.run(A.expr().multiply(B.expr()).multiply_scalar(2.0))
+        assert _paths(sess) == ["execute", "rc_hit", "rc_interior"]
+        recs = sess._prov.records()
+        # every record replayable: live expr + result references held
+        assert all(r.expr is not None and r.result is not None
+                   for r in recs)
+        # the interior record names its substitution-leaf ancestry
+        cache = recs[2].summary["cache"]
+        assert cache["kind"] == "interior"
+        assert len(cache["leaves"]) == 1
+        assert cache["leaves"][0]["provenance"]["query_id"] == \
+            recs[0].query_id
+        # the whole hit carries the producing entry's stamp
+        whole = recs[1].summary["cache"]
+        assert whole["kind"] == "whole"
+        assert whole["entry"]["provenance"]["query_id"] == \
+            recs[0].query_id
+
+    def test_execute_record_carries_strategy_stamps(self, rng, mesh8):
+        sess = _session(mesh8)
+        A = _dense(rng, 48, 64, mesh8)
+        B = _dense(rng, 64, 32, mesh8)
+        sess.run(A.expr().multiply(B.expr()))
+        (rec,) = sess._prov.records()
+        assert rec.summary["strategies"], "execute without planner stamps"
+        assert all("strategy" in s for s in rec.summary["strategies"])
+
+    def test_ivm_patched_record_carries_chain(self, rng, mesh8):
+        sess = _session(mesh8)
+        adj = (rng.random((32, 32)) < 0.2).astype(np.float32)
+        sess.register("A", sess.from_numpy(adj, integral=True))
+
+        def q():
+            return sess.table("A").expr().multiply(
+                sess.table("A").expr())
+
+        sess.run(q())
+        for gen in range(2):
+            rows = rng.integers(0, 32, 4)
+            cols = rng.integers(0, 32, 4)
+            sess.register_delta(
+                "A", (rows, cols, np.ones(4, np.float32)), kind="coo")
+        sess.run(q())
+        rec = sess._prov.records()[-1]
+        assert rec.path == "ivm_patched"
+        ivm = rec.summary["cache"]["ivm"]
+        # two composed patches in order, gen climbing
+        assert [c["gen"] for c in ivm["chain"]] == \
+            sorted(c["gen"] for c in ivm["chain"])
+        assert len(ivm["chain"]) == 2
+        # integer path counts: the composed bound stays exact
+        assert rec.err_bound == 0.0
+
+    def test_degraded_record_stamps_rung(self, rng, mesh8):
+        sess = _session(
+            mesh8, fault_inject="execute:transient:p=1.0:max=4",
+            retry_max_attempts=4, retry_backoff_ms=0.5)
+        A = _dense(rng, 32, 48, mesh8)
+        B = _dense(rng, 48, 16, mesh8)
+        sess.run(A.expr().multiply(B.expr()))
+        rec = sess._prov.records()[-1]
+        assert rec.path == "degraded"
+        assert rec.rung == 4
+        assert rec.summary["degrade"]["rung"] == 4
+
+    def test_stale_capture_carries_grant(self, rng, mesh8):
+        sess = _session(mesh8)
+        A = _dense(rng, 32, 32, mesh8)
+        e = A.expr().multiply(A.expr())
+        sess.run(e)
+        (_, ent), = sess._result_cache.items_snapshot()
+        sess._prov_capture_stale(
+            e, ent, {"sla": None, "staleness_ms": 125.0,
+                     "tenant": "t0"})
+        rec = sess._prov.records()[-1]
+        assert rec.path == "stale"
+        assert rec.summary["stale"] == {"staleness_ms": 125.0,
+                                        "tenant": "t0"}
+
+    def test_fleet_directory_hop_recorded(self, rng, mesh8):
+        sess = MatrelSession(mesh=mesh8, config=MatrelConfig(
+            fleet_slices=2, obs_provenance=64,
+            result_cache_max_bytes=1 << 26))
+        try:
+            sess.register("A", sess.from_numpy(
+                rng.standard_normal((64, 64)).astype(np.float32)))
+            fq = sess.table("A").expr().multiply(
+                sess.table("A").expr())
+            sess.submit(fq).result(timeout=120)
+            sess.serve_drain()
+            # repeat submits until placement prefers the non-owning
+            # slice and the answer crosses the directory
+            for _ in range(6):
+                sess.submit(fq).result(timeout=120)
+                sess.serve_drain()
+                if any(p.startswith("fleet") for p in _paths(sess)):
+                    break
+            recs = [r for r in sess._prov.records()
+                    if r.path.startswith("fleet")]
+            assert recs, f"no fleet hop in {_paths(sess)}"
+            hop = recs[0].summary["fleet"]
+            assert {"owner", "serving"} <= set(hop)
+            assert provenance_pass.verify_ledger(sess) == []
+        finally:
+            sess.serve_close()
+
+    def test_bounded_ledger_evicts_oldest(self, rng, mesh8):
+        sess = _session(mesh8, obs_provenance=3)
+        A = _dense(rng, 16, 16, mesh8)
+        for i in range(5):
+            sess.run(A.expr().multiply_scalar(float(i + 1)))
+        info = sess.provenance_info()
+        assert info["records"] == 3 and info["cap"] == 3
+        assert info["captured"] == 5
+
+    def test_provenance_event_emitted(self, rng, mesh8, tmp_path):
+        log = str(tmp_path / "events.jsonl")
+        sess = _session(mesh8, obs_level="on", obs_event_log=log)
+        A = _dense(rng, 32, 32, mesh8)
+        sess.run(A.expr().multiply(A.expr()))
+        evs = read_events(log, kinds=("provenance",))
+        assert len(evs) == 1
+        assert evs[0]["path"] == "execute"
+        assert evs[0]["schema"] == provenance_lib.SCHEMA_VERSION
+
+
+class TestWhyConsole:
+    def test_why_filters_and_render(self, rng, mesh8):
+        sess = _session(mesh8)
+        A = _dense(rng, 32, 32, mesh8)
+        out1 = sess.run(A.expr().multiply(A.expr()))
+        out2 = sess.run(A.expr().multiply(A.expr()))
+        assert len(sess.why()) == 2
+        assert sess.why(last=1)[0]["path"] == "rc_hit"
+        # BlockMatrix identity: both serves returned the cached object
+        assert out1 is out2
+        assert {s["path"] for s in sess.why(out2)} == \
+            {"execute", "rc_hit"}
+        # query-id and key-hash lookup route through find()
+        qid = sess.why()[0]["query_id"]
+        assert sess.why(qid)[0]["query_id"] == qid
+        khash = sess.why()[0]["key_hash"]
+        assert len(sess.why(khash)) == 2
+        text = provenance_lib.render(sess.why(last=1)[0])
+        assert "path=rc_hit" in text and "cache: whole hit" in text
+
+    def test_why_off_session_returns_empty(self, rng, mesh8):
+        sess = MatrelSession(mesh=mesh8, config=MatrelConfig())
+        A = _dense(rng, 16, 16, mesh8)
+        sess.run(A.expr().t())
+        assert sess.why() == []
+        assert sess.provenance_info()["records"] == 0
+
+    def test_cli_renders_from_event_log(self, rng, mesh8, tmp_path,
+                                        capsys):
+        log = str(tmp_path / "events.jsonl")
+        sess = _session(mesh8, obs_level="on", obs_event_log=log)
+        A = _dense(rng, 32, 32, mesh8)
+        sess.run(A.expr().multiply(A.expr()))
+        sess.run(A.expr().multiply(A.expr()))
+        args = types.SimpleNamespace(audit=False, log=log, key=None,
+                                     last=10)
+        assert provenance_lib.main(args) == 0
+        out = capsys.readouterr().out
+        assert "path=execute" in out and "path=rc_hit" in out
+
+
+class TestAuditReplay:
+    def test_audit_proves_all_paths(self, rng, mesh8):
+        sess = _session(mesh8)
+        A = _dense(rng, 48, 64, mesh8)
+        B = _dense(rng, 64, 32, mesh8)
+        sess.run(A.expr().multiply(B.expr()))
+        sess.run(A.expr().multiply(B.expr()))
+        sess.run(A.expr().multiply(B.expr()).multiply_scalar(2.0))
+        verdict = provenance_lib.audit(sess, sample=0)
+        assert verdict["ok"]
+        assert verdict["sampled"] == verdict["replayable"] == 3
+        assert verdict["failed"] == 0
+        # f32 executes are exact-path: bit-equal, not tolerance-passed
+        assert all(r["exact"] for r in verdict["results"])
+
+    def test_audit_catches_seeded_corruption(self, rng, mesh8):
+        # the tier-4 acceptance: tamper a cached answer through the
+        # cache's own patch seam, re-serve it, and the audit replay
+        # must catch the lie and (under --check) exit nonzero
+        cfg = MatrelConfig(obs_provenance=64,
+                           result_cache_max_bytes=1 << 26)
+        sess = MatrelSession(mesh=mesh8, config=cfg)
+        A = _dense(rng, 32, 48, mesh8)
+        B = _dense(rng, 48, 16, mesh8)
+        sess.run(A.expr().multiply(B.expr()))
+        (key, ent), = sess._result_cache.items_snapshot()
+        corrupt = BlockMatrix.from_numpy(
+            ent.result.to_numpy() + 1.0, mesh=mesh8)
+        tampered = dataclasses.replace(ent, result=corrupt)
+        assert sess._result_cache.apply_patch(
+            key, key, tampered, cfg.result_cache_max_bytes,
+            cfg.result_cache_max_entries)
+        served = sess.run(A.expr().multiply(B.expr()))
+        np.testing.assert_array_equal(served.to_numpy(),
+                                      corrupt.to_numpy())
+        verdict = provenance_lib.audit(sess, sample=0)
+        assert not verdict["ok"]
+        bad = [r for r in verdict["results"] if not r["ok"]]
+        assert bad and bad[0]["path"] == "rc_hit"
+        assert bad[0]["rel_err"] > 0.0
+
+    def test_cli_audit_check_exit_codes(self, rng, mesh8, monkeypatch,
+                                        capsys):
+        # cheap CLI-contract check: swap the self-contained workload
+        # for small sessions (clean, then tampered) and assert the
+        # --check verdict drives the exit code
+        clean = _session(mesh8)
+        A = _dense(rng, 24, 24, mesh8)
+        clean.run(A.expr().multiply(A.expr()))
+        monkeypatch.setattr(provenance_lib, "_audit_workload",
+                            lambda: clean)
+        args = types.SimpleNamespace(audit=True, sample=0, check=True)
+        assert provenance_lib.main(args) == 0
+        assert "-> OK" in capsys.readouterr().out
+
+        cfg = MatrelConfig(obs_provenance=64,
+                           result_cache_max_bytes=1 << 26)
+        dirty = MatrelSession(mesh=mesh8, config=cfg)
+        dirty.run(A.expr().multiply(A.expr()))
+        (key, ent), = dirty._result_cache.items_snapshot()
+        tampered = dataclasses.replace(
+            ent, result=BlockMatrix.from_numpy(
+                ent.result.to_numpy() * 1.5 + 0.25, mesh=mesh8))
+        dirty._result_cache.apply_patch(
+            key, key, tampered, cfg.result_cache_max_bytes,
+            cfg.result_cache_max_entries)
+        dirty.run(A.expr().multiply(A.expr()))
+        monkeypatch.setattr(provenance_lib, "_audit_workload",
+                            lambda: dirty)
+        assert provenance_lib.main(args) == 1
+        assert "FAILED" in capsys.readouterr().out
+
+
+class TestZeroOverhead:
+    def test_default_config_builds_no_ledger_objects(self, rng, mesh8,
+                                                     monkeypatch):
+        # the structural-zero contract, poisoned-__init__-enforced:
+        # obs_provenance=0 (the default) must construct ZERO ledger
+        # objects anywhere on the serve path
+        def no_ledgers(self, *a, **kw):
+            raise AssertionError(
+                "ProvenanceLedger constructed with obs_provenance=0")
+
+        def no_records(self, *a, **kw):
+            raise AssertionError(
+                "ProvenanceRecord constructed with obs_provenance=0")
+
+        monkeypatch.setattr(provenance_lib.ProvenanceLedger,
+                            "__init__", no_ledgers)
+        monkeypatch.setattr(provenance_lib.ProvenanceRecord,
+                            "__init__", no_records)
+        sess = MatrelSession(mesh=mesh8, config=MatrelConfig(
+            result_cache_max_bytes=1 << 26))
+        assert sess._prov is None
+        A = _dense(rng, 48, 64, mesh8)
+        B = _dense(rng, 64, 32, mesh8)
+        sess.run_many([A.expr().multiply(B.expr())])
+        sess.run(A.expr().multiply(B.expr()))                # hit
+        sess.run(A.expr().multiply(B.expr()).multiply_scalar(2.0))
+        # no stamps either: entries and leaves stay provenance-free
+        for _, ent in sess._result_cache.items_snapshot():
+            assert ent.provenance is None
+        assert sess.why() == []
+
+
+class TestMV115:
+    """Stamp coherence — static (annotated-tree) and dynamic
+    (ledger-record) halves, both directions each."""
+
+    def test_live_substitution_is_clean(self, rng, mesh8):
+        sess = _session(mesh8)
+        X = _dense(rng, 64, 16, mesh8)
+        gram = X.expr().t().multiply(X.expr())
+        sess.run(gram)
+        B = _dense(rng, 16, 16, mesh8)
+        substituted = sess._rc_substitute(gram.multiply(B.expr()))
+        leaves = [c for c in substituted.children
+                  if c.attrs.get("result_cache")]
+        assert leaves and all(
+            isinstance(c.attrs.get("provenance"), dict)
+            for c in leaves)
+        diags = analysis.verify_plan(_annotated(substituted, mesh8),
+                                     mesh8)
+        assert _mv115(diags) == []
+
+    def _leaf(self, rng, mesh, provenance, result_cache="default"):
+        bm = _dense(rng, 32, 32, mesh)
+        if result_cache == "default":
+            result_cache = {"key_hash": "cafe", "layout": "row",
+                            "dtype": "float32", "deps": []}
+        leaf = E.leaf(bm).with_attrs(provenance=provenance)
+        if result_cache is not None:
+            leaf = leaf.with_attrs(result_cache=result_cache)
+        return leaf
+
+    def _diags(self, rng, mesh, provenance, result_cache="default"):
+        leaf = self._leaf(rng, mesh, provenance, result_cache)
+        B = _dense(rng, 32, 32, mesh)
+        return _mv115(analysis.verify_plan(
+            _annotated(leaf.multiply(B.expr()), mesh), mesh))
+
+    def _stamp(self, **kw):
+        s = {"schema": provenance_lib.SCHEMA_VERSION, "path": "rc_hit",
+             "query_id": "p1", "key_hash": "cafe"}
+        s.update(kw)
+        return s
+
+    def test_coherent_stamp_is_clean(self, rng, mesh8):
+        assert self._diags(rng, mesh8, self._stamp()) == []
+
+    def test_non_dict_stamp_warns(self, rng, mesh8):
+        (d,) = self._diags(rng, mesh8, "p1:rc_hit")
+        assert d.severity == "warning" and "ML015" in d.message
+
+    def test_schema_drift_warns(self, rng, mesh8):
+        (d,) = self._diags(rng, mesh8, self._stamp(schema=99))
+        assert "schema" in d.message
+
+    def test_unknown_path_warns_never_errors(self, rng, mesh8):
+        (d,) = self._diags(rng, mesh8,
+                           self._stamp(path="teleported"))
+        assert d.severity == "warning"
+        assert "unknown serve path 'teleported'" in d.message
+
+    def test_stamp_without_result_cache_warns(self, rng, mesh8):
+        (d,) = self._diags(rng, mesh8, self._stamp(),
+                           result_cache=None)
+        assert "without a result_cache stamp" in d.message
+
+    def test_key_hash_disagreement_warns(self, rng, mesh8):
+        (d,) = self._diags(rng, mesh8,
+                           self._stamp(key_hash="beef"))
+        assert "disagree" in d.message
+
+    def test_ivm_claim_without_delta_stamp_warns(self, rng, mesh8):
+        (d,) = self._diags(rng, mesh8,
+                           self._stamp(path="ivm_patched"))
+        assert "no delta stamp" in d.message
+
+    def test_delta_stamp_without_ivm_claim_warns(self, rng, mesh8):
+        rc = {"key_hash": "cafe", "layout": "row", "dtype": "float32",
+              "deps": [], "delta": {"gen": 3, "rule": "coo"}}
+        (d,) = self._diags(rng, mesh8, self._stamp(), rc)
+        assert "claims path 'rc_hit'" in d.message
+
+    def test_replica_claim_without_fleet_stamp_warns(self, rng, mesh8):
+        (d,) = self._diags(rng, mesh8,
+                           self._stamp(path="fleet_replica"))
+        assert "no fleet stamp" in d.message
+
+    def test_fleet_stamp_without_replica_claim_warns(self, rng, mesh8):
+        rc = {"key_hash": "cafe", "layout": "row", "dtype": "float32",
+              "deps": [], "fleet": {"owner": 0}}
+        (d,) = self._diags(rng, mesh8, self._stamp(), rc)
+        assert "omits the inter-slice hop" in d.message
+
+    def test_verify_ledger_clean_and_off(self, rng, mesh8):
+        sess = _session(mesh8)
+        A = _dense(rng, 32, 32, mesh8)
+        sess.run(A.expr().multiply(A.expr()))
+        sess.run(A.expr().multiply(A.expr()))
+        assert provenance_pass.verify_ledger(sess) == []
+        off = MatrelSession(mesh=mesh8, config=MatrelConfig())
+        assert provenance_pass.verify_ledger(off) == []
+
+    def test_verify_ledger_flags_incoherent_records(self, rng, mesh8):
+        sess = _session(mesh8)
+        A = _dense(rng, 16, 16, mesh8)
+        sess.run(A.expr().t())
+
+        def fake(path, rung=0, **summary):
+            summary.setdefault("schema",
+                               provenance_lib.SCHEMA_VERSION)
+            return provenance_lib.ProvenanceRecord(
+                query_id="px", path=path, key="k", key_hash="beef",
+                sla="f32", rung=rung, err_bound=0.0, ts=0.0,
+                summary=summary)
+
+        # one incoherent record per direction the pass checks
+        bad = [
+            fake("teleported"),
+            fake("execute", schema=99),
+            fake("ivm_patched"),
+            fake("rc_hit", cache={"ivm": {"gen": 2}}),
+            fake("fleet_replica"),
+            fake("degraded"),
+            fake("execute", rung=0, degrade={"rung": 2}),
+            fake("stale"),
+        ]
+        sess._prov._records.extend(bad)
+        diags = provenance_pass.verify_ledger(sess)
+        assert len(diags) == len(bad)
+        assert all(d.code == "MV115" and d.severity == "warning"
+                   for d in diags)
+        # limit bounds the check to the newest N records
+        assert provenance_pass.verify_ledger(sess, limit=1)
+        assert len(provenance_pass.verify_ledger(sess)) == len(bad)

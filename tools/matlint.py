@@ -44,6 +44,12 @@ Rule catalogue (each rule's class docstring is the authority):
          lists) in matrel_tpu/ outside obs/ — timing metrics flow
          through the registry's sketch/histogram API so live and
          offline quantiles share one definition
+  ML014  cross-slice result-cache mutation outside the fleet API
+         (serve/fleet.py) — another slice's cache mutates only
+         through the directory/replication seam
+  ML015  provenance stamp written outside the answer ledger's
+         sanctioned writers (obs/provenance.py) — lineage stores are
+         one seam so MV115 can trust what it cross-checks
 """
 
 from __future__ import annotations
@@ -904,13 +910,88 @@ class FleetSeamRule(Rule):
                     f"directory/replication seam, docs/FLEET.md)")
 
 
+class ProvenanceSeamRule(Rule):
+    """ML015: answer-lineage stamps are written ONLY by the ledger's
+    sanctioned writers in obs/provenance.py (the ML012/ML014 one-seam
+    idiom applied to lineage).
+
+    The answer provenance ledger (docs/OBSERVABILITY.md tier 4) makes
+    ``CacheEntry.provenance`` and the substitution leaf's
+    ``attrs["provenance"]`` the account of where a served answer came
+    from — and MV115 cross-checks that account against the mechanism
+    stamps, while ``why --audit`` replays answers against the bounds
+    it records. Both are only sound if the stamps have exactly one
+    producer: a module hand-writing a provenance dict produces
+    lineage the ledger never witnessed (un-audited, un-renderable,
+    schema-drifting) — precisely the unverifiable-answer class ML012
+    pins for cache payloads. Serve/session modules CALL
+    ``stamp_entry`` / ``stamp_patched`` / ``stamp_leaf``; they never
+    build the stamp themselves. Pinned, in ``matrel_tpu/`` outside
+    ``matrel_tpu/obs/provenance.py``:
+
+    - attribute assignment (plain, augmented, annotated, or del) to a
+      ``.provenance`` field on any object;
+    - a subscript store ``X["provenance"] = ...`` (the attrs-dict
+      route around the attribute check);
+    - a ``provenance=`` keyword in a ``with_attrs(...)`` call (the
+      immutable-expr route).
+
+    Reads are fine everywhere — the ledger exists to be read.
+    """
+
+    id = "ML015"
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("matrel_tpu/")
+                and relpath != "matrel_tpu/obs/provenance.py")
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = node.targets
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and t.attr == "provenance":
+                    yield Finding(
+                        relpath, node.lineno, self.id,
+                        "direct store to a .provenance stamp — "
+                        "lineage is written only by the ledger's "
+                        "stamp_entry/stamp_patched/stamp_leaf "
+                        "(obs/provenance.py), so MV115 and the "
+                        "audit replay can trust it")
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.slice, ast.Constant) \
+                        and t.slice.value == "provenance":
+                    yield Finding(
+                        relpath, node.lineno, self.id,
+                        "subscript store to a ['provenance'] stamp — "
+                        "lineage is written only by the ledger's "
+                        "stamp writers (obs/provenance.py)")
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "with_attrs":
+                for kw in node.keywords:
+                    if kw.arg == "provenance":
+                        yield Finding(
+                            relpath, node.lineno, self.id,
+                            "with_attrs(provenance=...) outside the "
+                            "ledger — thread lineage onto leaves via "
+                            "stamp_leaf (obs/provenance.py)")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
                         SpecKeyedCacheRule(), RawTimingRule(),
                         BroadSwallowRule(), DevicePutRule(),
                         KernelSeamRule(), JitSeamRule(),
                         UnboundedQueueRule(), ResultCacheSeamRule(),
-                        TimingAccumulationRule(), FleetSeamRule())
+                        TimingAccumulationRule(), FleetSeamRule(),
+                        ProvenanceSeamRule())
 
 
 def _suppressed_codes(line: str) -> set:

@@ -97,6 +97,8 @@ log "--- flight_drill (obs tier 2: flight recorder + chrome trace + drift smoke,
 python tools/flight_drill.py
 log "--- chaos_drill (resilience: seeded fault schedule over a mixed serve stream, staged this round)"
 python tools/chaos_drill.py
+log "--- provenance_drill (obs tier 4: answer lineage on every serve path + full audit replay, staged this round)"
+python tools/provenance_drill.py
 log "--- traffic (open-loop overload harness: weighted tenants, brownout, typed shed, staged this round)"
 python tools/traffic.py
 log "--- traffic --slo (SLO burn-rate alert fire/clear proof + live metrics endpoint, staged this round)"
