@@ -1067,6 +1067,115 @@ def measure_serve() -> dict:
     return out
 
 
+def measure_cse() -> dict:
+    """Shared-interior batch row (the multi-query-optimization
+    acceptance number, serve/mqo.py; docs/SERVING.md): a batch of
+    ``MATREL_CSE_VARIANTS`` dashboard variants over ONE Gram interior
+    — Xᵀ·X scaled per variant, the identical-subplan shape dashboard
+    traffic produces — admitted through ``session.run_many`` with
+    ``cse_enable`` off vs on, FRESH session each trial so the measured
+    wall is first contact (optimize + trace + execute, nothing
+    amortized by the plan or result caches). CSE-on hoists the Gram
+    once and feeds every variant the computed leaf; the off/on median
+    ratio is the row's speedup.
+
+    A steady-state coda replays a structurally-identical batch over a
+    REBOUND leaf (a different X) on the warm CSE session: the
+    plan-template path must answer it by rebinding leaves into the
+    compiled MultiPlan (``mqo_info`` template-hit delta >= the batch),
+    paying zero optimize/trace — the event-verified half lives in
+    tests/test_cse.py. Interval methodology matches the bench
+    discipline: median over ``MATREL_CSE_MEAS`` fresh-session trials
+    with the min/max half-width; exactness is asserted by comparing
+    the two paths' answers bit-for-bit (zero wrong answers is part of
+    the row, not a separate check)."""
+    import jax  # noqa: F401  (backend registration)
+    from matrel_tpu.config import MatrelConfig, set_default_config
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.session import MatrelSession
+
+    set_default_config(MatrelConfig(obs_level="off"))
+    mesh = mesh_lib.make_mesh()
+    n = _env_int("MATREL_CSE_N", 2048)
+    cols = _env_int("MATREL_CSE_COLS", 512)
+    k = _env_int("MATREL_CSE_VARIANTS", 8)
+    meas = _env_int("MATREL_CSE_MEAS", 3)
+
+    X = BlockMatrix.random((n, cols), mesh=mesh, seed=0)
+    X2 = BlockMatrix.random((n, cols), mesh=mesh, seed=1)
+
+    def batch(M):
+        # shared interior: a cubic polynomial over the Gram (the
+        # graph-analytics A³ shape) — 4 matmuls every variant repays
+        # without CSE, one hoisted compute-once node with it
+        g = M.expr().t().multiply(M.expr())
+        h = g.multiply(g).multiply(g)
+        return [h.multiply_scalar(1.0 + 0.25 * i) for i in range(k)]
+
+    def first_contact(cse_on: bool):
+        ts, last = [], None
+        sess = None
+        for _ in range(meas):
+            sess = MatrelSession(mesh=mesh, config=MatrelConfig(
+                obs_level="off", cse_enable=cse_on))
+            qs = batch(X)
+            t0 = time.perf_counter()
+            outs = sess.run_many(qs)
+            for o in outs:
+                o.data.block_until_ready()
+            ts.append(time.perf_counter() - t0)
+            last = outs
+        ts.sort()
+        med = ts[len(ts) // 2]
+        row = {"median_ms": round(med * 1e3, 3),
+               "half_width_ms": round((ts[-1] - ts[0]) / 2 * 1e3, 3),
+               "trials": meas}
+        return row, med, last, sess
+
+    off_row, off_med, off_outs, _ = first_contact(False)
+    on_row, on_med, on_outs, on_sess = first_contact(True)
+
+    # zero wrong answers IS the row: both paths bit-identical
+    diff = max(float(np.abs(a.to_numpy().astype(np.float64)
+                            - b.to_numpy().astype(np.float64)).max())
+               for a, b in zip(off_outs, on_outs))
+    info = on_sess.mqo_info()
+
+    # steady state: structurally identical batch, REBOUND leaf — the
+    # template path answers by rebinding, zero optimize/trace
+    before = info["template_hits"]
+    qs2 = batch(X2)
+    t0 = time.perf_counter()
+    outs2 = on_sess.run_many(qs2)
+    for o in outs2:
+        o.data.block_until_ready()
+    steady_ms = (time.perf_counter() - t0) * 1e3
+    info2 = on_sess.mqo_info()
+    ref = X2.to_numpy().astype(np.float64)
+    g2 = ref.T @ ref
+    h2 = g2 @ g2 @ g2
+    scale = float(np.abs(h2).max())
+    exact2 = all(
+        float(np.abs(o.to_numpy().astype(np.float64)
+                     - h2 * (1.0 + 0.25 * i)).max()) / scale < 1e-4
+        for i, o in enumerate(outs2))
+
+    return {"n": n, "cols": cols, "variants": k,
+            "configs": {"cse_off": off_row, "cse_on": on_row},
+            "cse_off_ms": off_row["median_ms"],
+            "cse_on_ms": on_row["median_ms"],
+            "speedup": round(off_med / on_med, 2) if on_med else None,
+            "exact": diff == 0.0,
+            "hoisted_per_batch": int(info["cse_hoisted"]
+                                     / max(info["cse_batches"], 1)),
+            "steady": {
+                "rebind_ms": round(steady_ms, 3),
+                "template_hits_delta": info2["template_hits"] - before,
+                "templates": info2["templates"],
+                "exact": bool(exact2)}}
+
+
 def measure_reshard() -> dict:
     """Flagship-shape src→dst reshard sweep (the reshard-planner row,
     ROADMAP item 2): for each layout move, time the PLANNED staged
@@ -1578,6 +1687,24 @@ def main_serve() -> None:
     print(json.dumps(record))
 
 
+def main_cse() -> None:
+    """Wedge-safe shared-interior CSE/template row capture
+    (tools/tpu_batch.sh step): probe, then the measurement child under
+    a hard timeout; one parseable JSON line either way, rc 0 — same
+    contract as the headline metric."""
+    ok, payload = _run_child("probe", PROBE_TIMEOUT_S)
+    if ok:
+        ok, payload = _run_child("cse", MEASURE_TIMEOUT_S)
+    record = {"metric": "cse_shared_interior_batch"}
+    if ok and isinstance(payload, dict):
+        record.update(payload)
+        _emit_bench_event(dict(record))
+    else:
+        record.update({"value": None, "error": str(payload)[:500]})
+        _emit_bench_error(record["metric"], str(payload))
+    print(json.dumps(record))
+
+
 def main_precision() -> None:
     """Wedge-safe precision-tier row capture (tools/tpu_batch.sh step):
     probe, then the measurement child under a hard timeout; one
@@ -1713,6 +1840,8 @@ if __name__ == "__main__":
         print(json.dumps(measure_spgemm()))
     elif "--_serve" in sys.argv:
         print(json.dumps(measure_serve()))
+    elif "--_cse" in sys.argv:
+        print(json.dumps(measure_cse()))
     elif "--_precision" in sys.argv:
         print(json.dumps(measure_precision()))
     elif "--_reshard" in sys.argv:
@@ -1739,6 +1868,8 @@ if __name__ == "__main__":
         main_spgemm()
     elif "--serve" in sys.argv:
         main_serve()
+    elif "--cse" in sys.argv:
+        main_cse()
     elif "--precision" in sys.argv:
         main_precision()
     elif "--cpu-rows" in sys.argv:

@@ -87,6 +87,16 @@ def bench_serve(mesh, cfg):
     return {"metric": "serve_repeated_traffic_qps", **payload}
 
 
+def bench_cse(mesh, cfg):
+    """Shared-interior batch + plan-template row (serve/mqo.py;
+    docs/SERVING.md): k dashboard variants over one Gram-polynomial
+    interior, cse_enable off vs on at first contact, plus the
+    rebound-leaf template replay (see bench.measure_cse)."""
+    import bench
+    payload = bench.measure_cse()
+    return {"metric": "cse_shared_interior_batch", **payload}
+
+
 def bench_traffic(mesh, cfg):
     """Open-loop overload traffic harness (tools/traffic.py;
     docs/OVERLOAD.md): seeded Poisson arrivals at 2x measured
@@ -449,11 +459,11 @@ def main():
     dry = bool(os.environ.get("MATREL_DRY"))
     dry_rows = (bench_dense_4k, bench_chain, bench_spgemm,
                 bench_sparse_kernels, bench_fusion, bench_serve,
-                bench_fleet, bench_stream, bench_precision,
+                bench_cse, bench_fleet, bench_stream, bench_precision,
                 bench_reshard, bench_traffic)
     for fn in (bench_dense_4k, bench_chain, bench_linreg, bench_spmm,
                bench_spgemm, bench_sparse_kernels, bench_fusion,
-               bench_serve, bench_fleet, bench_stream,
+               bench_serve, bench_cse, bench_fleet, bench_stream,
                bench_precision, bench_reshard, bench_traffic,
                bench_pagerank, bench_pagerank_10x, bench_cg,
                bench_eigen, bench_triangles, bench_north_star):

@@ -56,6 +56,11 @@ codes documented in :mod:`matrel_tpu.analysis.diagnostics`):
                     backed by fleet; unknown paths/schemas warn); the
                     DYNAMIC half (provenance_pass.verify_ledger)
                     audits a live ledger's records — docs/OBSERVABILITY.md
+  cse        MV116  cross-query CSE stamps agree with the hoisted
+                    result they ride (layout/dtype, uses >= 2); the
+                    DYNAMIC half (cse_pass.verify_cse_executions)
+                    proves recent CSE-substituted batch roots equal
+                    their unshared executions — docs/SERVING.md
 """
 
 from __future__ import annotations
@@ -64,6 +69,7 @@ import logging
 from typing import List, Optional
 
 from matrel_tpu.analysis.brownout_pass import check_brownout_stamps
+from matrel_tpu.analysis.cse_pass import check_cse_stamps
 from matrel_tpu.analysis.delta_pass import check_delta_stamps
 from matrel_tpu.analysis.diagnostics import (  # noqa: F401 (re-export)
     Diagnostic, VerificationError)
@@ -103,6 +109,7 @@ PASSES = (
     ("delta", check_delta_stamps),
     ("placement", check_placement_stamps),
     ("provenance", check_provenance_stamps),
+    ("cse", check_cse_stamps),
 )
 
 

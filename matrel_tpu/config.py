@@ -357,6 +357,37 @@ class MatrelConfig:
         (est saved dispatches / HBM bytes), and MV111 verifies every
         stamp. The degradation ladder's rung 3 forces this off so a
         miscompiling fused region cannot survive retry.
+      cse_enable: admission-time multi-query optimization
+        (matrel_tpu/serve/mqo.py; docs/SERVING.md). Off (the default)
+        is bit-identical to the historical serve plane: no hoist or
+        template object is ever constructed (test-enforced), every
+        cache key keeps its historical format, plan snapshots
+        unchanged. On: (1) cross-query CSE — a MultiPlan batch
+        (``run_many`` / the admission worker's coalesced batches)
+        detects interior subplans shared across its queries via the
+        structural span keys, computes each exactly once, and feeds
+        every consumer the result as an already-laid-out leaf (the
+        result-cache interior-hit crediting, so ``infer_layout`` /
+        ``comm_cost`` price the reuse); hoists happen only at fused-
+        region boundaries (non-fusable kinds), so per-query epilogue
+        chains keep fusing instead of being split. (2) plan-template
+        reuse — queries structurally identical modulo dense-leaf
+        bindings hit a template cache keyed on the leaf-ABSTRACTED
+        structural key and rebind their leaves into the already-
+        compiled program, paying zero optimize/trace (the IVM
+        ``ivm_role`` rebinding seam generalized to serve traffic);
+        the ``degr:``/``axisw:``/``prec:`` key-prefix idiom keeps
+        degrade/topology/SLA isolation intact. MV116 verifies the
+        stamps; shared results flow into the result cache with
+        transitive dep sets so rebind invalidation cascades.
+      cse_min_uses: occurrence threshold for hoisting one shared
+        interior (>= 2: a "shared" node used once is just the query
+        itself). Occurrences are counted across the whole batch,
+        within-query duplicates included.
+      cse_template_max: entry bound on the plan-template cache (LRU
+        past it — a template is an affinity hint over the plan cache,
+        never a correctness surface; eviction only costs a
+        recompile).
       delta_patch_mode: how ``session.register_delta`` maintains
         dependent result-cache entries (serve/ivm.py; docs/IVM.md).
         "auto" (the default): patch when a delta rule applies AND the
@@ -510,6 +541,9 @@ class MatrelConfig:
     precision_enable_bf16: bool = True
     precision_enable_int: bool = True
     fusion_enable: bool = False
+    cse_enable: bool = False
+    cse_min_uses: int = 2
+    cse_template_max: int = 64
     delta_patch_mode: str = "auto"
     delta_rank_max: int = 512
     fleet_slices: int = 0
@@ -702,6 +736,20 @@ class MatrelConfig:
             raise ValueError(
                 f"delta_rank_max must be >= 1, "
                 f"got {self.delta_rank_max!r}")
+        # multi-query-optimization knobs (docs/SERVING.md): a
+        # min_uses of 1 would hoist EVERY interior of every batch —
+        # pure overhead read as "more sharing"; a zero template bound
+        # would evict each template at insert and turn steady-state
+        # rebind traffic into a permanent recompile while the
+        # operator believes templates are in force
+        if self.cse_min_uses < 2:
+            raise ValueError(
+                f"cse_min_uses must be >= 2 (an interior used once "
+                f"is not shared), got {self.cse_min_uses!r}")
+        if self.cse_template_max < 1:
+            raise ValueError(
+                f"cse_template_max must be >= 1, "
+                f"got {self.cse_template_max!r}")
         # same hazard for the kernel forcing knob: a typo'd override
         # would surface only as a mid-traffic ValueError on the first
         # dispatching query — or never, while the operator believes

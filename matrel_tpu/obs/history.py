@@ -165,6 +165,7 @@ def summarize(events: List[dict]) -> dict:
         "alerts": _summarize_alerts(events),
         "fleet": _summarize_fleet(events),
         "serve": _summarize_serve(events),
+        "cse": _summarize_cse(events),
         "resilience": _summarize_resilience(events, len(qs)),
         "overload": _summarize_overload(events),
         "execute_ms_total": round(sum(exec_ms), 3),
@@ -282,6 +283,29 @@ def _summarize_serve(events: List[dict]) -> dict:
         "queue_wait_p50_ms": _pctile(waits, 0.50),
         "queue_wait_p95_ms": _pctile(waits, 0.95),
         "result_cache": rc,
+    }
+
+
+def _summarize_cse(events: List[dict]) -> Optional[dict]:
+    """Roll up the multi-query-optimization deltas (round 17:
+    serve/mqo.py; docs/SERVING.md) — ``cse_hoisted``/``template_hits``
+    ride each serve record only when ``config.cse_enable`` is on, and
+    query events stamped ``cache="template_hit"`` prove the zero
+    optimize/trace steady state. None when no record carries either
+    (CSE off, or a pre-round-17 log), so historical summaries render
+    byte-identically."""
+    sv = [e for e in events if e.get("kind") == "serve"
+          and ("cse_hoisted" in e or "template_hits" in e)]
+    tpl_q = sum(1 for e in events if e.get("kind") == "query"
+                and e.get("cache") == "template_hit")
+    if not sv and not tpl_q:
+        return None
+    return {
+        "batches": len(sv),
+        "hoisted": sum(int(e.get("cse_hoisted") or 0) for e in sv),
+        "template_hits": sum(int(e.get("template_hits") or 0)
+                             for e in sv),
+        "template_hit_queries": tpl_q,
     }
 
 
@@ -655,6 +679,13 @@ def render_summary(events: List[dict]) -> str:
                f"invalidated: "
                f"{sv['result_cache'].get('invalidated', 0)})"
                if sv.get("result_cache") else ""))
+    cse = s.get("cse")
+    if cse:
+        lines.append(
+            f"mqo: {cse['hoisted']} interior(s) hoisted over "
+            f"{cse['batches']} batch(es), {cse['template_hits']} "
+            f"template rebind(s), {cse['template_hit_queries']} "
+            f"zero-optimize quer(ies)")
     if s["strategies"]:
         lines.append("")
         header = (f"{'strategy':<12}{'matmuls':>8}{'GFLOPs':>10}"

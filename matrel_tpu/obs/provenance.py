@@ -55,9 +55,13 @@ from typing import Iterable, List, Optional
 SCHEMA_VERSION = 1
 
 #: The serve-path vocabulary — every answer is exactly one of these.
-#: MV115 warns on stamps claiming a path outside it.
+#: MV115 warns on stamps claiming a path outside it. ``cse_hoist`` is
+#: a batch's compute-once shared interior (serve/mqo.py — the producer
+#: side); ``cse_interior`` a consumer answer that fed on one or more
+#: hoisted results (the rc_interior refinement for the CSE plane).
 PATHS = ("execute", "rc_hit", "rc_interior", "ivm_patched",
-         "fleet_directory", "fleet_replica", "stale", "degraded")
+         "fleet_directory", "fleet_replica", "stale", "degraded",
+         "cse_hoist", "cse_interior")
 
 #: Relative floor for audit replay — MV113's: a zero composed bound
 #: means EXACT; a nonzero bound is asserted as-is but never below one
@@ -181,7 +185,12 @@ class ProvenanceLedger:
         interior = _interior_stamps(executed) if executed is not None \
             else []
         if path == "execute" and interior:
-            path = "rc_interior"
+            # cse-stamped leaves refine to the CSE plane's path; mixed
+            # cse+rc ancestry stays honest — the leaves list carries
+            # both kinds of stamps either way
+            path = ("cse_interior"
+                    if any(s.get("cse") for s in interior)
+                    else "rc_interior")
         if path == "execute" and rung > 0:
             path = "degraded"
         err_bound = 0.0
@@ -280,7 +289,9 @@ def _entry_stamp(ent) -> dict:
 def _interior_stamps(executed) -> List[dict]:
     """Substitution-leaf ancestry of the tree that actually ran: one
     stamp per ``result_cache`` leaf (the MV107 stamps, which already
-    carry delta/fleet provenance when the consumed entry did)."""
+    carry delta/fleet provenance when the consumed entry did) and one
+    per ``cse`` leaf (a batch-shared interior hoisted by serve/mqo.py
+    — marked ``"cse": True`` so readers can tell the planes apart)."""
     out: List[dict] = []
     seen: set = set()
 
@@ -295,6 +306,11 @@ def _interior_stamps(executed) -> List[dict]:
             if isinstance(pv, dict):
                 stamp["provenance"] = {
                     k: v for k, v in pv.items() if k != "chain"}
+            out.append(stamp)
+        cse = n.attrs.get("cse")
+        if n.kind == "leaf" and isinstance(cse, dict):
+            stamp = {k: v for k, v in cse.items() if k != "deps"}
+            stamp["cse"] = True
             out.append(stamp)
         for c in n.children:
             walk(c)

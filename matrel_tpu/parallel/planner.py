@@ -1547,6 +1547,13 @@ def matmul_decisions(root: MatExpr, mesh: Mesh,
                   for c in n.children]
         if any(rc_ops):
             rec["rc_operands"] = rc_ops
+        # cross-query CSE reuse (serve/mqo.py): an operand fed by a
+        # batch-shared hoisted interior gets the same layout credit as
+        # a result-cache leaf — the decision record says which side(s)
+        cse_ops = [bool(c.kind == "leaf" and c.attrs.get("cse"))
+                   for c in n.children]
+        if any(cse_ops):
+            rec["cse_operands"] = cse_ops
         if _spgemm_matmul(n, cfg):
             # the S×S tile-intersection dispatch: record the estimated
             # FLOPs/HBM bytes it avoids vs the densify fallback — the

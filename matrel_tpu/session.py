@@ -41,6 +41,7 @@ from matrel_tpu.resilience import errors as rerrors
 from matrel_tpu.resilience import faults as faults_lib
 from matrel_tpu.resilience import retry as retry_lib
 from matrel_tpu.resilience.retry import RetryPolicy
+from matrel_tpu.serve import mqo as mqo_lib
 from matrel_tpu.serve.result_cache import (CacheEntry, ResultCache,
                                            result_nbytes)
 
@@ -81,6 +82,11 @@ class MatrelSession:
         self._result_cache = ResultCache()
         self._serve = None
         self._compile_lock = threading.RLock()
+        # multi-query optimization (serve/mqo.py; docs/SERVING.md):
+        # cross-query CSE + plan templates — None for the default
+        # config (cse_enable off: the structural zero-object contract,
+        # poisoned-init test-enforced; mqo._CONSTRUCTED stays 0)
+        self._mqo = None
         # obs tier 2 (obs/trace.py): the flight-recorder ring is
         # independent of obs_level (always-cheap post-mortem trail);
         # the tracer exists iff ANY span consumer does — with neither,
@@ -626,8 +632,16 @@ class MatrelSession:
                 rc = n.attrs.get("result_cache")
                 if rc is not None:
                     deps.update(rc["deps"])
-                else:
-                    deps.add(id(n.attrs["matrix"]))
+                    return
+                cse = n.attrs.get("cse")
+                if cse is not None:
+                    # a hoisted shared interior carries its own
+                    # transitive dep set (serve/mqo.py) — consumers
+                    # fold it in so rebinding any source matrix under
+                    # the hoist cascades into every consumer's entry
+                    deps.update(cse["deps"])
+                    return
+                deps.add(id(n.attrs["matrix"]))
                 return
             if n.kind in ("sparse_leaf", "coo_leaf"):
                 deps.add(id(n.attrs["matrix"]))
@@ -692,6 +706,269 @@ class MatrelSession:
         self._result_cache.put(key, ent,
                                self.config.result_cache_max_bytes,
                                self.config.result_cache_max_entries)
+
+    # -- multi-query optimization (serve/mqo.py; docs/SERVING.md) -----------
+
+    def _cse_on(self) -> bool:
+        return bool(self.config.cse_enable)
+
+    def _mqo_state(self) -> "mqo_lib.MqoState":
+        if self._mqo is None:
+            self._mqo = mqo_lib.MqoState(self.config)
+        return self._mqo
+
+    def mqo_info(self) -> dict:
+        """``plan_cache_info``-style surface for the multi-query
+        optimizer: template count, lifetime template hits/inserts,
+        hoisted-interior counts. All zeros (and no state constructed)
+        with ``cse_enable`` off."""
+        if self._mqo is None:
+            return {"templates": 0, "template_hits": 0,
+                    "template_inserts": 0, "cse_hoisted": 0,
+                    "cse_batches": 0}
+        return self._mqo.info()
+
+    def _tpl_prefix(self, sla: str, rung: int) -> str:
+        """Template keys compose the SAME isolation prefixes as
+        concrete plan keys (``degr:``/``axisw:``/``prec:`` — the
+        _compile_entry idiom): a degraded or fast-SLA template can
+        never serve a pristine exact query, because the probes never
+        share a key namespace."""
+        return (degrade_lib.key_prefix(rung) + self._axisw_prefix()
+                + _prec_prefix(sla))
+
+    def _template_probe(self, e: MatExpr, sla: str, rung: int):
+        """(plan, concrete key, bindings) when a cached template can
+        serve this query by REBINDING its dense leaves — None when the
+        concrete plan-cache entry exists (that path owns its hit-rate
+        accounting and pays no rebind), the tree is
+        template-ineligible, no template matches, or sound bindings
+        cannot be formed (a shared template leaf facing two distinct
+        matrices — miss, never a guess)."""
+        prefix = self._tpl_prefix(sla, rung)
+        key, _pins = _plan_key(e)
+        ckey = prefix + key
+        with self._compile_lock:
+            if ckey in self._plan_cache:
+                return None
+            try:
+                akey, _tp, leaves = mqo_lib.template_key(e)
+            except KeyError:
+                return None
+            st = self._mqo_state()
+            ent = st.get_template(prefix + akey)
+            if ent is None or not mqo_lib.rebindable(ent):
+                return None
+            (ak0, uids), = ent.slots
+            if ak0 != akey or len(uids) != len(leaves):
+                return None
+            bindings: dict = {}
+            for u, l in zip(uids, leaves):
+                m = l.attrs["matrix"]
+                prev = bindings.get(u)
+                if prev is not None and prev is not m:
+                    return None
+                bindings[u] = m
+            st.template_hits += 1
+            return ent.plan, ckey, bindings
+
+    def _template_insert(self, e: MatExpr, plan, sla: str,
+                         rung: int) -> None:
+        """Record a freshly compiled single plan as a rebindable
+        template. Guarded by :func:`mqo_lib.rebindable`: when the
+        optimizer dropped or re-created a dense leaf (fresh uid), the
+        recorded uids and the program's real binding order disagree —
+        a rebind would silently feed stale data, so no template is
+        stored (the only cost is no speedup)."""
+        try:
+            akey, tp, leaves = mqo_lib.template_key(e)
+        except KeyError:
+            return
+        ent = mqo_lib.TemplateEntry(
+            plan=plan, slots=((akey, tuple(l.uid for l in leaves)),),
+            pins=tuple(tp))
+        if not mqo_lib.rebindable(ent):
+            return
+        with self._compile_lock:
+            st = self._mqo_state()
+            st.put_template(self._tpl_prefix(sla, rung) + akey, ent)
+            st.template_inserts += 1
+
+    def _template_probe_multi(self, roots: List[MatExpr], sla: str,
+                              rung: int):
+        """(plan, per-root concrete keys, pos, bindings) when a cached
+        MultiPlan template matches this batch modulo dense-leaf
+        bindings — the :meth:`_template_probe` twin. Roots pair to
+        template slots by ABSTRACT key (structurally identical roots
+        are interchangeable programs — any assignment within an
+        abstract-key group is sound as long as ``pos`` routes each
+        concrete root to its assigned slot's output)."""
+        prefix = self._tpl_prefix(sla, rung)
+        keyed = []
+        for e in roots:
+            k, _p = _plan_key(e)
+            keyed.append(k)
+        uniq: "OrderedDict[str, MatExpr]" = OrderedDict()
+        for k, e in zip(keyed, roots):
+            uniq.setdefault(k, e)
+        skeys = sorted(uniq)
+        mkey = "multi:" + prefix + "||".join(skeys)
+        with self._compile_lock:
+            if mkey in self._plan_cache:
+                return None
+            try:
+                ab = {}
+                for k in skeys:
+                    ak, _tp, lv = mqo_lib.template_key(uniq[k])
+                    ab[k] = (ak, lv)
+            except KeyError:
+                return None
+            st = self._mqo_state()
+            ent = st.get_template(
+                "multi:" + prefix
+                + "||".join(sorted(ak for ak, _lv in ab.values())))
+            if ent is None or not mqo_lib.rebindable(ent):
+                return None
+            slot_pool: dict = {}
+            for s, (ak, _uids) in enumerate(ent.slots):
+                slot_pool.setdefault(ak, []).append(s)
+            pos: dict = {}
+            bindings: dict = {}
+            for k in skeys:
+                ak, lv = ab[k]
+                pool = slot_pool.get(ak)
+                if not pool:
+                    return None
+                s = pool.pop(0)
+                uids = ent.slots[s][1]
+                if len(uids) != len(lv):
+                    return None
+                for u, l in zip(uids, lv):
+                    m = l.attrs["matrix"]
+                    prev = bindings.get(u)
+                    if prev is not None and prev is not m:
+                        return None
+                    bindings[u] = m
+                pos[k] = s
+            if any(slot_pool.values()):
+                return None     # template has roots this batch lacks
+            st.template_hits += len(roots)
+            return ent.plan, keyed, pos, bindings
+
+    def _template_insert_multi(self, plan, sla: str,
+                               rung: int) -> None:
+        """Record a freshly compiled MultiPlan as a rebindable
+        template. The plan's pinned uniq roots (``_cache_pin``) ARE
+        plan-root order, so slot order matches the program's output
+        order by construction."""
+        roots = plan._cache_pin[0]
+        try:
+            slots = []
+            pins: list = []
+            for e in roots:
+                ak, tp, lv = mqo_lib.template_key(e)
+                slots.append((ak, tuple(l.uid for l in lv)))
+                pins.extend(tp)
+        except KeyError:
+            return
+        ent = mqo_lib.TemplateEntry(plan=plan, slots=tuple(slots),
+                                    pins=tuple(pins))
+        if not mqo_lib.rebindable(ent):
+            return
+        with self._compile_lock:
+            st = self._mqo_state()
+            st.put_template(
+                "multi:" + self._tpl_prefix(sla, rung)
+                + "||".join(sorted(ak for ak, _u in slots)), ent)
+            st.template_inserts += 1
+
+    def _cse_hoist_batch(self, pend: list, sla: str, rung: int,
+                         rc: bool) -> Tuple[list, int]:
+        """Hoist the shared interiors of one pending batch into a
+        compute-once MultiPlan, then substitute each result into its
+        consumers as an already-laid-out ``cse``-stamped leaf (the
+        result-cache interior-hit shape — ``infer_layout``/``comm_cost``
+        credit the reuse, ``matmul_decisions`` marks ``cse_operands``).
+        With the result cache on the hoisted results ALSO insert under
+        their interior structural keys, so cross-time reuse, fleet
+        replication and the provenance ledger ride the existing paths
+        — and rebinding any source matrix under a hoist invalidates
+        every consumer entry through the transitive dep sets. Returns
+        (substituted pend, hoist count)."""
+        from matrel_tpu.ir import expr as expr_mod
+        from matrel_tpu.parallel import planner
+        entries = []
+        for _i, e in pend:
+            parts, _pins, spans = _plan_key_spans(e)
+            entries.append((e, parts, spans))
+        hoists = mqo_lib.choose_hoists(entries,
+                                       self.config.cse_min_uses)
+        if not hoists:
+            return pend, 0
+        st = self._mqo_state()
+        # the hoisted interiors are their own micro-batch: one
+        # MultiPlan (plan-cache AND template participation — a
+        # steady-state dashboard batch rebinding fresh leaves
+        # recompiles nothing at all), one dispatch, one fusion domain
+        with trace_lib.span("cse.hoist", shared=len(hoists)):
+            hexprs = [h.expr for h in hoists]
+            bindings = None
+            tpl = self._template_probe_multi(hexprs, sla, rung)
+            if tpl is not None:
+                plan, hkeys, pos, bindings = tpl
+            else:
+                plan, p_hit, hkeys = self._compile_multi_entry(
+                    hexprs, sla=sla, rung=rung)
+                pos = {k: j for j, k in enumerate(plan._root_keys)}
+                if not p_hit:
+                    self._template_insert_multi(plan, sla, rung)
+            faults_lib.check("execute", self.config)
+            outs = self._arbitrated_run(plan, bindings=bindings)
+        rc_prefix = self._rc_key_prefix(sla)
+        leaf_of: dict = {}
+        for h, hk in zip(hoists, hkeys):
+            out = outs[pos[hk]]
+            full = rc_prefix + h.key
+            stamp = {
+                "key_hash": hashlib.sha1(
+                    full.encode()).hexdigest()[:16],
+                "layout": planner._layout_of(expr_mod.leaf(out),
+                                             self.mesh),
+                "dtype": str(np.dtype(out.dtype)),
+                "deps": sorted(self._rc_deps(h.expr)),
+                "uses": h.uses,
+            }
+            node = expr_mod.leaf(out).with_attrs(cse=stamp)
+            summary = None
+            if self._prov is not None:
+                summary = self._prov_capture(
+                    "cse_hoist", full, sla, rung=rung, expr=h.expr,
+                    result=out, executed=h.expr, plan=plan,
+                    strategies=executor_lib.multiplan_root_decisions(
+                        plan)[pos[hk]])
+            if rc:
+                # the interior key is EXACTLY what a later query's
+                # _rc_substitute probe computes for a matching subtree
+                # (the spans contract), so the hoisted result serves
+                # cross-time interior hits too
+                _k2, p2 = _plan_key(h.expr)
+                self._rc_insert(full, p2, h.expr, out, orig=h.expr,
+                                prec=_prec_prefix(sla), plan=plan,
+                                prov=summary)
+            for u in h.uids:
+                leaf_of[u] = node
+        new_pend = []
+        for (i, e), _entry in zip(pend, entries):
+            se = mqo_lib.substitute(e, leaf_of)
+            if se is not e:
+                # MV116's dynamic-verify feed: (original, substituted)
+                # — re-executing both fresh proves substituted ≡
+                # unshared over real traffic
+                st.remember(e, se)
+            new_pend.append((i, se))
+        st.cse_hoisted += len(hoists)
+        st.cse_batches += 1
+        return new_pend, len(hoists)
 
     # -- observability (obs/ — the SparkListener analogue) ------------------
 
@@ -840,7 +1117,8 @@ class MatrelSession:
                           execute_ms: float, first_execution: bool,
                           out: BlockMatrix, matmuls=None,
                           rule_hits=None, batch=None,
-                          tenant: Optional[str] = None) -> None:
+                          tenant: Optional[str] = None,
+                          cache_label: Optional[str] = None) -> None:
         """One event-log record + metrics-registry updates per query run.
         Assembled entirely OUTSIDE jitted code, from data the compile
         path already produced (plan.meta) — the only device sync the obs
@@ -852,7 +1130,15 @@ class MatrelSession:
         root only so history's roll-up never double-counts a compile.
         ``batch`` tags records produced by one micro-batched admission
         (``{"size": N, "index": i}``; execute_ms is then the batch
-        wall amortised per root)."""
+        wall amortised per root).
+
+        ``cache_label`` overrides the hit/miss vocabulary — a
+        plan-template hit (serve/mqo.py) records ``"template_hit"``
+        with optimize/trace FORCED to 0.0: unlike a plan-cache hit
+        (whose record describes the plan that ran), the template
+        contract is that steady-state traffic pays ZERO optimize/trace
+        this query, and the event is the proof the acceptance test
+        reads."""
         from matrel_tpu.obs.metrics import REGISTRY
         meta = plan.meta or {}
         if matmuls is None:
@@ -864,9 +1150,11 @@ class MatrelSession:
             "source_hash": sql_hash
             or hashlib.sha1(key.encode()).hexdigest()[:16],
             "root_kind": e.kind,
-            "cache": "hit" if hit else "miss",
-            "optimize_ms": meta.get("optimize_ms"),
-            "trace_ms": meta.get("trace_ms"),
+            "cache": cache_label or ("hit" if hit else "miss"),
+            "optimize_ms": (0.0 if cache_label == "template_hit"
+                            else meta.get("optimize_ms")),
+            "trace_ms": (0.0 if cache_label == "template_hit"
+                         else meta.get("trace_ms")),
             # compile-scoped: a cache hit ran no rewrite rules, so hit
             # records carry {} and history's roll-up counts real
             # optimizer work (optimize_ms/trace_ms DO repeat on hits —
@@ -904,6 +1192,8 @@ class MatrelSession:
         REGISTRY.counter("query.count").inc()
         REGISTRY.counter("plan_cache.hit" if hit
                          else "plan_cache.miss").inc()
+        if cache_label == "template_hit":
+            REGISTRY.counter("mqo.template_hit").inc()
         REGISTRY.gauge("plan_cache.plans").set(len(self._plan_cache))
         REGISTRY.gauge("plan_cache.hoisted_bytes").set(
             self._plan_cache_bytes)
@@ -1062,7 +1352,7 @@ class MatrelSession:
         except Exception:
             log.warning("obs: overload event dropped", exc_info=True)
 
-    def _arbitrated_run(self, plan):
+    def _arbitrated_run(self, plan, bindings=None):
         """Dispatch one compiled program under the fleet's execution
         arbitration (see ``_exec_lock``): dispatch-to-COMPLETION is
         serialized across the sessions sharing the lock, because an
@@ -1071,11 +1361,12 @@ class MatrelSession:
         exists to prevent. Cache hits, planning and admission never
         come here, so the fleet's host-side parallelism survives;
         only device programs serialize. Without a lock (every
-        non-fleet session) this IS ``plan.run()``."""
+        non-fleet session) this IS ``plan.run()``. ``bindings`` rebinds
+        dense leaves by uid (plan-template hits — serve/mqo.py)."""
         if self._exec_lock is None:
-            return plan.run()
+            return plan.run(bindings=bindings)
         with self._exec_lock:
-            out = plan.run()
+            out = plan.run(bindings=bindings)
             for o in (out if isinstance(out, (list, tuple))
                       else (out,)):
                 o.data.block_until_ready()
@@ -1116,21 +1407,27 @@ class MatrelSession:
             log.warning("obs: fleet event dropped", exc_info=True)
 
     def _run_observed(self, e: MatExpr, plan, hit: bool, key: str,
-                      tenant: Optional[str] = None) -> BlockMatrix:
+                      tenant: Optional[str] = None, bindings=None,
+                      cache_label: Optional[str] = None) -> BlockMatrix:
         """Execute one compiled plan with the obs timing/emission
-        wrapper (the obs-on half of compute())."""
+        wrapper (the obs-on half of compute()). ``bindings``/
+        ``cache_label`` are the plan-template hit channel
+        (serve/mqo.py): fresh leaves rebound into the cached program,
+        and the query record saying so (``cache: "template_hit"``)."""
         first = not getattr(plan, "_obs_executed", False)
         # phase(): the one timing mechanism — the duration lands in the
         # query record AND (tracer active here) as an "execute" span
         with trace_lib.phase("query.execute",
-                             cache="hit" if hit else "miss") as sp:
-            out = self._arbitrated_run(plan)
+                             cache=cache_label
+                             or ("hit" if hit else "miss")) as sp:
+            out = self._arbitrated_run(plan, bindings=bindings)
             out.data.block_until_ready()
         execute_ms = sp.dur_ms
         plan._obs_executed = True
         try:
             self._emit_query_event(e, plan, hit, key, execute_ms, first,
-                                   out, tenant=tenant)
+                                   out, tenant=tenant,
+                                   cache_label=cache_label)
             self._emit_verify_event(plan)
         except Exception:   # the result is already computed — keep the
             # never-fail-a-query contract (obs/events.py) even when
@@ -1183,12 +1480,14 @@ class MatrelSession:
             return self._compute_resilient(e, rc, sla, pol,
                                            tenant=tenant)
         if (not rc and not self._obs_enabled()
-                and self._tracer is None):
+                and self._tracer is None and not self._cse_on()):
             # the production path: zero event assembly, zero extra
             # device syncs, zero span objects, zero cache-key walks
             # beyond the plan cache's own (the obs_level="off" /
-            # result_cache_max_bytes=0 / flight-recorder-off contract
-            # bench.py relies on)
+            # result_cache_max_bytes=0 / flight-recorder-off /
+            # cse-off contract bench.py relies on; with cse_enable a
+            # single query must still reach the template probe/insert
+            # seam in _compute_observed)
             return self._arbitrated_run(
                 self._compile_entry(e, sla=sla)[0])
         # per-thread tracer activation: executor compile phases and
@@ -1226,18 +1525,33 @@ class MatrelSession:
                     self._prov_capture("rc_hit", key, sla, rung=rung,
                                        ent=ent)
                 return ent.result
+        bindings = cache_label = None
         with trace_lib.span("plan"):
-            plan, hit, pkey = self._compile_entry(e, sla=sla, rung=rung)
+            # plan-template probe (serve/mqo.py): a structurally
+            # identical query modulo dense-leaf bindings rebinds into
+            # the cached template's program — zero optimize/trace
+            tpl = (self._template_probe(e, sla, rung)
+                   if self._cse_on() else None)
+            if tpl is not None:
+                plan, pkey, bindings = tpl
+                hit, cache_label = True, "template_hit"
+            else:
+                plan, hit, pkey = self._compile_entry(e, sla=sla,
+                                                      rung=rung)
+                if self._cse_on() and not hit:
+                    self._template_insert(e, plan, sla, rung)
         # fault site "execute": the host-side dispatch point — the main
         # retryable site (per attempt, unlike the trace-time sites)
         faults_lib.check("execute", self.config)
         if self._obs_enabled():
-            out = self._run_observed(e, plan, hit, pkey, tenant=tenant)
+            out = self._run_observed(e, plan, hit, pkey, tenant=tenant,
+                                     bindings=bindings,
+                                     cache_label=cache_label)
         else:
             # flight-recorder-only tier: the span marks DISPATCH (JAX
             # async — deliberately no added sync; always-cheap)
             with trace_lib.span("query.execute"):
-                out = self._arbitrated_run(plan)
+                out = self._arbitrated_run(plan, bindings=bindings)
         summary = None
         if self._prov is not None:
             # capture BEFORE the cache insert so the new CacheEntry's
@@ -1477,11 +1791,30 @@ class MatrelSession:
             pend.append((i, e))
         execute_ms = 0.0
         plan_hit = None
+        cse_hoisted = 0
+        tpl_hit = False
         if pend:
+            if self._cse_on() and len(pend) > 1:
+                # cross-query CSE (serve/mqo.py): shared interiors of
+                # the batch compute once; consumers re-enter planning
+                # with cse-stamped leaves
+                pend, cse_hoisted = self._cse_hoist_batch(pend, sla,
+                                                          rung, rc)
+            bindings = None
             with trace_lib.span("plan", roots=len(pend)):
-                plan, plan_hit, keys = self._compile_multi_entry(
-                    [e for _, e in pend], sla=sla, rung=rung)
-            pos = {k: j for j, k in enumerate(plan._root_keys)}
+                tpl = (self._template_probe_multi(
+                    [e for _, e in pend], sla, rung)
+                    if self._cse_on() else None)
+                if tpl is not None:
+                    plan, keys, pos, bindings = tpl
+                    plan_hit = tpl_hit = True
+                else:
+                    plan, plan_hit, keys = self._compile_multi_entry(
+                        [e for _, e in pend], sla=sla, rung=rung)
+                    pos = {k: j
+                           for j, k in enumerate(plan._root_keys)}
+                    if self._cse_on() and not plan_hit:
+                        self._template_insert_multi(plan, sla, rung)
             # fault site "execute" — per batch attempt (host side)
             faults_lib.check("execute", self.config)
             # the batch's execute span: under obs the sync happens
@@ -1489,7 +1822,7 @@ class MatrelSession:
             # mark dispatch without adding a sync
             with trace_lib.span("serve.execute",
                                 executed=len(pend)) as sp_ex:
-                outs = self._arbitrated_run(plan)
+                outs = self._arbitrated_run(plan, bindings=bindings)
                 if obs:
                     for o in outs:
                         o.data.block_until_ready()
@@ -1531,7 +1864,9 @@ class MatrelSession:
                                        else (plan.meta or {}).get(
                                            "rule_hits", {})),
                             batch={"size": len(es), "index": i},
-                            tenant=_tenant_of(i))
+                            tenant=_tenant_of(i),
+                            cache_label=("template_hit" if tpl_hit
+                                         else None))
                     except Exception:
                         log.warning("obs: query event dropped",
                                     exc_info=True)
@@ -1564,6 +1899,12 @@ class MatrelSession:
                     record["tenants"] = census
                 if _brownout_rung:
                     record["brownout_rung"] = _brownout_rung
+                if self._cse_on():
+                    # MQO deltas (docs/OBSERVABILITY.md): absent with
+                    # cse off — historical serve records unchanged
+                    record["cse_hoisted"] = cse_hoisted
+                    record["template_hits"] = (len(pend) if tpl_hit
+                                               else 0)
                 self._emit_serve_event(record)
             except Exception:
                 log.warning("obs: serve event dropped", exc_info=True)

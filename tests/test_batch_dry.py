@@ -228,6 +228,34 @@ def test_serve_row_artifact(dry_batch):
                             "half_width_frac", "replays"}
 
 
+def test_cse_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    # twice in the dry batch, like its sibling rows: the wedge-safe
+    # bench.py --cse step AND bench_all's dry-enabled row
+    recs = [r for r in records
+            if r.get("metric") == "cse_shared_interior_batch"
+            and "speedup" in r]
+    assert len(recs) == 2, f"expected 2 cse artifacts, got {recs}"
+    rec = recs[0]
+    # the round-17 acceptance (docs/SERVING.md): >= 1.5x first-contact
+    # wall at k variants over one shared interior, CSE on vs off, with
+    # bit-identical answers and exactly one hoisted interior per batch
+    assert rec["speedup"] is not None and rec["speedup"] >= 1.5, rec
+    assert rec["exact"] is True
+    assert rec["hoisted_per_batch"] == 1
+    for name in ("cse_off", "cse_on"):
+        cfg = rec["configs"][name]
+        assert cfg["median_ms"] > 0
+        assert set(cfg) >= {"median_ms", "half_width_ms", "trials"}
+    # the steady-state coda: a structurally-identical batch over a
+    # REBOUND leaf answers through the plan-template path (hoist +
+    # consumer probes both hit) with correct answers
+    st = rec["steady"]
+    assert st["template_hits_delta"] >= 1, st
+    assert st["exact"] is True
+    assert st["rebind_ms"] < rec["cse_on_ms"]
+
+
 def test_fleet_row_artifact(dry_batch):
     _, records, _ = dry_batch
     # twice in the dry batch, like its sibling rows: the wedge-safe

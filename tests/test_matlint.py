@@ -949,6 +949,75 @@ class TestML015ProvenanceSeam:
         assert _lint(tmp_path, src, "tools/some_drill.py") == []
 
 
+class TestML016TemplateKeying:
+    """The MQO plane's keying contract (ISSUE 17): plan-template /
+    CSE caches key by the canonical leaf-abstracted structural key
+    (mqo.template_key), never id()/uid/spec — the ML005 hazard class
+    extended to entries that outlive the queries that built them. The
+    fixtures prove the rule would catch each regression shape, and the
+    real module must scan clean."""
+
+    def test_id_keyed_template_store_fires(self, tmp_path):
+        src = """
+            class MqoState:
+                def __init__(self):
+                    self.templates = {}
+                def put(self, root, plan):
+                    self.templates[id(root)] = plan
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/mqo.py")
+        assert _rules(got) == ["ML016"]
+
+    def test_uid_keyed_hoist_get_fires(self, tmp_path):
+        src = """
+            def probe(hoist_cache, node):
+                return hoist_cache.get(node.uid)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/session.py")
+        assert _rules(got) == ["ML016"]
+
+    def test_spec_keyed_template_fires(self, tmp_path):
+        # spec objects hash by identity or not at all — the original
+        # ML005 shape, caught on template-named dicts too
+        src = """
+            def put(tpl_entries, m, plan):
+                tpl_entries[m.spec] = plan
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/mqo.py")
+        assert _rules(got) == ["ML016"]
+
+    def test_structural_key_clean(self, tmp_path):
+        # the sanctioned idiom: key derived from template_key, a
+        # plain string whose equality IS plan equivalence
+        src = """
+            def put(templates, prefix, akey, entry):
+                templates[prefix + akey] = entry
+            def probe(templates, key):
+                return templates.get(key)
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/serve/mqo.py") == []
+
+    def test_local_identity_class_map_clean(self, tmp_path):
+        # first-occurrence identity classes inside one template_key
+        # walk die with the walk — not a cache, not template-named,
+        # exactly why the rule scopes by NAME
+        src = """
+            def template_key(leaves):
+                classes = {}
+                toks = [classes.setdefault(id(m), len(classes))
+                        for m in leaves]
+                return toks
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/serve/mqo.py") == []
+
+    def test_real_mqo_module_is_ml016_clean(self):
+        import os
+        got = matlint.lint_file(
+            os.path.join(matlint.REPO, "matrel_tpu", "serve",
+                         "mqo.py"))
+        assert [f for f in got if f.rule == "ML016"] == []
+
+
 def test_repo_lints_clean():
     """`make lint`'s contract, enforced from inside tier-1: the whole
     default scan set (package, tools, examples, bench harnesses) has

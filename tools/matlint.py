@@ -50,6 +50,10 @@ Rule catalogue (each rule's class docstring is the authority):
   ML015  provenance stamp written outside the answer ledger's
          sanctioned writers (obs/provenance.py) — lineage stores are
          one seam so MV115 can trust what it cross-checks
+  ML016  template/CSE cache keyed by identity or spec values
+         (id()/.uid/.spec/.sharding) instead of the canonical
+         structural key — the ML005 hazard extended to the
+         multi-query-optimization plane (serve/mqo.py)
 """
 
 from __future__ import annotations
@@ -984,6 +988,76 @@ class ProvenanceSeamRule(Rule):
                             "stamp_leaf (obs/provenance.py)")
 
 
+class TemplateKeyRule(Rule):
+    """ML016: plan-template / CSE caches keyed by identity or spec
+    values instead of the canonical structural key (ML005 extended to
+    the multi-query-optimization plane, serve/mqo.py).
+
+    A template entry outlives the queries that built it — that is the
+    point — so its key must mean the same thing at probe time as it
+    did at insert time. ``id()`` is recycled the moment the original
+    object dies (a false hit rebinds a STRANGER's matrices into a
+    compiled plan); node ``.uid`` values are per-tree counters that
+    collide across independently-built expressions; spec/sharding
+    objects hash by identity or not at all (the ML005 hazard). The
+    only sound key is the leaf-abstracted STRUCTURAL key
+    (``mqo.template_key`` / ``session._plan_key``) — derived strings
+    whose equality IS plan equivalence. Pinned: subscript stores and
+    ``get``/``setdefault`` consults on template-/hoist-named dicts
+    whose key expression reaches an ``id(...)`` call or a
+    ``.uid``/``.spec``/``.sharding`` attribute. Local first-occurrence
+    maps (``classes.setdefault(id(m), ...)`` inside one
+    ``template_key`` walk) are fine — they die with the walk, which
+    is why the rule scopes by cache NAME, not by module."""
+
+    id = "ML016"
+    _NAME_RE = re.compile(r"(template|tpl|hoist)", re.IGNORECASE)
+    _UNSTABLE_ATTRS = ("uid", "spec", "sharding")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("matrel_tpu/")
+
+    def _cacheish(self, target: ast.AST) -> bool:
+        if isinstance(target, ast.Name):
+            return bool(self._NAME_RE.search(target.id))
+        if isinstance(target, ast.Attribute):
+            return bool(self._NAME_RE.search(target.attr))
+        return False
+
+    def _unstable(self, key: ast.AST) -> Optional[str]:
+        for node in ast.walk(key):
+            if isinstance(node, ast.Call) \
+                    and _call_name(node.func).rsplit(".", 1)[-1] == "id":
+                return "id()"
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in self._UNSTABLE_ATTRS:
+                return f".{node.attr}"
+        return None
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            key = None
+            target = None
+            if isinstance(node, ast.Subscript):
+                target, key = node.value, node.slice
+            elif isinstance(node, ast.Call):
+                tail = _call_name(node.func).rsplit(".", 1)[-1]
+                if tail in ("get", "setdefault") and node.args and \
+                        isinstance(node.func, ast.Attribute):
+                    target, key = node.func.value, node.args[0]
+            if key is None or not self._cacheish(target):
+                continue
+            bad = self._unstable(key)
+            if bad is not None:
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    f"template/CSE cache keyed by {bad} — identity "
+                    f"and spec values do not survive the entry (a "
+                    f"recycled id() falsely rebinds, uids collide "
+                    f"across trees); key by the canonical structural "
+                    f"key (mqo.template_key / session._plan_key)")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
                         SpecKeyedCacheRule(), RawTimingRule(),
@@ -991,7 +1065,7 @@ RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         KernelSeamRule(), JitSeamRule(),
                         UnboundedQueueRule(), ResultCacheSeamRule(),
                         TimingAccumulationRule(), FleetSeamRule(),
-                        ProvenanceSeamRule())
+                        ProvenanceSeamRule(), TemplateKeyRule())
 
 
 def _suppressed_codes(line: str) -> set:

@@ -615,6 +615,116 @@ def soak_serve(n_trials: int, base: int, tol: float):
     return fails
 
 
+def soak_cse(n_trials: int, base: int, tol: float):
+    """Multi-query-optimization battery (serve/mqo.py;
+    docs/SERVING.md): every trial builds batches with SEEDED shared
+    interiors — a dense Gram polynomial, an S×S block-sparse product,
+    a COO SpMV — under a random precision tier, runs them through a
+    ``cse_enable`` session, and checks every answer against the numpy
+    oracle query-for-query (sharing may never change an answer).
+    Also per trial: at least one interior actually HOISTS (a battery
+    that never shares proves nothing); MV116's dynamic pass proves
+    every remembered substitution against unshared execution; a
+    catalog rebind mid-trial invalidates the hoisted node's cached
+    result and the same structural batch over the NEW binding must
+    answer from fresh data (a stale hoist is a wrong answer the
+    oracle catches); and a fleet-routed repeat (fleet_slices=2) runs
+    a shared-interior batch through placement."""
+    import numpy as np
+    from matrel_tpu.analysis import cse_pass
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.core.coo import COOMatrix
+    from matrel_tpu.core.sparse import BlockSparseMatrix
+    from matrel_tpu.session import MatrelSession
+
+    mesh = mesh_lib.make_mesh()
+    fails = []
+    for trial in range(n_trials):
+        rng = np.random.default_rng(base + trial)
+        try:
+            n = int(rng.choice([16, 24, 32]))
+            k = int(rng.integers(3, 6))
+            sla = str(rng.choice(["default", "high", "exact"]))
+            x_np = rng.standard_normal((n, n)).astype(np.float32)
+            y_np = rng.standard_normal((n, n)).astype(np.float32)
+            X = BlockMatrix.from_numpy(x_np, mesh=mesh)
+            Y = BlockMatrix.from_numpy(y_np, mesh=mesh)
+            sess = MatrelSession(mesh=mesh, config=MatrelConfig(
+                cse_enable=True, precision_sla=sla,
+                result_cache_max_bytes=16 << 20))
+            sess.register("src", X)
+
+            def check(outs, oracles):
+                for out, want in zip(outs, oracles):
+                    scale = max(float(np.abs(want).max()), 1.0)
+                    np.testing.assert_allclose(
+                        out.to_numpy().astype(np.float64) / scale,
+                        want / scale, rtol=tol, atol=tol)
+
+            def gram_batch(M, m_np):
+                g = M.expr().t().multiply(M.expr())
+                go = m_np.astype(np.float64).T @ m_np.astype(
+                    np.float64)
+                ss = [float(rng.uniform(0.5, 2.0)) for _ in range(k)]
+                return ([g.multiply_scalar(s) for s in ss],
+                        [go * s for s in ss])
+
+            # dense Gram interior, shared across k scalar variants
+            qs, oracles = gram_batch(X, x_np)
+            check(sess.run_many(qs), oracles)
+
+            # S×S block-sparse product interior (SpGEMM output feeds
+            # every variant)
+            sp = __import__("scipy.sparse", fromlist=["random"])
+            s_sp = sp.random(n, n, density=0.3, random_state=int(
+                rng.integers(1 << 30)), dtype=np.float32)
+            S = BlockSparseMatrix.from_scipy(s_sp, block_size=8,
+                                             mesh=mesh)
+            s_np = s_sp.toarray().astype(np.float64)
+            gs = S.expr().multiply(S.expr())
+            so = s_np @ s_np
+            sqs = [gs.multiply_scalar(1.0 + i) for i in range(k)]
+            check(sess.run_many(sqs), [so * (1.0 + i)
+                                       for i in range(k)])
+
+            # COO SpMV interior: A_coo · X dense, shared by variants
+            c_sp = sp.random(n, n, density=0.05, random_state=int(
+                rng.integers(1 << 30)), dtype=np.float32)
+            C = COOMatrix.from_scipy(c_sp.tocoo()).shard(mesh)
+            c_np = c_sp.toarray().astype(np.float64)
+            gc = C.expr().multiply(X.expr())
+            co = c_np @ x_np.astype(np.float64)
+            cqs = [gc.multiply_scalar(2.0 + i) for i in range(k)]
+            check(sess.run_many(cqs), [co * (2.0 + i)
+                                       for i in range(k)])
+
+            info = sess.mqo_info()
+            assert info["cse_hoisted"] >= 1, info
+            diags = cse_pass.verify_cse_executions(sess)
+            assert diags == [], [d.render() for d in diags]
+
+            # rebind invalidation: the hoisted Gram's source rebinds;
+            # the same STRUCTURE over the new binding must answer
+            # from fresh data, never the stale hoisted result
+            sess.register("src", Y)
+            qs2, oracles2 = gram_batch(Y, y_np)
+            check(sess.run_many(qs2), oracles2)
+
+            # fleet-routed repeat: the shared-interior batch through
+            # placement over 2 slices, same oracle contract
+            fsess = MatrelSession(mesh=mesh, config=MatrelConfig(
+                cse_enable=True, precision_sla=sla, fleet_slices=2,
+                result_cache_max_bytes=16 << 20))
+            fq, fo = gram_batch(X, x_np)
+            check(fsess.run_many(fq), fo)
+        except Exception as ex:  # noqa: BLE001
+            fails.append(("cse", trial, type(ex).__name__,
+                          str(ex)[:150]))
+    return fails
+
+
 def soak_stream(n_trials: int, base: int, tol: float):
     """Streaming-graph IVM battery (docs/IVM.md): a sliding-window
     edge stream (workloads/streaming.py) drives register_delta ticks
@@ -1171,7 +1281,7 @@ def main():
                    choices=["fuzz", "deep", "spmv", "sharded", "routed",
                             "ckpt", "serve", "precision", "chaos",
                             "sparse_kernels", "fusion", "overload",
-                            "stream", "fleet", "all"])
+                            "stream", "fleet", "cse", "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -1194,6 +1304,8 @@ def main():
                                  1e-6)
     if args.battery in ("serve", "all"):
         fails += soak_serve(max(args.seeds // 2, 5), args.base, tol)
+    if args.battery in ("cse", "all"):
+        fails += soak_cse(max(args.seeds // 5, 4), args.base, tol)
     if args.battery in ("chaos", "all"):
         fails += soak_chaos(max(args.seeds // 4, 5), args.base, tol)
     if args.battery in ("overload", "all"):

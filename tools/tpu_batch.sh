@@ -49,6 +49,8 @@ if [ "$DRY" = 1 ]; then
            MATREL_FUSION_REPEATS=5 MATREL_FUSION_INNER=4
     export MATREL_SERVE_N=256 MATREL_SERVE_K=64 \
            MATREL_SERVE_QUERIES=18 MATREL_SERVE_MEAS=3
+    export MATREL_CSE_N=512 MATREL_CSE_COLS=128 \
+           MATREL_CSE_VARIANTS=8 MATREL_CSE_MEAS=3
     export MATREL_FLEET_N=192 MATREL_FLEET_QUERIES=7 \
            MATREL_FLEET_REPLAYS=2
     export MATREL_TRAFFIC_SLICES=2
@@ -81,6 +83,8 @@ log "--- bench.py --fusion (fused-vs-staged region sweep, staged this round)"
 python bench.py --fusion
 log "--- bench.py --serve (repeated-traffic serving QPS row, staged this round)"
 python bench.py --serve
+log "--- bench.py --cse (shared-interior CSE batch + plan-template row, staged this round)"
+python bench.py --cse
 log "--- bench.py --fleet (multi-slice fleet scale-out QPS + kill drill, staged this round)"
 python bench.py --fleet
 log "--- bench.py --stream (streaming IVM delta-patch vs recompute row, staged this round)"

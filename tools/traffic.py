@@ -154,6 +154,23 @@ def build_pool(sess, rng, register=False):
                                  seed=1)
     pool.append(("spgemm_band", S1.expr().multiply(S2.expr()),
                  S1.to_numpy() @ S2.to_numpy()))
+    # dashboard-session class (round 17, serve/mqo.py): a burst of
+    # structurally-identical-modulo-leaves queries — the same scaled
+    # Gram shape over DISTINCT small tables. With cse_enable on, the
+    # first compiles and inserts a plan template; every sibling
+    # rebinds into it (template_hits), so dashboard traffic's compile
+    # count plateaus at one — the artifact's mqo assertion.
+    dn = 24
+    for i in range(6):
+        d = rng.standard_normal((dn, dn)).astype(np.float32)
+        D = sess.from_numpy(d)
+        if register:
+            sess.register(f"traffic_dash{i}", D)
+        pool.append((f"dash_{i}",
+                     D.expr().t().multiply(D.expr())
+                     .multiply_scalar(0.5),
+                     (d.astype(np.float64).T @ d.astype(np.float64))
+                     * 0.5))
     return pool
 
 
@@ -433,6 +450,12 @@ def main(slo: bool = False) -> int:
         # on a real TPU, where the MXU turns coalescing into a win.
         serve_max_batch=1,
         plan_cache_max_plans=256,
+        # round 17 (serve/mqo.py): plan-template reuse on — the
+        # dashboard-session pool class (structurally identical modulo
+        # leaves) must plateau its compile count: first variant pays
+        # optimize/trace, every sibling rebinds into the cached
+        # template (mqo.template_hits in the record)
+        cse_enable=True,
         brownout_enable=True,
         brownout_window=16,
         brownout_dwell=4,
@@ -607,6 +630,13 @@ def main(slo: bool = False) -> int:
         rung_census[str(r)] = rung_census.get(str(r), 0) + 1
     miss_hi = tenant_rows["gold"]["miss_rate"] or 0.0
     miss_lo = tenant_rows["bronze"]["miss_rate"] or 0.0
+    # compile-count plateau over the dashboard class: 6 dash_* pool
+    # entries (+ their brownout-stamped "fast" twins) share one
+    # structure each way, so at most 2 of the 12 first contacts pay
+    # optimize/trace — every other lands as a template rebind. >= 5
+    # hits proves the plateau held under the open-loop stream.
+    mqo = sess.mqo_info()
+    mqo_plateau = int(mqo.get("template_hits", 0)) >= 5
 
     if slo:
         # -- slo-mode verdict: alert fired during saturation, cleared
@@ -718,6 +748,10 @@ def main(slo: bool = False) -> int:
         "breakers": (sess._breakers.snapshot()
                      if sess._breakers else None),
         "queue": sess._serve._q.counters() if sess._serve else {},
+        "mqo": {"templates": mqo.get("templates", 0),
+                "template_hits": mqo.get("template_hits", 0),
+                "template_inserts": mqo.get("template_inserts", 0),
+                "plateau": mqo_plateau},
     }
     record["ok"] = bool(
         wrong == 0
@@ -727,6 +761,7 @@ def main(slo: bool = False) -> int:
         and miss_hi < miss_lo
         and brownout_entered
         and brownout_exited
+        and mqo_plateau
         and 0.0 < jain <= 1.0)
     print(json.dumps(record))
     return 0 if record["ok"] else 1
