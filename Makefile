@@ -1,10 +1,13 @@
 # matrel_tpu developer entry points.
 #
 # lint       — matlint (AST hazard rules, tools/matlint.py) + the
-#              static-verifier self-check over the plan-snapshot
-#              corpus (tools/plan_verify.py). Runs repo-wide; rc != 0
-#              on any finding/diagnostic. `test` depends on it, and
-#              tests/test_matlint.py re-runs it in-process so the
+#              concurrency sanitizer's static half (lock-order /
+#              hold-span analysis, tools/lockcheck.py; LK1xx rules,
+#              docs/CONCURRENCY.md) + the static-verifier self-check
+#              over the plan-snapshot corpus (tools/plan_verify.py).
+#              Runs repo-wide; rc != 0 on any finding/diagnostic.
+#              `test` depends on it, and tests/test_matlint.py +
+#              tests/test_lockcheck.py re-run it in-process so the
 #              tier-1 pytest path cannot silently skip it either.
 # test       — full CPU suite on the simulated 8-device mesh
 # soak       — oracle fuzz batteries on CPU (fast sanity)
@@ -36,6 +39,7 @@ OBS_LOG ?= .matrel_events.jsonl
 
 lint:
 	$(PY) tools/matlint.py
+	$(PY) tools/lockcheck.py
 	$(PY) tools/plan_verify.py
 
 test: lint

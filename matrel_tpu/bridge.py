@@ -30,6 +30,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from matrel_tpu.session import MatrelSession
+from matrel_tpu.utils import lockdep
 
 log = logging.getLogger("matrel_tpu.bridge")
 
@@ -70,7 +71,7 @@ class BridgeServer(socketserver.ThreadingTCPServer):
                  host: str = "127.0.0.1", port: int = 0):
         super().__init__((host, port), _Handler)
         self.session = session or MatrelSession.builder().get_or_create()
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("bridge.server")
 
     @property
     def port(self) -> int:
@@ -103,10 +104,12 @@ class BridgeServer(socketserver.ThreadingTCPServer):
                 if params.get("store"):
                     self.session.register(params["store"], out)
                     return {"stored": params["store"], "shape": list(out.shape)}
-                return {"data": out.to_numpy().tolist(), "shape": list(out.shape)}
+                return {"data": out.to_numpy().tolist(),  # lockcheck: disable=LK102 bridge.server IS the RPC serializer: the session is not thread-safe, so each RPC (including result materialization) runs under it by design; no other thread ever waits on this lock for latency
+                        "shape": list(out.shape)}
             if method == "fetch":
                 m = self.session.table(params["name"])
-                return {"data": m.to_numpy().tolist(), "shape": list(m.shape)}
+                return {"data": m.to_numpy().tolist(),  # lockcheck: disable=LK102 same RPC-serializer design as "sql" above: fetch materializes under bridge.server deliberately
+                        "shape": list(m.shape)}
             if method == "explain":
                 return {"plan": self.session.explain(
                     self.session.sql(params["query"]))}

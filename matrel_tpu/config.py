@@ -469,6 +469,21 @@ class MatrelConfig:
         byte-identical). Readers stitch ``<log>.1`` + ``<log>``
         transparently, so rotation bounds the DISK while
         ``tail_bytes`` keeps bounding each read.
+      lockdep_enable: runtime lock-order sanitizer
+        (matrel_tpu/utils/lockdep.py; docs/CONCURRENCY.md). Off (the
+        default) is bit-identical to the uninstrumented engine: the
+        sanctioned lock constructors return raw threading primitives
+        and ZERO lockdep objects are constructed (poisoned-init
+        test-enforced, plan snapshots unchanged). On: every
+        seam-constructed lock records per-thread acquisition stacks
+        into a global lock-ORDER graph; inversions and
+        held-across-dispatch violations are recorded as ``lockdep``
+        obs events (and into the flight ring), rolled up by
+        ``history --summary`` and fatal to ``--check``.
+      lockdep_raise: escalate lockdep diagnostics from record-only to
+        an immediate typed raise (LockOrderInversion /
+        HeldAcrossDispatch) at the acquisition site — the race-drill
+        and fixture-test mode. Requires ``lockdep_enable``.
     """
 
     block_size: int = 512
@@ -554,6 +569,8 @@ class MatrelConfig:
     fleet_placement_calibration: bool = True
     obs_provenance: int = 0
     obs_event_log_max_bytes: int = 0
+    lockdep_enable: bool = False
+    lockdep_raise: bool = False
 
     def __post_init__(self):
         # enablement is "anything != off", so an unvalidated typo/case
@@ -803,6 +820,16 @@ class MatrelConfig:
                 f"obs_event_log_max_bytes must be >= 0 (0 disables "
                 f"event-log rotation), "
                 f"got {self.obs_event_log_max_bytes!r}")
+        # concurrency sanitizer (docs/CONCURRENCY.md): lockdep_raise
+        # without lockdep_enable would silently raise NOTHING while
+        # the drill operator believes violations are fatal (the
+        # obs_level typo precedent — a sanitizer that monitors
+        # nothing while believed armed is its worst failure mode)
+        if self.lockdep_raise and not self.lockdep_enable:
+            raise ValueError(
+                "lockdep_raise requires lockdep_enable (a raise mode "
+                "with no instrumentation in force would silently "
+                "check nothing)")
 
     def replace(self, **kw: Any) -> "MatrelConfig":
         return dataclasses.replace(self, **kw)

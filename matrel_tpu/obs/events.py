@@ -32,6 +32,24 @@ import threading
 import time
 from typing import Iterator, List, Optional
 
+if __package__:
+    from matrel_tpu.utils import lockdep
+else:
+    # Loaded by FILE PATH (bench.py's jax-free parent, soak_guard):
+    # a package import here would execute matrel_tpu/__init__ and
+    # pull jax into a process that is deliberately backend-free
+    # (relay-wedge safety). Load the lock seam the same way — it is
+    # stdlib-only, and in these processes lockdep is never enabled,
+    # so the private module state is irrelevant (make_lock returns a
+    # raw threading.Lock either way).
+    import importlib.util as _ilu
+    _spec = _ilu.spec_from_file_location(
+        "_matrel_lockdep",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     os.pardir, "utils", "lockdep.py"))
+    lockdep = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(lockdep)
+
 log = logging.getLogger("matrel_tpu.obs")
 
 #: Bump when a reader-visible field changes meaning. Readers skip
@@ -58,7 +76,7 @@ def rotated_path(path: Optional[str]) -> str:
 #: is one O_APPEND write, and a concurrent rename at worst lands a
 #: line in the .1 sibling instead of the fresh main file — readers
 #: stitch both.
-_ROTATE_LOCK = threading.Lock()
+_ROTATE_LOCK = lockdep.make_lock("obs.event_rotate")
 
 
 class EventLog:

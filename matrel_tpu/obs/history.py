@@ -166,6 +166,7 @@ def summarize(events: List[dict]) -> dict:
         "fleet": _summarize_fleet(events),
         "serve": _summarize_serve(events),
         "cse": _summarize_cse(events),
+        "lockdep": _summarize_lockdep(events),
         "resilience": _summarize_resilience(events, len(qs)),
         "overload": _summarize_overload(events),
         "execute_ms_total": round(sum(exec_ms), 3),
@@ -306,6 +307,36 @@ def _summarize_cse(events: List[dict]) -> Optional[dict]:
         "template_hits": sum(int(e.get("template_hits") or 0)
                              for e in sv),
         "template_hit_queries": tpl_q,
+    }
+
+
+def _summarize_lockdep(events: List[dict]) -> Optional[dict]:
+    """Roll up runtime-lockdep diagnostics (utils/lockdep.py;
+    docs/CONCURRENCY.md) — ``lockdep`` records ride the obs funnel
+    only when ``config.lockdep_enable`` armed the sanitizer, so None
+    (and a byte-identical summary) on every default-config log. Any
+    recorded inversion/self-deadlock flips ``--summary --check`` to
+    exit 1: a lock-order violation in a capture log is a latent
+    deadlock, not a statistic."""
+    lds = [e for e in events if e.get("kind") == "lockdep"]
+    if not lds:
+        return None
+    by_diag: Dict[str, int] = {}
+    locks: Dict[str, int] = {}
+    for e in lds:
+        d = str(e.get("diag") or "?")
+        by_diag[d] = by_diag.get(d, 0) + 1
+        for key in ("lock", "held"):
+            if e.get(key):
+                locks[str(e[key])] = locks.get(str(e[key]), 0) + 1
+    inversions = (by_diag.get("inversion", 0)
+                  + by_diag.get("self_deadlock", 0))
+    return {
+        "diagnostics": len(lds),
+        "by_diag": by_diag,
+        "inversions": inversions,
+        "locks": locks,
+        "last_msg": str(lds[-1].get("msg") or ""),
     }
 
 
@@ -686,6 +717,15 @@ def render_summary(events: List[dict]) -> str:
             f"{cse['batches']} batch(es), {cse['template_hits']} "
             f"template rebind(s), {cse['template_hit_queries']} "
             f"zero-optimize quer(ies)")
+    ld = s.get("lockdep")
+    if ld:
+        diags = ", ".join(f"{k}: {v}"
+                          for k, v in sorted(ld["by_diag"].items()))
+        lines.append(
+            f"lockdep: {ld['diagnostics']} diagnostic(s) "
+            f"({diags}), {ld['inversions']} order inversion(s)"
+            + (" — LATENT DEADLOCK (--check exits nonzero)"
+               if ld["inversions"] else ""))
     if s["strategies"]:
         lines.append("")
         header = (f"{'strategy':<12}{'matmuls':>8}{'GFLOPs':>10}"
@@ -791,6 +831,16 @@ def main(args) -> int:
                 print(f"SLO CHECK FAILED: {len(al['uncleared'])} "
                       f"un-cleared alert(s): "
                       + ", ".join(al["uncleared"]))
+                return 1
+            # same idiom for the concurrency sanitizer: a recorded
+            # lock-order inversion is a deadlock that has not
+            # happened YET — a capture log carrying one must fail
+            # the report, not scroll past in the roll-up
+            ld = _summarize_lockdep(events)
+            if ld and ld["inversions"]:
+                print(f"LOCKDEP CHECK FAILED: {ld['inversions']} "
+                      f"lock-order inversion(s) recorded "
+                      f"({ld['last_msg']})")
                 return 1
     else:
         print(render_queries(events, last=args.last))

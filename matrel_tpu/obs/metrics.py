@@ -33,6 +33,7 @@ from __future__ import annotations
 import math
 import threading
 from typing import Dict, Iterable, Optional
+from matrel_tpu.utils import lockdep
 
 #: Default relative-accuracy target for every timing sketch: a reported
 #: quantile x̃_q satisfies |x̃_q − x_q| <= DEFAULT_ALPHA · x_q for the
@@ -314,7 +315,7 @@ class MetricsRegistry:
     lock keeps snapshot() consistent)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("obs.metrics_registry")
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}

@@ -49,6 +49,7 @@ import threading
 import time
 from collections import deque
 from typing import Iterable, List, Optional
+from matrel_tpu.utils import lockdep
 
 #: Bump when a reader-visible field changes meaning (the event-log
 #: SCHEMA_VERSION discipline). Readers warn on records they don't know.
@@ -113,7 +114,7 @@ class ProvenanceLedger:
         self.cap = cap
         self._records: "deque[ProvenanceRecord]" = deque(maxlen=cap)
         self._chains: dict = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("obs.provenance")
         self.captured = 0
 
     # -- the sanctioned stamp writers (ML015 pins every other one) -----

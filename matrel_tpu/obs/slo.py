@@ -50,6 +50,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from matrel_tpu.config import parse_slo_targets
 from matrel_tpu.obs.metrics import QuantileSketch
+from matrel_tpu.utils import lockdep
 
 #: The latency-objective vocabulary → (quantile, budget fraction).
 #: ``avail`` is handled separately (its budget comes from the target).
@@ -197,7 +198,7 @@ class SLOPlane:
         self.targets = parse_slo_targets(config.slo_targets)
         self.emit = emit
         clk = clock or time.monotonic
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("obs.slo")
         self.monitors: Dict[Tuple[str, str], SLOMonitor] = {}
         for tenant, objs in self.targets.items():
             for obj, target in objs.items():

@@ -41,6 +41,7 @@ import time
 from typing import List, Optional
 
 from matrel_tpu.obs.events import SCHEMA_VERSION
+from matrel_tpu.utils import lockdep
 
 _SPAN_SEQ = itertools.count(1)
 
@@ -217,7 +218,7 @@ class FlightRecorder:
         self.capacity = int(capacity)
         self._buf: "collections.deque" = collections.deque(
             maxlen=self.capacity)
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("obs.flight_ring")
         self.dumps = 0
 
     def add(self, record: dict) -> None:

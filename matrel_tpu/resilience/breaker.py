@@ -45,6 +45,7 @@ from matrel_tpu.resilience.errors import (AdmissionShed, CircuitOpen,
                                           DrainTimeout, PipelineClosed,
                                           QueryAborted)
 from matrel_tpu.resilience.retry import now
+from matrel_tpu.utils import lockdep
 
 #: Failure types that say nothing about the PLAN CLASS: starvation,
 #: backpressure and cancellation outcomes never trip a breaker.
@@ -166,7 +167,7 @@ class BreakerRegistry:
         self.cooldown_ms = float(cooldown_ms)
         self.probes = int(probes)
         self._clock = clock if clock is not None else now
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("resilience.breaker")
         self._by_class: Dict[str, CircuitBreaker] = {}
 
     @staticmethod

@@ -38,6 +38,7 @@ from collections import deque
 from typing import Optional
 
 from matrel_tpu.obs.metrics import percentile
+from matrel_tpu.utils import lockdep
 
 #: The rung vocabulary (cumulative; labels ride obs events and docs).
 MAX_RUNG = 3
@@ -95,7 +96,7 @@ class LoadController:
         self.depth_low = int(config.brownout_depth_low)
         self.miss_high = float(config.brownout_miss_high)
         self.miss_low = float(config.brownout_miss_low)
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("resilience.brownout")
         self._waits: deque = deque(maxlen=self.window)
         # per-query outcome bits over the window (1 = missed its
         # deadline, 0 = admitted fine) — the miss-RATE signal

@@ -51,6 +51,7 @@ import threading
 from typing import Dict, List, Optional
 
 from matrel_tpu.resilience.errors import InjectedFault
+from matrel_tpu.utils import lockdep
 
 #: The instrumented-site vocabulary (see module docstring).
 SITES = ("compile", "lower", "strategy", "execute", "rc_probe",
@@ -162,7 +163,7 @@ class FaultInjector:
     def __init__(self, spec: str, seed: int):
         self.spec = spec
         self.seed = seed
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("resilience.fault_plan")
         self._by_site: Dict[str, List[FaultRule]] = {}
         for i, r in enumerate(parse_spec(spec)):
             rule = FaultRule(r["site"], r["kind"], r["p"], r["n"],
@@ -202,7 +203,7 @@ class FaultInjector:
 
 
 _REGISTRY: Dict[tuple, FaultInjector] = {}
-_REGISTRY_LOCK = threading.Lock()
+_REGISTRY_LOCK = lockdep.make_lock("resilience.fault_registry")
 
 
 def injector_for(config) -> Optional[FaultInjector]:

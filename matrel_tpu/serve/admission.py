@@ -50,6 +50,7 @@ from typing import Dict, Optional
 from matrel_tpu.config import parse_tenant_weights
 from matrel_tpu.resilience.errors import AdmissionShed, DeadlineExceeded
 from matrel_tpu.resilience.retry import now as _now
+from matrel_tpu.utils import lockdep
 
 #: Stride-scheduling numerator: pass advances by BASE/weight per pop,
 #: so a weight-4 tenant is popped 4x as often as a weight-1 tenant
@@ -83,7 +84,7 @@ class AdmissionQueue:
         # budget burn, reported per tenant OUTSIDE the queue lock
         # (the monitor's emit callback does event-log I/O)
         self.slo = slo
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("serve.admission")
         self._not_empty = threading.Condition(self._lock)
         # queue.Queue-compatible drain surface (pipeline.drain waits
         # on these exact names)

@@ -66,6 +66,7 @@ from matrel_tpu.resilience.errors import (AdmissionShed,
                                           PipelineClosed)
 from matrel_tpu.serve import placement as placement_lib
 from matrel_tpu.serve.result_cache import CacheEntry, result_nbytes
+from matrel_tpu.utils import lockdep
 
 log = logging.getLogger("matrel_tpu.serve.fleet")
 
@@ -117,7 +118,7 @@ class FleetDirectory:
 
     def __init__(self, max_entries: int):
         self.max_entries = max(int(max_entries), 1)
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("fleet.directory")
         self._records: "OrderedDict[str, DirectoryRecord]" = \
             OrderedDict()
         self.inserts = 0
@@ -351,7 +352,7 @@ class FleetController:
         # cache/directory hits, planning and admission never take it.
         # Real multi-host slice deployments run one process per slice
         # — there the lock is trivially uncontended.
-        self._exec_lock = threading.RLock()
+        self._exec_lock = lockdep.make_rlock("fleet.exec", dispatch_ok=True)
         session._exec_lock = self._exec_lock
         self.slices = []
         for i, m in enumerate(meshes):
@@ -360,7 +361,18 @@ class FleetController:
             s._exec_lock = self._exec_lock
             self.slices.append(FleetSlice(i, s))
         self.directory = FleetDirectory(self.config.fleet_directory_max)
-        self._lock = threading.RLock()
+        self._lock = lockdep.make_rlock("fleet.controller")
+        # registration plane: serializes on_register end-to-end
+        # (map surgery + directory invalidation + re-replication) so
+        # two rebinds of one name cannot interleave, WITHOUT holding
+        # the controller lock across _replicate's device->host
+        # staging — that hold span stalled kill_slice/failover and
+        # every controller-lock reader behind a host transfer (the
+        # LK102 drain-wedge class). Never taken while _lock is held.
+        # dispatch_ok: holding it across _replicate's transfers is
+        # the lock's entire purpose — only rebinds contend on it.
+        self._reg_lock = lockdep.make_lock("fleet.registration",
+                                           dispatch_ok=True)
         self._repl_inflight: set = set()
         self._repl_threads: list = []
         self._rr = itertools.count()
@@ -432,26 +444,38 @@ class FleetController:
         re-replicates, slice caches invalidate through each slice
         session's own register() rebind path, and directory records
         depending on the name drop."""
-        with self._lock:
-            stale = [i for i, nm in self._names.items() if nm == name]
-            for i in stale:
-                del self._names[i]
-            for sl in self.slices:
-                # the per-slice reverse maps track the same binding:
-                # a rebind that leaves the old replica's id behind
-                # leaks one entry per slice per tick on a streaming
-                # host (the DeltaPlane._programs orphan class)
-                for i in [i for i, nm in sl.names_by_id.items()
-                          if nm == name]:
-                    del sl.names_by_id[i]
-            # invalidate BEFORE replicating: _replicate's first step
-            # maps the NEW matrix id to the name, so from that moment
-            # a concurrent submit built from the new binding resolves
-            # the same name-keyed fleet key as the old record — a
-            # still-live record would answer it with the OLD value
-            # (lookups don't take the controller lock; the reg_gen
-            # bump here also drops any old-binding insert in flight)
-            self.directory.invalidate_name(name)
+        with self._reg_lock:
+            with self._lock:
+                stale = [i for i, nm in self._names.items()
+                         if nm == name]
+                for i in stale:
+                    del self._names[i]
+                for sl in self.slices:
+                    # the per-slice reverse maps track the same
+                    # binding: a rebind that leaves the old replica's
+                    # id behind leaks one entry per slice per tick on
+                    # a streaming host (the DeltaPlane._programs
+                    # orphan class)
+                    for i in [i for i, nm in sl.names_by_id.items()
+                              if nm == name]:
+                        del sl.names_by_id[i]
+                # invalidate BEFORE replicating: _replicate's first
+                # step maps the NEW matrix id to the name, so from
+                # that moment a concurrent submit built from the new
+                # binding resolves the same name-keyed fleet key as
+                # the old record — a still-live record would answer
+                # it with the OLD value (lookups don't take the
+                # controller lock; the reg_gen bump here also drops
+                # any old-binding insert in flight)
+                self.directory.invalidate_name(name)
+            # replicate OUTSIDE the controller lock: host staging is
+            # a full device->host transfer per table — under _lock it
+            # wedges every controller-lock reader (kill_slice,
+            # failover, depth probes) behind the transfer. _reg_lock
+            # still serializes rebinds of the same name end-to-end,
+            # and _replicate's _names/names_by_id updates are single-
+            # key dict ops (lock-free readers see either binding,
+            # never a torn one).
             self._replicate(name, matrix)
 
     # -- helpers ------------------------------------------------------------

@@ -82,6 +82,7 @@ from matrel_tpu.resilience.errors import (AdmissionShed, CircuitOpen,
                                           DrainTimeout, PipelineClosed)
 from matrel_tpu.resilience.retry import Deadline
 from matrel_tpu.serve.admission import AdmissionQueue
+from matrel_tpu.utils import lockdep
 
 log = logging.getLogger("matrel_tpu.serve")
 
@@ -115,7 +116,7 @@ class ServePipeline:
         # RLock: submit() holds it across the closed-check + enqueue +
         # _ensure_worker (which locks again) so a concurrent close()
         # can never interleave between them
-        self._lock = threading.RLock()
+        self._lock = lockdep.make_rlock("serve.pipeline")
         # overload control plane (session-owned; None when off — the
         # bit-identity contract): brownout controller + breakers, plus
         # the last counter snapshot the overload event diffs against
@@ -640,6 +641,11 @@ def _sync_bounded(outs, rem: Optional[float]) -> bool:
 
 
 def _sync(outs) -> None:
+    # sanctioned blocking point (utils/lockdep.py): syncing a batch
+    # while holding any serve/fleet lock is the PR 8 drain-wedge class
+    # — with the sanitizer on, a held unsanctioned lock diagnoses as
+    # HeldAcrossDispatch. One flag check when off.
+    lockdep.note_dispatch("serve.sync")
     for o in outs:
         try:
             o.data.block_until_ready()

@@ -49,6 +49,7 @@ import dataclasses
 import os
 import threading
 from typing import Dict, Optional, Tuple
+from matrel_tpu.utils import lockdep
 
 #: Analytic fallback coefficients — deliberately round numbers in the
 #: planner's "relative units are what matter" tradition: ~1 TFLOP/s
@@ -148,7 +149,7 @@ def fleet_key(e, names_by_id: Dict[int, str],
 # Drift-calibrated coefficients (ROADMAP item 4's feedback loop)
 # ---------------------------------------------------------------------------
 
-_coeff_lock = threading.Lock()
+_coeff_lock = lockdep.make_lock("serve.placement_coeff")
 _coeff_cache: dict = {}
 
 

@@ -37,6 +37,7 @@ import numpy as np
 
 from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.resilience.retry import now as _now
+from matrel_tpu.utils import lockdep
 
 
 def result_nbytes(result: BlockMatrix) -> int:
@@ -127,7 +128,7 @@ class ResultCache:
     """
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = lockdep.make_rlock("serve.result_cache")
         self._entries: "OrderedDict[str, CacheEntry]" = OrderedDict()
         self._bytes = 0
         self.hits = 0

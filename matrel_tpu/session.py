@@ -44,6 +44,7 @@ from matrel_tpu.resilience.retry import RetryPolicy
 from matrel_tpu.serve import mqo as mqo_lib
 from matrel_tpu.serve.result_cache import (CacheEntry, ResultCache,
                                            result_nbytes)
+from matrel_tpu.utils import lockdep
 
 log = logging.getLogger("matrel_tpu")
 
@@ -61,6 +62,16 @@ class MatrelSession:
     def __init__(self, mesh: Optional[Mesh] = None,
                  config: Optional[MatrelConfig] = None):
         self.config = config or default_config()
+        # concurrency sanitizer (utils/lockdep.py;
+        # docs/CONCURRENCY.md): armed BEFORE any of this session's
+        # locks construct, so they all come back instrumented. Off
+        # (the default) this is one false branch — the seam keeps
+        # returning raw threading primitives and zero lockdep objects
+        # exist (poisoned-init test-enforced). The emit hook is wired
+        # after the obs attributes exist (end of __init__).
+        if self.config.lockdep_enable:
+            lockdep.enable(
+                raise_on_violation=self.config.lockdep_raise)
         self.mesh = mesh or mesh_lib.make_mesh(
             self.config.mesh_shape, self.config.mesh_axis_names)
         self.catalog: dict[str, BlockMatrix] = {}
@@ -81,7 +92,7 @@ class MatrelSession:
         # worker and the caller's thread compile concurrently.
         self._result_cache = ResultCache()
         self._serve = None
-        self._compile_lock = threading.RLock()
+        self._compile_lock = lockdep.make_rlock("session.compile")
         # multi-query optimization (serve/mqo.py; docs/SERVING.md):
         # cross-query CSE + plan templates — None for the default
         # config (cse_enable off: the structural zero-object contract,
@@ -148,6 +159,13 @@ class MatrelSession:
         # lineage record here and emits a ``provenance`` event.
         self._prov = provenance_lib.from_config(self.config)
         self._exporter = export_lib.from_config(self)
+        # lockdep diagnostics ride the ONE obs funnel as ``lockdep``
+        # events (event log + flight ring; history --summary rolls
+        # them up, --check fails on inversions). Wired last: the
+        # funnel reads _slice_tag/_flight, which now exist.
+        if self.config.lockdep_enable:
+            lockdep.set_emit(
+                lambda rec: self._obs_emit("lockdep", rec))
 
     # -- builder (MatfastSession.builder().getOrCreate() analogue) ---------
 
@@ -1363,6 +1381,12 @@ class MatrelSession:
         only device programs serialize. Without a lock (every
         non-fleet session) this IS ``plan.run()``. ``bindings`` rebinds
         dense leaves by uid (plan-template hits — serve/mqo.py)."""
+        # sanctioned dispatch point (utils/lockdep.py): with the
+        # sanitizer on, any lock held HERE that is not declared
+        # dispatch_ok (the fleet exec arbitration is, by design) is a
+        # HeldAcrossDispatch diagnostic — the PR 8 drain-wedge class
+        # caught at runtime. One flag check when off.
+        lockdep.note_dispatch("session.dispatch")
         if self._exec_lock is None:
             return plan.run(bindings=bindings)
         with self._exec_lock:

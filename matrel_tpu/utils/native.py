@@ -16,6 +16,7 @@ import threading
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
+from matrel_tpu.utils import lockdep
 
 log = logging.getLogger("matrel_tpu.native")
 
@@ -24,7 +25,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libmatrel_opt.so")
 
-_lock = threading.Lock()
+_lock = lockdep.make_lock("native.build")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

@@ -476,6 +476,25 @@ def test_provenance_drill_artifact(dry_batch):
         assert verdict["sampled"] == verdict["replayable"] >= 1
 
 
+def test_race_drill_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records, lambda r: r.get("metric") == "race_drill",
+               "race_drill")
+    # the concurrency-sanitizer acceptance (docs/CONCURRENCY.md):
+    # every seeded interleaving of the four hairy schedules resolves
+    # right-or-typed with runtime lockdep armed, and the observed
+    # lock-order graph stays acyclic
+    assert rec["ok"] is True, rec
+    assert rec["wrong"] == 0
+    assert rec["untyped"] == 0
+    assert rec["inversions"] == 0
+    assert rec["acyclic"] is True
+    assert rec["resolved"] >= 1
+    assert set(rec["schedules"]) == {
+        "submit_close_drain", "kill_replication",
+        "rebind_probes", "delta_serve"}
+
+
 def test_sweep_and_gram_artifacts(dry_batch):
     _, records, _ = dry_batch
     verdict = _one(records, lambda r: "results" in r and "ok" in r,

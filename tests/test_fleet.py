@@ -1146,3 +1146,79 @@ class TestFleetObs:
         from matrel_tpu.obs import export as export_lib
         sess = MatrelSession(mesh=mesh8, config=MatrelConfig())
         assert export_lib.snapshot(sess)["fleet"] is None
+
+
+# ---------------------------------------------------------------------------
+# registration-plane locking (the LK102 fix: tools/lockcheck.py)
+# ---------------------------------------------------------------------------
+
+
+class TestRegistrationPlaneLocking:
+    def test_replicate_runs_outside_controller_lock(self, mesh8, rng):
+        """on_register's re-replication (device->host staging per
+        table) must NOT run under the controller lock — that hold
+        span wedged kill_slice/failover behind a host transfer — but
+        MUST still be serialized by the registration lock (two
+        rebinds of one name never interleave)."""
+        import threading
+
+        sess, mats = _fleet_session(mesh8, rng, n=32)
+        try:
+            # the fleet builds lazily on first submit
+            sess.submit(_q(sess)).result(timeout=60)
+            fc = sess._fleet
+            orig = fc._replicate
+            seen = {}
+
+            def spy(name, matrix):
+                # probe from ANOTHER thread: a nonblocking acquire
+                # succeeds iff no thread holds the lock
+                def probe():
+                    free = fc._lock.acquire(blocking=False)
+                    if free:
+                        fc._lock.release()
+                    seen["controller_free"] = free
+                    reg_free = fc._reg_lock.acquire(blocking=False)
+                    if reg_free:
+                        fc._reg_lock.release()
+                    seen["reg_held"] = not reg_free
+
+                t = threading.Thread(target=probe, daemon=True)
+                t.start()
+                t.join(timeout=30)
+                return orig(name, matrix)
+
+            fc._replicate = spy
+            sess.register("A", sess.from_numpy(mats["A"]))  # rebind
+            assert seen == {"controller_free": True,
+                            "reg_held": True}
+        finally:
+            sess.serve_close(timeout=30)
+
+    def test_rebind_storm_with_concurrent_kill(self, mesh8, rng):
+        """The schedule the old hold span wedged: kill_slice (takes
+        the controller lock) must complete while a rebind's
+        replication is in flight, and answers stay right."""
+        import threading
+
+        sess, mats = _fleet_session(mesh8, rng, n=32)
+        try:
+            sess.submit(_q(sess)).result(timeout=60)  # builds the fleet
+            oracle = mats["A"] @ mats["B"]
+            done = threading.Event()
+
+            def rebinder():
+                for _ in range(4):
+                    sess.register("A", sess.from_numpy(mats["A"]))
+                done.set()
+
+            t = threading.Thread(target=rebinder, daemon=True)
+            t.start()
+            sess._fleet.kill_slice(0)
+            out = sess.submit(_q(sess)).result(timeout=60)
+            t.join(timeout=60)
+            assert done.is_set(), "rebind storm wedged"
+            np.testing.assert_allclose(np.asarray(out.to_numpy()),
+                                       oracle, rtol=3e-3, atol=3e-3)
+        finally:
+            sess.serve_close(timeout=30)

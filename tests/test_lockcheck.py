@@ -1,0 +1,274 @@
+"""lockcheck (tools/lockcheck.py): fixture-based proof that every
+LK1xx rule fires on its hazard, that suppressions silence it, and
+that the repo's own lock plane analyzes clean — the tier-1
+enforcement of `make lint`'s lockcheck half (docs/CONCURRENCY.md)."""
+
+import textwrap
+
+from tools import lockcheck
+
+
+def _analyze(tmp_path, sources, thread_roots=None):
+    """Write a mini-package {relpath: source} and analyze it with the
+    fixture's own thread-roots table (empty aliases)."""
+    for rel, src in sources.items():
+        f = tmp_path / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(src))
+    return lockcheck.analyze_paths(
+        sorted(sources), root=str(tmp_path),
+        thread_roots=thread_roots or {}, aliases={})
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+class TestLK101Cycle:
+    def test_fires_on_opposite_nesting(self, tmp_path):
+        src = """
+            import threading
+            A = threading.Lock()
+            B = threading.Lock()
+            def f():
+                with A:
+                    with B:
+                        pass
+            def g():
+                with B:
+                    with A:
+                        pass
+        """
+        got = _analyze(tmp_path, {"pkg/m.py": src})
+        assert _rules(got) == ["LK101"]
+        assert "pkg/m.py:A" in got[0].message
+        assert "pkg/m.py:B" in got[0].message
+
+    def test_fires_through_calls(self, tmp_path):
+        # the INTERPROCEDURAL half: f holds A and calls h (which
+        # takes B); g nests them directly in the other order
+        src = """
+            import threading
+            A = threading.Lock()
+            B = threading.Lock()
+            def h():
+                with B:
+                    pass
+            def f():
+                with A:
+                    h()
+            def g():
+                with B:
+                    with A:
+                        pass
+        """
+        got = _analyze(tmp_path, {"pkg/m.py": src})
+        assert "LK101" in _rules(got)
+
+    def test_consistent_order_clean(self, tmp_path):
+        src = """
+            import threading
+            A = threading.Lock()
+            B = threading.Lock()
+            def f():
+                with A:
+                    with B:
+                        pass
+            def g():
+                with A:
+                    with B:
+                        pass
+        """
+        assert _analyze(tmp_path, {"pkg/m.py": src}) == []
+
+
+class TestLK102Blocking:
+    def test_fires_on_sleep_under_lock(self, tmp_path):
+        src = """
+            import threading, time
+            L = threading.Lock()
+            def f():
+                with L:
+                    time.sleep(1)
+        """
+        got = _analyze(tmp_path, {"pkg/m.py": src})
+        assert _rules(got) == ["LK102"]
+
+    def test_fires_transitively(self, tmp_path):
+        src = """
+            import threading
+            L = threading.Lock()
+            def helper(x):
+                x.block_until_ready()
+            def f(x):
+                with L:
+                    helper(x)
+        """
+        got = _analyze(tmp_path, {"pkg/m.py": src})
+        assert _rules(got) == ["LK102"]
+        assert "helper" in got[0].message
+
+    def test_thread_join_and_future_result_fire(self, tmp_path):
+        src = """
+            import threading
+            L = threading.Lock()
+            def f(t, fut):
+                with L:
+                    t.join(timeout=5)
+                    fut.result(5)
+        """
+        got = _analyze(tmp_path, {"pkg/m.py": src})
+        assert [f.rule for f in got] == ["LK102", "LK102"]
+
+    def test_str_and_path_join_do_not_fire(self, tmp_path):
+        src = """
+            import os, threading
+            L = threading.Lock()
+            def f(parts, d):
+                with L:
+                    a = ", ".join(parts)
+                    b = os.path.join(d, "x")
+                    return a + b
+        """
+        assert _analyze(tmp_path, {"pkg/m.py": src}) == []
+
+    def test_dispatch_ok_lock_exempt(self, tmp_path):
+        # the declared sanction: a lock constructed for dispatch-to-
+        # completion arbitration may be held across device waits
+        src = """
+            from matrel_tpu.utils import lockdep
+            L = lockdep.make_lock("fix.exec", dispatch_ok=True)
+            def f(x):
+                with L:
+                    x.block_until_ready()
+        """
+        assert _analyze(tmp_path, {"pkg/m.py": src}) == []
+
+    def test_suppression_silences(self, tmp_path):
+        src = """
+            import threading, time
+            L = threading.Lock()
+            def f():
+                with L:
+                    time.sleep(1)  # lockcheck: disable=LK102 fixture: deliberate hold
+        """
+        assert _analyze(tmp_path, {"pkg/m.py": src}) == []
+
+
+class TestLK103SharedWrites:
+    ROOTS = {"worker": (("pkg/m.py", "C.run"),),
+             "daemon": (("pkg/m.py", "C.tick"),)}
+
+    def test_fires_on_unguarded_two_root_writes(self, tmp_path):
+        src = """
+            class C:
+                def __init__(self):
+                    self.count = 0
+                def run(self):
+                    self.count += 1
+                def tick(self):
+                    self.count = 0
+        """
+        got = _analyze(tmp_path, {"pkg/m.py": src},
+                       thread_roots=self.ROOTS)
+        assert _rules(got) == ["LK103"]
+        assert "C.count" in got[0].message
+
+    def test_common_guard_clean(self, tmp_path):
+        src = """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                def run(self):
+                    with self._lock:
+                        self.count += 1
+                def tick(self):
+                    with self._lock:
+                        self.count = 0
+        """
+        assert _analyze(tmp_path, {"pkg/m.py": src},
+                        thread_roots=self.ROOTS) == []
+
+    def test_single_root_clean(self, tmp_path):
+        src = """
+            class C:
+                def run(self):
+                    self.count = 1
+                def other(self):
+                    self.count = 2
+        """
+        roots = {"worker": (("pkg/m.py", "C.run"),)}
+        assert _analyze(tmp_path, {"pkg/m.py": src},
+                        thread_roots=roots) == []
+
+
+class TestLK104DoubleAcquire:
+    def test_fires_on_direct_nesting(self, tmp_path):
+        src = """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """
+        got = _analyze(tmp_path, {"pkg/m.py": src})
+        assert _rules(got) == ["LK104"]
+
+    def test_fires_through_self_call(self, tmp_path):
+        src = """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def inner(self):
+                    with self._lock:
+                        pass
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+        """
+        got = _analyze(tmp_path, {"pkg/m.py": src})
+        assert "LK104" in _rules(got)
+
+    def test_rlock_reentry_clean(self, tmp_path):
+        src = """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                def inner(self):
+                    with self._lock:
+                        pass
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+        """
+        assert _analyze(tmp_path, {"pkg/m.py": src}) == []
+
+
+class TestRepoClean:
+    def test_repo_lock_plane_analyzes_clean(self):
+        # mirrors `make lint`: the shipped tree carries no unsuppressed
+        # LK1xx finding — new hazards fail HERE, in tier 1
+        assert lockcheck.analyze_paths() == []
+
+    def test_inventory_covers_the_seam(self):
+        # every lockdep.make_lock/make_rlock name lands in the
+        # inventory, and the known arbitration locks carry their
+        # dispatch_ok sanction
+        ana = lockcheck.analyzer_for()
+        assert "fleet.controller" in ana.locks
+        assert "serve.pipeline" in ana.locks
+        assert ana.locks["fleet.exec"].dispatch_ok
+        assert ana.locks["fleet.registration"].dispatch_ok
+        assert not ana.locks["fleet.directory"].dispatch_ok
+
+    def test_rule_catalogue_documented(self):
+        doc = lockcheck.__doc__
+        for rid, _ in lockcheck._RULES:
+            assert rid in doc, rid

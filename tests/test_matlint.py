@@ -1018,6 +1018,80 @@ class TestML016TemplateKeying:
         assert [f for f in got if f.rule == "ML016"] == []
 
 
+class TestML017LockSeam:
+    def test_fires_on_bare_lock(self, tmp_path):
+        src = """
+            import threading
+            class Plane:
+                def __init__(self):
+                    self._lock = threading.Lock()
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newplane.py")
+        assert _rules(got) == ["ML017"]
+
+    def test_fires_on_bare_rlock_module_level(self, tmp_path):
+        src = """
+            from threading import RLock
+            _LOCK = RLock()
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/obs/newobs.py")
+        assert _rules(got) == ["ML017"]
+
+    def test_seam_construction_passes(self, tmp_path):
+        # the sanctioned idiom: named construction through the seam —
+        # the lock lands in lockcheck's inventory and lockdep's graph
+        src = """
+            from matrel_tpu.utils import lockdep
+            class Plane:
+                def __init__(self):
+                    self._lock = lockdep.make_lock("serve.newplane")
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newplane.py") == []
+
+    def test_condition_and_event_pass(self, tmp_path):
+        # only Lock/RLock construction is seamed: Condition wraps an
+        # already-seamed lock, Event's internal lock guards no
+        # package state
+        src = """
+            import threading
+            from matrel_tpu.utils import lockdep
+            class Plane:
+                def __init__(self):
+                    self._lock = lockdep.make_lock("serve.cvplane")
+                    self._cv = threading.Condition(self._lock)
+                    self._stop = threading.Event()
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newplane.py") == []
+
+    def test_lockdep_module_is_the_sanctioned_seam(self, tmp_path):
+        src = """
+            import threading
+            _STATE_LOCK = threading.Lock()
+            def make_lock(name):
+                return threading.Lock()
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/utils/lockdep.py") == []
+
+    def test_out_of_scope_modules_pass(self, tmp_path):
+        # tools/tests spin up fixture locks freely — the seam pins the
+        # package's lock plane, not the harnesses around it
+        src = """
+            import threading
+            L = threading.Lock()
+        """
+        assert _lint(tmp_path, src, "tools/some_drill.py") == []
+
+    def test_suppression_silences(self, tmp_path):
+        src = """
+            import threading
+            _LOCK = threading.Lock()  # matlint: disable=ML017 fixture: raw by necessity
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/obs/newobs.py") == []
+
+
 def test_repo_lints_clean():
     """`make lint`'s contract, enforced from inside tier-1: the whole
     default scan set (package, tools, examples, bench harnesses) has

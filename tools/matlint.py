@@ -54,6 +54,11 @@ Rule catalogue (each rule's class docstring is the authority):
          (id()/.uid/.spec/.sharding) instead of the canonical
          structural key — the ML005 hazard extended to the
          multi-query-optimization plane (serve/mqo.py)
+  ML017  bare threading.Lock()/RLock() construction outside the
+         utils/lockdep.py seam — locks are named, inventoried and
+         lockdep-swappable only when built through make_lock/
+         make_rlock (the ML009/ML010 one-seam idiom applied to
+         locks; docs/CONCURRENCY.md)
 """
 
 from __future__ import annotations
@@ -1058,6 +1063,50 @@ class TemplateKeyRule(Rule):
                     f"key (mqo.template_key / session._plan_key)")
 
 
+class LockSeamRule(Rule):
+    """ML017: bare ``threading.Lock()``/``RLock()`` construction in
+    ``matrel_tpu/`` outside the ``utils/lockdep.py`` seam.
+
+    The concurrency sanitizer (docs/CONCURRENCY.md) hangs off ONE
+    construction seam: ``lockdep.make_lock(name)`` /
+    ``make_rlock(name)`` return raw threading primitives by default
+    (zero objects — the structural-zero contract) and instrumented
+    wrappers under ``config.lockdep_enable``. A lock built bare is
+    invisible to all three layers the seam feeds: it has no inventory
+    name (docs/CONCURRENCY.md's lock table and lockcheck's LK1xx
+    findings key on them), the runtime order graph never sees its
+    acquisitions, and the race drill cannot prove schedules over it —
+    the ML009/ML010 one-seam argument applied to locks.
+    ``Condition``/``Event``/``Semaphore`` stay legal: they are
+    signalling primitives, not mutual-exclusion state, and the
+    Conditions in the serve plane deliberately WRAP a seam-built lock.
+    The sanitizer's own internal guard in utils/lockdep.py is the one
+    necessarily-raw lock (it cannot instrument itself)."""
+
+    id = "ML017"
+    _SEAM = ("matrel_tpu/utils/lockdep.py",)
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("matrel_tpu/")
+                and relpath not in self._SEAM)
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            if name in ("threading.Lock", "threading.RLock",
+                        "Lock", "RLock"):
+                kind = name.rsplit(".", 1)[-1]
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    f"bare threading.{kind}() outside the lockdep "
+                    f"seam — construct it via lockdep.make_"
+                    f"{'r' if kind == 'RLock' else ''}lock"
+                    f"(\"<inventory.name>\") (utils/lockdep.py) so "
+                    f"it is named, order-tracked and drill-able")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
                         SpecKeyedCacheRule(), RawTimingRule(),
@@ -1065,7 +1114,8 @@ RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         KernelSeamRule(), JitSeamRule(),
                         UnboundedQueueRule(), ResultCacheSeamRule(),
                         TimingAccumulationRule(), FleetSeamRule(),
-                        ProvenanceSeamRule(), TemplateKeyRule())
+                        ProvenanceSeamRule(), TemplateKeyRule(),
+                        LockSeamRule())
 
 
 def _suppressed_codes(line: str) -> set:

@@ -930,6 +930,48 @@ def soak_fleet(n_trials: int, base: int, tol: float):
     return fails
 
 
+def soak_race(n_trials: int, base: int, tol: float):
+    """Concurrency battery (docs/CONCURRENCY.md): the race_drill
+    schedules — submit/close/drain, kill-during-replication,
+    rebind-vs-template-probes, delta-under-load — each run n_trials
+    seeds with runtime lockdep armed. A trial fails on a wrong
+    answer, an untyped failure, a recorded lock-order inversion, or a
+    cyclic order graph; failures reproduce by (schedule, seed)."""
+    from matrel_tpu.utils import lockdep
+    from tools import race_drill
+
+    fails = []
+    for name, fn in race_drill.SCHEDULES.items():
+        for trial in range(n_trials):
+            seed = base + trial
+            lockdep.reset()
+            try:
+                res = fn(seed, 10)
+                diags = lockdep.diagnostics()
+                bad = []
+                if res["wrong"]:
+                    bad.append(f"{res['wrong']} wrong")
+                if res["untyped"]:
+                    bad.append(f"{res['untyped']} untyped")
+                inv = sum(1 for d in diags
+                          if d["diag"] in ("inversion",
+                                           "self_deadlock"))
+                if inv:
+                    bad.append(f"{inv} lockdep inversion(s)")
+                if not lockdep.is_acyclic():
+                    bad.append("cyclic lock-order graph")
+                if bad:
+                    raise AssertionError("; ".join(bad))
+                print(f"  race {name} trial {trial + 1}/{n_trials} ok")
+            except Exception as e:  # noqa: BLE001 — tally and continue
+                fails.append(f"race {name} seed {seed}: "
+                             f"{type(e).__name__} {e}")
+                print(f"  FAIL {fails[-1]}")
+    lockdep.reset()
+    lockdep.disable()
+    return fails
+
+
 def soak_precision(n_trials: int, base: int, tol: float):
     """Precision-SLA battery: random matmul-shaped queries executed at
     every SLA tier against an f64 numpy oracle, asserting the
@@ -1281,7 +1323,7 @@ def main():
                    choices=["fuzz", "deep", "spmv", "sharded", "routed",
                             "ckpt", "serve", "precision", "chaos",
                             "sparse_kernels", "fusion", "overload",
-                            "stream", "fleet", "cse", "all"])
+                            "stream", "fleet", "cse", "race", "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -1314,6 +1356,8 @@ def main():
         fails += soak_stream(max(args.seeds // 5, 4), args.base, tol)
     if args.battery in ("fleet", "all"):
         fails += soak_fleet(max(args.seeds // 5, 4), args.base, tol)
+    if args.battery in ("race", "all"):
+        fails += soak_race(max(args.seeds // 10, 3), args.base, tol)
     if args.battery in ("precision", "all"):
         fails += soak_precision(max(args.seeds // 2, 5), args.base, tol)
     if args.battery in ("sharded", "all"):

@@ -66,6 +66,7 @@ if [ "$DRY" = 1 ]; then
            MATREL_GRAMFULL_PANEL=25000
     export MATREL_AUTOTUNE_SIDES=256 MATREL_AUTOTUNE_DTYPES=float32
     export MATREL_AUTOTUNE_SPMV=2000,20000
+    export MATREL_RACE_SEEDS=2 MATREL_RACE_QUERIES=6
     SEEDS=2
     log "TPU batch DRY fire-drill (CPU backend; artifacts in $DRY_DIR)"
 fi
@@ -109,6 +110,8 @@ log "--- traffic --slo (SLO burn-rate alert fire/clear proof + live metrics endp
 python tools/traffic.py --slo
 log "--- traffic --slices (open-loop fleet drill: placement spread, directory hits, mid-stream slice kill, staged this round)"
 python tools/traffic.py --slices
+log "--- race_drill (concurrency sanitizer: seeded serve/fleet interleavings under runtime lockdep, staged this round)"
+python tools/race_drill.py
 log "--- north_star_sweep (VERDICT #10 residual)"
 python tools/north_star_sweep.py
 log "--- gram_manual3 (symmetric-Gram microbench, BASELINE row 3 support)"
