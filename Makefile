@@ -23,7 +23,9 @@
 #              --check on the summary exits nonzero on any UN-CLEARED
 #              SLO alert (a log ending mid-incident must not read
 #              green), then the round-9 smokes over the same log: the
-#              cost-model drift audit (history --drift --check) and a
+#              cost-model drift audit (history --drift --check), the
+#              closed-loop gate (history --coeffs --check — a firing
+#              rank flag with no re-plan round fails the report) and a
 #              chrome-trace export of the tracing spans, then the
 #              tier-4 audit-replay gate (why --audit: sampled served
 #              answers re-executed fresh and proved within their
@@ -82,6 +84,7 @@ tpu-batch-dry:
 obs-report:
 	$(PY) -m matrel_tpu history --summary --check --log $(OBS_LOG)
 	$(PY) -m matrel_tpu history --drift --check --log $(OBS_LOG)
+	$(PY) -m matrel_tpu history --coeffs --check --log $(OBS_LOG)
 	$(PY) -m matrel_tpu trace --export chrome --log $(OBS_LOG) \
 		--out $(OBS_LOG).chrome.json
 	$(PY) -m matrel_tpu why --audit --sample 8 --check

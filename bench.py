@@ -1176,6 +1176,198 @@ def measure_cse() -> dict:
                 "exact": bool(exact2)}}
 
 
+def measure_coeffs() -> dict:
+    """Calibrated-vs-analytic planner row (the cost-model loop's
+    acceptance number, parallel/coeffs.py; docs/COST_MODEL.md): for
+    each workload, run every strategy FORCED (``strategy_override`` —
+    the ground truth the closed loop is supposed to learn), convert
+    the steady-state wall times into drift samples at the workloads'
+    OWN matmul shapes, and persist them through the auditor's
+    calibrate/update_table writers — a measured coefficient table
+    built the way live traffic builds it. Then run the chain /
+    PageRank-step / linreg-epilogue workloads on fresh sessions with
+    ``coeff_planner_enable`` off (analytic closed forms) vs on
+    (measured ms ranking against that table), steady state (warm plan
+    cache: the strategy choice is what differs, and execution is
+    where it pays). The three workloads land in three DISTINCT shape
+    classes (side n, 2n, rows 4n), so each ranking consults rows
+    calibrated on its own class. The row reports per-workload
+    medians, the strategies each ranking picked and the ``cost``
+    provenance stamps; answers from the two paths are asserted close
+    (zero wrong answers is part of the row). Acceptance: every
+    covered workload class (all decisions stamped ``measured``) runs
+    no slower than analytic beyond host noise — and strictly faster
+    wherever the closed forms mispick."""
+    import tempfile
+
+    import jax
+    from matrel_tpu import executor as executor_lib
+    from matrel_tpu.config import MatrelConfig, set_default_config
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.obs import drift
+    from matrel_tpu.parallel import strategies as strategies_lib
+    from matrel_tpu.session import MatrelSession
+
+    n = _env_int("MATREL_COEFFS_N", 512)
+    k = _env_int("MATREL_COEFFS_K", 128)
+    meas = _env_int("MATREL_COEFFS_MEAS", 5)
+    inner = _env_int("MATREL_COEFFS_INNER", 8)
+
+    table = os.path.join(tempfile.mkdtemp(prefix="matrel_coeffs_"),
+                         "drift.json")
+    cfg_analytic = MatrelConfig(obs_level="off",
+                                drift_table_path=table)
+    cfg_measured = cfg_analytic.replace(coeff_planner_enable=True,
+                                        coeff_min_samples=2)
+    set_default_config(cfg_analytic)
+    mesh = mesh_lib.make_mesh()
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    backend = jax.default_backend()
+    rng = np.random.default_rng(0)
+
+    # three workloads, three DISTINCT shape classes (shape_class
+    # buckets on the max dim): chain at side n, PageRank at side 2n,
+    # linreg Gram over 4n rows
+    n2, n4 = 2 * n, 4 * n
+    C1 = BlockMatrix.random((n, n), mesh=mesh, seed=2)
+    C2 = BlockMatrix.random((n, n), mesh=mesh, seed=3)
+    C3 = BlockMatrix.random((n, n), mesh=mesh, seed=4)
+    P = BlockMatrix.random((n2, n2), mesh=mesh, seed=5)
+    R = BlockMatrix.from_numpy(
+        rng.random((n2, 1), dtype=np.float32), mesh=mesh)
+    W = BlockMatrix.from_numpy(
+        rng.random((n2, 1), dtype=np.float32), mesh=mesh)
+    X = BlockMatrix.from_numpy(
+        rng.random((n4, k), dtype=np.float32), mesh=mesh)
+    I_k = BlockMatrix.from_numpy(np.eye(k, dtype=np.float32),
+                                 mesh=mesh)
+
+    def chain_expr():
+        return C1.expr().multiply(C2.expr()).multiply(C3.expr())
+
+    def pagerank_expr():
+        return P.expr().t() \
+            .multiply(W.expr().elem_multiply(R.expr())) \
+            .multiply_scalar(0.85).add_scalar(0.15 / n2)
+
+    def linreg_expr():
+        return X.expr().t().multiply(X.expr()) \
+            .multiply_scalar(1.0 / n4) \
+            .add(I_k.expr().multiply_scalar(0.1))
+
+    workloads = (("chain", chain_expr),
+                 ("pagerank_step", pagerank_expr),
+                 ("linreg_epilogue", linreg_expr))
+
+    def bench_one(make, cfg):
+        """Steady-state median over ``meas`` samples of ``inner``
+        back-to-back runs each (the measure_fusion discipline: these
+        workloads execute in ~1 ms, a single run is host-jitter, not
+        signal), plus the plan's per-matmul decision records."""
+        sess = MatrelSession(mesh=mesh, config=cfg)
+        out = sess.run(make())
+        out.data.block_until_ready()        # compile + warm
+
+        def sample():
+            o = None
+            for _ in range(max(inner, 1)):
+                o = sess.run(make())
+            o.data.block_until_ready()
+
+        ts = []
+        for _ in range(max(meas, 2)):
+            t0 = time.perf_counter()
+            sample()
+            ts.append((time.perf_counter() - t0) / max(inner, 1))
+        ts.sort()
+        plan = executor_lib.compile_expr(make(), mesh, cfg)
+        decs = executor_lib.plan_matmul_decisions(plan)
+        return {"ms": round(ts[len(ts) // 2] * 1e3, 3),
+                "half_width_ms": round((ts[-1] - ts[0]) / 2 * 1e3, 3),
+                "ts": ts,
+                "decisions": decs,
+                "strategies": [d.get("strategy") for d in decs],
+                "cost": [d.get("cost", "analytic") for d in decs],
+                }, out
+
+    # phase 1: calibrate — every strategy forced per workload, the
+    # per-rep wall attributed across the plan's matmuls by flops share
+    # (the per-op exclusive-ms discipline), one drift sample per rep
+    # so the persisted count clears coeff_min_samples
+    samples = []
+    for _name, make in workloads:
+        for s in strategies_lib.STRATEGIES:
+            if s == "summa" and gx != gy:
+                continue
+            try:
+                row, _ = bench_one(make, cfg_analytic.replace(
+                    strategy_override=s))
+            except Exception:  # matlint: disable=ML007 probe loop — a strategy failing to compile on this backend drops out of the table (the autotune idiom)
+                continue
+            decs = [d for d in row["decisions"]
+                    if isinstance(d.get("flops"), (int, float))
+                    and d.get("flops") > 0]
+            total_gf = sum(d["flops"] for d in decs)
+            if not decs or total_gf <= 0:
+                continue
+            for t in row["ts"]:
+                for d in decs:
+                    share = d["flops"] / total_gf
+                    samples.append({
+                        "strategy": d.get("strategy", s),
+                        "class": drift.shape_class(
+                            tuple(d.get("dims") or ())),
+                        "backend": backend, "tier": "",
+                        "flops": float(d["flops"]),
+                        "est_bytes": float(
+                            d.get("est_ici_bytes") or 0.0),
+                        "ms": t * 1e3 * share, "source": "bench"})
+    drift.update_table(table, drift.calibrate(samples))
+
+    # phase 2: analytic vs calibrated ranking, fresh sessions
+    rows = []
+    all_ok = True
+    for name, make in workloads:
+        a_row, a_out = bench_one(make, cfg_analytic)
+        m_row, m_out = bench_one(make, cfg_measured)
+        ref = a_out.to_numpy().astype(np.float64)
+        got = m_out.to_numpy().astype(np.float64)
+        scale = max(float(np.abs(ref).max()), 1.0)
+        agree = bool(np.allclose(got / scale, ref / scale, atol=1e-5))
+        covered = bool(m_row["cost"]) and all(
+            c == "measured" for c in m_row["cost"])
+        speedup = (round(a_row["ms"] / m_row["ms"], 2)
+                   if m_row["ms"] > 0 else None)
+        # "no slower beyond host noise": identical strategy picks mean
+        # identical plans — any ratio off 1.0 is pure host jitter, not
+        # a planner regression; when the rankings DIVERGE the
+        # calibrated pick must hold 0.9 (the shared-box guard band)
+        same_plan = (m_row["strategies"] == a_row["strategies"])
+        ok = (agree and covered and speedup is not None
+              and (same_plan or speedup >= 0.9))
+        all_ok = all_ok and ok
+        rows.append({"workload": name,
+                     "analytic_ms": a_row["ms"],
+                     "calibrated_ms": m_row["ms"],
+                     "half_width_ms": max(a_row["half_width_ms"],
+                                          m_row["half_width_ms"]),
+                     "speedup": speedup,
+                     "analytic_strategies": a_row["strategies"],
+                     "calibrated_strategies": m_row["strategies"],
+                     "cost_sources": m_row["cost"],
+                     "covered": covered,
+                     "outputs_agree": agree,
+                     "ok": ok})
+    return {"n": n, "k": k, "backend": backend,
+            "classes": sorted({s["class"] for s in samples}),
+            "table_strategies": sorted({s["strategy"]
+                                        for s in samples}),
+            "trials": meas,
+            "rows": rows,
+            "ok": bool(all_ok)}
+
+
 def measure_reshard() -> dict:
     """Flagship-shape src→dst reshard sweep (the reshard-planner row,
     ROADMAP item 2): for each layout move, time the PLANNED staged
@@ -1705,6 +1897,24 @@ def main_cse() -> None:
     print(json.dumps(record))
 
 
+def main_coeffs() -> None:
+    """Wedge-safe calibrated-vs-analytic planner row capture
+    (tools/tpu_batch.sh step): probe, then the measurement child under
+    a hard timeout; one parseable JSON line either way, rc 0 — same
+    contract as the headline metric."""
+    ok, payload = _run_child("probe", PROBE_TIMEOUT_S)
+    if ok:
+        ok, payload = _run_child("coeffs", MEASURE_TIMEOUT_S)
+    record = {"metric": "coeff_planner_sweep"}
+    if ok and isinstance(payload, dict):
+        record.update(payload)
+        _emit_bench_event(dict(record))
+    else:
+        record.update({"value": None, "error": str(payload)[:500]})
+        _emit_bench_error(record["metric"], str(payload))
+    print(json.dumps(record))
+
+
 def main_precision() -> None:
     """Wedge-safe precision-tier row capture (tools/tpu_batch.sh step):
     probe, then the measurement child under a hard timeout; one
@@ -1844,6 +2054,8 @@ if __name__ == "__main__":
         print(json.dumps(measure_cse()))
     elif "--_precision" in sys.argv:
         print(json.dumps(measure_precision()))
+    elif "--_coeffs" in sys.argv:
+        print(json.dumps(measure_coeffs()))
     elif "--_reshard" in sys.argv:
         print(json.dumps(measure_reshard()))
     elif "--_sparse_kernels" in sys.argv:
@@ -1872,6 +2084,8 @@ if __name__ == "__main__":
         main_cse()
     elif "--precision" in sys.argv:
         main_precision()
+    elif "--coeffs" in sys.argv:
+        main_coeffs()
     elif "--cpu-rows" in sys.argv:
         # host-only (no jax, relay-safe): BASELINE rows 2-6 + the
         # SpGEMM row's CPU reference column, cached in cpu_baseline.json

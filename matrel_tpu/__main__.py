@@ -186,14 +186,21 @@ def main(argv=None):
                     help="calibration-table path (default: "
                          "config.drift_table_path, else "
                          ".matrel_drift.json)")
+    hi.add_argument("--coeffs", action="store_true",
+                    help="cost-model loop view: planner decisions by "
+                         "cost source, coefficient epoch, and every "
+                         "rank-order flag paired with whether a "
+                         "re-plan round actioned it")
     hi.add_argument("--no-save", action="store_true",
                     help="with --drift: report only, don't update the "
                          "persisted calibration table")
     hi.add_argument("--check", action="store_true",
                     help="with --drift: exit nonzero when any DRIFT "
                          "rank-order flag fires; with --summary: exit "
-                         "nonzero on any UN-CLEARED SLO alert — the "
-                         "CI/make obs-report gates")
+                         "nonzero on any UN-CLEARED SLO alert; with "
+                         "--coeffs: exit nonzero on a firing but "
+                         "UNACTIONED flag — the CI/make obs-report "
+                         "gates")
     hi.set_defaults(fn=cmd_history)
     tp = sub.add_parser("top")
     tp.add_argument("--url", default=None,

@@ -97,6 +97,14 @@ def check_axis_traffic(root, mesh, config) -> Iterator[Diagnostic]:
             # it would warn on every fresh annotation of an
             # autotune-enabled weighted session
             return
+        if n.attrs.get("cost_model") == "measured":
+            # same exemption, coefficient-ranked decisions (round 19,
+            # parallel/coeffs.py; docs/COST_MODEL.md): a drift-
+            # calibrated ms ranking legitimately disagrees with the
+            # raw byte model — measured reality overriding the closed
+            # forms is the closed loop WORKING, not a smell this pass
+            # (which re-prices by exactly those closed forms) can judge
+            return
         if _dispatch_kind(n, config) is not None:
             return               # fast-path dispatch: no collectives run
         a, b = n.children

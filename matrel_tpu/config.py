@@ -484,6 +484,36 @@ class MatrelConfig:
         an immediate typed raise (LockOrderInversion /
         HeldAcrossDispatch) at the acquisition site — the race-drill
         and fixture-test mode. Requires ``lockdep_enable``.
+      coeff_planner_enable: let the MAIN planner consult the drift
+        auditor's calibrated ms/GFLOP + ms/MiB coefficients
+        (parallel/coeffs.py — the seam; docs/COST_MODEL.md): strategy
+        ranking and the chain DP's step cost price by measured ratios
+        where every candidate has a warm row, falling back to the
+        analytic closed forms otherwise; decisions are stamped
+        ``cost: "measured"|"analytic"`` and plan-cache keys gain the
+        ``coeffv:<epoch>|`` prefix so plans compiled under different
+        coefficients never share a slot. Off (the default) is
+        bit-identical: zero new objects, zero new key prefixes, zero
+        new event fields (plan snapshots unchanged, test-enforced).
+      coeff_min_samples: calibration rows below this sample count are
+        treated as cold for planner ranking — a one-off measurement
+        must not flip a strategy choice (the drift auditor's
+        noise-band argument).
+      coeff_replan_enable: close the loop (docs/COST_MODEL.md): a
+        serve-side controller (serve/replan.py) watches the query
+        event stream, and a firing DRIFT rank-order flag triggers a
+        coefficient re-calibration + background re-planning of the
+        affected cached plans under the new epoch — old plans keep
+        serving, in-flight queries never block (the ``coeffv:``
+        prefix). Requires ``coeff_planner_enable``. Off = zero
+        controller objects (replan._CONSTRUCTED stays 0).
+      coeff_replan_interval: queries between the controller's drift
+        checks — the re-plan loop's cadence.
+      coeff_replan_cooldown: checks a just-re-planned population sits
+        out before its flags can fire again (hysteresis, the brownout
+        dwell discipline): fresh samples under the NEW plans must
+        accumulate before the loop may act on that population again,
+        so a re-plan can never oscillate on its own stale evidence.
     """
 
     block_size: int = 512
@@ -571,6 +601,11 @@ class MatrelConfig:
     obs_event_log_max_bytes: int = 0
     lockdep_enable: bool = False
     lockdep_raise: bool = False
+    coeff_planner_enable: bool = False
+    coeff_min_samples: int = 3
+    coeff_replan_enable: bool = False
+    coeff_replan_interval: int = 32
+    coeff_replan_cooldown: int = 2
 
     def __post_init__(self):
         # enablement is "anything != off", so an unvalidated typo/case
@@ -830,6 +865,29 @@ class MatrelConfig:
                 "lockdep_raise requires lockdep_enable (a raise mode "
                 "with no instrumentation in force would silently "
                 "check nothing)")
+        # cost-model loop knobs (docs/COST_MODEL.md): a re-plan
+        # controller with no coefficient-consulting planner would
+        # re-calibrate a table nothing reads (the lockdep_raise
+        # dependency precedent); degenerate cadence/sample bounds
+        # would spin the check loop or let one noisy sample flip
+        # strategy rankings
+        if self.coeff_min_samples < 1:
+            raise ValueError(
+                f"coeff_min_samples must be >= 1, "
+                f"got {self.coeff_min_samples!r}")
+        if self.coeff_replan_enable and not self.coeff_planner_enable:
+            raise ValueError(
+                "coeff_replan_enable requires coeff_planner_enable "
+                "(re-planning recalibrates coefficients the planner "
+                "would otherwise never consult)")
+        if self.coeff_replan_interval < 1:
+            raise ValueError(
+                f"coeff_replan_interval must be >= 1, "
+                f"got {self.coeff_replan_interval!r}")
+        if self.coeff_replan_cooldown < 0:
+            raise ValueError(
+                f"coeff_replan_cooldown must be >= 0, "
+                f"got {self.coeff_replan_cooldown!r}")
 
     def replace(self, **kw: Any) -> "MatrelConfig":
         return dataclasses.replace(self, **kw)

@@ -92,7 +92,30 @@ def optimal_order(operands: List[MatExpr],
     # the two are bit-identical by construction (tests/test_reshard.py).
     reshard_budget = getattr(config, "reshard_peak_budget_bytes", 0) \
         if config is not None else 0
-    if n >= 3 and flop_scale == 1.0 and reshard_budget == 0:
+    # learned comm weights (round 19, parallel/coeffs.py — the ML018
+    # seam; docs/COST_MODEL.md): under coeff_planner_enable each DP
+    # step's byte bill converts to FLOP-equivalents at the MEASURED
+    # flops-per-byte ratio of its shape class on the live backend,
+    # instead of the analytic COMM_FLOPS_PER_BYTE constant. Cold
+    # classes keep the constant. The native mirror predates learned
+    # weights, so coefficient-active requests run the Python DP —
+    # degrade to the reference implementation, never to dishonest
+    # pricing (the flop_scale/reshard-budget precedent).
+    coeff_cw = None
+    shape_cls = None
+    if (config is not None
+            and getattr(config, "coeff_planner_enable", False)
+            and gx * gy > 1):
+        from matrel_tpu.parallel import coeffs as coeffs_lib
+        from matrel_tpu.obs import drift as drift_lib
+        import jax
+        coeff_cw = coeffs_lib.chain_comm_weights(
+            drift_lib.table_path(config), jax.default_backend(),
+            min_samples=getattr(config, "coeff_min_samples", 1)) or None
+        if coeff_cw is not None:
+            shape_cls = drift_lib.shape_class
+    if (n >= 3 and flop_scale == 1.0 and reshard_budget == 0
+            and coeff_cw is None):
         from matrel_tpu.utils import native
         dims = [op.shape[0] for op in operands] + [operands[-1].shape[1]]
         dens = [op.density for op in operands]
@@ -122,10 +145,14 @@ def optimal_order(operands: List[MatExpr],
             for s in range(i, j):
                 cl, el, ll = best[i][s]
                 cr, er, lr = best[s + 1][j]
+                cw = (coeff_cw.get(shape_cls(
+                    (el.shape[0], el.shape[1], er.shape[1])))
+                    if coeff_cw is not None else None)
                 step, lay = stats.chain_step_cost_layout(
                     el.shape[0], el.shape[1], er.shape[1],
                     el.density, er.density, gx, gy, ll, lr,
                     weights=weights, flop_scale=flop_scale,
+                    comm_weight=cw,
                 )
                 total = cl + cr + step
                 if cand is None or total < cand[0]:

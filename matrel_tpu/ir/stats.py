@@ -314,7 +314,8 @@ def chain_step_cost(n: int, k: int, m: int, da: float, db: float,
 def chain_step_cost_layout(n: int, k: int, m: int, da: float, db: float,
                            gx: int, gy: int, la: str, lb: str,
                            weights: tuple = (1.0, 1.0),
-                           flop_scale: float = 1.0) -> tuple:
+                           flop_scale: float = 1.0,
+                           comm_weight=None) -> tuple:
     """(step cost, output layout): chain_step_cost with per-layout,
     topology-weighted comm terms — the layout-aware DP's step (round 5;
     weights round 7). ``flop_scale`` (round 8) is the precision tier's
@@ -322,11 +323,20 @@ def chain_step_cost_layout(n: int, k: int, m: int, da: float, db: float,
     bf16 query retires its FLOPs faster, so the comm term weighs
     relatively MORE and the DP may legitimately prefer a different
     parenthesisation. 1.0 (the default, and every "default"-SLA query)
-    is bit-identical to the pre-tier step cost."""
+    is bit-identical to the pre-tier step cost.
+
+    ``comm_weight`` overrides :data:`COMM_FLOPS_PER_BYTE` with a
+    MEASURED flops-per-byte conversion for this step's shape class
+    (parallel/coeffs.chain_comm_weights — the drift-calibrated ratio
+    of interconnect time to MXU time on the live backend, consulted
+    under ``config.coeff_planner_enable``; docs/COST_MODEL.md). None
+    (the default, and every cold class) keeps the analytic constant —
+    bit-identical."""
     comm, lay = comm_proxy_layout(n, k, m, da, db, gx, gy, la=la, lb=lb,
                                   weights=weights)
+    w = COMM_FLOPS_PER_BYTE if comm_weight is None else float(comm_weight)
     return (matmul_cost(n, k, m, da, db) * flop_scale
-            + COMM_FLOPS_PER_BYTE * comm), lay
+            + w * comm), lay
 
 
 def matmul_out_nnz(

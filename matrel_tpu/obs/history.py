@@ -166,6 +166,7 @@ def summarize(events: List[dict]) -> dict:
         "fleet": _summarize_fleet(events),
         "serve": _summarize_serve(events),
         "cse": _summarize_cse(events),
+        "cost_model": _summarize_cost_model(events),
         "lockdep": _summarize_lockdep(events),
         "resilience": _summarize_resilience(events, len(qs)),
         "overload": _summarize_overload(events),
@@ -370,6 +371,41 @@ def _summarize_resilience(events: List[dict], n_queries: int) -> dict:
         "rungs": rungs,
         "fault_sites": sites,
     }
+
+
+def _summarize_cost_model(events: List[dict]) -> Optional[dict]:
+    """Cost-model loop roll-up (round 19, docs/COST_MODEL.md): how many
+    planner decisions ranked by measured coefficients vs the analytic
+    closed forms, the coefficient epoch the log ends on, and the
+    re-plan rounds the drift controller actioned. None when the log
+    carries no cost-model signal at all (coeff planner off — the
+    roll-up key is absent, not zeroed, so default-config reports are
+    bit-identical to pre-round-19 output)."""
+    counts: Dict[str, int] = {}
+    epoch = None
+    for e in events:
+        if e.get("kind") != "query":
+            continue
+        if e.get("coeff_epoch"):
+            epoch = e["coeff_epoch"]
+        for d in e.get("matmuls") or ():
+            c = d.get("cost")
+            if c:
+                counts[c] = counts.get(c, 0) + 1
+    replans = [e for e in events if e.get("kind") == "replan"]
+    if not counts and epoch is None and not replans:
+        return None
+    rewarmed = sum(int(e.get("replanned") or 0) for e in replans)
+    out = {"measured": counts.get("measured", 0),
+           "analytic": counts.get("analytic", 0),
+           "epoch": epoch,
+           "replans": len(replans),
+           "rewarmed": rewarmed}
+    if replans:
+        last = replans[-1]
+        out["last_replan"] = {"classes": last.get("classes"),
+                              "epoch": last.get("epoch")}
+    return out
 
 
 def _summarize_ivm(events: List[dict]) -> Optional[dict]:
@@ -717,6 +753,21 @@ def render_summary(events: List[dict]) -> str:
             f"{cse['batches']} batch(es), {cse['template_hits']} "
             f"template rebind(s), {cse['template_hit_queries']} "
             f"zero-optimize quer(ies)")
+    cmod = s.get("cost_model")
+    if cmod:
+        line = (f"cost model: {cmod['measured']} measured / "
+                f"{cmod['analytic']} analytic decision(s)")
+        if cmod.get("epoch"):
+            line += f", epoch {cmod['epoch']}"
+        if cmod.get("replans"):
+            line += (f", {cmod['replans']} re-plan round(s) "
+                     f"({cmod['rewarmed']} plan(s) re-warmed)")
+            lr = cmod.get("last_replan") or {}
+            if lr.get("classes"):
+                line += ("; last: classes "
+                         + ", ".join(lr["classes"])
+                         + f" -> epoch {lr.get('epoch')}")
+        lines.append(line)
     ld = s.get("lockdep")
     if ld:
         diags = ", ".join(f"{k}: {v}"
@@ -818,6 +869,46 @@ def main(args) -> int:
             print(f"DRIFT CHECK FAILED: {len(flags)} rank-order "
                   f"flag(s) — the planner prefers a strategy that "
                   f"measures slower")
+            return 1
+    elif getattr(args, "coeffs", False):
+        # the cost-model loop view (round 19, docs/COST_MODEL.md):
+        # rank-order flags the log's samples support, each paired with
+        # whether a later `replan` event actioned it. --check turns a
+        # firing-but-UNACTIONED flag into a nonzero exit: the drift
+        # controller either is not running (coeff_replan_enable off
+        # while drift fires) or is wedged — either way the loop is
+        # open and `make obs-report` must not read green over it
+        from matrel_tpu.obs import drift
+        flags = drift.rank_flags(list(drift.iter_samples(events)))
+        actioned = set()
+        for e in events:
+            if e.get("kind") != "replan":
+                continue
+            for fl in e.get("flags") or ():
+                actioned.add((fl.get("class"), fl.get("backend")))
+        cmod = _summarize_cost_model(events) or {}
+        print(f"cost model: {cmod.get('measured', 0)} measured / "
+              f"{cmod.get('analytic', 0)} analytic decision(s), "
+              f"epoch {cmod.get('epoch')}, "
+              f"{cmod.get('replans', 0)} re-plan round(s)")
+        unactioned = []
+        for fl in flags:
+            key = (fl["class"], fl["backend"])
+            done = key in actioned
+            if not done:
+                unactioned.append(fl)
+            print(f"  flag [{fl['class']}|{fl['backend']}]: model "
+                  f"prefers {fl['model_prefers']}, measures "
+                  f"{fl['slowdown']}x slower than "
+                  f"{fl['measured_prefers']} "
+                  f"({'actioned' if done else 'UNACTIONED'})")
+        if not flags:
+            print("  no rank-order flags — model agrees with "
+                  "measurement on every sampled population")
+        if getattr(args, "check", False) and unactioned:
+            print(f"COEFF CHECK FAILED: {len(unactioned)} firing "
+                  f"rank-order flag(s) with no re-plan round — the "
+                  f"cost-model loop is open")
             return 1
     elif args.summary:
         print(render_summary(events))

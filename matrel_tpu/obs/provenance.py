@@ -165,14 +165,18 @@ class ProvenanceLedger:
                 executed=None, plan=None, strategies=None,
                 mesh=None, config=None,
                 fleet: Optional[dict] = None,
-                stale: Optional[dict] = None) -> dict:
+                stale: Optional[dict] = None,
+                coeff_epoch: Optional[str] = None) -> dict:
         """Assemble + append one lineage record; returns the JSON-safe
         summary for the caller to emit as a ``provenance`` event.
         ``ent`` is the serving cache entry (hit paths), ``executed``
         the possibly-substituted tree that actually ran (interior
         ancestry), ``plan`` the compiled plan (strategy provenance);
         ``strategies`` overrides the plan's decision records with one
-        root's (the MultiPlan batch path)."""
+        root's (the MultiPlan batch path); ``coeff_epoch`` records
+        which learned-coefficient epoch priced the answer's plan
+        (docs/COST_MODEL.md — None with the loop off: no new field,
+        the bit-identity contract)."""
         from matrel_tpu.resilience import degrade as degrade_lib
         qid = f"p{next(_prov_seq)}"
         if ent is not None and path in ("rc_hit", "stale"):
@@ -211,6 +215,8 @@ class ProvenanceLedger:
         }
         if rung > 0:
             summary["degrade"] = degrade_lib.rung_meta(rung)
+        if coeff_epoch is not None:
+            summary["coeff_epoch"] = coeff_epoch
         if ent is not None:
             cache: dict = {"kind": "whole", "entry": _entry_stamp(ent)}
             if ent.delta_gen:

@@ -209,9 +209,11 @@ def comm_cost(strategy: str, n: int, k: int, m: int,
               itemsize: int = 4,
               a_layout: str = "2d", b_layout: str = "2d",
               alpha_bytes: float = 0.0,
-              weights: Tuple[float, float] = (1.0, 1.0)) -> float:
+              weights: Tuple[float, float] = (1.0, 1.0),
+              coeff: Optional[dict] = None) -> float:
     """Estimated per-device interconnect cost of each strategy, in
-    weighted byte-equivalents.
+    weighted byte-equivalents — or in calibrated MILLISECONDS when a
+    ``coeff`` row is passed (see below).
 
     ``a_layout``/``b_layout`` describe how the operand already lives on the
     mesh ("2d", "row", "col", "rep", "other"): co-partitioned inputs make
@@ -241,25 +243,50 @@ def comm_cost(strategy: str, n: int, k: int, m: int,
     default (1.0, 1.0) reproduces the flat byte model bit-identically
     (same per-term arithmetic, same summation order); α steps are
     weighted the same way.
+
+    ``coeff`` (a drift-calibrated row from parallel/coeffs.py — the
+    ML018 seam) converts the weighted bill into measured milliseconds:
+    the row's ms/est-MiB ratio was calibrated against exactly this
+    quantity (the drift samples' ``est_bytes``), so the scale applies
+    to what it was measured on. None (the default) keeps the raw
+    byte-equivalents every existing caller ranks by — bit-identical.
     """
-    return _comm_detail(strategy, n, k, m, da, db, gx, gy, itemsize,
+    cost = _comm_detail(strategy, n, k, m, da, db, gx, gy, itemsize,
                         a_layout, b_layout, alpha_bytes, weights)[0]
+    if coeff is not None:
+        from matrel_tpu.parallel import coeffs as coeffs_lib
+        cm = coeff.get("ms_per_mib")
+        if cm is None:
+            cm = coeffs_lib.ANALYTIC_MS_PER_MIB
+        return float(cm) * (cost / (1 << 20))
+    return cost
 
 
 def comm_cost_axes(strategy: str, n: int, k: int, m: int,
                    da: float, db: float, gx: int, gy: int,
                    itemsize: int = 4,
                    a_layout: str = "2d", b_layout: str = "2d",
-                   weights: Tuple[float, float] = (1.0, 1.0)
+                   weights: Tuple[float, float] = (1.0, 1.0),
+                   coeff: Optional[dict] = None
                    ) -> Tuple[float, float]:
     """Raw (unweighted) per-device bytes a strategy moves over each
     mesh axis, as (x_bytes, y_bytes) — the per-axis decomposition of
     :func:`comm_cost`'s bill, recorded by ``matmul_decisions`` so
     slow-axis traffic is auditable per decision. ``weights`` only
     influence which stage order a full-mesh collective's bytes are
-    attributed under (the split the weighted cost actually uses)."""
+    attributed under (the split the weighted cost actually uses).
+    ``coeff`` (the parallel/coeffs.py seam row, same contract as
+    :func:`comm_cost`) scales both axes into calibrated milliseconds;
+    None keeps raw bytes — bit-identical."""
     _, bx, by = _comm_detail(strategy, n, k, m, da, db, gx, gy,
                              itemsize, a_layout, b_layout, 0.0, weights)
+    if coeff is not None:
+        from matrel_tpu.parallel import coeffs as coeffs_lib
+        cm = coeff.get("ms_per_mib")
+        if cm is None:
+            cm = coeffs_lib.ANALYTIC_MS_PER_MIB
+        scale = float(cm) / (1 << 20)
+        return bx * scale, by * scale
     return bx, by
 
 
@@ -1007,14 +1034,24 @@ def choose_strategy_ex(node: MatExpr, mesh: Mesh,
                        root_output: bool = False,
                        root_transposed: bool = False,
                        consumer_hint: Optional[str] = None,
-                       root_scale: float = 1.0
+                       root_scale: float = 1.0,
+                       cost_detail: Optional[dict] = None
                        ) -> Tuple[str, str]:
     """(strategy, source) for one matmul node. ``source`` records WHY —
     the observability side of the closed loop (physical EXPLAIN prints
     it): "override" (config.strategy_override), "dispatch" (an S×S
     SpGEMM the lowering takes regardless of the byte model), "measured"
     (autotune table hit), "model" (byte-model argmin), "default"
-    (single device / no admissible candidates)."""
+    (single device / no admissible candidates).
+
+    ``cost_detail`` (an out-param dict, the return tuple stays a
+    2-tuple for the existing callers — analysis passes unpack it
+    positionally) reports WHICH cost model priced a "model" decision
+    when ``config.coeff_planner_enable``: ``{"cost": "measured"}``
+    when the learned-coefficient ranking ran (every admissible
+    candidate had a warm parallel/coeffs.py row), ``{"cost":
+    "analytic"}`` when any candidate was cold and the closed forms
+    decided (docs/COST_MODEL.md)."""
     cfg = config or default_config()
     if _spgemm_matmul(node, cfg):
         # S×S below the density crossover: the LOWERING dispatches the
@@ -1148,6 +1185,39 @@ def choose_strategy_ex(node: MatExpr, mesh: Mesh,
                  for s, c in cands.items()}
     if not cands:
         return "xla", "default"
+    if cfg.coeff_planner_enable:
+        # learned-coefficient ranking (parallel/coeffs.py — the ML018
+        # seam; docs/COST_MODEL.md): when EVERY admissible candidate
+        # has a warm calibration row for this (strategy[@tier],
+        # shape-class, backend) population, rank by predicted
+        # milliseconds — ms/GFLOP × FLOPs + ms/est-MiB × the weighted
+        # bill each candidate was just priced at (the exact quantity
+        # the drift auditor calibrated the ratio against, root-reshard
+        # charge included). Partial coverage stays analytic: comparing
+        # one candidate's measured milliseconds against another's raw
+        # byte-equivalents would be a units error, not a ranking —
+        # the cold-class fallback the placement model set.
+        from matrel_tpu.parallel import coeffs as coeffs_lib
+        from matrel_tpu.obs import drift as drift_lib
+        import jax
+        cost_src = "analytic"
+        path = drift_lib.table_path(cfg)
+        cls = drift_lib.shape_class((n, k, m))
+        backend = jax.default_backend()
+        gf = 2.0 * n * k * m / 1e9
+        measured: Optional[dict] = {}
+        for s, c in cands.items():
+            row = coeffs_lib.strategy_row(s, cls, backend, path,
+                                          tier=tier or "")
+            if row is None or row["count"] < cfg.coeff_min_samples:
+                measured = None
+                break
+            measured[s] = coeffs_lib.predict_ms(row, gf, c)
+        if measured:
+            cands = measured
+            cost_src = "measured"
+        if cost_detail is not None:
+            cost_detail["cost"] = cost_src
     best = min(cands, key=cands.get)
     if not root_output:
         # consumer-aware tiebreak (the matmul analogue of the join
@@ -1438,14 +1508,24 @@ def annotate_strategies(e: MatExpr, mesh: Mesh,
         if tier is not None:
             e = e.with_attrs(precision_tier=tier)
     if e.kind == "matmul" and "strategy" not in e.attrs:
+        # cost-model provenance (docs/COST_MODEL.md): only requested —
+        # and only stamped — under coeff_planner_enable, so default
+        # plans carry zero new attrs (the bit-identity snapshot
+        # contract)
+        detail = ({} if config is not None
+                  and config.coeff_planner_enable else None)
         strat, source = choose_strategy_ex(e, mesh, config,
                                            dtype_memo=memo,
                                            layout_memo=lmemo,
                                            root_output=_root_scale > 0.0,
                                            root_transposed=_root_swap,
                                            consumer_hint=_consumer_hint,
-                                           root_scale=_root_scale)
-        e = e.with_attrs(strategy=strat, strategy_source=source)
+                                           root_scale=_root_scale,
+                                           cost_detail=detail)
+        stamp = {"strategy": strat, "strategy_source": source}
+        if detail is not None and detail.get("cost"):
+            stamp["cost_model"] = detail["cost"]
+        e = e.with_attrs(**stamp)
         if strat == "spgemm":
             # registry dispatch (ops/kernel_registry.py): stamp WHICH
             # kernel the S×S lowering will run — chosen from the
@@ -1526,6 +1606,13 @@ def matmul_decisions(root: MatExpr, mesh: Mesh,
                "strategy": n.attrs.get("strategy", "xla"),
                "source": n.attrs.get("strategy_source", "unknown"),
                "flops": 2.0 * nn * kk * mm}
+        cm = n.attrs.get("cost_model")
+        if cm:
+            # WHICH cost model priced the ranking: "measured" (learned
+            # parallel/coeffs.py coefficients) or "analytic" (closed
+            # forms) — absent with coeff_planner_enable off, the
+            # bit-identity obs contract (docs/COST_MODEL.md)
+            rec["cost"] = cm
         tier = n.attrs.get("precision_tier")
         if tier is not None:
             # the chosen precision tier + what it really costs/promises

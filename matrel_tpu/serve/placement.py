@@ -46,18 +46,16 @@ fall back to the analytic constants (``"analytic"``).
 from __future__ import annotations
 
 import dataclasses
-import os
-import threading
 from typing import Dict, Optional, Tuple
-from matrel_tpu.utils import lockdep
 
-#: Analytic fallback coefficients — deliberately round numbers in the
-#: planner's "relative units are what matter" tradition: ~1 TFLOP/s
+#: Analytic fallback coefficients — re-exported from the coefficient
+#: seam (parallel/coeffs.py, where the whole pattern now lives after
+#: the round-19 promotion) for the existing import sites: ~1 TFLOP/s
 #: effective per device and ~50 GB/s effective collective bandwidth.
 #: A drift-calibrated row replaces both the moment one exists; these
 #: only ever decide the span/slice trade, never numerics.
-ANALYTIC_MS_PER_GFLOP = 1.0
-ANALYTIC_MS_PER_MIB = 0.02
+from matrel_tpu.parallel.coeffs import (ANALYTIC_MS_PER_GFLOP,
+                                        ANALYTIC_MS_PER_MIB)
 
 #: Precision-SLA -> calibration-tier suffix for coefficient lookup
 #: (the drift table keys tiered rows ``strategy@tier``). Default/exact
@@ -149,72 +147,27 @@ def fleet_key(e, names_by_id: Dict[int, str],
 # Drift-calibrated coefficients (ROADMAP item 4's feedback loop)
 # ---------------------------------------------------------------------------
 
-_coeff_lock = lockdep.make_lock("serve.placement_coeff")
-_coeff_cache: dict = {}
-
 
 def placement_coefficients(path: str) -> Dict[Tuple[str, str, str],
                                               dict]:
-    """Promote the drift table's per-(strategy, class, backend)
-    calibration rows into per-(shape-class, backend, tier)
-    COEFFICIENTS the placement model consults ahead of its closed
-    forms: a count-weighted blend of each population's ms/GFLOP and
-    ms/est-MiB medians (strategies are the planner's concern — the
-    placement trade is per query, so the class-level blend is the
-    right altitude). Rows: ``{"ms_per_gflop", "ms_per_mib", "count",
-    "source": "measured"}``; absent keys mean "cold class" and the
-    caller falls back to the analytic model. Memoised on the table
-    file's stat signature (the export-endpoint drift-cache idiom) so
-    per-submit consults never re-parse an unchanged table."""
-    try:
-        st = os.stat(path)
-        sig = (st.st_size, st.st_mtime_ns)
-    except OSError:
-        return {}
-    with _coeff_lock:
-        hit = _coeff_cache.get(path)
-        if hit is not None and hit[0] == sig:
-            return hit[1]
-    from matrel_tpu.obs import drift
-    table = drift.load_table(path)
-    acc: Dict[Tuple[str, str, str], dict] = {}
-    for row in table.get("entries", {}).values():
-        strat = str(row.get("strategy") or "")
-        tier = strat.split("@", 1)[1] if "@" in strat else ""
-        key = (str(row.get("class") or "?"),
-               str(row.get("backend") or "?"), tier)
-        n = int(row.get("count") or 0)
-        if n <= 0:
-            continue
-        slot = acc.setdefault(key, {"_gf": 0.0, "_gfn": 0,
-                                    "_mib": 0.0, "_mibn": 0})
-        if isinstance(row.get("ms_per_gflop"), (int, float)):
-            slot["_gf"] += row["ms_per_gflop"] * n
-            slot["_gfn"] += n
-        if isinstance(row.get("ms_per_est_mib"), (int, float)):
-            slot["_mib"] += row["ms_per_est_mib"] * n
-            slot["_mibn"] += n
-    coeffs: Dict[Tuple[str, str, str], dict] = {}
-    for key, slot in acc.items():
-        if not slot["_gfn"] and not slot["_mibn"]:
-            continue
-        coeffs[key] = {
-            "ms_per_gflop": (slot["_gf"] / slot["_gfn"]
-                             if slot["_gfn"] else None),
-            "ms_per_mib": (slot["_mib"] / slot["_mibn"]
-                           if slot["_mibn"] else None),
-            "count": max(slot["_gfn"], slot["_mibn"]),
-            "source": "measured",
-        }
-    with _coeff_lock:
-        _coeff_cache[path] = (sig, coeffs)
-    return coeffs
+    """The per-(shape-class, backend, tier) coefficient blend the
+    placement model consults ahead of its closed forms — since round
+    19 served from the ONE coefficient seam
+    (parallel/coeffs.class_coefficients, matlint ML018): this module
+    introduced the pattern in PR 15, the main planner now shares it,
+    and both read the same memoised view of the drift table. Rows:
+    ``{"ms_per_gflop", "ms_per_mib", "count", "source": "measured"}``;
+    absent keys mean "cold class" and the caller falls back to the
+    analytic model."""
+    from matrel_tpu.parallel import coeffs
+    return coeffs.class_coefficients(path)
 
 
 def reset_coefficient_cache() -> None:
-    """Test hook: drop the stat-signature memo."""
-    with _coeff_lock:
-        _coeff_cache.clear()
+    """Test hook: drop the seam's stat-signature memo (kept under the
+    historical name — tests and operators call it here)."""
+    from matrel_tpu.parallel import coeffs
+    coeffs.reset_coefficient_cache()
 
 
 # ---------------------------------------------------------------------------

@@ -42,6 +42,7 @@ from matrel_tpu.resilience import faults as faults_lib
 from matrel_tpu.resilience import retry as retry_lib
 from matrel_tpu.resilience.retry import RetryPolicy
 from matrel_tpu.serve import mqo as mqo_lib
+from matrel_tpu.serve import replan as replan_lib
 from matrel_tpu.serve.result_cache import (CacheEntry, ResultCache,
                                            result_nbytes)
 from matrel_tpu.utils import lockdep
@@ -158,6 +159,14 @@ class MatrelSession:
         # test-enforced). When on, every served answer appends one
         # lineage record here and emits a ``provenance`` event.
         self._prov = provenance_lib.from_config(self.config)
+        # cost-model re-plan controller (serve/replan.py;
+        # docs/COST_MODEL.md): watches the query event stream and
+        # turns a firing DRIFT rank-order flag into a coefficient
+        # re-calibration + background re-warm of the affected cached
+        # plans — None unless config.coeff_replan_enable (the
+        # structural-zero contract: replan._CONSTRUCTED stays 0,
+        # poisoned-init test-enforced)
+        self._replan = replan_lib.from_config(self.config, self)
         self._exporter = export_lib.from_config(self)
         # lockdep diagnostics ride the ONE obs funnel as ``lockdep``
         # events (event log + flight ring; history --summary rolls
@@ -395,7 +404,7 @@ class MatrelSession:
         faults_lib.check("compile", self.config)
         key, pins = _plan_key(e)
         key = (degrade_lib.key_prefix(rung) + self._axisw_prefix()
-               + _prec_prefix(sla) + key)
+               + self._coeff_prefix() + _prec_prefix(sla) + key)
         with self._compile_lock:
             plan = self._plan_cache.get(key)
             if plan is not None:
@@ -440,6 +449,30 @@ class MatrelSession:
             return ""
         return f"axisw:{wts[0]:g}x{wts[1]:g}|"
 
+    def _coeff_epoch(self) -> Optional[str]:
+        """The coefficient epoch in force (parallel/coeffs.epoch — a
+        digest of the drift table's blended ratios), or None with
+        coeff_planner_enable off. Rides every query record and
+        provenance capture, so obs can always say which coefficients
+        priced an answer's plan (docs/COST_MODEL.md)."""
+        if not self.config.coeff_planner_enable:
+            return None
+        from matrel_tpu.obs import drift as drift_lib
+        from matrel_tpu.parallel import coeffs as coeffs_lib
+        return coeffs_lib.epoch(drift_lib.table_path(self.config))
+
+    def _coeff_prefix(self) -> str:
+        """Coefficient-epoch plan-key isolation (the axisw/prec/delta
+        prefix idiom; docs/COST_MODEL.md): plans ranked under
+        different learned coefficients must never share a cache slot —
+        a re-calibration (serve/replan.py) bumps the epoch, so every
+        affected entry invalidates LAZILY: old plans keep serving
+        in-flight queries, new lookups miss and recompile under the
+        corrected coefficients. Empty with coeff_planner_enable off
+        (the historical key format, bit-identical)."""
+        ep = self._coeff_epoch()
+        return "" if ep is None else f"coeffv:{ep}|"
+
     def _compile_multi_entry(self, roots: List[MatExpr],
                              sla: Optional[str] = None,
                              rung: int = 0
@@ -468,8 +501,8 @@ class MatrelSession:
             uniq.setdefault(k, e)
         skeys = sorted(uniq)
         mkey = ("multi:" + degrade_lib.key_prefix(rung)
-                + self._axisw_prefix() + _prec_prefix(sla)
-                + "||".join(skeys))
+                + self._axisw_prefix() + self._coeff_prefix()
+                + _prec_prefix(sla) + "||".join(skeys))
         with self._compile_lock:
             plan = self._plan_cache.get(mkey)
             if plan is not None:
@@ -513,6 +546,47 @@ class MatrelSession:
         return {"plans": len(self._plan_cache),
                 "hoisted_bytes": self._plan_cache_bytes,
                 "evicted": self._plan_cache_evicted}
+
+    def _replan_warm(self, classes) -> dict:
+        """Proactively recompile cached plans whose matmul decisions
+        touch the given shape classes, under the CURRENT coefficient
+        epoch (serve/replan.py's background thread calls this after a
+        re-calibration). Correctness never depends on it — the
+        ``coeffv:`` key prefix already makes every post-bump lookup
+        miss and recompile lazily; this pass just pays the compiles
+        off the query path. Each entry re-warms from its pinned root
+        expr(s) at the session default SLA / rung 0 — SLA-variant and
+        degraded entries re-warm lazily on first use (a warm is an
+        optimization, so fidelity loss there costs one compile, never
+        an answer). Old-epoch entries stay until LRU eviction: an
+        in-flight query holding one is never invalidated under it."""
+        from matrel_tpu.obs import drift as drift_lib
+        with self._compile_lock:
+            snapshot = list(self._plan_cache.values())
+        matched = warmed = 0
+        for plan in snapshot:
+            pin = getattr(plan, "_cache_pin", None)
+            if pin is None:
+                continue
+            try:
+                decs = executor_lib.plan_matmul_decisions(plan)
+            except Exception:  # matlint: disable=ML007 best-effort warm census — an unreadable plan is skipped; the lazy coeffv: miss still re-plans it
+                continue
+            if not any(drift_lib.shape_class(d.get("dims") or ())
+                       in classes for d in decs):
+                continue
+            matched += 1
+            roots = pin[0]
+            try:
+                if isinstance(roots, tuple):
+                    self._compile_multi_entry(list(roots))
+                else:
+                    self._compile_entry(roots)
+                warmed += 1
+            except Exception:
+                log.warning("replan: warm recompile failed",
+                            exc_info=True)
+        return {"matched": matched, "replanned": warmed}
 
     # -- cross-query result cache (matrel_tpu/serve/) ----------------------
 
@@ -748,12 +822,12 @@ class MatrelSession:
 
     def _tpl_prefix(self, sla: str, rung: int) -> str:
         """Template keys compose the SAME isolation prefixes as
-        concrete plan keys (``degr:``/``axisw:``/``prec:`` — the
-        _compile_entry idiom): a degraded or fast-SLA template can
-        never serve a pristine exact query, because the probes never
-        share a key namespace."""
+        concrete plan keys (``degr:``/``axisw:``/``coeffv:``/``prec:``
+        — the _compile_entry idiom): a degraded or fast-SLA template
+        can never serve a pristine exact query, because the probes
+        never share a key namespace."""
         return (degrade_lib.key_prefix(rung) + self._axisw_prefix()
-                + _prec_prefix(sla))
+                + self._coeff_prefix() + _prec_prefix(sla))
 
     def _template_probe(self, e: MatExpr, sla: str, rung: int):
         """(plan, concrete key, bindings) when a cached template can
@@ -1047,7 +1121,8 @@ class MatrelSession:
                 ent=ent, executed=executed, plan=plan,
                 strategies=strategies,
                 mesh=mesh if mesh is not None else self.mesh,
-                config=cfg, fleet=fleet, stale=stale)
+                config=cfg, fleet=fleet, stale=stale,
+                coeff_epoch=self._coeff_epoch())
             self._obs_emit("provenance", summary)
             return summary
         except Exception:
@@ -1206,7 +1281,17 @@ class MatrelSession:
         # calibrate per backend (a CPU ms and a TPU ms must never
         # blend into one ratio)
         record["backend"] = jax.default_backend()
+        if self.config.coeff_planner_enable:
+            # which coefficient epoch priced this answer's plan — the
+            # history cost-model roll-up's feed (absent with the loop
+            # off: the bit-identity obs contract, docs/COST_MODEL.md)
+            record["coeff_epoch"] = self._coeff_epoch()
         self._obs_emit("query", record)
+        if self._replan is not None:
+            # feed the re-plan controller AFTER emission: it sees the
+            # same record the log does (backend + matmuls included),
+            # and its own failure can never drop the query event
+            self._replan.observe(record)
         REGISTRY.counter("query.count").inc()
         REGISTRY.counter("plan_cache.hit" if hit
                          else "plan_cache.miss").inc()

@@ -376,6 +376,28 @@ def test_reshard_row_artifact(dry_batch):
             assert row["peak_bytes"] < row["naive_peak_bytes"], row
 
 
+def test_coeffs_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records,
+               lambda r: r.get("metric") == "coeff_planner_sweep"
+               and "rows" in r, "bench.py --coeffs")
+    # the cost-model-loop acceptance on the dry mesh: every workload
+    # class fully covered by calibrated rows (all decisions stamped
+    # measured), answers bit-close to the analytic path, and the
+    # calibrated ranking never slower beyond the documented guard band
+    # (identical picks = identical plans, exempt from the jitter gate)
+    assert rec["ok"] is True, rec
+    names = [row["workload"] for row in rec["rows"]]
+    assert names == ["chain", "pagerank_step", "linreg_epilogue"], names
+    assert len(rec["classes"]) == 3, rec["classes"]  # distinct buckets
+    for row in rec["rows"]:
+        assert row["ok"] is True, row
+        assert row["covered"] is True, row
+        assert row["outputs_agree"] is True, row
+        assert all(c == "measured" for c in row["cost_sources"]), row
+        assert row["speedup"] is not None, row
+
+
 def test_bench_all_rows_artifacts(dry_batch):
     _, records, _ = dry_batch
     # every heavy row emits an explicit, parseable skip record — a

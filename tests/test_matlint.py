@@ -1092,6 +1092,76 @@ class TestML017LockSeam:
         assert _lint(tmp_path, src, "matrel_tpu/obs/newobs.py") == []
 
 
+class TestML018CoeffSeam:
+    def test_fires_on_drift_qualified_call(self, tmp_path):
+        src = """
+            from matrel_tpu.obs import drift
+            def rank(cfg):
+                table = drift.load_table(drift.table_path(cfg))
+                return table
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newrank.py")
+        assert _rules(got) == ["ML018"]
+
+    def test_fires_on_import_from_drift(self, tmp_path):
+        src = """
+            from matrel_tpu.obs.drift import load_table, table_path
+            def rank(cfg):
+                return load_table(table_path(cfg))
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/parallel/newrank.py")
+        assert _rules(got) == ["ML018"]
+
+    def test_seam_consult_passes(self, tmp_path):
+        # the sanctioned idiom: memoized, epoch-stamped reads through
+        # parallel/coeffs.py (table_path/shape_class stay legal — they
+        # are addressing, not reads)
+        src = """
+            from matrel_tpu.obs import drift
+            from matrel_tpu.parallel import coeffs
+            def rank(cfg, strategy, dims):
+                return coeffs.strategy_row(
+                    strategy, drift.shape_class(dims), "cpu",
+                    drift.table_path(cfg))
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newrank.py") == []
+
+    def test_autotune_table_reader_passes(self, tmp_path):
+        # parallel/autotune.py has its own same-named load_table for
+        # the AUTOTUNE table — a different store with its own seam;
+        # only drift-qualified consults are in ML018's domain
+        src = """
+            import json
+            def load_table(path):
+                with open(path) as f:
+                    return json.load(f)
+            def consult(path):
+                return load_table(path)
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/parallel/newtune.py") == []
+
+    def test_obs_modules_out_of_scope(self, tmp_path):
+        # the auditor/controller plane OWNS the table — obs/ reads and
+        # writes it directly by design
+        src = """
+            from matrel_tpu.obs import drift
+            def audit(cfg):
+                return drift.load_table(drift.table_path(cfg))
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/obs/newaudit.py") == []
+
+    def test_coeffs_module_is_the_sanctioned_seam(self, tmp_path):
+        src = """
+            from matrel_tpu.obs import drift
+            def _payload(path):
+                return drift.load_table(path)
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/parallel/coeffs.py") == []
+
+
 def test_repo_lints_clean():
     """`make lint`'s contract, enforced from inside tier-1: the whole
     default scan set (package, tools, examples, bench harnesses) has

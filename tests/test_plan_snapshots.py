@@ -31,15 +31,26 @@ def _load_tool():
 
 @pytest.fixture(scope="module")
 def snapshots():
+    from matrel_tpu.serve import replan
     tool = _load_tool()
     with open(tool.SNAPSHOT_PATH) as f:
         want = json.load(f)
+    before = replan._CONSTRUCTED["count"]
     got = tool.build_snapshots()
-    return want, got
+    constructed = replan._CONSTRUCTED["count"] - before
+    return want, got, constructed
+
+
+def test_snapshot_build_constructs_no_replan_state(snapshots):
+    # poisoned-init proof at corpus scale: planning the whole default-
+    # config corpus must never build a ReplanController — the cost-
+    # model loop is structurally absent until coeff_replan_enable
+    *_, constructed = snapshots
+    assert constructed == 0
 
 
 def test_snapshot_corpus_covered(snapshots):
-    want, got = snapshots
+    want, got, _ = snapshots
     assert set(want) == set(got), (
         "corpus and snapshot disagree on entry names — regenerate via "
         "tools/plan_snapshot.py --update")
@@ -62,7 +73,7 @@ def test_plan_signature_stable(name, snapshots):
     assert name != "__snapshot_file_unreadable__", (
         "tests/plan_snapshots.json is missing or corrupt — regenerate "
         "via tools/plan_snapshot.py --update")
-    want, got = snapshots
+    want, got, _ = snapshots
     assert got[name] == want[name], (
         f"plan for {name!r} changed — if intentional, regenerate via "
         f"tools/plan_snapshot.py --update and commit the JSON\n"

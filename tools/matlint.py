@@ -59,6 +59,12 @@ Rule catalogue (each rule's class docstring is the authority):
          lockdep-swappable only when built through make_lock/
          make_rlock (the ML009/ML010 one-seam idiom applied to
          locks; docs/CONCURRENCY.md)
+  ML018  raw drift-table read (drift.load_table) in planner/serve
+         code outside the parallel/coeffs.py seam — coefficient
+         consults flow through one memoized, epoch-stamped reader so
+         every consumer ranks by the SAME table state and plan keys
+         shatter exactly when decisions could change
+         (docs/COST_MODEL.md)
 """
 
 from __future__ import annotations
@@ -1107,6 +1113,61 @@ class LockSeamRule(Rule):
                     f"it is named, order-tracked and drill-able")
 
 
+class CoeffSeamRule(Rule):
+    """ML018: raw ``drift.load_table`` consult in planner/serve code
+    outside the ``parallel/coeffs.py`` seam.
+
+    The cost-model loop (docs/COST_MODEL.md) hangs off ONE coefficient
+    reader: ``parallel/coeffs.py`` parses the drift table once per
+    file state (stat-signature memoized), drops non-finite rows, and
+    stamps the coefficient EPOCH the session embeds in every plan key
+    (``coeffv:``). A planner or serve module that calls
+    ``drift.load_table`` directly re-reads and re-parses the raw JSON
+    on its own schedule: it can rank by a table state no other
+    consumer saw, its decisions carry no epoch (so a re-plan round
+    cannot invalidate the plans it influenced), and the NaN/zero-ms
+    hardening lives only in the seam — the ML009/ML010 one-seam
+    argument applied to learned coefficients. ``obs/`` is out of
+    scope (the auditor/controller own the table and its writers);
+    the seam itself is exempt."""
+
+    id = "ML018"
+    _EXEMPT = ("matrel_tpu/parallel/coeffs.py",)
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("matrel_tpu/")
+                and not relpath.startswith("matrel_tpu/obs/")
+                and relpath not in self._EXEMPT)
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if (node.module or "").endswith("obs.drift") and any(
+                        a.name == "load_table" for a in node.names):
+                    yield Finding(
+                        relpath, node.lineno, self.id,
+                        "load_table imported from obs.drift outside "
+                        "the coefficient seam — consult "
+                        "parallel/coeffs.py (strategy_row/"
+                        "class_coefficients/epoch) so the read is "
+                        "memoized, hardened and epoch-stamped")
+            elif isinstance(node, ast.Call):
+                # drift-qualified calls only (drift.load_table,
+                # drift_lib.load_table): the autotune table has its
+                # own same-named reader in parallel/autotune.py and
+                # is a different store with its own seam
+                name = _call_name(node.func)
+                if (name.rsplit(".", 1)[-1] == "load_table"
+                        and "drift" in name.rsplit(".", 1)[0]):
+                    yield Finding(
+                        relpath, node.lineno, self.id,
+                        "raw drift.load_table consult outside the "
+                        "coefficient seam — consult "
+                        "parallel/coeffs.py (strategy_row/"
+                        "class_coefficients/epoch) so the read is "
+                        "memoized, hardened and epoch-stamped")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
                         SpecKeyedCacheRule(), RawTimingRule(),
@@ -1115,7 +1176,7 @@ RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         UnboundedQueueRule(), ResultCacheSeamRule(),
                         TimingAccumulationRule(), FleetSeamRule(),
                         ProvenanceSeamRule(), TemplateKeyRule(),
-                        LockSeamRule())
+                        LockSeamRule(), CoeffSeamRule())
 
 
 def _suppressed_codes(line: str) -> set:

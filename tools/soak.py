@@ -1258,6 +1258,231 @@ def soak_overload(n_trials: int, base: int, tol: float):
     return fails
 
 
+def soak_coeffs(n_trials: int, base: int, tol: float):
+    """Cost-model closed-loop battery (parallel/coeffs.py,
+    serve/replan.py; docs/COST_MODEL.md): seeded-miscalibration
+    convergence. Per trial, the drift table is POISONED >=4x off — the
+    shape class's cheapest-by-bytes strategy (the one the analytic
+    byte model loves) claims coefficients far below reality while its
+    TRUE cost is 4x the worst candidate — so the coefficient-ranked
+    planner provably mispicks it on first contact. Replay traffic then
+    flows a ReplanController wired to a live session: per round, the
+    planner's current pick plus (round 1 only) a canary sweep of every
+    candidate, each sample's execute_ms drawn from a deterministic
+    per-strategy ground-truth model with seeded noise. The checks:
+
+      * the poison takes (initial pick == the decoy),
+      * a DRIFT rank flag fires and the controller re-calibrates,
+        converging the pick to the TRUE winner within <=3 re-plan
+        rounds (count-weighted blend: poisoned priors wash out),
+      * ZERO wrong answers: a real query runs on the session every
+        round — including the rounds where the coefficient epoch flips
+        under it — and matches the numpy oracle,
+      * ZERO oscillation: over a 3-round exploit-only tail the pick
+        never leaves the winner and no further re-plan actions (the
+        cooldown + dropped-window + reversal-dwell hysteresis),
+      * the epoch bump is visible end-to-end (replan record old !=
+        new epoch; the session's plan re-warm census counted it).
+    """
+    import json as _json
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import jax
+    from matrel_tpu import executor as executor_lib
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.obs import drift
+    from matrel_tpu.parallel import coeffs as coeffs_lib, planner
+    from matrel_tpu.serve import replan as replan_lib
+    from matrel_tpu.session import MatrelSession
+
+    mesh = mesh_lib.make_mesh()
+    gx, gy = mesh_lib.mesh_grid_shape(mesh)
+    backend = jax.default_backend()
+    fails = []
+    for trial in range(n_trials):
+        seed = base + trial
+        rng = np.random.default_rng(seed)
+        tmp = tempfile.mkdtemp(prefix="matrel_soak_coeffs_")
+        table = os.path.join(tmp, "drift.json")
+        try:
+            n = int(rng.choice([96, 112, 128]))
+            cls = drift.shape_class((n, n, n))
+            gf = 2.0 * n ** 3 / 1e9
+            cands = [s for s in ("bmm_right", "bmm_left", "cpmm",
+                                 "rmm", "summa", "xla")
+                     if not (s == "summa" and gx != gy)]
+            est = {s: max(float(planner.comm_cost(s, n, n, n, 1.0,
+                                                  1.0, gx, gy)),
+                          1024.0)
+                   for s in cands}
+            # ground truth: a well-separated ms ladder shuffled over
+            # the candidates (gaps >= 45%, far above the 3% sample
+            # noise, so the calibrated ranking can never flap on a
+            # near-tie); the DECOY is the byte model's favourite (min
+            # est bytes, deterministic name tiebreak) with its true
+            # cost forced to 4x the worst other — the drift scenario
+            # in its purest form
+            ladder = [0.4, 0.6, 0.9, 1.35, 2.0, 3.0][:len(cands)]
+            rng.shuffle(ladder)
+            ms_tab = dict(zip(cands, ladder))
+            decoy = min(cands, key=lambda s: (est[s], s))
+            ms_tab[decoy] = 4.0 * max(ms_tab[s] for s in cands
+                                      if s != decoy)
+
+            def ms_true(s):
+                return ms_tab[s]
+
+            def write_table(poisoned: bool) -> None:
+                # rows shaped exactly as drift.calibrate derives them
+                # from live samples (both ratios from the SAME total
+                # ms), so a re-calibration from truthful traffic
+                # reproduces the truthful rows and the blend is a
+                # fixed point
+                entries = {}
+                for s in cands:
+                    ms = ms_tab[s]
+                    r = {"strategy": s, "class": cls,
+                         "backend": backend, "count": 10,
+                         "ms_median": round(ms, 5),
+                         "ms_per_gflop": round(ms / gf, 5),
+                         "ms_per_est_mib": round(
+                             ms / (est[s] / 2 ** 20), 5)}
+                    if poisoned and s == decoy:
+                        r["ms_per_gflop"] = 0.01
+                        r["ms_per_est_mib"] = 0.0001
+                    entries[f"{s}|{cls}|{backend}"] = r
+                with open(table, "w") as f:
+                    _json.dump({"schema": 1, "entries": entries}, f)
+                coeffs_lib.reset_coefficient_cache()
+
+            cfg = MatrelConfig(obs_level="off",
+                               drift_table_path=table,
+                               coeff_planner_enable=True,
+                               coeff_min_samples=2)
+            cfg_ctl = cfg.replace(coeff_replan_enable=True,
+                                  coeff_replan_interval=10 ** 6,
+                                  coeff_replan_cooldown=1)
+            A = BlockMatrix.random((n, n), mesh=mesh, seed=seed)
+            B = BlockMatrix.random((n, n), mesh=mesh, seed=seed + 1)
+            oracle = (A.to_numpy().astype(np.float64)
+                      @ B.to_numpy().astype(np.float64))
+
+            def pick():
+                plan = executor_lib.compile_expr(
+                    A.expr().multiply(B.expr()), mesh, cfg)
+                decs = executor_lib.plan_matmul_decisions(plan)
+                return decs[0].get("strategy"), \
+                    decs[0].get("cost", "analytic")
+
+            # the MEASURED WINNER is the system's own choice under a
+            # truth-calibrated table — the pick the loop must converge
+            # back to once the poison washes out
+            write_table(poisoned=False)
+            winner, wcost = pick()
+            if wcost != "measured" or winner == decoy:
+                fails.append(("coeffs", seed, "BadTruthPick",
+                              f"{winner}/{wcost}, decoy {decoy}"))
+                continue
+            write_table(poisoned=True)
+
+            sess = MatrelSession(mesh=mesh, config=cfg)
+            ctl = replan_lib.ReplanController(cfg_ctl, session=sess)
+
+            def feed(s, k=6):
+                for _ in range(k):
+                    noise = float(rng.uniform(0.97, 1.03))
+                    ctl.observe({
+                        "kind": "query", "backend": backend,
+                        "cache": "miss",
+                        "execute_ms": max(ms_true(s) * noise, 1e-4),
+                        "matmuls": [{"strategy": s,
+                                     "dims": [n, n, n],
+                                     "flops": 2.0 * n ** 3,
+                                     "est_ici_bytes": est[s]}]})
+
+            first, first_cost = pick()
+            if first_cost != "measured" or first != decoy:
+                fails.append(("coeffs", seed, "PoisonDidNotTake",
+                              f"first pick {first}/{first_cost}, "
+                              f"decoy {decoy}"))
+                continue
+            # prime the session's plan cache under the POISONED epoch:
+            # this is the live plan the re-plan round must find, match
+            # and re-warm (and the answer must already be right)
+            out = sess.run(A.expr().multiply(B.expr()))
+            np.testing.assert_allclose(
+                out.to_numpy().astype(np.float64), oracle,
+                rtol=tol, atol=tol)
+            converged_at = None
+            tail_replans = 0
+            rounds = 6
+            for rnd in range(1, rounds + 1):
+                cur, _ = pick()
+                feed(cur)
+                if rnd == 1:
+                    # canary sweep: one exploration burst, the
+                    # heterogeneous-traffic stand-in that gives
+                    # rank_flags its cross-strategy evidence
+                    for s in cands:
+                        if s != cur:
+                            feed(s)
+                before = ctl.replans
+                ctl.check()
+                if converged_at is not None:
+                    tail_replans += ctl.replans - before
+                # zero wrong answers, epoch flips and all: a REAL
+                # query through the session every round
+                out = sess.run(A.expr().multiply(B.expr()))
+                np.testing.assert_allclose(
+                    out.to_numpy().astype(np.float64), oracle,
+                    rtol=tol, atol=tol)
+                cur, _ = pick()
+                if converged_at is None and cur == winner:
+                    converged_at = ctl.replans
+                elif converged_at is not None and cur != winner:
+                    fails.append(("coeffs", seed, "Oscillation",
+                                  f"pick left winner {winner} -> "
+                                  f"{cur} round {rnd}"))
+                    break
+            ctl.drain()
+            if converged_at is None:
+                fails.append(("coeffs", seed, "NoConvergence",
+                              f"decoy {decoy} winner {winner} "
+                              f"pick {pick()[0]} "
+                              f"replans {ctl.replans}"))
+                continue
+            if converged_at > 3:
+                fails.append(("coeffs", seed, "SlowConvergence",
+                              f"{converged_at} re-plan rounds"))
+            if tail_replans:
+                fails.append(("coeffs", seed, "ReplanChurn",
+                              f"{tail_replans} re-plan(s) after "
+                              f"convergence"))
+            if not ctl.events:
+                fails.append(("coeffs", seed, "NoReplanRecord", ""))
+            else:
+                ev = ctl.events[0]
+                if ev["old_epoch"] == ev["epoch"]:
+                    fails.append(("coeffs", seed, "EpochDidNotBump",
+                                  str(ev)))
+                if ev.get("replanned") is None \
+                        or ev.get("matched", 0) < 1:
+                    fails.append(("coeffs", seed, "WarmMissedPlan",
+                                  str(ev)))
+        except Exception as ex:  # noqa: BLE001 — soak collects everything
+            fails.append(("coeffs", seed, type(ex).__name__,
+                          str(ex)[:200]))
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        print(f"  coeffs {trial + 1}/{n_trials}, "
+              f"{len(fails)} failures", flush=True)
+    return fails
+
+
 def soak_checkpoint(n_trials: int, base: int, tol: float):
     """Randomized checkpoint/restore: matrices with random specs, sparse
     tile stacks, loop state — restored values AND shardings must match;
@@ -1323,7 +1548,8 @@ def main():
                    choices=["fuzz", "deep", "spmv", "sharded", "routed",
                             "ckpt", "serve", "precision", "chaos",
                             "sparse_kernels", "fusion", "overload",
-                            "stream", "fleet", "cse", "race", "all"])
+                            "stream", "fleet", "cse", "race",
+                            "coeffs", "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -1356,6 +1582,8 @@ def main():
         fails += soak_stream(max(args.seeds // 5, 4), args.base, tol)
     if args.battery in ("fleet", "all"):
         fails += soak_fleet(max(args.seeds // 5, 4), args.base, tol)
+    if args.battery in ("coeffs", "all"):
+        fails += soak_coeffs(max(args.seeds // 10, 8), args.base, tol)
     if args.battery in ("race", "all"):
         fails += soak_race(max(args.seeds // 10, 3), args.base, tol)
     if args.battery in ("precision", "all"):

@@ -59,6 +59,8 @@ if [ "$DRY" = 1 ]; then
     export MATREL_TRAFFIC_SECONDS=5 MATREL_TRAFFIC_TAIL_SECONDS=2.5 \
            MATREL_TRAFFIC_CAL=300 MATREL_TRAFFIC_N=48
     export MATREL_PRECISION_N=256 MATREL_PRECISION_REPEATS=3
+    export MATREL_COEFFS_N=128 MATREL_COEFFS_K=64 \
+           MATREL_COEFFS_MEAS=3 MATREL_COEFFS_INNER=4
     export MATREL_RESHARD_N=256 MATREL_RESHARD_REPEATS=3
     export MATREL_NS_N=2048
     export MATREL_GRAM3_K=64 MATREL_GRAM3_PANEL=4096 MATREL_GRAM3_NPANELS=2
@@ -94,6 +96,8 @@ log "--- bench.py --precision (bf16/int precision-tier sweep + error bounds, sta
 python bench.py --precision
 log "--- bench.py --reshard (staged-vs-naive reshard sweep, staged this round)"
 python bench.py --reshard
+log "--- bench.py --coeffs (calibrated-vs-analytic planner row, staged this round)"
+python bench.py --coeffs
 log "--- bench_all.py (all BASELINE rows)"
 python bench_all.py
 log "--- topology_flip (ICI/DCN-weighted planner flip proof, staged this round)"
