@@ -1662,6 +1662,146 @@ def cpu_rows() -> dict:
     return results
 
 
+def measure_spill() -> dict:
+    """Spill-hierarchy row (docs/DURABILITY.md): a working set
+    deliberately larger than ``result_cache_max_bytes`` cycles
+    through the HBM/host/disk tiers under sustained repeats (every
+    repeat answers from a lower tier — recompute count is the
+    regression signal), then the same fleet restarts COLD (fresh
+    process state, first query pays compile + execute) vs THAWED
+    (``save_state()`` → ``restore()``, first query pays only the
+    priced disk_read + h2d legs) — restart-to-first-hit is the
+    headline pair. Per-leg transfer timings land in ``rows``
+    (``{"leg","n","bytes","ms"}``), the seed calibration the drift
+    auditor ingests as ``spill:<leg>`` coefficient rows (the
+    reshard_sweep precedent). Zero wrong answers is part of the row:
+    every served repeat and both restart paths are asserted close to
+    the fresh-execution oracle."""
+    import shutil
+    import tempfile
+
+    from matrel_tpu.config import MatrelConfig, set_default_config
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.session import MatrelSession
+
+    n = _env_int("MATREL_SPILL_N", 512)
+    m = _env_int("MATREL_SPILL_MATS", 6)
+    reps = _env_int("MATREL_SPILL_REPEATS", 3)
+
+    state_dir = tempfile.mkdtemp(prefix="matrel_spill_")
+    entry_bytes = n * n * 4
+    # the budget holds ~2 entries; the working set is m of them, so
+    # sustained repeats MUST serve from the lower tiers to avoid
+    # recompute (the proof the acceptance criteria ask for)
+    budget = int(2.5 * entry_bytes)
+    cfg = MatrelConfig(obs_level="off", spill_enable=True,
+                       result_cache_max_bytes=budget,
+                       result_cache_max_entries=m + 2,
+                       spill_host_max_bytes=2 * entry_bytes,
+                       spill_disk_hits=0,
+                       state_dir=state_dir)
+    set_default_config(cfg)
+    mesh = mesh_lib.make_mesh()
+
+    def build(sess) -> dict:
+        exprs = {}
+        for i in range(m):
+            name = f"spill_{i}"
+            mat = BlockMatrix.random((n, n), mesh=mesh, seed=100 + i)
+            sess.register(name, mat)
+            exprs[name] = mat.expr().t().multiply(mat.expr())
+        return exprs
+
+    rows: list = []
+
+    def collect(rec: dict) -> None:
+        for leg in rec.get("legs") or ():
+            if isinstance(leg, dict) and leg.get("ms"):
+                rows.append({"leg": leg["leg"], "n": n,
+                             "bytes": leg["bytes"], "ms": leg["ms"]})
+
+    sess = MatrelSession(mesh=mesh, config=cfg)
+    sess._spill.emit = collect
+    exprs = build(sess)
+    oracle = {}
+    for name, e in exprs.items():
+        oracle[name] = np.asarray(sess.run(e).data)
+
+    wrong = 0
+    sustained_ms = []
+    for _ in range(max(reps, 1)):
+        for name, e in exprs.items():
+            t0 = time.perf_counter()
+            out = np.asarray(sess.run(e).data)
+            sustained_ms.append((time.perf_counter() - t0) * 1e3)
+            if not np.allclose(out, oracle[name], rtol=1e-4,
+                               atol=1e-4):
+                wrong += 1
+    sustained_ms.sort()
+    spill_info = sess.result_cache_info().get("spill") or {}
+
+    t0 = time.perf_counter()
+    save = sess.save_state()
+    save_ms = (time.perf_counter() - t0) * 1e3
+
+    first = next(iter(exprs))
+
+    # COLD restart: a fresh session, no snapshot — first answer pays
+    # plan compile + full execution
+    cold = MatrelSession(mesh=mesh, config=cfg)
+    cold_exprs = build(cold)
+    t0 = time.perf_counter()
+    out = np.asarray(cold.run(cold_exprs[first]).data)
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    if not np.allclose(out, oracle[first], rtol=1e-4, atol=1e-4):
+        wrong += 1
+
+    # THAWED restart: restore() the snapshot — the first answer thaws
+    # a restored disk entry through the priced legs, recomputing
+    # nothing
+    warm = MatrelSession(mesh=mesh, config=cfg)
+    warm._spill.emit = collect
+    t0 = time.perf_counter()
+    restore = warm.restore()
+    restore_ms = (time.perf_counter() - t0) * 1e3
+    mat = warm.catalog[first]
+    t0 = time.perf_counter()
+    out = np.asarray(warm.run(
+        mat.expr().t().multiply(mat.expr())).data)
+    thawed_ms = (time.perf_counter() - t0) * 1e3
+    if not np.allclose(out, oracle[first], rtol=1e-4, atol=1e-4):
+        wrong += 1
+    thawed = (warm.result_cache_info().get("spill") or {}).get(
+        "thawed_restored", 0)
+
+    shutil.rmtree(state_dir, ignore_errors=True)
+    return {
+        "n": n, "mats": m, "entry_bytes": entry_bytes,
+        "hbm_budget_bytes": budget,
+        "working_set_bytes": m * entry_bytes,
+        "working_set_over_budget": bool(m * entry_bytes > budget),
+        "sustained": {
+            "queries": len(sustained_ms),
+            "ms_p50": round(
+                sustained_ms[len(sustained_ms) // 2], 3),
+            "promoted": spill_info.get("promoted", 0),
+            "demoted_host": spill_info.get("demoted_host", 0),
+            "demoted_disk": spill_info.get("demoted_disk", 0),
+        },
+        "restart": {
+            "save_ms": round(save_ms, 3),
+            "restore_ms": round(restore_ms, 3),
+            "restored_entries": restore.get("rc_entries", 0),
+            "cold_first_hit_ms": round(cold_ms, 3),
+            "thawed_first_hit_ms": round(thawed_ms, 3),
+            "thawed_served_from_snapshot": bool(thawed),
+        },
+        "wrong": wrong,
+        "rows": rows,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Subprocess harness: the relay can HANG (not just error), so both the probe
 # and the measurement run as child processes under hard timeouts.
@@ -2005,6 +2145,24 @@ def main_fleet() -> None:
     print(json.dumps(record))
 
 
+def main_spill() -> None:
+    """Wedge-safe spill-hierarchy row capture (tools/tpu_batch.sh
+    step): probe, then the measurement child under a hard timeout;
+    one parseable JSON line either way, rc 0 — same contract as the
+    headline metric."""
+    ok, payload = _run_child("probe", PROBE_TIMEOUT_S)
+    if ok:
+        ok, payload = _run_child("spill", MEASURE_TIMEOUT_S)
+    record = {"metric": "spill_sweep"}
+    if ok and isinstance(payload, dict):
+        record.update(payload)
+        _emit_bench_event(dict(record))
+    else:
+        record.update({"value": None, "error": str(payload)[:500]})
+        _emit_bench_error(record["metric"], str(payload))
+    print(json.dumps(record))
+
+
 def main_stream() -> None:
     """Wedge-safe streaming-IVM row capture (tools/tpu_batch.sh step):
     probe, then the measurement child under a hard timeout; one
@@ -2066,6 +2224,10 @@ if __name__ == "__main__":
         print(json.dumps(measure_stream()))
     elif "--_fleet" in sys.argv:
         print(json.dumps(measure_fleet()))
+    elif "--_spill" in sys.argv:
+        print(json.dumps(measure_spill()))
+    elif "--spill" in sys.argv:
+        main_spill()
     elif "--fleet" in sys.argv:
         main_fleet()
     elif "--stream" in sys.argv:

@@ -61,6 +61,11 @@ codes documented in :mod:`matrel_tpu.analysis.diagnostics`):
                     DYNAMIC half (cse_pass.verify_cse_executions)
                     proves recent CSE-substituted batch roots equal
                     their unshared executions — docs/SERVING.md
+  spill      MV117  spill-thaw provenance stamps cohere with the tier
+                    hierarchy (legs are what spill_plan stages from
+                    the claimed tier, fits verdict matches the live
+                    peak budget, cost provenance classifiable) —
+                    docs/DURABILITY.md
 """
 
 from __future__ import annotations
@@ -82,6 +87,7 @@ from matrel_tpu.analysis.precision_pass import check_precision_stamps
 from matrel_tpu.analysis.provenance_pass import check_provenance_stamps
 from matrel_tpu.analysis.reshard_pass import check_reshard_peaks
 from matrel_tpu.analysis.result_cache_pass import check_result_cache_stamps
+from matrel_tpu.analysis.spill_pass import check_spill_stamps
 from matrel_tpu.analysis.strategy_pass import (check_spgemm_dispatch,
                                                check_spgemm_kernel,
                                                check_strategy_stamps)
@@ -110,6 +116,7 @@ PASSES = (
     ("placement", check_placement_stamps),
     ("provenance", check_provenance_stamps),
     ("cse", check_cse_stamps),
+    ("spill", check_spill_stamps),
 )
 
 

@@ -514,6 +514,47 @@ class MatrelConfig:
         dwell discipline): fresh samples under the NEW plans must
         accumulate before the loop may act on that population again,
         so a re-plan can never oscillate on its own stale evidence.
+      spill_enable: the result cache's HBM → host RAM → disk spill
+        hierarchy (serve/spill.py; docs/DURABILITY.md — the [P2]
+        RDD-persist amortization rebuilt as explicit priced tiers).
+        Off (the default) constructs ZERO spill objects and is
+        bit-identical to the single-tier cache: LRU eviction drops
+        entries exactly as before, plan snapshots unchanged
+        (poisoned-init test-enforced, the brownout/breaker
+        structural-zero contract). On: entries the byte budget evicts
+        DEMOTE to a host-RAM numpy tier instead of dropping (and age
+        host → disk under the host budget, as sha1-verified artifacts
+        in ``state_dir`` — requires a result cache to spill FROM, so
+        ``result_cache_max_bytes`` must be > 0, validated); a lower-
+        tier hit THAWS the entry back to HBM paying only the priced
+        transfer legs (parallel/coeffs.py ``spill:<leg>`` rows when
+        the drift loop has calibrated them, analytic per-leg ms/MiB
+        otherwise) — it never recomputes, and interior-substitution
+        probes see the thawed entry as a laid-out leaf exactly like
+        an HBM hit. Requires ``spill_enable`` for ``save_state()`` to
+        persist result-cache entries (catalog/tables persist without
+        it).
+      spill_host_max_bytes: byte budget of the host-RAM tier. Past
+        it, least-recently-used host entries age to disk when the
+        disk tier exists (``state_dir`` set) AND the entry's hit
+        count shows expected reuse (>= spill_disk_hits) — cold
+        never-hit entries drop instead of paying disk IO on no
+        evidence (docs/DURABILITY.md demotion policy).
+      spill_disk_hits: minimum lifetime hit count an entry needs for
+        the host tier to age it to DISK rather than drop it (the
+        expected-reuse gate). 0 demotes everything the host tier
+        evicts.
+      state_dir: durable state directory — the disk spill tier
+        (``<state_dir>/spill/`` sha1-verified artifacts) and the
+        ``MatrelSession.save_state()``/``restore()`` snapshot root
+        (``<state_dir>/state/`` checkpoint-format step dirs holding
+        the catalog, the result-cache index with disk-tier entries by
+        reference, the fleet directory, MQO template keys and the
+        autotune/drift tables — docs/DURABILITY.md snapshot format).
+        "" (the default) constructs nothing and disables the disk
+        tier (host-only spill when spill_enable is on);
+        ``save_state()``/``restore()`` then require an explicit
+        directory argument.
     """
 
     block_size: int = 512
@@ -606,6 +647,10 @@ class MatrelConfig:
     coeff_replan_enable: bool = False
     coeff_replan_interval: int = 32
     coeff_replan_cooldown: int = 2
+    spill_enable: bool = False
+    spill_host_max_bytes: int = 2 << 30
+    spill_disk_hits: int = 1
+    state_dir: str = ""
 
     def __post_init__(self):
         # enablement is "anything != off", so an unvalidated typo/case
@@ -888,6 +933,25 @@ class MatrelConfig:
             raise ValueError(
                 f"coeff_replan_cooldown must be >= 0, "
                 f"got {self.coeff_replan_cooldown!r}")
+        # durability knobs (docs/DURABILITY.md): a spill hierarchy
+        # under a DISABLED result cache would demote nothing while the
+        # operator believes the working set extends past HBM (the
+        # lockdep_raise dependency precedent); a non-positive host
+        # budget would bounce every demotion straight to disk/drop
+        # while reading as "host tier in force"
+        if self.spill_enable and self.result_cache_max_bytes <= 0:
+            raise ValueError(
+                "spill_enable requires result_cache_max_bytes > 0 "
+                "(the spill hierarchy extends the result cache — with "
+                "the cache off there is nothing to demote)")
+        if self.spill_host_max_bytes < 1:
+            raise ValueError(
+                f"spill_host_max_bytes must be >= 1, "
+                f"got {self.spill_host_max_bytes!r}")
+        if self.spill_disk_hits < 0:
+            raise ValueError(
+                f"spill_disk_hits must be >= 0 (0 ages everything "
+                f"the host tier evicts), got {self.spill_disk_hits!r}")
 
     def replace(self, **kw: Any) -> "MatrelConfig":
         return dataclasses.replace(self, **kw)

@@ -183,6 +183,44 @@ def iter_samples(events: List[dict]):
                            "backend": backend, "tier": "",
                            "flops": 0.0, "est_bytes": float(b),
                            "ms": float(ms), "source": "bench"}
+        elif kind == "spill":
+            # live spill events (session._emit_spill_event): each
+            # demotion/promotion records its priced transfer legs with
+            # measured ms — the ``spill:<leg>`` ms/MiB calibration rows
+            # the coefficient seam (coeffs.spill_leg_row) serves back
+            # to the next pricing decision, closing the same loop the
+            # reshard rows ride
+            dims = e.get("dims") or ()
+            for leg in e.get("legs") or ():
+                if not isinstance(leg, dict):
+                    continue
+                name = leg.get("leg")
+                b, ms = leg.get("bytes"), leg.get("ms")
+                if not (name and isinstance(b, (int, float)) and b > 0
+                        and isinstance(ms, (int, float)) and ms > 0):
+                    continue
+                yield {"strategy": f"spill:{name}",
+                       "class": shape_class(dims),
+                       "backend": backend, "tier": "",
+                       "flops": 0.0, "est_bytes": float(b),
+                       "ms": float(ms), "source": "spill"}
+        elif kind == "bench" and e.get("metric") == "spill_sweep":
+            # bench.py --spill rows: per-leg transfer timings at
+            # controlled sizes — the seeded calibration a fresh table
+            # starts from (the reshard_sweep precedent)
+            for row in e.get("rows") or ():
+                if not isinstance(row, dict):
+                    continue
+                name, n = row.get("leg"), row.get("n")
+                b, ms = row.get("bytes"), row.get("ms")
+                if not (name and isinstance(b, (int, float)) and b > 0
+                        and isinstance(ms, (int, float)) and ms > 0):
+                    continue
+                yield {"strategy": f"spill:{name}",
+                       "class": shape_class([n] if n else ()),
+                       "backend": backend, "tier": "",
+                       "flops": 0.0, "est_bytes": float(b),
+                       "ms": float(ms), "source": "bench"}
 
 
 def _sample(d: dict, ms: float, backend: str, source: str) -> dict:
@@ -254,6 +292,12 @@ def rank_flags(samples: List[dict]) -> List[dict]:
     for s in samples:
         if s["est_bytes"] is None:
             continue            # dispatch records have no byte ranking
+        if s["strategy"].startswith("spill:"):
+            # transfer legs are PRICED, never RANKED: the tier a value
+            # ages to is fixed by adjacency, so "the model preferred
+            # d2h over rmm" is not a choice anything makes — a disk
+            # leg's honest 25x ms/MiB would flag as drift forever
+            continue
         # tier joins the population key: rank-order is only meaningful
         # between strategies executing at the SAME precision tier
         g = groups.setdefault(

@@ -166,6 +166,7 @@ def summarize(events: List[dict]) -> dict:
         "fleet": _summarize_fleet(events),
         "serve": _summarize_serve(events),
         "cse": _summarize_cse(events),
+        "spill": _summarize_spill(events),
         "cost_model": _summarize_cost_model(events),
         "lockdep": _summarize_lockdep(events),
         "resilience": _summarize_resilience(events, len(qs)),
@@ -309,6 +310,44 @@ def _summarize_cse(events: List[dict]) -> Optional[dict]:
                              for e in sv),
         "template_hit_queries": tpl_q,
     }
+
+
+def _summarize_spill(events: List[dict]) -> Optional[dict]:
+    """Roll up the ``spill`` records (serve/spill.py;
+    docs/DURABILITY.md): demotion/promotion traffic by tier, the
+    measured transfer bytes/ms per leg (the drift loop's raw feed),
+    and the save_state/restore lifecycle. None when the log carries
+    no spill traffic — a pre-durability (or ``spill_enable=False``)
+    log renders byte-identically."""
+    sp = [e for e in events if e.get("kind") == "spill"]
+    if not sp:
+        return None
+    out = {"demoted": 0, "aged_to_disk": 0, "promoted": {},
+           "legs": {}, "save_states": 0, "restores": 0,
+           "restored_entries": 0}
+    for e in sp:
+        op = e.get("op")
+        if op == "demote":
+            out["demoted"] += 1
+            out["aged_to_disk"] += int(e.get("aged_to_disk") or 0)
+        elif op == "promote":
+            t = str(e.get("tier") or "?")
+            out["promoted"][t] = out["promoted"].get(t, 0) + 1
+        elif op == "save_state":
+            out["save_states"] += 1
+        elif op == "restore":
+            out["restores"] += 1
+            out["restored_entries"] += int(e.get("rc_entries") or 0)
+        for leg in e.get("legs") or ():
+            if not isinstance(leg, dict):
+                continue
+            row = out["legs"].setdefault(
+                str(leg.get("leg") or "?"), {"n": 0, "bytes": 0.0,
+                                             "ms": 0.0})
+            row["n"] += 1
+            row["bytes"] += float(leg.get("bytes") or 0.0)
+            row["ms"] += float(leg.get("ms") or 0.0)
+    return out
 
 
 def _summarize_lockdep(events: List[dict]) -> Optional[dict]:
@@ -753,6 +792,26 @@ def render_summary(events: List[dict]) -> str:
             f"{cse['batches']} batch(es), {cse['template_hits']} "
             f"template rebind(s), {cse['template_hit_queries']} "
             f"zero-optimize quer(ies)")
+    sp = s.get("spill")
+    if sp:
+        line = (f"spill: {sp['demoted']} demotion(s) "
+                f"({sp['aged_to_disk']} aged to disk)"
+                + ("; promoted: " + ", ".join(
+                    f"{k}={v}"
+                    for k, v in sorted(sp["promoted"].items()))
+                   if sp["promoted"] else ""))
+        if sp.get("legs"):
+            line += "; legs: " + ", ".join(
+                f"{k}={v['n']}x{_fmt(v['bytes'] / (1 << 20))}MiB/"
+                f"{_fmt(v['ms'])}ms"
+                for k, v in sorted(sp["legs"].items()))
+        if sp.get("save_states") or sp.get("restores"):
+            line += (f"; durability: {sp['save_states']} "
+                     f"save_state(s), {sp['restores']} restore(s)")
+            if sp.get("restored_entries"):
+                line += (f" ({sp['restored_entries']} entr(ies) "
+                         f"rethawable)")
+        lines.append(line)
     cmod = s.get("cost_model")
     if cmod:
         line = (f"cost model: {cmod['measured']} measured / "

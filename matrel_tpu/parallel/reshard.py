@@ -63,7 +63,22 @@ _STATES = RESHARD_LAYOUTS + ("rowx", "coly")
 #:   oneshot     the legacy single-constraint move across BOTH axes
 #:               (row↔col) — XLA's own lowering, modelled conservatively
 #:               as gather-then-slice (transient full array)
-STEP_KINDS = ("all_to_all", "gather", "slice", "oneshot")
+#:   host        one HBM↔host-RAM transfer leg of the spill hierarchy
+#:               (docs/DURABILITY.md) — d2h on demotion, h2d on
+#:               promotion; the device-side transient is the staging
+#:               buffer, so ``peak_bytes`` is the entry's device bytes
+#:   disk        one host-RAM↔disk leg (the checkpoint-format artifact
+#:               write/read) — zero DEVICE bytes live during the step,
+#:               so it never charges the peak-HBM budget
+STEP_KINDS = ("all_to_all", "gather", "slice", "oneshot",
+              "host", "disk")
+
+#: Tier vocabulary of the result-cache spill hierarchy, ordered top to
+#: bottom. ``spill_plan`` stages any demotion/promotion as one step
+#: per ADJACENT-tier hop — an HBM↔disk move always stages through host
+#: RAM (the arXiv:2112.01075 discipline: never materialise a second
+#: device-resident copy to skip a tier).
+SPILL_TIERS = ("hbm", "host", "disk")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -313,6 +328,54 @@ def compile_reshard(src: str, dst: str, nbytes: float,
         steps, cost = min(pool,
                           key=lambda c: max(s.peak_bytes for s in c[0]))
     return plan(steps, cost)
+
+
+def spill_plan(src_tier: str, dst_tier: str, nbytes: float,
+               peak_budget: float = 0.0) -> ReshardPlan:
+    """Compile one tier demotion/promotion of the result-cache spill
+    hierarchy into the step vocabulary — the same ReshardPlan record
+    the layout moves use, so MV117 proves spill stamps with the MV109
+    machinery and ``plan.fits`` charges the device transient against
+    the SAME ``reshard_peak_budget_bytes`` the layout moves respect.
+
+    One step per adjacent-tier hop: ``hbm↔host`` is a ``host`` step
+    (peak = the entry's device bytes — the staging buffer),
+    ``host↔disk`` is a ``disk`` step (zero device bytes). Step
+    ``src_state``/``dst_state`` carry TIER names, not layouts — the
+    spill steps never reach ``apply_staged`` (numpy/file IO, not a
+    sharding constraint). ``bytes_x`` carries each leg's payload
+    bytes (no mesh axis is involved); ``weighted_cost`` is the total
+    payload — pricing in milliseconds is the coefficient seam's job
+    (``coeffs.spill_cost_ms``), not the topology weights'."""
+    if src_tier not in SPILL_TIERS or dst_tier not in SPILL_TIERS:
+        raise ValueError(
+            f"spill endpoints must be in {SPILL_TIERS}, "
+            f"got {src_tier!r} -> {dst_tier!r}")
+    nbytes = float(nbytes)
+    i, j = SPILL_TIERS.index(src_tier), SPILL_TIERS.index(dst_tier)
+    step_dir = 1 if j >= i else -1
+    steps = []
+    for k in range(i, j, step_dir):
+        a, b = SPILL_TIERS[k], SPILL_TIERS[k + step_dir]
+        kind = "host" if "hbm" in (a, b) else "disk"
+        steps.append(ReshardStep(
+            kind, None, a, b, nbytes, 0.0,
+            nbytes if kind == "host" else 0.0))
+    return ReshardPlan(src_tier, dst_tier, nbytes, (1, 1), (1.0, 1.0),
+                       tuple(steps), nbytes * len(steps),
+                       naive_peak_bytes=nbytes)
+
+
+def spill_leg(step: ReshardStep) -> str:
+    """A spill step → the coefficient-seam leg token it is priced by
+    (``coeffs.SPILL_LEGS``; drift calibrates ``spill:<leg>`` rows):
+    direction matters — d2h and h2d ride different DMA paths, disk
+    read and write different IO paths."""
+    if step.kind == "host":
+        return "d2h" if step.src_state == "hbm" else "h2d"
+    if step.kind == "disk":
+        return "disk_write" if step.dst_state == "disk" else "disk_read"
+    raise ValueError(f"not a spill step: {step.kind!r}")
 
 
 #: Layout each strategy's shard_map in_specs CONSUME an operand at,

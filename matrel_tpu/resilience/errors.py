@@ -171,6 +171,26 @@ class CheckpointCorruption(ResilienceError):
     is acceptable."""
 
 
+class SnapshotCorruption(CheckpointCorruption):
+    """A durable-state artifact (a disk-tier spill entry or a
+    ``save_state()`` snapshot member — serve/spill.py,
+    docs/DURABILITY.md) failed its stored sha1 or does not parse.
+    Subclasses :class:`CheckpointCorruption` (same checksum
+    discipline, same deterministic classification — never retried);
+    the SESSION-level restore path catches it and cold-starts with a
+    warning (a corrupt snapshot must never crash a restart), while a
+    disk-tier THAW treats it as a cache miss: the entry drops, the
+    query recomputes, the answer is never wrong."""
+
+    def __init__(self, artifact: str, detail: str = ""):
+        self.artifact = artifact
+        self.detail = detail
+        super().__init__(
+            f"durable-state artifact {artifact!r} is corrupt"
+            + (f": {detail}" if detail else "")
+            + " — refusing to thaw silently-corrupt data")
+
+
 #: Exception type names treated as transient runtime faults — the
 #: device/runtime layer's own failure vocabulary (jax wraps XLA status
 #: codes into these). Matched by NAME so the taxonomy works across jax

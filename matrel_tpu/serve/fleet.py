@@ -135,6 +135,16 @@ class FleetDirectory:
         #: the name-keyed fleet key would otherwise serve the old
         #: value to queries built from the new binding.
         self.reg_gen = 0
+        #: restored demand hints (``seed_hits``): per-key historical
+        #: hit counts carried across a restart by save_state/restore
+        #: (serve/spill.py). NEVER inserted as records — a restored
+        #: owner key points at a cache that no longer exists, and
+        #: lookup would drop-and-recompute exactly the hot keys. The
+        #: first fresh record_insert per key merges its history in,
+        #: so the replication trigger (``fleet_replicate_hits``)
+        #: re-arms at pre-restart demand instead of from zero. Pure
+        #: affinity hint — never a correctness surface.
+        self._seed_hits: Dict[str, Dict[int, int]] = {}
 
     def lookup(self, key: str) -> Optional[DirectoryRecord]:
         with self._lock:
@@ -159,6 +169,13 @@ class FleetDirectory:
                 # slice's LRU, unreachable through the fleet)
                 self.stale_inserts += 1
                 return
+            seeded = self._seed_hits.pop(key, None)
+            if seeded:
+                # restored demand history ADDS to the fresh insert's
+                # own counts — a hint re-arms the replication trigger
+                # at pre-restart demand, it never erases a live hit
+                for sid, n in seeded.items():
+                    rec.hits[sid] = rec.hits.get(sid, 0) + n
             old = self._records.pop(key, None)
             if old is not None:
                 # ownership moved (owner evicted its copy and another
@@ -260,6 +277,57 @@ class FleetDirectory:
             rec.replicas[slice_id] = local_key
             return True
 
+    def export_state(self) -> list:
+        """JSON-safe demand snapshot for save_state (serve/spill.py):
+        per-key total hit history plus the cosmetic record fields a
+        restore summary reports. Local owner keys are deliberately
+        NOT exported — they are id-based and die with the process."""
+        with self._lock:
+            out = []
+            for key, rec in self._records.items():
+                out.append({
+                    "key": key,
+                    "nbytes": int(rec.nbytes),
+                    "layout": rec.layout,
+                    "dtype": rec.dtype,
+                    "dep_names": sorted(rec.dep_names),
+                    "hits": {str(s): int(n)
+                             for s, n in rec.hits.items()},
+                })
+            # not-yet-consumed hints from a previous restore carry
+            # forward (restart-of-a-restart)
+            for key, hits in self._seed_hits.items():
+                out.append({"key": key, "hits": {str(s): int(n)
+                                                 for s, n in
+                                                 hits.items()}})
+            return out
+
+    def seed_hints(self, records) -> int:
+        """Install restored demand hints (see ``_seed_hits``).
+        Bounded by ``max_entries``; malformed rows are skipped — a
+        snapshot is never a correctness surface."""
+        installed = 0
+        with self._lock:
+            for rec in records:
+                if len(self._seed_hits) >= self.max_entries:
+                    break
+                if not isinstance(rec, dict):
+                    continue
+                key = rec.get("key")
+                hits = rec.get("hits")
+                if not isinstance(key, str) or not isinstance(
+                        hits, dict):
+                    continue
+                slot = self._seed_hits.setdefault(key, {})
+                for sid, n in hits.items():
+                    try:
+                        slot[int(sid)] = (slot.get(int(sid), 0)
+                                          + int(n))
+                    except (TypeError, ValueError):
+                        continue
+                installed += 1
+        return installed
+
     def info(self) -> dict:
         with self._lock:
             return {"entries": len(self._records),
@@ -270,7 +338,8 @@ class FleetDirectory:
                     "misses": self.misses,
                     "evicted": self.evicted,
                     "invalidated": self.invalidated,
-                    "stale_inserts": self.stale_inserts}
+                    "stale_inserts": self.stale_inserts,
+                    "seed_hints": len(self._seed_hits)}
 
 
 # ---------------------------------------------------------------------------
@@ -1056,6 +1125,18 @@ class FleetController:
                                 sl.slice_id, exc_info=True)
         if first is not None:
             raise first
+
+    def export_directory(self) -> list:
+        """The directory's demand snapshot for ``save_state()``
+        (serve/spill.py) — name-keyed hit histories, no local cache
+        keys (those die with the process)."""
+        return self.directory.export_state()
+
+    def seed_directory(self, records) -> int:
+        """Warm a restarted fleet's directory with a snapshot's
+        demand hints (``restore()``'s seam) — see
+        :meth:`FleetDirectory.seed_hints`."""
+        return self.directory.seed_hints(records)
 
     def info(self) -> dict:
         return {"slices": [sl.snapshot() for sl in self.slices],

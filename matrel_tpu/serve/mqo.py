@@ -128,6 +128,14 @@ class MqoState:
         #: executions — MV116's dynamic-verify feed: executing both
         #: fresh and comparing proves substituted ≡ unshared.
         self.recent: deque = deque(maxlen=RECENT_MAX)
+        #: abstract keys restored from a ``save_state()`` snapshot
+        #: (serve/spill.py) — KEYS ONLY: a compiled plan holds device
+        #: buffers and traced closures no snapshot can carry, so
+        #: programs recompile lazily on first probe and the seeded set
+        #: just tracks which pre-restart templates have come back
+        #: (``templates_rewarmed``). Bookkeeping, never a plan source.
+        self.seeded: set = set()
+        self.templates_rewarmed = 0
 
     def info(self) -> dict:
         """``plan_cache_info``-style surface."""
@@ -135,7 +143,9 @@ class MqoState:
                 "template_hits": self.template_hits,
                 "template_inserts": self.template_inserts,
                 "cse_hoisted": self.cse_hoisted,
-                "cse_batches": self.cse_batches}
+                "cse_batches": self.cse_batches,
+                "seeded_templates": len(self.seeded),
+                "templates_rewarmed": self.templates_rewarmed}
 
     def remember(self, orig, substituted) -> None:
         self.recent.append((orig, substituted))
@@ -147,6 +157,9 @@ class MqoState:
         # alias two distinct plans
         self.templates[key] = entry
         self.templates.move_to_end(key)
+        if key in self.seeded:
+            self.seeded.discard(key)
+            self.templates_rewarmed += 1
         while len(self.templates) > self.config.cse_template_max:
             self.templates.popitem(last=False)
 
@@ -155,6 +168,28 @@ class MqoState:
         if ent is not None:
             self.templates.move_to_end(key)
         return ent
+
+    def template_keys(self) -> list:
+        """LRU-ordered abstract keys (coldest first) for
+        ``save_state()`` — plus any still-unrewarmed seeded keys, so
+        a restart-of-a-restart does not forget the original hot set."""
+        out = sorted(self.seeded)
+        out.extend(k for k in self.templates if k not in self.seeded)
+        return out
+
+    def seed_templates(self, keys) -> int:
+        """Install a snapshot's template keys (``restore()``'s seam)
+        — see ``seeded``. Bounded by ``cse_template_max``; non-string
+        rows are skipped (a snapshot is never a correctness
+        surface)."""
+        installed = 0
+        for k in keys:
+            if len(self.seeded) >= self.config.cse_template_max:
+                break
+            if isinstance(k, str) and k not in self.templates:
+                self.seeded.add(k)
+                installed += 1
+        return installed
 
 
 # -- leaf-abstracted structural keys (plan templates) -------------------

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import logging
 import threading
 from collections import OrderedDict
 from typing import FrozenSet, Optional, Tuple
@@ -39,15 +40,45 @@ from matrel_tpu.core.blockmatrix import BlockMatrix
 from matrel_tpu.resilience.retry import now as _now
 from matrel_tpu.utils import lockdep
 
+_log = logging.getLogger("matrel_tpu.serve")
+
+#: warn-once latch for the result_nbytes fallback (list so tests can
+#: reset it without a global statement)
+_NBYTES_WARNED = [False]
+
 
 def result_nbytes(result: BlockMatrix) -> int:
     """Device bytes a cached result pins: its PADDED array. Computed
-    from shape/dtype — jax 0.9 arrays may lack .nbytes."""
+    from shape/dtype — jax 0.9 arrays may lack .nbytes.
+
+    An array missing even shape/dtype (a foreign array type, a
+    donated/deleted buffer) must NOT size as 0: a 0-byte entry escapes
+    the LRU byte budget entirely, so a stream of them would pin
+    unbounded device memory while the cache believes it is empty.
+    Fall back to the UNPADDED ``shape × itemsize`` estimate (the
+    logical shape is a plain tuple on the BlockMatrix itself, never
+    derived from the array) — an under-estimate of the padded truth,
+    but budget-visible — and warn once per process."""
     try:
         return int(np.prod(result.data.shape)) * np.dtype(
             result.data.dtype).itemsize
     except (AttributeError, TypeError):
-        return 0
+        pass
+    try:
+        itemsize = np.dtype(result.data.dtype).itemsize
+    except (AttributeError, TypeError):
+        itemsize = 4            # f32, the package-wide default dtype
+    try:
+        est = int(np.prod(result.shape)) * itemsize
+    except (AttributeError, TypeError):
+        est = 0                 # not a BlockMatrix at all
+    if not _NBYTES_WARNED[0]:
+        _NBYTES_WARNED[0] = True
+        _log.warning(
+            "result_nbytes: cached result's array has no usable "
+            "shape/dtype; falling back to the unpadded shape*itemsize "
+            "estimate (%d bytes) for LRU accounting (warned once)", est)
+    return est
 
 
 @dataclasses.dataclass
@@ -99,6 +130,18 @@ class CacheEntry:
       replication (ML015 pins every other writer). None (the
       default) when ``obs_provenance`` is off — the historical
       shape, zero objects.
+    hits: lifetime consult count of THIS entry (lookup + probe) — the
+      expected-reuse signal the spill policy's host→disk demotion gate
+      reads (``config.spill_disk_hits``; docs/DURABILITY.md). 0 until
+      first consulted; costs one int, no behavior change when spill
+      is off.
+    spill: tier provenance (serve/spill.py; docs/DURABILITY.md) for
+      entries PROMOTED back from a lower tier: ``{"tier": "host"/
+      "disk"/"restored", "legs": [...], "est_ms": float, "cost":
+      "measured"/"analytic"}`` — which tier the value thawed from and
+      the priced transfer legs it paid, which MV117 re-checks against
+      the plan vocabulary. None (the default) for every entry that
+      has only ever lived in HBM — the historical shape.
     """
 
     key_hash: str
@@ -116,6 +159,8 @@ class CacheEntry:
     ivm_id: Optional[int] = None
     fleet: Optional[dict] = None
     provenance: Optional[dict] = None
+    hits: int = 0
+    spill: Optional[dict] = None
 
 
 class ResultCache:
@@ -154,23 +199,76 @@ class ResultCache:
         # register_delta is ever used (the bit-identity contract)
         self.patched = 0
         self.rekeyed = 0
+        # spill hierarchy (serve/spill.py; docs/DURABILITY.md): the
+        # attached SpillManager, or None — the default, and the ONLY
+        # state the default config ever sees (zero spill objects).
+        # When attached, evictions DEMOTE instead of dropping and
+        # lookup/probe fall through to the lower tiers on a miss.
+        self.spill = None
+
+    def attach_spill(self, spill) -> None:
+        """Wire the tier hierarchy under this cache (session-build
+        seam; ``config.spill_enable`` gates the one call site)."""
+        with self._lock:
+            self.spill = spill
+
+    def _thaw(self, key: str) -> Optional[CacheEntry]:
+        """Lower-tier consult on an HBM miss: promote the entry back
+        (the spill manager prices + stages the move and stamps
+        ``entry.spill``), re-insert it under the HBM budget, and hand
+        it back — the caller counts the hit. The entry is served even
+        when it no longer fits the HBM budget (a hit is a hit; it just
+        isn't re-cached). Lock order: result_cache → spill, the same
+        direction ``put``'s demotion takes."""
+        if self.spill is None:
+            return None
+        ent = self.spill.promote(key)
+        if ent is None:
+            return None
+        if not self.put(key, ent, self.spill.hbm_max_bytes,
+                        self.spill.hbm_max_entries):
+            # larger than the whole HBM budget: serve it, but park the
+            # value back in the host tier instead of losing it
+            self.spill.demote(key, ent)
+        return ent
 
     def lookup(self, key: str) -> Optional[CacheEntry]:
         with self._lock:
             ent = self._entries.get(key)
             if ent is None:
-                self.misses += 1
-                return None
+                ent = self._thaw(key)
+                if ent is None:
+                    self.misses += 1
+                    return None
+                ent.hits += 1
+                self.hits += 1
+                return ent
             self._entries.move_to_end(key)
+            ent.hits += 1
             self.hits += 1
             return ent
+
+    def note_restored_hit(self) -> None:
+        """Counter correction for the session's restored-snapshot
+        consult (docs/DURABILITY.md): the first-level ``lookup``
+        already counted a miss before the name-keyed index thawed the
+        value — a served answer must read as the hit it was."""
+        with self._lock:
+            self.misses = max(self.misses - 1, 0)
+            self.hits += 1
 
     def probe(self, key: str) -> Optional[CacheEntry]:
         with self._lock:
             ent = self._entries.get(key)
             if ent is None:
-                return None
+                ent = self._thaw(key)
+                if ent is None:
+                    return None
+                ent.hits += 1
+                self.interior_hits += 1
+                return ent
             self._entries.move_to_end(key)
+            ent.hits += 1
             self.interior_hits += 1
             return ent
 
@@ -202,9 +300,14 @@ class ResultCache:
                     self._bytes > max_bytes
                     or (max_entries > 0
                         and len(self._entries) > max_entries)):
-                _, dropped = self._entries.popitem(last=False)
+                k, dropped = self._entries.popitem(last=False)
                 self._bytes -= dropped.nbytes
                 self.evicted += 1
+                # spill hierarchy: LRU pressure DEMOTES instead of
+                # dropping — the value ages HBM → host (→ disk, the
+                # manager's call) and a later consult thaws it back
+                if self.spill is not None and k != key:
+                    self.spill.demote(k, dropped)
             self._bytes = max(self._bytes, 0)
             return True
 
@@ -245,9 +348,14 @@ class ResultCache:
                             last=False)
                         self._stale_bytes -= dropped.nbytes
                     self._stale_bytes = max(self._stale_bytes, 0)
-            self.invalidated += len(stale)
+            dropped_n = len(stale)
+            # the kill cascades into every tier: a host/disk copy of a
+            # rebound-matrix result is exactly as wrong as an HBM one
+            if self.spill is not None:
+                dropped_n += self.spill.invalidate_deps(ids)
+            self.invalidated += dropped_n
             self._bytes = max(self._bytes, 0)
-            return len(stale)
+            return dropped_n
 
     def lookup_stale(self, key: str, max_age_ms: float
                      ) -> Optional[CacheEntry]:
@@ -286,6 +394,8 @@ class ResultCache:
         semantics) — the delta plane's ineligible-entry fallback, so
         a kill here is indistinguishable from today's rebind kill."""
         with self._lock:
+            if self.spill is not None and self.spill.discard(key):
+                self.invalidated += 1
             ent = self._entries.pop(key, None)
             if ent is None:
                 return False
@@ -371,19 +481,26 @@ class ResultCache:
             self._stale.clear()
             self._bytes = 0
             self._stale_bytes = 0
+            if self.spill is not None:
+                self.spill.clear()
 
     def info(self) -> dict:
-        """``plan_cache_info``-style observability snapshot."""
+        """``plan_cache_info``-style observability snapshot. The
+        ``spill`` sub-dict appears only when a hierarchy is attached —
+        the default dict keeps its historical shape."""
         with self._lock:
-            return {"entries": len(self._entries),
-                    "bytes": self._bytes,
-                    "hits": self.hits,
-                    "misses": self.misses,
-                    "interior_hits": self.interior_hits,
-                    "evicted": self.evicted,
-                    "invalidated": self.invalidated,
-                    "stale_entries": len(self._stale),
-                    "stale_bytes": self._stale_bytes,
-                    "stale_hits": self.stale_hits,
-                    "patched": self.patched,
-                    "rekeyed": self.rekeyed}
+            out = {"entries": len(self._entries),
+                   "bytes": self._bytes,
+                   "hits": self.hits,
+                   "misses": self.misses,
+                   "interior_hits": self.interior_hits,
+                   "evicted": self.evicted,
+                   "invalidated": self.invalidated,
+                   "stale_entries": len(self._stale),
+                   "stale_bytes": self._stale_bytes,
+                   "stale_hits": self.stale_hits,
+                   "patched": self.patched,
+                   "rekeyed": self.rekeyed}
+            if self.spill is not None:
+                out["spill"] = self.spill.info()
+            return out

@@ -94,6 +94,17 @@ class MatrelSession:
         self._result_cache = ResultCache()
         self._serve = None
         self._compile_lock = lockdep.make_rlock("session.compile")
+        # durable spill hierarchy (serve/spill.py; docs/DURABILITY.md):
+        # host/disk tiers under the result cache + the warm-restart
+        # snapshot index — None for the default config (spill_enable
+        # off: the structural zero-object contract, poisoned-init
+        # test-enforced; spill._CONSTRUCTED stays 0)
+        self._spill = None
+        if self.config.spill_enable:
+            from matrel_tpu.serve.spill import SpillManager
+            self._spill = SpillManager(self)
+            self._spill.emit = self._emit_spill_event
+            self._result_cache.attach_spill(self._spill)
         # multi-query optimization (serve/mqo.py; docs/SERVING.md):
         # cross-query CSE + plan templates — None for the default
         # config (cse_enable off: the structural zero-object contract,
@@ -251,6 +262,12 @@ class MatrelSession:
                 keep_stale=self._brownout is not None,
                 stale_max=self.config.result_cache_max_entries,
                 stale_max_bytes=self.config.result_cache_max_bytes)
+            if self._spill is not None:
+                # restored snapshot entries carry dep NAMES, not ids
+                # (serve/spill.py): the rebind kill reaches them by
+                # name — the id cascade above already covered the
+                # live host/disk tiers
+                self._spill.invalidate_names({name})
 
     def table(self, name: str) -> BlockMatrix:
         return self.catalog[name]
@@ -342,6 +359,47 @@ class MatrelSession:
         for name in sorted(mats):
             self.register(name, mats[name])
         return sorted(mats)
+
+    # -- durable state (serve/spill.py; docs/DURABILITY.md) -----------------
+
+    def save_state(self, directory: Optional[str] = None) -> dict:
+        """Snapshot this session's durable state — catalog bindings
+        (the checkpoint step format), the result-cache index (entries
+        with catalog-name-computable keys, frozen as sha1-verified
+        disk artifacts), the fleet directory, MQO template keys, and
+        the autotune/drift tables — under ``directory`` (default
+        ``config.state_dir``; neither set raises ValueError). A later
+        :meth:`restore` in a NEW process comes back serving warm:
+        repeats thaw the frozen entries instead of recomputing.
+        Without ``spill_enable`` only the catalog + tables persist
+        (cached results are skipped, counted in the summary) — the
+        zero-object default stays zero. Returns the save summary,
+        also emitted as a ``spill`` event (op ``save_state``)."""
+        from matrel_tpu.serve import spill as spill_lib
+        with self._compile_lock:
+            out = spill_lib.save_state(self, directory)
+        self._emit_spill_event({"op": "save_state", **out})
+        return out
+
+    def restore(self, directory: Optional[str] = None) -> dict:
+        """Warm-restart this session from a :meth:`save_state`
+        snapshot: catalog restored through :meth:`register`, tables
+        written if absent, the result-cache index seeded into the
+        spill hierarchy's restored tier (requires ``spill_enable``;
+        entries thaw lazily on first consult, paying only the priced
+        transfer), the fleet directory re-seeded as affinity hints,
+        MQO template keys re-indexed. ROBUST: a corrupt/truncated
+        snapshot (or any single bad component) warns and cold-starts
+        — restore never crashes a restart; a disk-tier entry failing
+        its sha1 later surfaces as a per-entry miss (typed
+        ``SnapshotCorruption`` internally), never a wrong answer.
+        Returns the restore summary, also emitted as a ``spill``
+        event (op ``restore``)."""
+        from matrel_tpu.serve import spill as spill_lib
+        with self._compile_lock:
+            out = spill_lib.load_snapshot(self, directory)
+        self._emit_spill_event({"op": "restore", **out})
+        return out
 
     # -- constructors bound to this session's mesh/config ------------------
 
@@ -632,10 +690,45 @@ class MatrelSession:
         parts, pins, spans = _plan_key_spans(e)
         key = prefix + "|".join(parts)
         ent = self._result_cache.lookup(key)
+        if ent is None and self._spill is not None \
+                and self._spill.restored_count():
+            # warm restart (docs/DURABILITY.md): a restored snapshot's
+            # name-keyed index may hold this query's value frozen at
+            # disk tier — thaw it, and the repeat pays a priced
+            # transfer instead of a recompute
+            ent = self._rc_thaw_restored(e, prefix, key)
         if ent is not None:
             return ent, key, pins, e
         return None, key, pins, self._rc_substitute(e, parts, spans,
                                                     prefix)
+
+    def _rc_thaw_restored(self, e: MatExpr, prefix: str, key: str):
+        """Consult the restored-snapshot index on a cache miss: the
+        session-independent NAME key (placement.fleet_key — catalog
+        names, not id()s) is the only key format that survives a
+        process boundary. A thaw re-resolves dep names against the
+        LIVE catalog, re-inserts under the query's live structural
+        key (so the next repeat is a plain HBM hit), and corrects the
+        miss the first-level lookup already counted. Precision tiers
+        stay isolated: the entry thaws only for a query under the
+        same ``prec:`` token it was cached under."""
+        from matrel_tpu.serve import placement as placement_lib
+        nk = placement_lib.fleet_key(
+            e, {id(m): n for n, m in self.catalog.items()})
+        if nk is None:
+            return None
+        # the prec component of the admission prefix (the delta:<gen>|
+        # part, when present, always precedes it and ends at its "|")
+        prec = (prefix.split("|", 1)[1]
+                if prefix.startswith("delta:") else prefix)
+        ent = self._spill.thaw_restored(nk, prec, self.catalog.get)
+        if ent is None:
+            return None
+        self._result_cache.note_restored_hit()
+        self._result_cache.put(key, ent,
+                               self.config.result_cache_max_bytes,
+                               self.config.result_cache_max_entries)
+        return ent
 
     def _rc_leaf(self, ent: CacheEntry) -> MatExpr:
         """Lift a cache entry into planning as an already-laid-out
@@ -668,6 +761,12 @@ class MatrelSession:
             # entry's own claims (the MV107 stale-stamp idiom across
             # slices)
             stamp["fleet"] = dict(ent.fleet)
+        if ent.spill:
+            # spill provenance (docs/DURABILITY.md): the consumed
+            # value was THAWED from a lower tier — MV117 re-checks
+            # the stamped legs against the step vocabulary and the
+            # peak-HBM budget claim
+            stamp["spill"] = dict(ent.spill)
         node = expr_mod.leaf(ent.result).with_attrs(result_cache=stamp)
         if self._prov is not None:
             # lineage threading (obs tier 4): the consumed entry's
@@ -1402,6 +1501,24 @@ class MatrelSession:
             REGISTRY.counter("ivm.killed").inc(record.get("killed", 0))
         except Exception:
             log.warning("obs: delta event dropped", exc_info=True)
+
+    def _emit_spill_event(self, record: dict) -> None:
+        """One ``spill`` record per tier move (demote / promote /
+        thaw — serve/spill.py's emit hook) and per save_state/restore
+        (op ``save_state``/``restore``): the measured transfer legs
+        the drift auditor calibrates ``spill:<leg>`` rows from and
+        the ``history --summary`` spill/restart roll-up's feed. Obs
+        on / flight recorder on; no-op otherwise — the default path
+        emits nothing. Never fails the cache operation."""
+        if not self._obs_enabled() and self._flight is None:
+            return
+        from matrel_tpu.obs.metrics import REGISTRY
+        try:
+            self._obs_emit("spill", dict(record))
+            REGISTRY.counter(
+                f"spill.{record.get('op') or 'op'}").inc()
+        except Exception:
+            log.warning("obs: spill event dropped", exc_info=True)
 
     def _emit_serve_event(self, record: dict) -> None:
         """One ``serve`` record per micro-batched admission (obs on

@@ -398,6 +398,32 @@ def test_coeffs_row_artifact(dry_batch):
         assert row["speedup"] is not None, row
 
 
+def test_spill_row_artifact(dry_batch):
+    _, records, _ = dry_batch
+    rec = _one(records,
+               lambda r: r.get("metric") == "spill_sweep"
+               and "restart" in r, "bench.py --spill")
+    # the durability acceptance on the dry mesh: working set larger
+    # than the HBM budget sustained by lower-tier promotions with
+    # zero wrong answers, and the thawed restart's first hit served
+    # from the snapshot (not recomputed)
+    assert rec["working_set_over_budget"] is True, rec
+    assert rec["wrong"] == 0, rec
+    assert rec["sustained"]["promoted"] > 0, rec["sustained"]
+    rs = rec["restart"]
+    assert rs["restored_entries"] > 0, rs
+    assert rs["thawed_served_from_snapshot"] is True, rs
+    assert rs["cold_first_hit_ms"] > 0, rs
+    assert rs["thawed_first_hit_ms"] > 0, rs
+    # per-leg transfer rows (the drift calibration feed): every leg
+    # in the reshard vocabulary with positive measured bytes/ms
+    assert rec["rows"], rec
+    for row in rec["rows"]:
+        assert row["leg"] in ("d2h", "h2d", "disk_write",
+                              "disk_read"), row
+        assert row["bytes"] > 0 and row["ms"] > 0, row
+
+
 def test_bench_all_rows_artifacts(dry_batch):
     _, records, _ = dry_batch
     # every heavy row emits an explicit, parseable skip record — a

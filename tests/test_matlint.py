@@ -1152,6 +1152,76 @@ class TestML018CoeffSeam:
         """
         assert _lint(tmp_path, src, "matrel_tpu/obs/newaudit.py") == []
 
+
+class TestML019DurableIoSeam:
+    def test_fires_on_open_in_serve(self, tmp_path):
+        src = """
+            def persist(path, payload):
+                with open(path, "w") as f:
+                    f.write(payload)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newstate.py")
+        assert _rules(got) == ["ML019"]
+
+    def test_fires_on_np_save_and_os_replace(self, tmp_path):
+        src = """
+            import os
+            import numpy as np
+            def persist(path, arr):
+                np.save(path + ".tmp", arr)
+                os.replace(path + ".tmp", path)
+            def thaw(path):
+                return np.load(path)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newcache.py")
+        assert [f.rule for f in got] == ["ML019"] * 3
+
+    def test_fires_on_json_dump(self, tmp_path):
+        src = """
+            import json
+            def persist(f, payload):
+                json.dump(payload, f)
+        """
+        got = _lint(tmp_path, src, "matrel_tpu/serve/newmeta.py")
+        assert _rules(got) == ["ML019"]
+
+    def test_spill_seam_exempt(self, tmp_path):
+        # the sanctioned seam: serve/spill.py IS the one writer
+        src = """
+            import os
+            import numpy as np
+            def _write_artifact(path, arr):
+                with open(path + ".tmp", "wb") as f:
+                    np.save(f, arr)
+                os.replace(path + ".tmp", path)
+        """
+        assert _lint(tmp_path, src, "matrel_tpu/serve/spill.py") == []
+
+    def test_outside_serve_out_of_scope(self, tmp_path):
+        # checkpoint/obs/tools keep their own IO discipline — the
+        # seam rule scopes to the serving plane only
+        src = """
+            import json
+            def persist(path, payload):
+                with open(path, "w") as f:
+                    json.dump(payload, f)
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/utils/newstore.py") == []
+
+    def test_in_memory_dict_ops_pass(self, tmp_path):
+        # same tails, different owners: dict.pop/list ops and
+        # non-IO modules' save/load verbs are not in the rule's
+        # vocabulary
+        src = """
+            def evict(cache, key):
+                return cache.pop(key, None)
+            def save(state, snapshot):
+                state.update(snapshot)
+        """
+        assert _lint(tmp_path, src,
+                     "matrel_tpu/serve/newpolicy.py") == []
+
     def test_coeffs_module_is_the_sanctioned_seam(self, tmp_path):
         src = """
             from matrel_tpu.obs import drift

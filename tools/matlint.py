@@ -65,6 +65,13 @@ Rule catalogue (each rule's class docstring is the authority):
          every consumer ranks by the SAME table state and plan keys
          shatter exactly when decisions could change
          (docs/COST_MODEL.md)
+  ML019  raw file IO (open/np.save/np.load/json.dump/os.replace) in
+         matrel_tpu/serve/ outside the spill/checkpoint seam
+         (serve/spill.py) — durable serving state goes through ONE
+         writer so every artifact is sha1-stamped, atomically
+         renamed, and readable by the robust restore path; an ad-hoc
+         write is invisible to save_state and unverifiable on thaw
+         (docs/DURABILITY.md)
 """
 
 from __future__ import annotations
@@ -1168,6 +1175,60 @@ class CoeffSeamRule(Rule):
                         "memoized, hardened and epoch-stamped")
 
 
+class DurableIoSeamRule(Rule):
+    """ML019: raw file IO in ``matrel_tpu/serve/`` outside the
+    spill/checkpoint seam.
+
+    The durability plane (docs/DURABILITY.md) hangs off ONE writer:
+    ``serve/spill.py`` stages every artifact through the checkpoint
+    format's atomic tmp+rename with a streamed sha1, and its restore
+    path treats any mismatch as a typed miss (SnapshotCorruption —
+    recompute, never a wrong answer). A serve module that opens files
+    on its own creates durable state save_state() does not know to
+    freeze and restore() cannot verify — a restart either loses it
+    silently or thaws bytes nothing checksummed. The ML009/ML010
+    one-seam idiom applied to durable serving state; the seam itself
+    is exempt, and modules outside serve/ (obs exporters, the
+    checkpoint manager, tools) keep their own IO discipline."""
+
+    id = "ML019"
+    _EXEMPT = ("matrel_tpu/serve/spill.py",)
+    #: call tokens whose tail identifies a raw durable-IO primitive
+    _IO_TAILS = {"save": ("np", "numpy"), "load": ("np", "numpy"),
+                 "dump": ("json",), "dumps": (),
+                 "replace": ("os",), "remove": ("os",),
+                 "unlink": ("os",)}
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("matrel_tpu/serve/")
+                and relpath not in self._EXEMPT)
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            head, _, tail = name.rpartition(".")
+            if name == "open":
+                yield Finding(
+                    relpath, node.lineno, self.id,
+                    "raw open() in serve code — durable serving "
+                    "state goes through the spill/checkpoint seam "
+                    "(serve/spill.py) so artifacts are sha1-stamped, "
+                    "atomically renamed and restore-verifiable")
+            elif tail in ("save", "load", "dump", "replace",
+                          "remove", "unlink"):
+                owners = self._IO_TAILS.get(tail, ())
+                if head in owners:
+                    yield Finding(
+                        relpath, node.lineno, self.id,
+                        f"raw {name}() in serve code — durable "
+                        "serving state goes through the spill/"
+                        "checkpoint seam (serve/spill.py) so "
+                        "artifacts are sha1-stamped, atomically "
+                        "renamed and restore-verifiable")
+
+
 RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         ShardMapOutSpecsRule(), ConfigFlowRule(),
                         SpecKeyedCacheRule(), RawTimingRule(),
@@ -1176,7 +1237,8 @@ RULES: Sequence[Rule] = (HostSyncRule(), NoDensifyRule(),
                         UnboundedQueueRule(), ResultCacheSeamRule(),
                         TimingAccumulationRule(), FleetSeamRule(),
                         ProvenanceSeamRule(), TemplateKeyRule(),
-                        LockSeamRule(), CoeffSeamRule())
+                        LockSeamRule(), CoeffSeamRule(),
+                        DurableIoSeamRule())
 
 
 def _suppressed_codes(line: str) -> set:

@@ -1542,6 +1542,138 @@ def soak_checkpoint(n_trials: int, base: int, tol: float):
     return fails
 
 
+#: The restore half of soak_durable, run as a NEW PROCESS (the
+#: kill-and-restore contract — an in-process "restore" would share
+#: interpreter state with the session that saved). Args: state root,
+#: matrix side, catalog names, integer-valued names, float tolerance.
+_DURABLE_CHILD = '''\
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_f = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _f:
+    os.environ["XLA_FLAGS"] = (
+        _f + " --xla_force_host_platform_device_count=8").strip()
+import numpy as np
+root, n = sys.argv[1], int(sys.argv[2])
+names = sys.argv[3].split(",")
+int_names = set(filter(None, sys.argv[4].split(",")))
+tol = float(sys.argv[5])
+from matrel_tpu.config import MatrelConfig
+from matrel_tpu.core import mesh as mesh_lib
+from matrel_tpu.session import MatrelSession
+entry = n * n * 4
+cfg = MatrelConfig(obs_level="off", spill_enable=True,
+                   result_cache_max_bytes=int(1.5 * entry),
+                   result_cache_max_entries=16,
+                   spill_host_max_bytes=2 * entry,
+                   spill_disk_hits=0, state_dir=root)
+sess = MatrelSession(mesh=mesh_lib.make_mesh(), config=cfg)
+out = sess.restore()
+assert out.get("restored"), out
+wrong = int_mismatch = 0
+for name in names:
+    m = sess.catalog[name]
+    got = np.asarray(sess.run(m.expr().t().multiply(m.expr())).data)
+    oracle = np.load(os.path.join(root, "oracle_%s.npy" % name))
+    if name in int_names and not np.array_equal(got, oracle):
+        int_mismatch += 1
+    elif not np.allclose(got, oracle, rtol=tol, atol=tol):
+        wrong += 1
+info = sess.result_cache_info().get("spill") or {}
+print(json.dumps({"wrong": wrong, "int_mismatch": int_mismatch,
+                  "thawed": info.get("thawed_restored", 0)}))
+'''
+
+
+def soak_durable(n_trials: int, base: int, tol: float):
+    """Kill-and-restore battery (docs/DURABILITY.md): random named
+    working sets LARGER than the HBM budget serve traffic through the
+    spill tiers, the session snapshots (``save_state``) MID-TRAFFIC
+    (queries keep flowing after the save), and a NEW PROCESS restores
+    the snapshot and repeats the whole query mix — zero wrong
+    answers, integer-valued working sets bit-exact (``array_equal``,
+    the precision plane's int discipline), and at least one answer
+    must come from a thawed snapshot entry (a battery that silently
+    recomputed everything proves nothing)."""
+    import json as json_lib
+    import shutil
+    import subprocess
+    import tempfile
+    import numpy as np
+    from matrel_tpu.config import MatrelConfig
+    from matrel_tpu.core import mesh as mesh_lib
+    from matrel_tpu.core.blockmatrix import BlockMatrix
+    from matrel_tpu.session import MatrelSession
+
+    mesh = mesh_lib.make_mesh()
+    fails = []
+    for trial in range(base, base + n_trials):
+        rng = np.random.default_rng(trial)
+        root = tempfile.mkdtemp(prefix="matrel_soak_durable_")
+        try:
+            n = int(rng.choice([32, 48, 64]))
+            m_count = int(rng.integers(3, 6))
+            entry = n * n * 4
+            cfg = MatrelConfig(
+                obs_level="off", spill_enable=True,
+                result_cache_max_bytes=int(1.5 * entry),
+                result_cache_max_entries=16,
+                spill_host_max_bytes=2 * entry,
+                spill_disk_hits=0, state_dir=root)
+            sess = MatrelSession(mesh=mesh, config=cfg)
+            names, int_names = [], set()
+            for i in range(m_count):
+                name = f"d{i}"
+                if rng.random() < 0.4:
+                    v = rng.integers(-4, 5, (n, n)).astype(np.float32)
+                    int_names.add(name)
+                else:
+                    v = rng.standard_normal((n, n)).astype(np.float32)
+                sess.register(name,
+                              BlockMatrix.from_numpy(v, mesh=mesh))
+                names.append(name)
+
+            def gram(s, name):
+                mm = s.catalog[name]
+                return s.run(mm.expr().t().multiply(mm.expr()))
+
+            oracle = {nm: np.asarray(gram(sess, nm).data)
+                      for nm in names}
+            # mid-traffic snapshot: repeats flow before AND after
+            for nm in names[: max(m_count // 2, 1)]:
+                gram(sess, nm)
+            sess.save_state()
+            for nm in names[m_count // 2:]:
+                gram(sess, nm)
+            for nm in names:
+                np.save(os.path.join(root, f"oracle_{nm}.npy"),
+                        oracle[nm])
+            child = os.path.join(root, "child.py")
+            with open(child, "w") as f:
+                f.write(_DURABLE_CHILD)
+            env = dict(os.environ)
+            env["PYTHONPATH"] = (
+                REPO + os.pathsep + env.get("PYTHONPATH", ""))
+            out = subprocess.run(
+                [sys.executable, child, root, str(n),
+                 ",".join(names), ",".join(sorted(int_names)),
+                 str(tol)],
+                capture_output=True, text=True, timeout=600, env=env)
+            assert out.returncode == 0, out.stderr[-400:]
+            rep = json_lib.loads(
+                out.stdout.strip().splitlines()[-1])
+            assert rep["wrong"] == 0, rep
+            assert rep["int_mismatch"] == 0, rep
+            assert rep["thawed"] > 0, (
+                "restore served nothing from the snapshot", rep)
+        except Exception as ex:  # noqa: BLE001
+            fails.append(("durable", trial, type(ex).__name__,
+                          str(ex)[:200]))
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    return fails
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("battery",
@@ -1549,7 +1681,7 @@ def main():
                             "ckpt", "serve", "precision", "chaos",
                             "sparse_kernels", "fusion", "overload",
                             "stream", "fleet", "cse", "race",
-                            "coeffs", "all"])
+                            "coeffs", "durable", "all"])
     p.add_argument("--seeds", type=int, default=100)
     p.add_argument("--base", type=int, default=10_000)
     p.add_argument("--tpu", action="store_true",
@@ -1584,6 +1716,8 @@ def main():
         fails += soak_fleet(max(args.seeds // 5, 4), args.base, tol)
     if args.battery in ("coeffs", "all"):
         fails += soak_coeffs(max(args.seeds // 10, 8), args.base, tol)
+    if args.battery in ("durable", "all"):
+        fails += soak_durable(max(args.seeds // 20, 3), args.base, tol)
     if args.battery in ("race", "all"):
         fails += soak_race(max(args.seeds // 10, 3), args.base, tol)
     if args.battery in ("precision", "all"):

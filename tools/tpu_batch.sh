@@ -62,6 +62,8 @@ if [ "$DRY" = 1 ]; then
     export MATREL_COEFFS_N=128 MATREL_COEFFS_K=64 \
            MATREL_COEFFS_MEAS=3 MATREL_COEFFS_INNER=4
     export MATREL_RESHARD_N=256 MATREL_RESHARD_REPEATS=3
+    export MATREL_SPILL_N=128 MATREL_SPILL_MATS=4 \
+           MATREL_SPILL_REPEATS=2
     export MATREL_NS_N=2048
     export MATREL_GRAM3_K=64 MATREL_GRAM3_PANEL=4096 MATREL_GRAM3_NPANELS=2
     export MATREL_GRAMFULL_N=200000 MATREL_GRAMFULL_K=64 \
@@ -98,6 +100,8 @@ log "--- bench.py --reshard (staged-vs-naive reshard sweep, staged this round)"
 python bench.py --reshard
 log "--- bench.py --coeffs (calibrated-vs-analytic planner row, staged this round)"
 python bench.py --coeffs
+log "--- bench.py --spill (spill-tier sweep + cold-vs-thawed restart row, staged this round)"
+python bench.py --spill
 log "--- bench_all.py (all BASELINE rows)"
 python bench_all.py
 log "--- topology_flip (ICI/DCN-weighted planner flip proof, staged this round)"
